@@ -1,0 +1,11 @@
+"""lws_tpu — TPU-native LeaderWorkerSet / DisaggregatedSet framework.
+
+Control plane (`lws_tpu.core`, `lws_tpu.controllers`, `lws_tpu.webhooks`,
+`lws_tpu.sched`) orchestrates groups of workers over multi-host TPU slices;
+compute plane (`lws_tpu.parallel`, `lws_tpu.models`, `lws_tpu.ops`,
+`lws_tpu.serving`) is the JAX/XLA workload contract those groups run.
+
+See ARCHITECTURE.md at the repo root.
+"""
+
+__version__ = "0.1.0"

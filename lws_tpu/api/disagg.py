@@ -1,0 +1,81 @@
+"""DisaggregatedSet API (≈ api/disaggregatedset/v1/disaggregatedset_types.go).
+
+Coordinates 2-10 roles (e.g. prefill/decode), each an embedded LWS template,
+as one versioned unit with N-dimensional lockstep rollouts. On TPU, each role
+lands on its own slice pool; KV-transfer endpoints are published via
+revision-aware per-role services.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from lws_tpu.api.meta import Condition, ObjectMeta, TypedObject
+from lws_tpu.api.types import LeaderWorkerSetSpec
+
+DOMAIN = "disaggregatedset.lws.tpu"
+
+# Labels on child LWS + pods (ref disaggregatedset_types.go:24-39).
+DS_NAME_LABEL_KEY = f"{DOMAIN}/name"
+DS_ROLE_LABEL_KEY = f"{DOMAIN}/role"
+DS_REVISION_LABEL_KEY = f"{DOMAIN}/revision"
+# Snapshot of per-role replicas at rollout start (the planner baseline).
+DS_INITIAL_REPLICAS_ANNOTATION_KEY = f"{DOMAIN}/initial-replicas"
+
+MIN_ROLES = 2
+MAX_ROLES = 10
+
+
+@dataclass
+class TemplateObjectMeta:
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class LeaderWorkerSetTemplateSpec:
+    metadata: TemplateObjectMeta = field(default_factory=TemplateObjectMeta)
+    spec: LeaderWorkerSetSpec = field(default_factory=LeaderWorkerSetSpec)
+
+
+@dataclass
+class DisaggregatedRoleSpec:
+    name: str = ""
+    replicas: int = 1
+    template: LeaderWorkerSetTemplateSpec = field(default_factory=LeaderWorkerSetTemplateSpec)
+
+
+@dataclass
+class DisaggregatedSetSpec:
+    roles: list[DisaggregatedRoleSpec] = field(default_factory=list)
+
+
+@dataclass
+class RoleStatus:
+    name: str = ""
+    replicas: int = 0
+    ready_replicas: int = 0
+    updated_replicas: int = 0
+
+
+@dataclass
+class DisaggregatedSetStatus:
+    conditions: list[Condition] = field(default_factory=list)
+    roles: list[RoleStatus] = field(default_factory=list)
+    current_revision: str = ""
+    observed_generation: int = 0
+
+
+@dataclass
+class DisaggregatedSet(TypedObject):
+    kind = "DisaggregatedSet"
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DisaggregatedSetSpec = field(default_factory=DisaggregatedSetSpec)
+    status: DisaggregatedSetStatus = field(default_factory=DisaggregatedSetStatus)
+
+    def role(self, name: str) -> Optional[DisaggregatedRoleSpec]:
+        for r in self.spec.roles:
+            if r.name == name:
+                return r
+        return None

@@ -1,0 +1,33 @@
+"""Node: one TPU host (a machine attached to part of a slice).
+
+The scheduler (lws_tpu.sched) binds pods to nodes honoring nodeSelector,
+affinity topology domains, chip capacity, and gang constraints. Topology
+labels model GKE's `cloud.google.com/gke-tpu-topology` world: all hosts of one
+ICI-connected slice share NODE_TPU_SLICE_LABEL.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from lws_tpu.api.meta import ObjectMeta, TypedObject
+
+
+@dataclass
+class NodeStatus:
+    ready: bool = True
+
+
+@dataclass
+class NodeSpec:
+    # resource name -> capacity, e.g. {"google.com/tpu": 4, "cpu": 8}
+    capacity: dict[str, int] = field(default_factory=dict)
+    unschedulable: bool = False
+
+
+@dataclass
+class Node(TypedObject):
+    kind = "Node"
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)

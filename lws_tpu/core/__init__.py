@@ -1,0 +1,10 @@
+"""Core control-plane runtime: versioned object store with watches + owner GC
+(≈ kube-apiserver/etcd), admission chain (≈ webhook admission), level-triggered
+reconciler manager with per-controller workqueues (≈ controller-runtime), event
+recorder, and rendezvous DNS view (≈ headless-service DNS).
+"""
+
+from lws_tpu.core.store import AdmissionError, ConflictError, NotFoundError, Store, WatchEvent  # noqa: F401
+from lws_tpu.core.manager import Manager, Reconciler, Result  # noqa: F401
+from lws_tpu.core.events import EventRecorder  # noqa: F401
+from lws_tpu.core.dns import DnsView  # noqa: F401

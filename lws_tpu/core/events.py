@@ -1,0 +1,35 @@
+"""Event recorder: the user-facing trace of controller decisions
+(≈ k8s Events; ref leaderworkerset_controller.go:71-84 event reasons)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from lws_tpu.api.meta import TypedObject
+
+
+@dataclass
+class Event:
+    object_key: tuple[str, str, str]
+    type: str  # "Normal" | "Warning"
+    reason: str
+    message: str
+    timestamp: float = field(default_factory=time.time)
+
+
+class EventRecorder:
+    def __init__(self, max_events: int = 10000) -> None:
+        self.events: list[Event] = []
+        self._max = max_events
+
+    def event(self, obj: TypedObject, etype: str, reason: str, message: str) -> None:
+        self.events.append(Event(obj.key(), etype, reason, message))
+        if len(self.events) > self._max:
+            del self.events[: len(self.events) - self._max]
+
+    def for_object(self, obj: TypedObject) -> list[Event]:
+        return [e for e in self.events if e.object_key == obj.key()]
+
+    def reasons(self, obj: TypedObject) -> list[str]:
+        return [e.reason for e in self.for_object(obj)]

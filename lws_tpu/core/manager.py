@@ -1,0 +1,149 @@
+"""Reconciler runtime: per-controller dedup workqueues fed by store watches.
+
+≈ controller-runtime: level-triggered, idempotent reconciles keyed by object
+key; watch mapping functions translate events on secondary kinds into primary
+keys (ref SetupWithManager wiring, leaderworkerset_controller.go:224-256).
+
+Deterministic execution: `run_until_stable()` drains every queue to a fixed
+point with zero sleeps — the test-and-embedding-friendly mode. A threaded mode
+(`start()`/`stop()`) runs the same queues on background workers for live
+deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Protocol
+
+from lws_tpu.core.store import ConflictError, Key, Store, WatchEvent
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+class Reconciler(Protocol):
+    name: str
+
+    def reconcile(self, key: Key) -> Optional[Result]: ...
+
+
+MapFn = Callable[[object], list[Key]]
+
+
+@dataclass
+class _Registration:
+    reconciler: Reconciler
+    # kind -> mapping fn from event object to primary keys to enqueue.
+    watches: dict[str, MapFn]
+    queue: list[Key] = field(default_factory=list)
+    queued: set[Key] = field(default_factory=set)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def enqueue(self, key: Key) -> None:
+        with self.lock:
+            if key not in self.queued:
+                self.queued.add(key)
+                self.queue.append(key)
+
+    def pop(self) -> Optional[Key]:
+        with self.lock:
+            if not self.queue:
+                return None
+            key = self.queue.pop(0)
+            self.queued.discard(key)
+            return key
+
+    def empty(self) -> bool:
+        with self.lock:
+            return not self.queue
+
+
+class Manager:
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self._registrations: list[_Registration] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        store.watch(self._on_event)
+
+    def register(self, reconciler: Reconciler, watches: dict[str, MapFn]) -> None:
+        self._registrations.append(_Registration(reconciler, watches))
+
+    # ---- event fan-out -----------------------------------------------------
+    def _on_event(self, event: WatchEvent) -> None:
+        for reg in self._registrations:
+            fn = reg.watches.get(event.obj.kind)
+            if fn is None:
+                continue
+            for key in fn(event.obj):
+                reg.enqueue(key)
+
+    # ---- deterministic mode ------------------------------------------------
+    def run_until_stable(self, max_iterations: int = 10000) -> int:
+        """Process queues to a fixed point; returns reconcile count.
+
+        Conflict errors requeue (another writer won the optimistic-concurrency
+        race — the standard controller-runtime pattern); any other exception
+        propagates so tests fail loudly instead of looping.
+        """
+        processed = 0
+        for _ in range(max_iterations):
+            progressed = False
+            for reg in self._registrations:
+                key = reg.pop()
+                if key is None:
+                    continue
+                progressed = True
+                processed += 1
+                try:
+                    result = reg.reconciler.reconcile(key)
+                except ConflictError:
+                    reg.enqueue(key)
+                    continue
+                if result and result.requeue:
+                    reg.enqueue(key)
+            if not progressed:
+                return processed
+        raise RuntimeError(
+            f"run_until_stable did not converge after {max_iterations} iterations "
+            f"(queues: {[(r.reconciler.name, len(r.queue)) for r in self._registrations]})"
+        )
+
+    # ---- threaded mode -----------------------------------------------------
+    def start(self, poll_interval: float = 0.01) -> None:
+        self._stop.clear()
+
+        def worker(reg: _Registration) -> None:
+            while not self._stop.is_set():
+                key = reg.pop()
+                if key is None:
+                    time.sleep(poll_interval)
+                    continue
+                try:
+                    result = reg.reconciler.reconcile(key)
+                except ConflictError:
+                    reg.enqueue(key)
+                    continue
+                except Exception:  # noqa: BLE001 — keep the loop alive like controller-runtime
+                    import traceback
+
+                    traceback.print_exc()
+                    continue
+                if result and result.requeue:
+                    reg.enqueue(key)
+
+        for reg in self._registrations:
+            t = threading.Thread(target=worker, args=(reg,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()

@@ -1,0 +1,235 @@
+"""Versioned in-memory object store with watches and owner-based cascade GC.
+
+Plays the role Kubernetes' apiserver+etcd play for the reference: the single
+source of truth all controllers reconcile against. Objects are deep-copied on
+the way in and out (apiserver boundary isolation); writes use optimistic
+concurrency on `resource_version`; every mutation fans out a WatchEvent.
+
+Controllers are stateless against this store, so crash/restart resumes any
+rollout mid-flight exactly like the reference (SURVEY §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+from lws_tpu.api.meta import ObjectMeta, TypedObject, to_plain
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class ConflictError(RuntimeError):
+    pass
+
+
+class AlreadyExistsError(RuntimeError):
+    pass
+
+
+class AdmissionError(ValueError):
+    """Raised when a validating admission hook rejects a write."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # "ADDED" | "MODIFIED" | "DELETED"
+    obj: TypedObject
+
+
+Key = tuple[str, str, str]  # (kind, namespace, name)
+
+
+class Store:
+    def __init__(self) -> None:
+        self._objects: dict[Key, TypedObject] = {}
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._watchers: list[Callable[[WatchEvent], None]] = []
+        # kind -> list of hooks, run inside create/update before storing.
+        self._mutators: dict[str, list[Callable[[TypedObject, Optional[TypedObject]], None]]] = {}
+        self._validators: dict[str, list[Callable[[TypedObject, Optional[TypedObject]], None]]] = {}
+
+    # ---- admission registration -------------------------------------------
+    def register_mutator(self, kind: str, fn) -> None:
+        self._mutators.setdefault(kind, []).append(fn)
+
+    def register_validator(self, kind: str, fn) -> None:
+        self._validators.setdefault(kind, []).append(fn)
+
+    def watch(self, fn: Callable[[WatchEvent], None]) -> None:
+        self._watchers.append(fn)
+
+    # ---- reads -------------------------------------------------------------
+    def get(self, kind: str, namespace: str, name: str) -> TypedObject:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[TypedObject]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+    ) -> list[TypedObject]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if labels and any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
+            return out
+
+    # ---- writes ------------------------------------------------------------
+    def create(self, obj: TypedObject) -> TypedObject:
+        obj = copy.deepcopy(obj)
+        with self._lock:
+            key = obj.key()
+            if key in self._objects:
+                raise AlreadyExistsError(f"{key} already exists")
+            self._admit(obj, None)
+            obj.meta.uid = obj.meta.uid or uuid.uuid4().hex[:12]
+            obj.meta.resource_version = next(self._rv)
+            obj.meta.generation = 1
+            obj.meta.creation_timestamp = time.time()
+            self._objects[key] = obj
+            stored = copy.deepcopy(obj)
+        self._notify(WatchEvent("ADDED", copy.deepcopy(stored)))
+        return stored
+
+    def update(self, obj: TypedObject) -> TypedObject:
+        """Spec/metadata update: bumps generation when the non-status portion
+        changes. Optimistic-concurrency on resource_version."""
+        return self._update(obj, status_only=False)
+
+    def update_status(self, obj: TypedObject) -> TypedObject:
+        """Status-subresource update: never bumps generation."""
+        return self._update(obj, status_only=True)
+
+    def _update(self, obj: TypedObject, status_only: bool) -> TypedObject:
+        obj = copy.deepcopy(obj)
+        with self._lock:
+            key = obj.key()
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{key} not found")
+            if obj.meta.resource_version != current.meta.resource_version:
+                raise ConflictError(
+                    f"{key}: stale resource_version {obj.meta.resource_version} "
+                    f"(current {current.meta.resource_version})"
+                )
+            if status_only:
+                # Carry over everything but status from the stored object.
+                preserved = copy.deepcopy(current)
+                preserved.status = obj.status  # type: ignore[attr-defined]
+                obj = preserved
+            else:
+                self._admit(obj, current)
+                # Immutable system metadata.
+                obj.meta.uid = current.meta.uid
+                obj.meta.creation_timestamp = current.meta.creation_timestamp
+                obj.meta.generation = current.meta.generation
+                if self._spec_changed(current, obj):
+                    obj.meta.generation += 1
+            obj.meta.resource_version = next(self._rv)
+            self._objects[key] = obj
+            stored = copy.deepcopy(obj)
+        self._notify(WatchEvent("MODIFIED", copy.deepcopy(stored)))
+        return stored
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        """Delete + synchronous cascade of controller-owned dependents (the
+        foreground-propagation the reference leans on for group teardown,
+        ref pkg/controllers/pod_controller.go:258-263)."""
+        events: list[WatchEvent] = []
+        with self._lock:
+            self._delete_locked((kind, namespace, name), events)
+        for ev in events:
+            self._notify(ev)
+
+    def _delete_locked(self, key: Key, events: list[WatchEvent]) -> None:
+        obj = self._objects.pop(key, None)
+        if obj is None:
+            return
+        # Cascade: anything whose controller owner is this object.
+        dependents = [
+            k
+            for k, dep in self._objects.items()
+            if k[1] == key[1]
+            and any(ref.uid == obj.meta.uid and ref.controller for ref in dep.meta.owner_references)
+        ]
+        for dep_key in dependents:
+            self._delete_locked(dep_key, events)
+        events.append(WatchEvent("DELETED", copy.deepcopy(obj)))
+
+    # ---- helpers -----------------------------------------------------------
+    @staticmethod
+    def _spec_changed(old: TypedObject, new: TypedObject) -> bool:
+        old_spec = to_plain(getattr(old, "spec", None))
+        new_spec = to_plain(getattr(new, "spec", None))
+        if old_spec != new_spec:
+            return True
+        return (
+            to_plain(old.meta.labels) != to_plain(new.meta.labels)
+            or to_plain(old.meta.annotations) != to_plain(new.meta.annotations)
+        )
+
+    def _admit(self, obj: TypedObject, old: Optional[TypedObject]) -> None:
+        for fn in self._mutators.get(obj.kind, []):
+            fn(obj, old)
+        for fn in self._validators.get(obj.kind, []):
+            fn(obj, old)
+
+    def _notify(self, event: WatchEvent) -> None:
+        for fn in list(self._watchers):
+            fn(event)
+
+    # ---- convenience -------------------------------------------------------
+    def owned_by(self, kind: str, namespace: str, owner_uid: str) -> list[TypedObject]:
+        return [
+            o
+            for o in self.list(kind, namespace)
+            if any(r.uid == owner_uid and r.controller for r in o.meta.owner_references)
+        ]
+
+
+def owner_ref(obj: TypedObject) -> "OwnerReference":
+    from lws_tpu.api.meta import OwnerReference
+
+    return OwnerReference(kind=obj.kind, name=obj.meta.name, uid=obj.meta.uid, controller=True)
+
+
+def new_meta(
+    name: str,
+    namespace: str = "default",
+    labels: Optional[dict[str, str]] = None,
+    annotations: Optional[dict[str, str]] = None,
+    owners: Iterable[TypedObject] = (),
+) -> ObjectMeta:
+    return ObjectMeta(
+        name=name,
+        namespace=namespace,
+        labels=dict(labels or {}),
+        annotations=dict(annotations or {}),
+        owner_references=[owner_ref(o) for o in owners],
+    )

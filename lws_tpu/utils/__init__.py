@@ -1,0 +1,2 @@
+"""L1 utilities: pure functions — index math, readiness predicates, revision
+hashing/snapshots, TPU env synthesis (≈ pkg/utils/*)."""

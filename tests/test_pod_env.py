@@ -1,0 +1,63 @@
+"""Generic LWS_* + JAX coordinator env injection (parity with
+pkg/utils/pod/pod_utils.go AddLWSVariables tests)."""
+
+import pytest
+
+from lws_tpu.api import contract
+from lws_tpu.api.meta import ObjectMeta
+from lws_tpu.api.pod import Container, EnvVar, Pod, PodSpec
+from lws_tpu.utils.podutils import add_lws_variables
+
+
+def make_pod(worker_index="0", group_index="1", size="4", subdomain="svc", env=()):
+    return Pod(
+        meta=ObjectMeta(
+            name=f"lws-{group_index}" if worker_index == "0" else f"lws-{group_index}-{worker_index}",
+            namespace="ns1",
+            labels={
+                contract.SET_NAME_LABEL_KEY: "lws",
+                contract.GROUP_INDEX_LABEL_KEY: group_index,
+                contract.WORKER_INDEX_LABEL_KEY: worker_index,
+            },
+            annotations={contract.SIZE_ANNOTATION_KEY: size},
+        ),
+        spec=PodSpec(
+            containers=[Container(env=[EnvVar(*e) for e in env])],
+            init_containers=[Container(name="init")],
+            subdomain=subdomain,
+        ),
+    )
+
+
+def test_injects_all_vars_leader_first():
+    pod = make_pod(worker_index="2")
+    add_lws_variables(pod)
+    env = pod.spec.containers[0].env
+    assert env[0].name == contract.LWS_LEADER_ADDRESS
+    assert env[0].value == "lws-1.svc.ns1"
+    values = {e.name: e.value for e in env}
+    assert values[contract.LWS_GROUP_SIZE] == "4"
+    assert values[contract.LWS_WORKER_INDEX] == "2"
+    assert values[contract.JAX_COORDINATOR_ADDRESS] == "lws-1.svc.ns1:8471"
+    assert values[contract.JAX_NUM_PROCESSES] == "4"
+    assert values[contract.JAX_PROCESS_ID] == "2"
+    # init containers too
+    init_values = {e.name: e.value for e in pod.spec.init_containers[0].env}
+    assert init_values[contract.LWS_LEADER_ADDRESS] == "lws-1.svc.ns1"
+
+
+def test_injected_value_wins_and_user_env_preserved():
+    pod = make_pod(env=[("MY_VAR", "x"), (contract.LWS_LEADER_ADDRESS, "stale")])
+    add_lws_variables(pod)
+    env = pod.spec.containers[0].env
+    assert env[0].name == contract.LWS_LEADER_ADDRESS
+    assert env[0].value == "lws-1.svc.ns1"
+    assert [e.name for e in env].count(contract.LWS_LEADER_ADDRESS) == 1
+    assert {e.name: e.value for e in env}["MY_VAR"] == "x"
+
+
+def test_missing_labels_raise():
+    pod = make_pod()
+    del pod.meta.labels[contract.GROUP_INDEX_LABEL_KEY]
+    with pytest.raises(ValueError):
+        add_lws_variables(pod)

@@ -1,0 +1,76 @@
+"""Revision history semantics (parity with pkg/utils/revision tests)."""
+
+from lws_tpu.api.types import (
+    LeaderWorkerSet,
+    LeaderWorkerSetSpec,
+    LeaderWorkerTemplate,
+    NetworkConfig,
+    SubdomainPolicy,
+)
+from lws_tpu.api.pod import Container, PodSpec, PodTemplateSpec
+from lws_tpu.core.store import Store, new_meta
+from lws_tpu.utils import revision as rev
+
+
+def make_lws(name="sample", image="img:v1", size=4):
+    return LeaderWorkerSet(
+        meta=new_meta(name),
+        spec=LeaderWorkerSetSpec(
+            replicas=2,
+            leader_worker_template=LeaderWorkerTemplate(
+                worker_template=PodTemplateSpec(spec=PodSpec(containers=[Container(image=image)])),
+                size=size,
+            ),
+        ),
+    )
+
+
+def test_hash_stable_and_sensitive():
+    a, b = make_lws(), make_lws()
+    assert rev.hash_revision_data(rev.revision_data(a)) == rev.hash_revision_data(rev.revision_data(b))
+    c = make_lws(image="img:v2")
+    assert rev.hash_revision_data(rev.revision_data(a)) != rev.hash_revision_data(rev.revision_data(c))
+
+
+def test_replicas_change_does_not_change_revision():
+    a = make_lws()
+    b = make_lws()
+    b.spec.replicas = 99
+    assert rev.hash_revision_data(rev.revision_data(a)) == rev.hash_revision_data(rev.revision_data(b))
+
+
+def test_get_or_create_idempotent():
+    store = Store()
+    lws = store.create(make_lws())
+    r1 = rev.get_or_create_current_revision(store, lws)
+    r2 = rev.get_or_create_current_revision(store, lws)
+    assert r1.meta.name == r2.meta.name
+    assert len(store.list("ControllerRevision")) == 1
+
+
+def test_apply_revision_restores_template():
+    store = Store()
+    lws = store.create(make_lws(image="img:v1"))
+    r1 = rev.get_or_create_current_revision(store, lws)
+    lws.spec.leader_worker_template.worker_template.spec.containers[0].image = "img:v2"
+    lws.spec.network_config = NetworkConfig(subdomain_policy=SubdomainPolicy.UNIQUE_PER_REPLICA)
+    assert not rev.equal_revision(lws, r1)
+    restored = rev.apply_revision(lws, r1)
+    assert restored.spec.leader_worker_template.worker_template.spec.containers[0].image == "img:v1"
+    assert restored.spec.network_config is None
+    assert rev.equal_revision(restored, r1)
+
+
+def test_truncate_keeps_current():
+    store = Store()
+    lws = store.create(make_lws(image="img:v1"))
+    r1 = rev.get_or_create_current_revision(store, lws)
+    lws.spec.leader_worker_template.worker_template.spec.containers[0].image = "img:v2"
+    lws = store.update(lws)
+    r2 = rev.get_or_create_current_revision(store, lws)
+    assert r2.revision == 2
+    assert len(store.list("ControllerRevision")) == 2
+    rev.truncate_revisions(store, lws, rev.get_revision_key(r2))
+    remaining = store.list("ControllerRevision")
+    assert len(remaining) == 1
+    assert remaining[0].meta.name == r2.meta.name

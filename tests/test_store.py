@@ -1,0 +1,118 @@
+"""Core store semantics: versioning, conflicts, cascade GC, watches, admission."""
+
+import pytest
+
+from lws_tpu.api.pod import Pod
+from lws_tpu.api.groupset import GroupSet
+from lws_tpu.core.store import (
+    AdmissionError,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+    new_meta,
+)
+
+
+def make_pod(name, **kw):
+    return Pod(meta=new_meta(name, **kw))
+
+
+def test_create_assigns_identity():
+    store = Store()
+    pod = store.create(make_pod("p0"))
+    assert pod.meta.uid
+    assert pod.meta.resource_version > 0
+    assert pod.meta.generation == 1
+    with pytest.raises(AlreadyExistsError):
+        store.create(make_pod("p0"))
+
+
+def test_isolation_no_aliasing():
+    store = Store()
+    pod = store.create(make_pod("p0"))
+    pod.meta.labels["mutated"] = "yes"
+    fetched = store.get("Pod", "default", "p0")
+    assert "mutated" not in fetched.meta.labels
+
+
+def test_optimistic_concurrency():
+    store = Store()
+    pod = store.create(make_pod("p0"))
+    first = store.get("Pod", "default", "p0")
+    second = store.get("Pod", "default", "p0")
+    first.meta.labels["a"] = "1"
+    store.update(first)
+    second.meta.labels["b"] = "2"
+    with pytest.raises(ConflictError):
+        store.update(second)
+
+
+def test_generation_bumps_on_spec_change_only():
+    store = Store()
+    pod = store.create(make_pod("p0"))
+    pod.status.ready = True
+    pod = store.update_status(pod)
+    assert pod.meta.generation == 1
+    pod.spec.subdomain = "svc"
+    pod = store.update(pod)
+    assert pod.meta.generation == 2
+
+
+def test_status_update_preserves_spec():
+    store = Store()
+    pod = store.create(make_pod("p0"))
+    stale = store.get("Pod", "default", "p0")
+    pod.spec.subdomain = "svc"
+    pod = store.update(pod)
+    pod.status.ready = True
+    updated = store.update_status(pod)
+    assert updated.spec.subdomain == "svc"
+    assert updated.status.ready
+
+
+def test_cascade_delete():
+    store = Store()
+    gs = store.create(GroupSet(meta=new_meta("leader")))
+    child = store.create(Pod(meta=new_meta("leader-0", owners=[gs])))
+    grandchild = store.create(GroupSet(meta=new_meta("leader-0-workers", owners=[child])))
+    store.create(Pod(meta=new_meta("leader-0-workers-1", owners=[grandchild])))
+    store.delete("GroupSet", "default", "leader")
+    assert store.list("Pod") == []
+    assert store.list("GroupSet") == []
+
+
+def test_watch_events():
+    store = Store()
+    events = []
+    store.watch(lambda e: events.append((e.type, e.obj.meta.name)))
+    pod = store.create(make_pod("p0"))
+    pod.spec.subdomain = "s"
+    store.update(pod)
+    store.delete("Pod", "default", "p0")
+    assert events == [("ADDED", "p0"), ("MODIFIED", "p0"), ("DELETED", "p0")]
+
+
+def test_admission_mutate_and_validate():
+    store = Store()
+
+    def mutator(obj, old):
+        obj.meta.labels["mutated"] = "true"
+
+    def validator(obj, old):
+        if obj.meta.name == "bad":
+            raise AdmissionError("bad name")
+
+    store.register_mutator("Pod", mutator)
+    store.register_validator("Pod", validator)
+    pod = store.create(make_pod("ok"))
+    assert pod.meta.labels["mutated"] == "true"
+    with pytest.raises(AdmissionError):
+        store.create(make_pod("bad"))
+
+
+def test_missing_get():
+    store = Store()
+    with pytest.raises(NotFoundError):
+        store.get("Pod", "default", "nope")
+    assert store.try_get("Pod", "default", "nope") is None

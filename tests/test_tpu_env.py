@@ -1,0 +1,181 @@
+"""TPU env synthesis behavior (parity with pkg/utils/accelerators/tpu_test.go):
+whole-group hostnames, leader-without-TPU shift, multi-container interleaving,
+subgroup windows with leader-inclusion shifts."""
+
+from lws_tpu.api import contract
+from lws_tpu.api.meta import ObjectMeta
+from lws_tpu.api.pod import Container, EnvVar, Pod, PodSpec
+from lws_tpu.utils.tpu import add_tpu_variables, get_subgroup_index
+
+
+def env_map(container):
+    return {e.name: e.value for e in container.env}
+
+
+def make_pod(
+    name,
+    worker_index=None,
+    leader_requests=None,
+    subgroup=None,  # (size, index)
+    tpu_containers=1,
+    chips=4,
+    subdomain="default",
+    extra_env=(),
+):
+    labels, annotations = {}, {}
+    if worker_index is not None:
+        labels[contract.WORKER_INDEX_LABEL_KEY] = str(worker_index)
+    if leader_requests:
+        annotations[contract.LEADER_REQUESTS_TPUS_ANNOTATION_KEY] = "true"
+    if subgroup is not None:
+        annotations[contract.SUBGROUP_SIZE_ANNOTATION_KEY] = str(subgroup[0])
+        labels[contract.SUBGROUP_INDEX_LABEL_KEY] = str(subgroup[1])
+    containers = [
+        Container(name=f"c{i}", resources={contract.TPU_RESOURCE_NAME: chips}, env=[EnvVar(*e) for e in extra_env])
+        for i in range(tpu_containers)
+    ]
+    return Pod(
+        meta=ObjectMeta(name=name, labels=labels, annotations=annotations),
+        spec=PodSpec(containers=containers, subdomain=subdomain),
+    )
+
+
+def test_leader_pod_whole_group():
+    pod = make_pod("sample-1", worker_index=0)
+    add_tpu_variables(pod, size=2)
+    env = env_map(pod.spec.containers[0])
+    assert env[contract.TPU_WORKER_HOSTNAMES] == "sample-1.default,sample-1-1.default"
+    assert env[contract.TPU_WORKER_ID] == "0"
+    assert env[contract.TPU_NAME] == "sample-1"
+    assert env[contract.TPU_PROCESS_ADDRESSES] == "sample-1.default:8476,sample-1-1.default:8476"
+    assert env[contract.TPU_PROCESS_PORT] == "8476"
+
+
+def test_worker_pod_leader_requests_tpus():
+    pod = make_pod("sample-1-3", worker_index=3, leader_requests=True)
+    add_tpu_variables(pod, size=5)
+    env = env_map(pod.spec.containers[0])
+    assert env[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-1.default,sample-1-1.default,sample-1-2.default,"
+        "sample-1-3.default,sample-1-4.default"
+    )
+    assert env[contract.TPU_WORKER_ID] == "3"
+    assert env[contract.TPU_NAME] == "sample-1"
+
+
+def test_worker_pod_leader_without_tpus_shifts_ids():
+    pod = make_pod("sample-1-3", worker_index=3)
+    add_tpu_variables(pod, size=5)
+    env = env_map(pod.spec.containers[0])
+    # Leader excluded from hostnames; ids shift down by one.
+    assert env[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-1-1.default,sample-1-2.default,sample-1-3.default,sample-1-4.default"
+    )
+    assert env[contract.TPU_WORKER_ID] == "2"
+
+
+def test_multi_container_interleaving():
+    leader = make_pod("sample-1", worker_index=0, tpu_containers=2)
+    add_tpu_variables(leader, size=2)
+    env0, env1 = env_map(leader.spec.containers[0]), env_map(leader.spec.containers[1])
+    assert env0[contract.TPU_WORKER_ID] == "0"
+    assert env1[contract.TPU_WORKER_ID] == "1"
+    assert env0[contract.TPU_PROCESS_PORT] == "8476"
+    assert env1[contract.TPU_PROCESS_PORT] == "8477"
+    # Hostname list interleaves per-container entries: each pod appears
+    # numContainers times.
+    assert env0[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-1.default,sample-1.default,sample-1-1.default,sample-1-1.default"
+    )
+    # Per-container ports interleave in the address list (ref tpu.go:251-263).
+    assert env0[contract.TPU_PROCESS_ADDRESSES] == (
+        "sample-1.default:8476,sample-1.default:8477,"
+        "sample-1-1.default:8476,sample-1-1.default:8477"
+    )
+
+    worker = make_pod("sample-1-1", worker_index=1, leader_requests=True, tpu_containers=2)
+    add_tpu_variables(worker, size=2)
+    wenv0, wenv1 = env_map(worker.spec.containers[0]), env_map(worker.spec.containers[1])
+    assert wenv0[contract.TPU_WORKER_ID] == "2"
+    assert wenv1[contract.TPU_WORKER_ID] == "3"
+
+
+def test_user_specified_port_wins():
+    pod = make_pod("sample-1", worker_index=0, extra_env=[(contract.TPU_PROCESS_PORT, "9999")])
+    add_tpu_variables(pod, size=2)
+    env = env_map(pod.spec.containers[0])
+    assert env[contract.TPU_PROCESS_ADDRESSES] == "sample-1.default:9999,sample-1-1.default:9999"
+    # Not re-appended.
+    assert [e.name for e in pod.spec.containers[0].env].count(contract.TPU_PROCESS_PORT) == 1
+
+
+def test_idempotent():
+    pod = make_pod("sample-1", worker_index=0)
+    add_tpu_variables(pod, size=2)
+    n = len(pod.spec.containers[0].env)
+    add_tpu_variables(pod, size=2)
+    assert len(pod.spec.containers[0].env) == n
+
+
+def test_no_tpu_containers_noop():
+    pod = make_pod("sample-1", worker_index=0, chips=0)
+    add_tpu_variables(pod, size=2)
+    assert pod.spec.containers[0].env == []
+
+
+# ---- subgroup path ---------------------------------------------------------
+
+
+def test_subgroup_leader_requests_tpus_window0():
+    # size=8, sgs=4, leader holds TPUs -> subgroup 0 = leader + workers 1..3.
+    pod = make_pod("sample-1", worker_index=0, leader_requests=True, subgroup=(4, 0))
+    add_tpu_variables(pod, size=8)
+    env = env_map(pod.spec.containers[0])
+    assert env[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-1.default,sample-1-1.default,sample-1-2.default,sample-1-3.default"
+    )
+    assert env[contract.TPU_WORKER_ID] == "0"
+
+
+def test_subgroup_worker_in_leader_subgroup():
+    pod = make_pod("sample-1-2", worker_index=2, leader_requests=True, subgroup=(4, 0))
+    add_tpu_variables(pod, size=8)
+    env = env_map(pod.spec.containers[0])
+    assert env[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-1.default,sample-1-1.default,sample-1-2.default,sample-1-3.default"
+    )
+    assert env[contract.TPU_WORKER_ID] == "2"
+
+
+def test_subgroup_second_window_shifted_when_leader_has_tpus():
+    # Subgroup 1 window [5..8] shifts left to [4..7].
+    pod = make_pod("sample-1-5", worker_index=5, leader_requests=True, subgroup=(4, 1))
+    add_tpu_variables(pod, size=8)
+    env = env_map(pod.spec.containers[0])
+    assert env[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-1-4.default,sample-1-5.default,sample-1-6.default,sample-1-7.default"
+    )
+    assert env[contract.TPU_WORKER_ID] == "1"  # 5 % 4
+
+
+def test_subgroup_leader_without_tpus_no_shift():
+    # size=9, sgs=4, leader not a TPU worker: workers 1..8, windows [1..4],[5..8].
+    pod = make_pod("sample-1-5", worker_index=5, subgroup=(4, 1))
+    add_tpu_variables(pod, size=9)
+    env = env_map(pod.spec.containers[0])
+    assert env[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-1-5.default,sample-1-6.default,sample-1-7.default,sample-1-8.default"
+    )
+    assert env[contract.TPU_WORKER_ID] == "0"  # (5-1) % 4
+
+
+def test_subgroup_index_math():
+    # size-1 divisible: leader is extra pod in subgroup 0.
+    assert get_subgroup_index(9, 4, 1) == 0
+    assert get_subgroup_index(9, 4, 4) == 0
+    assert get_subgroup_index(9, 4, 5) == 1
+    assert get_subgroup_index(9, 4, 8) == 1
+    # size divisible (not size-1): plain division.
+    assert get_subgroup_index(8, 4, 3) == 0
+    assert get_subgroup_index(8, 4, 4) == 1
+    assert get_subgroup_index(8, 4, 7) == 1
