@@ -87,3 +87,13 @@ NODE_TPU_TOPOLOGY_LABEL = "tpu.lws/topology"
 NODE_TPU_SLICE_LABEL = "tpu.lws/slice"
 # Accelerator generation, e.g. "v5e", "v5p".
 NODE_TPU_ACCELERATOR_LABEL = "tpu.lws/accelerator"
+
+# ---- internal labels (framework-owned kinds) -------------------------------
+# Pod-template hash the GroupSet controller uses for its own rolling updates
+# (distinct from the LWS-level template revision above).
+GROUPSET_POD_REVISION_LABEL_KEY = "groupset.lws.tpu/pod-revision"
+
+# ---- gang scheduling -------------------------------------------------------
+# PodGroup a pod belongs to; injected by the scheduler provider
+# (≈ volcano.sh/group-name, ref pkg/schedulerprovider/volcano_provider.go:103-109).
+POD_GROUP_ANNOTATION_KEY = "gang.lws.tpu/pod-group"

@@ -119,6 +119,13 @@ class PodSpec:
     def tpu_chips(self) -> int:
         return sum(c.tpu_chips() for c in self.containers)
 
+    def effective_tpu_chips(self) -> int:
+        """Schedulable chip demand: max(sum of main containers, largest init
+        container) — k8s effective-request semantics, so init-container-only
+        TPU requests still reserve capacity."""
+        init_max = max((c.tpu_chips() for c in self.init_containers), default=0)
+        return max(self.tpu_chips(), init_max)
+
 
 @dataclass
 class TemplateMeta:

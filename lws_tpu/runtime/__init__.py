@@ -1,0 +1,8 @@
+"""Runtime: the assembled control plane + cluster backends.
+
+- harness.ControlPlane: store + admission + all controllers + scheduler wired
+  into a Manager (≈ cmd/main.go setup, SURVEY §3.1).
+- FakeKubelet: drives pod status like a node agent would (test/e2e backends).
+"""
+
+from lws_tpu.runtime.harness import ControlPlane, FakeKubelet  # noqa: F401

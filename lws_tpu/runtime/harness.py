@@ -1,0 +1,160 @@
+"""Assembled control plane (≈ cmd/main.go:72-250 startup + watch wiring).
+
+Everything is wired into one Manager over one Store; `run_until_stable()`
+drains all workqueues to a fixed point (deterministic, no sleeps), `start()`
+runs them on background threads for live use.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from lws_tpu.api import contract
+from lws_tpu.api.node import Node
+from lws_tpu.api.pod import Pod, PodPhase
+from lws_tpu.core.events import EventRecorder
+from lws_tpu.core.manager import Manager, Result
+from lws_tpu.core.store import Key, Store
+from lws_tpu.controllers.groupset_controller import GroupSetReconciler
+from lws_tpu.controllers.lws_controller import LWSReconciler
+from lws_tpu.controllers.pod_controller import PodReconciler
+from lws_tpu.sched.provider import make_scheduler_provider
+from lws_tpu.sched.scheduler import Scheduler
+from lws_tpu.webhooks import register_lws_webhooks, register_pod_webhooks
+
+
+class FakeKubelet:
+    """Node-agent stand-in: pods that land on a node start Running+ready.
+
+    With require_binding=False it also runs unbound pods — handy for control
+    plane tests that don't model a fleet (the envtest trick, SURVEY §4.2,
+    except our tests get it automatically)."""
+
+    name = "kubelet"
+
+    def __init__(self, store: Store, require_binding: bool = False) -> None:
+        self.store = store
+        self.require_binding = require_binding
+
+    def reconcile(self, key: Key) -> Result | None:
+        pod = self.store.try_get("Pod", key[1], key[2])
+        if pod is None or not isinstance(pod, Pod):
+            return None
+        if pod.status.phase != PodPhase.PENDING:
+            return None
+        if self.require_binding and not pod.spec.node_name:
+            return None
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.ready = True
+        pod.status.address = f"{pod.meta.name}.{pod.spec.subdomain}.{pod.meta.namespace}"
+        self.store.update_status(pod)
+        return None
+
+
+class ControlPlane:
+    def __init__(
+        self,
+        scheduler_provider: Optional[str] = None,
+        enable_scheduler: bool = False,
+        auto_ready: bool = False,
+        require_binding: bool = False,
+    ) -> None:
+        self.store = Store()
+        self.recorder = EventRecorder()
+
+        provider = make_scheduler_provider(scheduler_provider, self.store)
+        register_lws_webhooks(self.store)
+        register_pod_webhooks(self.store, provider)
+
+        self.manager = Manager(self.store)
+        store = self.store
+
+        def lws_key_by_label(obj) -> list[Key]:
+            name = obj.meta.labels.get(contract.SET_NAME_LABEL_KEY)
+            return [("LeaderWorkerSet", obj.meta.namespace, name)] if name else []
+
+        def leader_pods_of_lws(obj) -> list[Key]:
+            name = obj.meta.labels.get(contract.SET_NAME_LABEL_KEY)
+            if not name:
+                return []
+            pods = store.list(
+                "Pod",
+                obj.meta.namespace,
+                labels={contract.SET_NAME_LABEL_KEY: name, contract.WORKER_INDEX_LABEL_KEY: "0"},
+            )
+            return [p.key() for p in pods]
+
+        def groupset_owner_of_pod(obj) -> list[Key]:
+            owner = obj.meta.controller_owner()
+            if owner is not None and owner.kind == "GroupSet":
+                return [("GroupSet", obj.meta.namespace, owner.name)]
+            return []
+
+        def pods_of_lws(obj) -> list[Key]:
+            # LWS spec changes (e.g. size, template) flow through leader pods.
+            pods = store.list(
+                "Pod",
+                obj.meta.namespace,
+                labels={contract.SET_NAME_LABEL_KEY: obj.meta.name, contract.WORKER_INDEX_LABEL_KEY: "0"},
+            )
+            return [p.key() for p in pods]
+
+        self.lws_controller = LWSReconciler(self.store, self.recorder)
+        self.manager.register(
+            self.lws_controller,
+            {
+                "LeaderWorkerSet": lambda o: [o.key()],
+                "GroupSet": lws_key_by_label,
+                "Service": lws_key_by_label,
+                "Pod": lws_key_by_label,
+            },
+        )
+
+        self.pod_controller = PodReconciler(self.store, self.recorder, provider)
+        self.manager.register(
+            self.pod_controller,
+            {
+                "Pod": lambda o: [o.key()],
+                "ControllerRevision": leader_pods_of_lws,
+                "Node": lambda o: [],  # placeholder; exclusive placement keys off pod binding
+                "LeaderWorkerSet": pods_of_lws,
+            },
+        )
+
+        self.groupset_controller = GroupSetReconciler(self.store, self.recorder)
+        self.manager.register(
+            self.groupset_controller,
+            {
+                "GroupSet": lambda o: [o.key()],
+                "Pod": groupset_owner_of_pod,
+            },
+        )
+
+        if enable_scheduler:
+            def unbound_pods(obj) -> list[Key]:
+                return [p.key() for p in store.list("Pod") if not p.spec.node_name]
+
+            self.scheduler = Scheduler(self.store, self.recorder)
+            self.manager.register(
+                self.scheduler,
+                {
+                    "Pod": lambda o: [o.key()],
+                    "Node": unbound_pods,
+                    "PodGroup": unbound_pods,
+                },
+            )
+
+        if auto_ready:
+            self.kubelet = FakeKubelet(self.store, require_binding=require_binding)
+            self.manager.register(self.kubelet, {"Pod": lambda o: [o.key()]})
+
+    # ------------------------------------------------------------------
+    def run_until_stable(self, max_iterations: int = 10000) -> int:
+        return self.manager.run_until_stable(max_iterations)
+
+    def add_nodes(self, nodes: list[Node]) -> None:
+        for node in nodes:
+            self.store.create(node)
+
+    def create(self, obj):
+        return self.store.create(obj)

@@ -1,0 +1,10 @@
+"""L5 scheduling: slice-aware pod scheduler + gang-scheduling providers.
+
+The reference delegates binding to kube-scheduler and gang admission to
+Volcano (pkg/schedulerprovider/); here both are native and TPU-topology-aware:
+a slice (NODE_TPU_SLICE_LABEL domain) is the atomic placement unit.
+"""
+
+from lws_tpu.sched.provider import GangSchedulerProvider, SchedulerProvider, get_pod_group_name  # noqa: F401
+from lws_tpu.sched.scheduler import Scheduler  # noqa: F401
+from lws_tpu.sched.topology import make_slice_nodes  # noqa: F401

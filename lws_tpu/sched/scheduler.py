@@ -1,0 +1,237 @@
+"""Slice-aware pod scheduler: binds pods to TPU-host Nodes.
+
+Native replacement for kube-scheduler + Volcano gang admission. Honors:
+  * node_selector (exclusive-placement follow-the-leader uses this,
+    ref pod_controller.go:297-336),
+  * chip capacity (google.com/tpu) with allocation tracking,
+  * required pod affinity/anti-affinity over topology-key domains — the
+    mechanism behind 1:1 group<->slice exclusive placement
+    (ref pod_webhook.go:185-227),
+  * gang admission: pods carrying a PodGroup annotation bind all-or-nothing
+    once min_member peers exist and a joint feasible assignment is found.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from lws_tpu.api import contract
+from lws_tpu.api.node import Node
+from lws_tpu.api.pod import Pod
+from lws_tpu.core.events import EventRecorder
+from lws_tpu.core.manager import Result
+from lws_tpu.core.store import Key, Store
+
+
+class Scheduler:
+    name = "scheduler"
+
+    def __init__(self, store: Store, recorder: EventRecorder) -> None:
+        self.store = store
+        self.recorder = recorder
+
+    # ---- reconcile ---------------------------------------------------------
+    def reconcile(self, key: Key) -> Result | None:
+        pod = self.store.try_get("Pod", key[1], key[2])
+        if pod is None or not isinstance(pod, Pod) or pod.spec.node_name:
+            return None
+
+        gang_name = pod.meta.annotations.get(contract.POD_GROUP_ANNOTATION_KEY)
+        if gang_name:
+            self._schedule_gang(pod.meta.namespace, gang_name)
+        else:
+            nodes = self._nodes(pod.meta.namespace)
+            bound = self._bound_pods(pod.meta.namespace)
+            node = self._feasible_node(pod, nodes, bound, extra_assigned={})
+            if node is not None:
+                self._bind(pod, node)
+            else:
+                self.recorder.event(pod, "Warning", "FailedScheduling", "no feasible node")
+        return None
+
+    # ---- gang --------------------------------------------------------------
+    def _schedule_gang(self, namespace: str, gang_name: str) -> None:
+        group = self.store.try_get("PodGroup", namespace, gang_name)
+        if group is None:
+            return  # wait for the PodGroup; its creation event retriggers us
+        members = [
+            p
+            for p in self.store.list("Pod", namespace)
+            if p.meta.annotations.get(contract.POD_GROUP_ANNOTATION_KEY) == gang_name
+        ]
+        pending = [p for p in members if not p.spec.node_name]
+        min_member = group.spec.min_member
+        if not pending:
+            return
+        nodes = self._nodes(namespace)
+        bound = self._bound_pods(namespace)
+        allowed: Optional[set[str]] = None
+        members_chips = sum(p.spec.effective_tpu_chips() for p in members)
+        need_chips = group.spec.min_resources.get(contract.TPU_RESOURCE_NAME, 0)
+        if len(members) < min_member or members_chips < need_chips:
+            # The gang's full demand is not yet represented by live pods (the
+            # common LWS shape: the leader exists, workers follow only once it
+            # is placed — worker groupsets gate on leader binding under
+            # exclusive placement, ref pod_controller.go:162-172; LeaderReady
+            # even sets min_member=1). Admit early members only if some
+            # topology domain can RESERVE the whole group's min_resources;
+            # otherwise a leader binding to a too-small slice deadlocks the
+            # group (SURVEY §7 "gang admission on slices").
+            allowed = self._reserve_for_group(group, pending[0], nodes, bound)
+            if allowed is None:
+                self.recorder.event(
+                    group, "Warning", "GangNotSchedulable",
+                    f"no topology domain can hold min_resources {group.spec.min_resources}",
+                )
+                return
+        # Joint assignment: greedily place every pending member treating
+        # earlier in-pass assignments as bound; all-or-nothing on failure.
+        assignment: dict[str, str] = {}  # pod name -> node name
+        extra: dict[str, Pod] = {}
+        usable = nodes if allowed is None else [n for n in nodes if n.meta.name in allowed]
+        for p in sorted(pending, key=lambda p: p.meta.name):
+            node = self._feasible_node(p, usable, bound, extra_assigned=extra)
+            if node is None:
+                self.recorder.event(
+                    group, "Warning", "GangNotSchedulable",
+                    f"no joint assignment for {len(pending)} pending pods",
+                )
+                return
+            assignment[p.meta.name] = node.meta.name
+            placed = p.deepcopy()
+            placed.spec.node_name = node.meta.name
+            extra[p.meta.name] = placed
+        for p in pending:
+            self._bind(p, node_name=assignment[p.meta.name])
+        if len(members) >= min_member and group.status.phase != "Running":
+            group.status.phase = "Running"
+            self.store.update_status(group)
+
+    def _reserve_for_group(
+        self, group, sample_pod: Pod, nodes: list[Node], bound: list[Pod]
+    ) -> Optional[set[str]]:
+        """Find a topology domain whose free chips fit the whole gang's
+        min_resources; returns the node names of that domain (None if no fit).
+
+        The domain key is the sample pod's exclusive-affinity topology key when
+        present (one slice per group), else the whole cluster is one domain.
+        """
+        candidates = [
+            n
+            for n in nodes
+            if all(n.meta.labels.get(k) == v for k, v in sample_pod.spec.node_selector.items())
+        ]
+        need = group.spec.min_resources.get(contract.TPU_RESOURCE_NAME, 0)
+        topology_key = None
+        if sample_pod.spec.affinity is not None and sample_pod.spec.affinity.required_affinity:
+            topology_key = sample_pod.spec.affinity.required_affinity[0].topology_key
+        domains: dict[str, list[Node]] = {}
+        for n in candidates:
+            domain = n.meta.labels.get(topology_key, "") if topology_key else ""
+            if topology_key and domain == "":
+                continue
+            domains.setdefault(domain, []).append(n)
+        for _, domain_nodes in sorted(domains.items()):
+            free = sum(self._free_chips(n, bound, {}) for n in domain_nodes)
+            if free >= need:
+                return {n.meta.name for n in domain_nodes}
+        return None
+
+    # ---- feasibility -------------------------------------------------------
+    def _nodes(self, namespace: str) -> list[Node]:
+        return [
+            n
+            for n in self.store.list("Node", namespace)
+            if isinstance(n, Node) and n.status.ready and not n.spec.unschedulable
+        ]
+
+    def _bound_pods(self, namespace: str) -> list[Pod]:
+        return [p for p in self.store.list("Pod", namespace) if p.spec.node_name]
+
+    def _free_chips(self, node: Node, bound: list[Pod], extra: dict[str, Pod]) -> int:
+        used = sum(
+            p.spec.effective_tpu_chips()
+            for p in list(bound) + list(extra.values())
+            if p.spec.node_name == node.meta.name
+        )
+        return node.spec.capacity.get(contract.TPU_RESOURCE_NAME, 0) - used
+
+    def _feasible_node(
+        self,
+        pod: Pod,
+        nodes: list[Node],
+        bound: list[Pod],
+        extra_assigned: dict[str, Pod],
+    ) -> Optional[Node]:
+        all_pods = [p for p in bound if p.meta.name != pod.meta.name] + [
+            p for p in extra_assigned.values() if p.meta.name != pod.meta.name
+        ]
+        node_by_name = {n.meta.name: n for n in nodes}
+
+        def domain_of(p: Pod, topology_key: str) -> Optional[str]:
+            n = node_by_name.get(p.spec.node_name)
+            return None if n is None else n.meta.labels.get(topology_key)
+
+        candidates = []
+        for node in nodes:
+            if any(node.meta.labels.get(k) != v for k, v in pod.spec.node_selector.items()):
+                continue
+            chips = pod.spec.effective_tpu_chips()
+            if chips > 0 and self._free_chips(node, bound, extra_assigned) < chips:
+                continue
+            if not self._affinity_ok(pod, node, all_pods, domain_of):
+                continue
+            candidates.append(node)
+        if not candidates:
+            return None
+        # Deterministic bin-packing: prefer slices already hosting peers of the
+        # same group key, then stable order.
+        group_key = pod.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY)
+
+        def score(node: Node) -> tuple:
+            slice_id = node.meta.labels.get(contract.NODE_TPU_SLICE_LABEL, "")
+            peers = sum(
+                1
+                for p in all_pods
+                if group_key
+                and p.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY) == group_key
+                and domain_of(p, contract.NODE_TPU_SLICE_LABEL) == slice_id
+            )
+            return (-peers, slice_id, node.meta.name)
+
+        return sorted(candidates, key=score)[0]
+
+    def _affinity_ok(self, pod: Pod, node: Node, all_pods: list[Pod], domain_of) -> bool:
+        aff = pod.spec.affinity
+        if aff is None:
+            return True
+        for term in aff.required_affinity:
+            node_domain = node.meta.labels.get(term.topology_key)
+            if node_domain is None:
+                return False
+            matching = [p for p in all_pods if term.selector_matches(p.meta.labels)]
+            if not matching:
+                # Self-affinity bootstrap: first pod of the group may open a
+                # new domain (kube-scheduler's special case).
+                if term.selector_matches(pod.meta.labels):
+                    continue
+                return False
+            if not any(domain_of(p, term.topology_key) == node_domain for p in matching):
+                return False
+        for term in aff.required_anti_affinity:
+            node_domain = node.meta.labels.get(term.topology_key)
+            if node_domain is None:
+                continue
+            for p in all_pods:
+                if term.selector_matches(p.meta.labels) and domain_of(p, term.topology_key) == node_domain:
+                    return False
+        return True
+
+    # ---- binding -----------------------------------------------------------
+    def _bind(self, pod: Pod, node: Optional[Node] = None, node_name: str = "") -> None:
+        fresh = self.store.try_get("Pod", pod.meta.namespace, pod.meta.name)
+        if fresh is None or fresh.spec.node_name:
+            return
+        fresh.spec.node_name = node.meta.name if node is not None else node_name
+        self.store.update(fresh)
+        self.recorder.event(fresh, "Normal", "Scheduled", f"bound to {fresh.spec.node_name}")

@@ -1,0 +1,220 @@
+"""Test utilities: fluent LWS builders, status manipulation, validators
+(≈ test/wrappers/wrappers.go + test/testutils/{util,validators}.go).
+
+Status setters simulate node-agent behavior the same way the reference's
+envtest utilities do (SURVEY §4.2) — but here the GroupSet controller and
+scheduler are real, so tests only flip *pod* status, never groupset status.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from lws_tpu.api import contract
+from lws_tpu.api.groupset import GroupSet
+from lws_tpu.api.pod import Container, Pod, PodPhase, PodSpec, PodTemplateSpec, TemplateMeta
+from lws_tpu.api.types import (
+    LeaderWorkerSet,
+    LeaderWorkerSetSpec,
+    LeaderWorkerTemplate,
+    NetworkConfig,
+    RestartPolicy,
+    RollingUpdateConfiguration,
+    RolloutStrategy,
+    StartupPolicy,
+    SubdomainPolicy,
+    SubGroupPolicy,
+    SubGroupPolicyType,
+)
+from lws_tpu.core.store import Store, new_meta
+
+
+def make_worker_template(image: str = "worker:v1", tpu_chips: int = 0) -> PodTemplateSpec:
+    resources = {contract.TPU_RESOURCE_NAME: tpu_chips} if tpu_chips else {}
+    return PodTemplateSpec(
+        metadata=TemplateMeta(),
+        spec=PodSpec(containers=[Container(name="worker", image=image, resources=dict(resources))]),
+    )
+
+
+class LWSBuilder:
+    """Fluent builder (≈ wrappers.go LeaderWorkerSetWrapper)."""
+
+    def __init__(self, name: str = "sample", namespace: str = "default") -> None:
+        self._lws = LeaderWorkerSet(
+            meta=new_meta(name, namespace),
+            spec=LeaderWorkerSetSpec(
+                replicas=2,
+                leader_worker_template=LeaderWorkerTemplate(
+                    worker_template=make_worker_template(), size=3
+                ),
+            ),
+        )
+
+    def replicas(self, n: int) -> "LWSBuilder":
+        self._lws.spec.replicas = n
+        return self
+
+    def size(self, n: int) -> "LWSBuilder":
+        self._lws.spec.leader_worker_template.size = n
+        return self
+
+    def image(self, image: str) -> "LWSBuilder":
+        for c in self._lws.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = image
+        if self._lws.spec.leader_worker_template.leader_template is not None:
+            for c in self._lws.spec.leader_worker_template.leader_template.spec.containers:
+                c.image = image
+        return self
+
+    def leader_template(self, template: Optional[PodTemplateSpec] = None, tpu_chips: int = 0) -> "LWSBuilder":
+        self._lws.spec.leader_worker_template.leader_template = template or make_worker_template(
+            "leader:v1", tpu_chips
+        )
+        return self
+
+    def tpu_chips(self, chips: int) -> "LWSBuilder":
+        for c in self._lws.spec.leader_worker_template.worker_template.spec.containers:
+            c.resources[contract.TPU_RESOURCE_NAME] = chips
+        return self
+
+    def restart_policy(self, policy: RestartPolicy) -> "LWSBuilder":
+        self._lws.spec.leader_worker_template.restart_policy = policy
+        return self
+
+    def startup_policy(self, policy: StartupPolicy) -> "LWSBuilder":
+        self._lws.spec.startup_policy = policy
+        return self
+
+    def subdomain_policy(self, policy: SubdomainPolicy) -> "LWSBuilder":
+        self._lws.spec.network_config = NetworkConfig(subdomain_policy=policy)
+        return self
+
+    def subgroup(self, size: int, type_: SubGroupPolicyType = SubGroupPolicyType.LEADER_WORKER) -> "LWSBuilder":
+        self._lws.spec.leader_worker_template.sub_group_policy = SubGroupPolicy(
+            type=type_, sub_group_size=size
+        )
+        return self
+
+    def rollout(self, max_unavailable=1, max_surge=0, partition=0) -> "LWSBuilder":
+        self._lws.spec.rollout_strategy = RolloutStrategy(
+            rolling_update_configuration=RollingUpdateConfiguration(
+                partition=partition, max_unavailable=max_unavailable, max_surge=max_surge
+            )
+        )
+        return self
+
+    def annotation(self, key: str, value: str) -> "LWSBuilder":
+        self._lws.meta.annotations[key] = value
+        return self
+
+    def exclusive_topology(self, key: str = contract.NODE_TPU_SLICE_LABEL) -> "LWSBuilder":
+        return self.annotation(contract.EXCLUSIVE_KEY_ANNOTATION_KEY, key)
+
+    def build(self) -> LeaderWorkerSet:
+        return self._lws
+
+
+# ---- status manipulation (the "play kubelet" helpers) ----------------------
+
+
+def set_pod_ready(store: Store, namespace: str, name: str) -> None:
+    pod = store.get("Pod", namespace, name)
+    pod.status.phase = PodPhase.RUNNING
+    pod.status.ready = True
+    pod.status.address = f"{name}.{pod.spec.subdomain}.{namespace}"
+    store.update_status(pod)
+
+
+def set_pod_not_ready(store: Store, namespace: str, name: str) -> None:
+    pod = store.get("Pod", namespace, name)
+    pod.status.ready = False
+    store.update_status(pod)
+
+
+def restart_pod_container(store: Store, namespace: str, name: str) -> None:
+    pod = store.get("Pod", namespace, name)
+    pod.status.container_restarts += 1
+    store.update_status(pod)
+
+
+def group_pod_names(lws_name: str, group: int, size: int) -> list[str]:
+    names = [f"{lws_name}-{group}"]
+    names += [f"{lws_name}-{group}-{i}" for i in range(1, size)]
+    return names
+
+
+def make_group_ready(store: Store, lws_name: str, group: int, namespace: str = "default") -> None:
+    lws = store.get("LeaderWorkerSet", namespace, lws_name)
+    for name in group_pod_names(lws_name, group, lws.spec.leader_worker_template.size):
+        if store.try_get("Pod", namespace, name) is not None:
+            set_pod_ready(store, namespace, name)
+
+
+def make_all_groups_ready(cp, lws_name: str, namespace: str = "default", max_rounds: int = 10) -> None:
+    """Flip every existing pod of the LWS ready, settling between passes —
+    drives multi-step flows (LeaderReady gates, rolling updates) to completion
+    with the test playing kubelet."""
+    for _ in range(max_rounds):
+        cp.run_until_stable()
+        pods = cp.store.list("Pod", namespace, labels={contract.SET_NAME_LABEL_KEY: lws_name})
+        flipped = False
+        for pod in pods:
+            if not pod.status.ready:
+                set_pod_ready(cp.store, namespace, pod.meta.name)
+                flipped = True
+        if not flipped:
+            return
+    raise AssertionError(f"{lws_name} never settled after {max_rounds} rounds")
+
+
+# ---- validators (≈ test/testutils/validators.go) ---------------------------
+
+
+def expect_valid_leader_groupset(store: Store, lws: LeaderWorkerSet, replicas: Optional[int] = None) -> GroupSet:
+    gs = store.get("GroupSet", lws.meta.namespace, lws.meta.name)
+    assert gs.spec.selector == {
+        contract.SET_NAME_LABEL_KEY: lws.meta.name,
+        contract.WORKER_INDEX_LABEL_KEY: "0",
+    }
+    tmpl = gs.spec.template.metadata
+    assert tmpl.labels[contract.WORKER_INDEX_LABEL_KEY] == "0"
+    assert tmpl.labels[contract.SET_NAME_LABEL_KEY] == lws.meta.name
+    assert tmpl.labels[contract.REVISION_LABEL_KEY]
+    assert tmpl.annotations[contract.SIZE_ANNOTATION_KEY] == str(lws.spec.leader_worker_template.size)
+    assert gs.meta.annotations[contract.REPLICAS_ANNOTATION_KEY] == str(lws.spec.replicas)
+    assert gs.spec.service_name == lws.meta.name
+    if replicas is not None:
+        assert gs.spec.replicas == replicas, f"leader groupset replicas {gs.spec.replicas} != {replicas}"
+    return gs
+
+
+def expect_valid_worker_groupsets(store: Store, lws: LeaderWorkerSet, count: Optional[int] = None) -> list[GroupSet]:
+    size = lws.spec.leader_worker_template.size
+    out = []
+    groupsets = [
+        g
+        for g in store.list("GroupSet", lws.meta.namespace, labels={contract.SET_NAME_LABEL_KEY: lws.meta.name})
+        if g.meta.name != lws.meta.name
+    ]
+    for gs in groupsets:
+        assert gs.spec.replicas == size - 1
+        assert gs.spec.start_ordinal == 1
+        assert gs.meta.labels[contract.GROUP_INDEX_LABEL_KEY] == gs.spec.template.metadata.labels[contract.GROUP_INDEX_LABEL_KEY]
+        assert gs.spec.template.metadata.annotations[contract.SIZE_ANNOTATION_KEY] == str(size)
+        assert gs.spec.template.metadata.annotations[contract.LEADER_POD_NAME_ANNOTATION_KEY] == gs.meta.name
+        out.append(gs)
+    if count is not None:
+        assert len(out) == count, f"worker groupsets {len(out)} != {count}"
+    return out
+
+
+def lws_pods(store: Store, lws_name: str, namespace: str = "default") -> list[Pod]:
+    return store.list("Pod", namespace, labels={contract.SET_NAME_LABEL_KEY: lws_name})
+
+
+def condition_status(lws: LeaderWorkerSet, ctype: str) -> Optional[bool]:
+    for c in lws.status.conditions:
+        if c.type == ctype:
+            return c.status
+    return None

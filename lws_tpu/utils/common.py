@@ -41,3 +41,14 @@ def group_resource_total(leader_resources: dict[str, int], worker_resources: dic
     for k, v in worker_resources.items():
         total[k] = total.get(k, 0) + v * (size - 1)
     return total
+
+
+def stable_hash(obj) -> str:
+    """Canonical short hash of any plain-able object (shared by revision
+    hashing and groupset template hashing so the two can never diverge)."""
+    import json
+
+    from lws_tpu.api.meta import to_plain
+
+    canonical = json.dumps(to_plain(obj), sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canonical.encode()).hexdigest()[:10]

@@ -10,8 +10,6 @@ live spec (no mixed groups mid-rollout, ref revision_utils.go:168-184).
 from __future__ import annotations
 
 import copy
-import hashlib
-import json
 from typing import Optional
 
 from lws_tpu.api import contract
@@ -19,6 +17,7 @@ from lws_tpu.api.meta import to_plain
 from lws_tpu.api.revision import ControllerRevision
 from lws_tpu.api.types import LeaderWorkerSet
 from lws_tpu.core.store import Store, new_meta
+from lws_tpu.utils.common import stable_hash
 
 
 def revision_data(lws: LeaderWorkerSet) -> dict:
@@ -30,8 +29,7 @@ def revision_data(lws: LeaderWorkerSet) -> dict:
 
 
 def hash_revision_data(data: dict) -> str:
-    canonical = json.dumps(to_plain(data), sort_keys=True, separators=(",", ":"))
-    return hashlib.sha256(canonical.encode()).hexdigest()[:10]
+    return stable_hash(data)
 
 
 def get_revision_key(obj) -> str:

@@ -119,6 +119,10 @@ def _add_tpu_variables_subgroup(pod: Pod) -> None:
             return
 
     annotations, labels = pod.meta.annotations, pod.meta.labels
+    if contract.SUBGROUP_INDEX_LABEL_KEY not in labels:
+        # A TPU-holding pod outside any subgroup (e.g. a LeaderExcluded leader,
+        # which admission normally rejects) gets no subgroup TPU env.
+        return
     sgs = int(annotations[contract.SUBGROUP_SIZE_ANNOTATION_KEY])
     sub_index = int(labels[contract.SUBGROUP_INDEX_LABEL_KEY])
     worker_index = int(labels[contract.WORKER_INDEX_LABEL_KEY])

@@ -1,0 +1,7 @@
+"""L2 admission: defaulting + validation for LWS/DS, pod mutation
+(≈ pkg/webhooks/). Registered as store admission hooks — synchronous, inside
+the write path, exactly like webhooks sit inside the apiserver request path.
+"""
+
+from lws_tpu.webhooks.lws_webhook import register_lws_webhooks  # noqa: F401
+from lws_tpu.webhooks.pod_webhook import register_pod_webhooks  # noqa: F401

@@ -1,0 +1,190 @@
+"""Integration tier: full control plane against the in-process store
+(≈ test/integration/controllers/leaderworkerset_test.go create/scale cases).
+"""
+
+import pytest
+
+from lws_tpu.api import contract
+from lws_tpu.api.types import (
+    CONDITION_AVAILABLE,
+    CONDITION_PROGRESSING,
+    StartupPolicy,
+    SubdomainPolicy,
+)
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import (
+    LWSBuilder,
+    condition_status,
+    expect_valid_leader_groupset,
+    expect_valid_worker_groupsets,
+    lws_pods,
+    make_all_groups_ready,
+    set_pod_ready,
+)
+
+
+def make_cp(**kw):
+    return ControlPlane(**kw)
+
+
+def test_create_materializes_groups():
+    cp = make_cp()
+    lws = cp.create(LWSBuilder().replicas(2).size(3).build())
+    cp.run_until_stable()
+
+    expect_valid_leader_groupset(cp.store, lws, replicas=2)
+    expect_valid_worker_groupsets(cp.store, lws, count=2)
+    pods = lws_pods(cp.store, "sample")
+    names = sorted(p.meta.name for p in pods)
+    assert names == sorted(
+        ["sample-0", "sample-0-1", "sample-0-2", "sample-1", "sample-1-1", "sample-1-2"]
+    )
+    # Shared headless service exists and is the pods' subdomain.
+    svc = cp.store.get("Service", "default", "sample")
+    assert svc.spec.publish_not_ready_addresses
+    for p in pods:
+        assert p.spec.subdomain == "sample"
+
+
+def test_pod_contract_injected():
+    cp = make_cp()
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+
+    worker = cp.store.get("Pod", "default", "sample-0-1")
+    assert worker.meta.labels[contract.WORKER_INDEX_LABEL_KEY] == "1"
+    assert worker.meta.labels[contract.GROUP_INDEX_LABEL_KEY] == "0"
+    env = {e.name: e.value for e in worker.spec.containers[0].env}
+    assert env[contract.LWS_LEADER_ADDRESS] == "sample-0.sample.default"
+    assert env[contract.LWS_GROUP_SIZE] == "2"
+    assert env[contract.TPU_WORKER_ID] == "1"
+    leader = cp.store.get("Pod", "default", "sample-0")
+    assert leader.meta.labels[contract.GROUP_UNIQUE_HASH_LABEL_KEY]
+    assert (
+        worker.meta.labels[contract.GROUP_UNIQUE_HASH_LABEL_KEY]
+        == leader.meta.labels[contract.GROUP_UNIQUE_HASH_LABEL_KEY]
+    )
+
+
+def test_status_becomes_available_when_ready():
+    cp = make_cp()
+    lws = cp.create(LWSBuilder().replicas(2).size(2).build())
+    cp.run_until_stable()
+
+    fetched = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert condition_status(fetched, CONDITION_PROGRESSING) is True
+    assert fetched.status.replicas == 2
+
+    make_all_groups_ready(cp, "sample")
+    cp.run_until_stable()
+    fetched = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert fetched.status.ready_replicas == 2
+    assert fetched.status.updated_replicas == 2
+    assert condition_status(fetched, CONDITION_AVAILABLE) is True
+    assert condition_status(fetched, CONDITION_PROGRESSING) is False
+    assert fetched.status.hpa_pod_selector
+
+
+def test_scale_up_and_down():
+    cp = make_cp(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.run_until_stable()
+    assert len(lws_pods(cp.store, "sample")) == 2
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.replicas = 3
+    cp.store.update(lws)
+    cp.run_until_stable()
+    assert len(lws_pods(cp.store, "sample")) == 6
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.replicas = 0
+    cp.store.update(lws)
+    cp.run_until_stable()
+    assert len(lws_pods(cp.store, "sample")) == 0
+
+
+def test_scale_to_zero_and_back():
+    cp = make_cp(auto_ready=True)
+    cp.create(LWSBuilder().replicas(2).size(2).build())
+    cp.run_until_stable()
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.replicas = 0
+    cp.store.update(lws)
+    cp.run_until_stable()
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.replicas == 0
+    lws.spec.replicas = 2
+    cp.store.update(lws)
+    cp.run_until_stable()
+    assert len(lws_pods(cp.store, "sample")) == 4
+
+
+def test_size_one_no_worker_groupsets():
+    cp = make_cp(auto_ready=True)
+    lws = cp.create(LWSBuilder().replicas(2).size(1).build())
+    cp.run_until_stable()
+    assert len(lws_pods(cp.store, "sample")) == 2
+    expect_valid_worker_groupsets(cp.store, lws, count=0)
+    fetched = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert fetched.status.ready_replicas == 2
+    assert condition_status(fetched, CONDITION_AVAILABLE) is True
+
+
+def test_leader_ready_startup_policy_gates_workers():
+    cp = make_cp()
+    cp.create(LWSBuilder().replicas(1).size(3).startup_policy(StartupPolicy.LEADER_READY).build())
+    cp.run_until_stable()
+    assert len(lws_pods(cp.store, "sample")) == 1  # leader only
+
+    set_pod_ready(cp.store, "default", "sample-0")
+    cp.run_until_stable()
+    assert len(lws_pods(cp.store, "sample")) == 3
+
+
+def test_unique_per_replica_services_and_subdomains():
+    cp = make_cp(auto_ready=True)
+    cp.create(
+        LWSBuilder().replicas(2).size(2).subdomain_policy(SubdomainPolicy.UNIQUE_PER_REPLICA).build()
+    )
+    cp.run_until_stable()
+    # One service per replica, named after the leader pod.
+    assert cp.store.try_get("Service", "default", "sample-0") is not None
+    assert cp.store.try_get("Service", "default", "sample-1") is not None
+    leader = cp.store.get("Pod", "default", "sample-0")
+    assert leader.spec.subdomain == "sample-0"
+    worker = cp.store.get("Pod", "default", "sample-0-1")
+    assert worker.spec.subdomain == "sample-0"
+    env = {e.name: e.value for e in worker.spec.containers[0].env}
+    assert env[contract.LWS_LEADER_ADDRESS] == "sample-0.sample-0.default"
+
+
+def test_deleted_worker_groupset_recreated():
+    cp = make_cp(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(3).build())
+    cp.run_until_stable()
+    cp.store.delete("GroupSet", "default", "sample-0")
+    cp.run_until_stable()
+    assert cp.store.try_get("GroupSet", "default", "sample-0") is not None
+    assert len(lws_pods(cp.store, "sample")) == 3
+
+
+def test_deleted_service_recreated():
+    cp = make_cp(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.run_until_stable()
+    cp.store.delete("Service", "default", "sample")
+    cp.run_until_stable()
+    assert cp.store.try_get("Service", "default", "sample") is not None
+
+
+def test_lws_delete_cascades_everything():
+    cp = make_cp(auto_ready=True)
+    cp.create(LWSBuilder().replicas(2).size(3).build())
+    cp.run_until_stable()
+    cp.store.delete("LeaderWorkerSet", "default", "sample")
+    cp.run_until_stable()
+    assert cp.store.list("Pod") == []
+    assert cp.store.list("GroupSet") == []
+    assert cp.store.list("Service") == []
+    assert cp.store.list("ControllerRevision") == []

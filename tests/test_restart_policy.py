@@ -1,0 +1,100 @@
+"""All-or-nothing restart semantics (≈ SURVEY §3.5 + KEP-820 budget)."""
+
+from lws_tpu.api import contract
+from lws_tpu.api.pod import PodPhase
+from lws_tpu.api.types import CONDITION_FAILED, RestartPolicy
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder, condition_status, lws_pods, restart_pod_container
+
+
+def uids(cp, lws_name):
+    return {p.meta.name: p.meta.uid for p in lws_pods(cp.store, lws_name)}
+
+
+def test_recreate_group_on_pod_restart():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(2).size(3).build())
+    cp.run_until_stable()
+    before = uids(cp, "sample")
+
+    restart_pod_container(cp.store, "default", "sample-0-2")
+    cp.run_until_stable()
+
+    after = uids(cp, "sample")
+    assert set(after) == set(before)
+    # Whole group 0 recreated (new uids), group 1 untouched.
+    for name in ("sample-0", "sample-0-1", "sample-0-2"):
+        assert after[name] != before[name], name
+    for name in ("sample-1", "sample-1-1", "sample-1-2"):
+        assert after[name] == before[name], name
+    assert "RecreateGroup" in [e.reason for e in cp.recorder.events]
+
+
+def test_leader_restart_recreates_group():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.run_until_stable()
+    before = uids(cp, "sample")
+    restart_pod_container(cp.store, "default", "sample-0")
+    cp.run_until_stable()
+    after = uids(cp, "sample")
+    assert after["sample-0"] != before["sample-0"]
+    assert after["sample-0-1"] != before["sample-0-1"]
+
+
+def test_none_policy_keeps_group():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(3).restart_policy(RestartPolicy.NONE).build())
+    cp.run_until_stable()
+    before = uids(cp, "sample")
+    restart_pod_container(cp.store, "default", "sample-0-1")
+    cp.run_until_stable()
+    assert uids(cp, "sample") == before
+
+
+def test_recreate_after_start_waits_for_pending():
+    cp = ControlPlane()  # manual readiness: all pods stay Pending
+    cp.create(
+        LWSBuilder().replicas(1).size(3).restart_policy(RestartPolicy.RECREATE_GROUP_AFTER_START).build()
+    )
+    cp.run_until_stable()
+    before = uids(cp, "sample")
+
+    # Restart while a group member is still Pending: skipped.
+    restart_pod_container(cp.store, "default", "sample-0-1")
+    cp.run_until_stable()
+    assert uids(cp, "sample") == before
+
+    # Once all pods started, the same restart triggers recreation.
+    for pod in lws_pods(cp.store, "sample"):
+        fresh = cp.store.get("Pod", "default", pod.meta.name)
+        fresh.status.phase = PodPhase.RUNNING
+        cp.store.update_status(fresh)
+    restart_pod_container(cp.store, "default", "sample-0-1")
+    cp.run_until_stable()
+    after = uids(cp, "sample")
+    assert after["sample-0"] != before["sample-0"]
+
+
+def test_restart_budget_fail_fast():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(
+        LWSBuilder()
+        .replicas(1)
+        .size(2)
+        .annotation(contract.MAX_GROUP_RESTARTS_ANNOTATION_KEY, "2")
+        .build()
+    )
+    cp.run_until_stable()
+
+    for i in range(2):
+        restart_pod_container(cp.store, "default", "sample-0-1")
+        cp.run_until_stable()
+
+    before = uids(cp, "sample")
+    # Third failure: budget exhausted, no recreation, LWS goes Failed.
+    restart_pod_container(cp.store, "default", "sample-0-1")
+    cp.run_until_stable()
+    assert uids(cp, "sample") == before
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert condition_status(lws, CONDITION_FAILED) is True

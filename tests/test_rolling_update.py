@@ -1,0 +1,177 @@
+"""Rolling update machinery (≈ test/integration leaderworkerset_test.go update
+tables): group-by-group updates from the highest index, maxSurge bursting and
+reclaim, partition staging, conditions, revision truncation."""
+
+from lws_tpu.api import contract
+from lws_tpu.api.types import (
+    CONDITION_AVAILABLE,
+    CONDITION_UPDATE_IN_PROGRESS,
+)
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import (
+    LWSBuilder,
+    condition_status,
+    lws_pods,
+    make_all_groups_ready,
+    set_pod_ready,
+)
+
+
+def image_of(cp, pod_name):
+    return cp.store.get("Pod", "default", pod_name).spec.containers[0].image
+
+
+def update_image(cp, name, image):
+    lws = cp.store.get("LeaderWorkerSet", "default", name)
+    for c in lws.spec.leader_worker_template.worker_template.spec.containers:
+        c.image = image
+    cp.store.update(lws)
+
+
+def settle_and_make_ready(cp, name="sample", max_rounds=60):
+    """Drive the rollout to completion, the test playing kubelet (SURVEY §4.2)."""
+    make_all_groups_ready(cp, name, max_rounds=max_rounds)
+
+
+def test_rolling_update_replaces_all_groups():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(4).size(2).image("img:v1").build())
+    settle_and_make_ready(cp)
+
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert condition_status(lws, CONDITION_UPDATE_IN_PROGRESS) is True
+    # First step: only the highest-index group is being updated.
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.update_strategy.partition == 3
+
+    settle_and_make_ready(cp)
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 4
+    assert lws.status.ready_replicas == 4
+    assert condition_status(lws, CONDITION_AVAILABLE) is True
+    assert condition_status(lws, CONDITION_UPDATE_IN_PROGRESS) is False
+    for name in ("sample-0", "sample-1", "sample-2", "sample-3", "sample-0-1", "sample-3-1"):
+        assert image_of(cp, name) == "img:v2", name
+    # Old revision truncated once update is done.
+    assert len(cp.store.list("ControllerRevision")) == 1
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.update_strategy.partition == 0
+    assert gs.spec.replicas == 4
+
+
+def test_rolling_update_respects_max_unavailable_budget():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(4).size(2).image("img:v1").rollout(max_unavailable=2).build())
+    settle_and_make_ready(cp)
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.update_strategy.partition == 2  # two groups at once
+    settle_and_make_ready(cp)
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 4
+
+
+def test_rolling_update_with_surge_bursts_and_reclaims():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(2).size(2).image("img:v1").rollout(max_unavailable=1, max_surge=1).build())
+    settle_and_make_ready(cp)
+
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()
+    # Burst replica appears immediately, built from the NEW template.
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.replicas == 3
+    assert cp.store.try_get("Pod", "default", "sample-2") is not None
+
+    settle_and_make_ready(cp)
+    # Update done: surge reclaimed, back to 2 groups, all on v2.
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.replicas == 2
+    assert cp.store.try_get("Pod", "default", "sample-2") is None
+    assert image_of(cp, "sample-0") == "img:v2"
+    assert image_of(cp, "sample-1") == "img:v2"
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 2
+    assert condition_status(lws, CONDITION_AVAILABLE) is True
+
+
+def test_partition_stages_the_rollout():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(4).size(2).image("img:v1").rollout(partition=2).build())
+    settle_and_make_ready(cp)
+
+    update_image(cp, "sample", "img:v2")
+    settle_and_make_ready(cp)
+
+    # Only groups >= partition updated.
+    assert image_of(cp, "sample-0") == "img:v1"
+    assert image_of(cp, "sample-1") == "img:v1"
+    assert image_of(cp, "sample-2") == "img:v2"
+    assert image_of(cp, "sample-3") == "img:v2"
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 2
+    assert condition_status(lws, CONDITION_AVAILABLE) is True
+    # Update not "done" while partition > 0: both revisions retained.
+    assert len(cp.store.list("ControllerRevision")) == 2
+
+    # Dropping partition to 0 finishes the rollout.
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.rollout_strategy.rolling_update_configuration.partition = 0
+    cp.store.update(lws)
+    settle_and_make_ready(cp)
+    assert image_of(cp, "sample-0") == "img:v2"
+    assert len(cp.store.list("ControllerRevision")) == 1
+
+
+def test_scale_up_during_rolling_update_uses_new_template():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(2).size(2).image("img:v1").build())
+    settle_and_make_ready(cp)
+
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()
+    # Scale up mid-update: new replicas come up with the new template.
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.replicas = 4
+    cp.store.update(lws)
+    settle_and_make_ready(cp)
+
+    for name in ("sample-0", "sample-1", "sample-2", "sample-3"):
+        assert image_of(cp, name) == "img:v2", name
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 4
+    assert lws.status.ready_replicas == 4
+
+
+def test_replicas_only_change_is_not_an_update():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(2).size(2).build())
+    settle_and_make_ready(cp)
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.replicas = 3
+    cp.store.update(lws)
+    cp.run_until_stable()
+    fetched = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert condition_status(fetched, CONDITION_UPDATE_IN_PROGRESS) in (None, False)
+    assert len(cp.store.list("ControllerRevision")) == 1
+
+
+def test_rollout_recovers_when_all_replicas_unready():
+    """Regression: a rollout starting with crash-looping (never-ready) groups
+    must still replace them — deleting an already-unavailable pod consumes no
+    budget (ref leaderworkerset_controller.go:660-669 escape hatch)."""
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(2).size(2).image("img:bad").build())
+    cp.run_until_stable()  # pods exist but stay Pending/not-ready
+
+    update_image(cp, "sample", "img:fixed")
+    settle_and_make_ready(cp)
+
+    for name in ("sample-0", "sample-1", "sample-0-1", "sample-1-1"):
+        assert image_of(cp, name) == "img:fixed", name
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 2

@@ -1,0 +1,113 @@
+"""Slice-aware scheduling: exclusive topology, gang admission,
+follow-the-leader placement (≈ e2e gang + exclusive placement cases)."""
+
+from lws_tpu.api import contract
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.sched import make_slice_nodes
+from lws_tpu.testing import LWSBuilder, lws_pods
+
+
+def make_cp_with_slices(n_slices=2, topology="2x4", **kw):
+    cp = ControlPlane(
+        enable_scheduler=True, auto_ready=True, require_binding=True,
+        scheduler_provider=kw.pop("scheduler_provider", None),
+    )
+    for s in range(n_slices):
+        cp.add_nodes(make_slice_nodes(f"slice-{s}", topology=topology))  # 2 hosts x 4 chips
+    return cp
+
+
+def node_slice(cp, pod_name):
+    pod = cp.store.get("Pod", "default", pod_name)
+    assert pod.spec.node_name, f"{pod_name} not scheduled"
+    node = cp.store.get("Node", "default", pod.spec.node_name)
+    return node.meta.labels[contract.NODE_TPU_SLICE_LABEL]
+
+
+def test_exclusive_topology_one_group_per_slice():
+    cp = make_cp_with_slices(n_slices=2)
+    cp.create(
+        LWSBuilder().replicas(2).size(2).tpu_chips(4).exclusive_topology().build()
+    )
+    cp.run_until_stable()
+    pods = lws_pods(cp.store, "sample")
+    assert len(pods) == 4
+    # Each group fully on one slice; the two groups on different slices.
+    g0 = {node_slice(cp, "sample-0"), node_slice(cp, "sample-0-1")}
+    g1 = {node_slice(cp, "sample-1"), node_slice(cp, "sample-1-1")}
+    assert len(g0) == 1 and len(g1) == 1
+    assert g0 != g1
+
+
+def test_follow_the_leader_node_selector():
+    cp = make_cp_with_slices(n_slices=2)
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).exclusive_topology().build())
+    cp.run_until_stable()
+    worker_gs = cp.store.get("GroupSet", "default", "sample-0")
+    sel = worker_gs.spec.template.spec.node_selector
+    assert sel[contract.NODE_TPU_SLICE_LABEL] == node_slice(cp, "sample-0")
+
+
+def test_chip_capacity_respected():
+    cp = make_cp_with_slices(n_slices=1, topology="2x4")  # 2 hosts x 4 chips
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+    # Two pods, 4 chips each, one host has only 4: one pod per host.
+    p0 = cp.store.get("Pod", "default", "sample-0")
+    p1 = cp.store.get("Pod", "default", "sample-0-1")
+    assert p0.spec.node_name != p1.spec.node_name
+
+
+def test_unschedulable_group_stays_pending():
+    cp = make_cp_with_slices(n_slices=1, topology="1x4")  # one host, 4 chips
+    cp.create(LWSBuilder().replicas(1).size(3).tpu_chips(4).build())
+    cp.run_until_stable()
+    pods = lws_pods(cp.store, "sample")
+    unbound = [p for p in pods if not p.spec.node_name]
+    assert unbound, "expected some pods to remain unschedulable"
+
+
+def test_gang_all_or_nothing():
+    # Gang provider: group needs 12 chips but fleet has 8 -> nothing binds.
+    cp = make_cp_with_slices(n_slices=1, topology="2x4", scheduler_provider="gang")
+    cp.create(LWSBuilder().replicas(1).size(3).tpu_chips(4).build())
+    cp.run_until_stable()
+    pods = lws_pods(cp.store, "sample")
+    assert len(pods) == 3
+    assert all(not p.spec.node_name for p in pods), "gang must bind all-or-nothing"
+    # PodGroup exists with whole-group min resources.
+    groups = cp.store.list("PodGroup")
+    assert len(groups) == 1
+    assert groups[0].spec.min_member == 3
+    assert groups[0].spec.min_resources[contract.TPU_RESOURCE_NAME] == 12
+
+
+def test_gang_binds_when_feasible():
+    cp = make_cp_with_slices(n_slices=1, topology="3x4", scheduler_provider="gang")
+    cp.create(LWSBuilder().replicas(1).size(3).tpu_chips(4).build())
+    cp.run_until_stable()
+    pods = lws_pods(cp.store, "sample")
+    assert all(p.spec.node_name for p in pods)
+    assert cp.store.list("PodGroup")[0].status.phase == "Running"
+
+
+def test_gang_leader_ready_reserves_whole_slice():
+    """Regression: under LeaderReady min_member=1, the lone leader must still
+    reserve a slice that fits the WHOLE group, not greedily grab a small one."""
+    from lws_tpu.api.types import StartupPolicy
+
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, require_binding=True,
+                      scheduler_provider="gang")
+    cp.add_nodes(make_slice_nodes("small", topology="1x4"))   # 4 chips
+    cp.add_nodes(make_slice_nodes("big", topology="4x4"))     # 16 chips
+    cp.create(
+        LWSBuilder().replicas(1).size(4).tpu_chips(4).exclusive_topology()
+        .startup_policy(StartupPolicy.LEADER_READY).build()
+    )
+    cp.run_until_stable()
+    assert node_slice(cp, "sample-0") == "big"
+    # Workers follow onto the same slice and the whole group binds.
+    pods = lws_pods(cp.store, "sample")
+    assert len(pods) == 4
+    assert all(p.spec.node_name for p in pods)
+    assert {node_slice(cp, p.meta.name) for p in pods} == {"big"}
