@@ -1,0 +1,13 @@
+"""DisaggregatedSet controller suite (≈ pkg/controllers/disaggregatedset/):
+pure-math rollout planner, rolling-update executor, LWS/service managers, and
+the DS reconciler. On TPU, roles (prefill/decode) land on independent slice
+pools; revision-aware per-role services publish KV-transfer endpoints.
+"""
+
+from lws_tpu.controllers.disagg.planner import (  # noqa: F401
+    ComputeAllSteps,
+    ComputeNextStep,
+    RollingUpdateConfig,
+    UpdateStep,
+)
+from lws_tpu.controllers.disagg.ds_controller import DSReconciler  # noqa: F401

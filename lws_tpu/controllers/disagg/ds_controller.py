@@ -1,0 +1,114 @@
+"""DisaggregatedSet reconciler
+(≈ pkg/controllers/disaggregatedset/disaggregatedset_controller.go:53-124).
+
+Four steps: compute target revision -> GC fully-drained old revisions ->
+rolling update (executor) or simple create/scale -> revision-aware role
+services. Plus status aggregation over the child LWS objects.
+"""
+
+from __future__ import annotations
+
+from lws_tpu.api import disagg
+from lws_tpu.api.disagg import DisaggregatedSet, RoleStatus
+from lws_tpu.controllers.disagg import utils as dsutils
+from lws_tpu.controllers.disagg.executor import RollingUpdateExecutor
+from lws_tpu.controllers.disagg.lws_manager import LWSManager
+from lws_tpu.controllers.disagg.service_manager import ServiceManager
+from lws_tpu.core.events import EventRecorder
+from lws_tpu.core.manager import Result
+from lws_tpu.core.store import Key, Store
+
+
+class DSReconciler:
+    name = "disaggregatedset"
+
+    def __init__(self, store: Store, recorder: EventRecorder) -> None:
+        self.store = store
+        self.recorder = recorder
+        self.lws_manager = LWSManager(store)
+        self.service_manager = ServiceManager(store)
+        self.executor = RollingUpdateExecutor(self.lws_manager, recorder)
+
+    def reconcile(self, key: Key) -> Result | None:
+        ds = self.store.try_get("DisaggregatedSet", key[1], key[2])
+        if ds is None or not isinstance(ds, DisaggregatedSet):
+            return None
+
+        revision = dsutils.compute_revision(ds.spec.roles)
+        # One snapshot drives cleanup + the rollout decision; a second list
+        # after mutations feeds services/status.
+        snapshot = self.lws_manager.list(ds.meta.namespace, ds.meta.name)
+        snapshot = self._cleanup_drained_lws(ds, revision, snapshot)
+
+        old_revisions, new_revision = dsutils.split_revisions(snapshot, revision)
+        total_old = sum(
+            old_revisions.total_replicas_for_role(role) for role in dsutils.get_role_names(ds)
+        )
+        if old_revisions and total_old > 0:
+            self.executor.reconcile(ds, revision, old_revisions, new_revision)
+        else:
+            self._reconcile_simple(ds, revision)
+
+        all_lws = self.lws_manager.list(ds.meta.namespace, ds.meta.name)
+        revision_roles = dsutils.group_by_revision(all_lws)
+        self.service_manager.reconcile_services(ds, revision_roles, revision)
+        self._update_status(ds, all_lws, revision)
+        return None
+
+    # ---- simple path (ref :135-187) ------------------------------------
+    def _reconcile_simple(self, ds: DisaggregatedSet, revision: str) -> None:
+        for role, config in dsutils.get_role_configs(ds).items():
+            name = dsutils.generate_name(ds.meta.name, role, revision)
+            existing = self.lws_manager.get(ds.meta.namespace, name)
+            if existing is None:
+                self.lws_manager.create(ds, role, config, revision, replicas=config.replicas)
+            elif existing.spec.replicas != config.replicas:
+                self.lws_manager.scale(ds.meta.namespace, name, config.replicas)
+
+    # ---- drained-revision GC (ref :193-248) -----------------------------
+    def _cleanup_drained_lws(self, ds: DisaggregatedSet, revision: str, snapshot: list) -> list:
+        """Deletes fully-drained old revisions; returns the remaining LWS."""
+        by_revision: dict[str, list] = {}
+        for lws in snapshot:
+            lws_revision = lws.meta.labels.get(disagg.DS_REVISION_LABEL_KEY, "")
+            if lws_revision == revision:
+                continue
+            by_revision.setdefault(lws_revision, []).append(lws)
+        deleted: set[str] = set()
+        for old_revision, lws_list in by_revision.items():
+            if any(lws.spec.replicas != 0 for lws in lws_list):
+                continue
+            for lws in lws_list:
+                self.lws_manager.delete(ds.meta.namespace, lws.meta.name)
+                deleted.add(lws.meta.name)
+                self.recorder.event(ds, "Normal", "LWSDeleted", f"Deleted drained LWS {lws.meta.name}")
+        return [lws for lws in snapshot if lws.meta.name not in deleted]
+
+    # ---- status ---------------------------------------------------------
+    def _update_status(self, ds: DisaggregatedSet, all_lws, revision: str) -> None:
+        fresh = self.store.get("DisaggregatedSet", ds.meta.namespace, ds.meta.name)
+        roles: list[RoleStatus] = []
+        for role in dsutils.get_role_names(ds):
+            replicas = ready = updated = 0
+            for lws in all_lws:
+                if lws.meta.labels.get(disagg.DS_ROLE_LABEL_KEY) != role:
+                    continue
+                replicas += lws.status.replicas
+                ready += lws.status.ready_replicas
+                if lws.meta.labels.get(disagg.DS_REVISION_LABEL_KEY) == revision:
+                    # Every group of a target-revision child IS updated,
+                    # ready or not (ref disaggregatedset_types.go:89-91).
+                    updated += lws.status.replicas
+            roles.append(RoleStatus(name=role, replicas=replicas, ready_replicas=ready, updated_replicas=updated))
+        from lws_tpu.api.meta import to_plain
+
+        changed = (
+            to_plain(fresh.status.roles) != to_plain(roles)
+            or fresh.status.current_revision != revision
+            or fresh.status.observed_generation != fresh.meta.generation
+        )
+        if changed:
+            fresh.status.roles = roles
+            fresh.status.current_revision = revision
+            fresh.status.observed_generation = fresh.meta.generation
+            self.store.update_status(fresh)

@@ -1,0 +1,85 @@
+"""Child-LWS CRUD adapter (≈ pkg/controllers/disaggregatedset/lws_manager.go).
+
+Creates per-role LWS objects with DS name/role/revision labels injected into
+both the LWS and its pod templates (so pods are selectable by revision-aware
+role services), scales via spec patch, and snapshots initial-replicas.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from lws_tpu.api import disagg
+from lws_tpu.api.disagg import DisaggregatedRoleSpec, DisaggregatedSet
+from lws_tpu.api.types import LeaderWorkerSet
+from lws_tpu.controllers.disagg import utils as dsutils
+from lws_tpu.core.store import Store, new_meta
+
+
+class LWSManager:
+    def __init__(self, store: Store) -> None:
+        self.store = store
+
+    def get(self, namespace: str, name: str) -> Optional[LeaderWorkerSet]:
+        obj = self.store.try_get("LeaderWorkerSet", namespace, name)
+        return obj if isinstance(obj, LeaderWorkerSet) else None
+
+    def list(self, namespace: str, ds_name: str, role: str = "") -> list[LeaderWorkerSet]:
+        labels = {disagg.DS_NAME_LABEL_KEY: ds_name}
+        if role:
+            labels[disagg.DS_ROLE_LABEL_KEY] = role
+        return self.store.list("LeaderWorkerSet", namespace, labels=labels)  # type: ignore[return-value]
+
+    def create(
+        self,
+        ds: DisaggregatedSet,
+        role: str,
+        config: DisaggregatedRoleSpec,
+        revision: str,
+        replicas: int,
+    ) -> LeaderWorkerSet:
+        labels = dsutils.generate_labels(ds.meta.name, role, revision)
+        spec = copy.deepcopy(config.template.spec)
+        spec.replicas = replicas
+        # Pods inherit the DS identity through their templates
+        # (≈ lws_manager.go:59-107 label injection).
+        spec.leader_worker_template.worker_template.metadata.labels.update(labels)
+        if spec.leader_worker_template.leader_template is not None:
+            spec.leader_worker_template.leader_template.metadata.labels.update(labels)
+        meta_labels = {**config.template.metadata.labels, **labels}
+        annotations = dict(config.template.metadata.annotations)
+        lws = LeaderWorkerSet(
+            meta=new_meta(
+                dsutils.generate_name(ds.meta.name, role, revision),
+                ds.meta.namespace,
+                labels=meta_labels,
+                annotations=annotations,
+                owners=[ds],
+            ),
+            spec=spec,
+        )
+        return self.store.create(lws)  # type: ignore[return-value]
+
+    def scale(self, namespace: str, name: str, replicas: int) -> None:
+        lws = self.store.get("LeaderWorkerSet", namespace, name)
+        if lws.spec.replicas != replicas:
+            lws.spec.replicas = replicas
+            self.store.update(lws)
+
+    def delete(self, namespace: str, name: str) -> None:
+        self.store.delete("LeaderWorkerSet", namespace, name)
+
+    def set_initial_replicas(self, namespace: str, name: str, replicas: int) -> None:
+        lws = self.get(namespace, name)
+        if lws is None:
+            return
+        if lws.meta.annotations.get(disagg.DS_INITIAL_REPLICAS_ANNOTATION_KEY) == str(replicas):
+            return
+        lws.meta.annotations[disagg.DS_INITIAL_REPLICAS_ANNOTATION_KEY] = str(replicas)
+        self.store.update(lws)
+
+    def revision_roles(
+        self, namespace: str, ds_name: str, target_revision: str
+    ) -> tuple[dsutils.RevisionRolesList, Optional[dsutils.RevisionRoles]]:
+        return dsutils.split_revisions(self.list(namespace, ds_name), target_revision)

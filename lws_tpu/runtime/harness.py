@@ -21,6 +21,7 @@ from lws_tpu.controllers.pod_controller import PodReconciler
 from lws_tpu.sched.provider import make_scheduler_provider
 from lws_tpu.sched.scheduler import Scheduler
 from lws_tpu.webhooks import register_lws_webhooks, register_pod_webhooks
+from lws_tpu.webhooks.ds_webhook import register_ds_webhooks
 
 
 class FakeKubelet:
@@ -65,6 +66,7 @@ class ControlPlane:
         provider = make_scheduler_provider(scheduler_provider, self.store)
         register_lws_webhooks(self.store)
         register_pod_webhooks(self.store, provider)
+        register_ds_webhooks(self.store)
 
         self.manager = Manager(self.store)
         store = self.store
@@ -127,6 +129,22 @@ class ControlPlane:
             {
                 "GroupSet": lambda o: [o.key()],
                 "Pod": groupset_owner_of_pod,
+            },
+        )
+
+        from lws_tpu.api import disagg
+        from lws_tpu.controllers.disagg import DSReconciler
+
+        def ds_key_by_label(obj) -> list[Key]:
+            name = obj.meta.labels.get(disagg.DS_NAME_LABEL_KEY)
+            return [("DisaggregatedSet", obj.meta.namespace, name)] if name else []
+
+        self.ds_controller = DSReconciler(self.store, self.recorder)
+        self.manager.register(
+            self.ds_controller,
+            {
+                "DisaggregatedSet": lambda o: [o.key()],
+                "LeaderWorkerSet": ds_key_by_label,
             },
         )
 

@@ -139,9 +139,10 @@ class Scheduler:
 
     # ---- feasibility -------------------------------------------------------
     def _nodes(self, namespace: str) -> list[Node]:
+        # Nodes are cluster-scoped hardware: never filter by namespace.
         return [
             n
-            for n in self.store.list("Node", namespace)
+            for n in self.store.list("Node")
             if isinstance(n, Node) and n.status.ready and not n.spec.unschedulable
         ]
 

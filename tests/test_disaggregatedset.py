@@ -1,0 +1,167 @@
+"""DisaggregatedSet end-to-end: simple path, N-dimensional lockstep rolling
+update with coordinated drain, revision-aware services, role add/remove
+(≈ test/e2e/disaggregatedset/e2e_test.go flows, driven in-process)."""
+
+import pytest
+
+from lws_tpu.api import contract, disagg
+from lws_tpu.api.disagg import (
+    DisaggregatedRoleSpec,
+    DisaggregatedSet,
+    DisaggregatedSetSpec,
+    LeaderWorkerSetTemplateSpec,
+)
+from lws_tpu.api.types import LeaderWorkerSetSpec, LeaderWorkerTemplate
+from lws_tpu.core.store import AdmissionError, new_meta
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import make_worker_template
+from lws_tpu.controllers.disagg import utils as dsutils
+
+
+def role(name, replicas=2, size=2, image="img:v1"):
+    return DisaggregatedRoleSpec(
+        name=name,
+        replicas=replicas,
+        template=LeaderWorkerSetTemplateSpec(
+            spec=LeaderWorkerSetSpec(
+                leader_worker_template=LeaderWorkerTemplate(
+                    worker_template=make_worker_template(image), size=size
+                )
+            )
+        ),
+    )
+
+
+def make_ds(roles=None, name="llmd"):
+    return DisaggregatedSet(
+        meta=new_meta(name),
+        spec=DisaggregatedSetSpec(roles=roles or [role("prefill"), role("decode")]),
+    )
+
+
+def child_lws(cp, ds_name="llmd"):
+    return {
+        l.meta.name: l
+        for l in cp.store.list("LeaderWorkerSet", "default", labels={disagg.DS_NAME_LABEL_KEY: ds_name})
+    }
+
+
+def test_simple_create_builds_role_lws():
+    cp = ControlPlane(auto_ready=True)
+    ds = cp.create(make_ds())
+    cp.run_until_stable()
+    revision = dsutils.compute_revision(ds.spec.roles)
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-{revision}-prefill", f"llmd-{revision}-decode"}
+    for name, lws in children.items():
+        assert lws.spec.replicas == 2
+        assert lws.meta.labels[disagg.DS_REVISION_LABEL_KEY] == revision
+    # Pods carry DS identity labels (selectable by role services).
+    pods = cp.store.list("Pod", "default", labels={disagg.DS_NAME_LABEL_KEY: "llmd"})
+    assert len(pods) == 8  # 2 roles x 2 replicas x size 2
+    # Private services appear once all roles ready.
+    svc = cp.store.try_get("Service", "default", f"llmd-{revision}-prefill-prv")
+    assert svc is not None
+    assert svc.spec.selector[disagg.DS_ROLE_LABEL_KEY] == "prefill"
+    # Status aggregated.
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    assert {r.name: r.ready_replicas for r in fetched.status.roles} == {"prefill": 2, "decode": 2}
+
+
+def test_scale_role_is_not_a_new_revision():
+    cp = ControlPlane(auto_ready=True)
+    ds = cp.create(make_ds())
+    cp.run_until_stable()
+    rev1 = dsutils.compute_revision(ds.spec.roles)
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    fetched.spec.roles[0].replicas = 4
+    cp.store.update(fetched)
+    cp.run_until_stable()
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-{rev1}-prefill", f"llmd-{rev1}-decode"}
+    assert children[f"llmd-{rev1}-prefill"].spec.replicas == 4
+
+
+def test_rolling_update_lockstep_and_drain():
+    cp = ControlPlane(auto_ready=True)
+    ds = cp.create(make_ds())
+    cp.run_until_stable()
+    rev1 = dsutils.compute_revision(ds.spec.roles)
+
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:v2"
+    cp.store.update(fetched)
+    rev2 = dsutils.compute_revision(fetched.spec.roles)
+    assert rev2 != rev1
+
+    cp.run_until_stable()
+
+    # Old revision fully drained + GC'd; new revision at target on both roles.
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-{rev2}-prefill", f"llmd-{rev2}-decode"}, children.keys()
+    for lws in children.values():
+        assert lws.spec.replicas == 2
+        assert lws.status.ready_replicas == 2
+    # Old services gone, new services exist.
+    assert cp.store.try_get("Service", "default", f"llmd-{rev1}-prefill-prv") is None
+    assert cp.store.try_get("Service", "default", f"llmd-{rev2}-prefill-prv") is not None
+    assert cp.store.try_get("Service", "default", f"llmd-{rev2}-decode-prv") is not None
+    reasons = {e.reason for e in cp.recorder.events}
+    assert {"RollingUpdateStarted", "ScalingUp", "ScalingDown", "LWSDeleted"} <= reasons
+    status = cp.store.get("DisaggregatedSet", "default", "llmd")
+    assert status.status.current_revision == rev2
+    assert {r.name: r.updated_replicas for r in status.status.roles} == {"prefill": 2, "decode": 2}
+
+
+def test_rolling_update_role_added_and_removed():
+    cp = ControlPlane(auto_ready=True)
+    ds = cp.create(make_ds())
+    cp.run_until_stable()
+    rev1 = dsutils.compute_revision(ds.spec.roles)
+
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    # Rename decode -> worker (a remove + add) and bump the template.
+    fetched.spec.roles = [role("prefill", image="img:v2"), role("worker", image="img:v2")]
+    cp.store.update(fetched)
+    rev2 = dsutils.compute_revision(fetched.spec.roles)
+    cp.run_until_stable()
+
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-{rev2}-prefill", f"llmd-{rev2}-worker"}, children.keys()
+    for lws in children.values():
+        assert lws.status.ready_replicas == 2
+
+
+def test_ds_validation():
+    cp = ControlPlane()
+    with pytest.raises(AdmissionError):
+        cp.create(make_ds(roles=[role("only")]))  # < 2 roles
+    with pytest.raises(AdmissionError):
+        cp.create(make_ds(roles=[role("a"), role("a")]))  # duplicate names
+    with pytest.raises(AdmissionError):
+        cp.create(make_ds(roles=[role("a", replicas=0), role("b", replicas=2)]))  # mixed zero
+    bad = make_ds()
+    bad.spec.roles[0].template.spec.rollout_strategy.rolling_update_configuration = (
+        __import__("lws_tpu.api.types", fromlist=["RollingUpdateConfiguration"]).RollingUpdateConfiguration(partition=1)
+    )
+    with pytest.raises(AdmissionError):
+        cp.create(bad)
+
+
+def test_ds_delete_cascades():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(make_ds())
+    cp.run_until_stable()
+    cp.store.delete("DisaggregatedSet", "default", "llmd")
+    cp.run_until_stable()
+    assert cp.store.list("LeaderWorkerSet") == []
+    assert cp.store.list("Pod") == []
+    assert cp.store.list("Service") == []
+
+
+def test_ds_name_length_bounded_at_admission():
+    cp = ControlPlane()
+    with pytest.raises(AdmissionError):
+        cp.create(make_ds(name="a" * 50))  # derived service name would exceed 63
