@@ -1,0 +1,143 @@
+"""Driver benchmark: single-chip serving throughput of the flagship model.
+
+Runs a ~1B-param llama-class model (bf16) through the Engine on the real TPU:
+prefill TTFT + steady-state greedy decode throughput. Prints ONE JSON line:
+
+  {"metric": ..., "value": tok/s/chip, "unit": ..., "vs_baseline": fraction}
+
+vs_baseline is the fraction of the chip's HBM-bandwidth roofline for decode
+(decode streams all params + the KV cache every step; the reference publishes
+no serving numbers — BASELINE.md "none published" — so the hardware roofline
+is the honest denominator and is comparable across rounds).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+HBM_BYTES_PER_S = {
+    # Peak HBM bandwidth per chip.
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "cpu": 50e9,  # dev-mode placeholder
+}
+
+
+def detect_generation() -> str:
+    import os
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if gen:
+        return gen
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for g in ("v5p", "v5e", "v4"):
+        if g in kind or g.replace("v", "v5 lite") in kind:
+            return g
+    if "lite" in kind:
+        return "v5e"
+    return "cpu" if jax.default_backend() == "cpu" else "v5e"
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving import Engine
+
+    on_accelerator = jax.default_backend() != "cpu"
+    if on_accelerator:
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            d_model=2048,
+            n_layers=16,
+            n_heads=16,
+            n_kv_heads=8,
+            d_ff=5632,
+            max_seq_len=2048,
+            dtype=jnp.bfloat16,
+            param_dtype=jnp.bfloat16,
+            remat=False,
+        )
+        batch, prompt_len, decode_steps, max_len = 16, 1024, 256, 2048
+    else:  # dev smoke (not the recorded benchmark)
+        cfg = LlamaConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=256, max_seq_len=128, dtype=jnp.float32, param_dtype=jnp.float32,
+            remat=False,
+        )
+        batch, prompt_len, decode_steps, max_len = 2, 16, 8, 64
+
+    n_params = cfg.n_params()
+    print(f"[bench] model: {n_params/1e9:.2f}B params, batch={batch}, "
+          f"prompt={prompt_len}, decode={decode_steps}", file=sys.stderr)
+
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    jax.block_until_ready(params)
+
+    engine = Engine(cfg, params, batch_size=batch, max_len=max_len)
+    prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size).astype(
+        jnp.int32
+    )
+
+    # Compile both phases before timing.
+    t0 = time.perf_counter()
+    result = engine.generate(prompt, max_new_tokens=8)
+    print(f"[bench] compile+warmup {time.perf_counter()-t0:.1f}s "
+          f"(cold TTFT {result.ttft_s*1e3:.1f}ms)", file=sys.stderr)
+
+    # Timed decode: the whole loop runs on-device (lax.scan), one dispatch per
+    # run. Two run lengths difference away the fixed sync overhead of
+    # relay-backed backends.
+    from lws_tpu.serving.engine import host_sync
+
+    short_steps = max(2, decode_steps // 4)
+    if short_steps >= decode_steps:
+        short_steps = decode_steps // 2
+
+    def timed_decode(n):
+        token, cache = engine.prefill(prompt)
+        host_sync(token)
+        t0 = time.perf_counter()
+        token, cache, _ = engine.decode_n(token, cache, n)
+        host_sync(token)
+        return time.perf_counter() - t0
+
+    timed_decode(short_steps)  # compile short
+    timed_decode(decode_steps)  # compile long
+    t_short = timed_decode(short_steps)
+    t_long = timed_decode(decode_steps)
+    step_s = (t_long - t_short) / (decode_steps - short_steps)
+    tok_per_s = batch / step_s
+    result = engine.generate(prompt, max_new_tokens=8)  # for TTFT reporting
+
+    # Roofline: decode streams params + K and V cache lines each step.
+    bytes_per_param = jnp.dtype(cfg.param_dtype).itemsize
+    cache_bytes = (
+        2 * cfg.n_layers * batch * max_len * cfg.n_kv_heads * cfg.head_dim
+        * jnp.dtype(cfg.dtype).itemsize
+    )
+    bytes_per_step = n_params * bytes_per_param + cache_bytes
+    gen = detect_generation()
+    bw = HBM_BYTES_PER_S.get(gen, HBM_BYTES_PER_S["v5e"])
+    roofline_tok_s = bw / bytes_per_step * batch
+
+    print(f"[bench] gen={gen} TTFT={result.ttft_s*1e3:.1f}ms "
+          f"decode={tok_per_s:.0f} tok/s (roofline {roofline_tok_s:.0f})", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"llama-{n_params/1e9:.1f}B-bf16 greedy decode throughput, single chip ({gen})",
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

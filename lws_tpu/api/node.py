@@ -12,6 +12,10 @@ from dataclasses import dataclass, field
 
 from lws_tpu.api.meta import ObjectMeta, TypedObject
 
+# Nodes are cluster-scoped hardware: they live under this canonical
+# pseudo-namespace in the Store so lookups stay O(1) by name.
+CLUSTER_NAMESPACE = "_cluster"
+
 
 @dataclass
 class NodeStatus:

@@ -78,8 +78,3 @@ class LWSManager:
             return
         lws.meta.annotations[disagg.DS_INITIAL_REPLICAS_ANNOTATION_KEY] = str(replicas)
         self.store.update(lws)
-
-    def revision_roles(
-        self, namespace: str, ds_name: str, target_revision: str
-    ) -> tuple[dsutils.RevisionRolesList, Optional[dsutils.RevisionRoles]]:
-        return dsutils.split_revisions(self.list(namespace, ds_name), target_revision)

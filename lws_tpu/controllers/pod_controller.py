@@ -260,10 +260,12 @@ class PodReconciler:
 
     def _topology_value(self, pod: Pod, topology_key: str) -> Optional[str]:
         """≈ :315-336 topologyValueFromPod. Nodes are cluster-scoped."""
-        for node in self.store.list("Node"):
-            if node.meta.name == pod.spec.node_name:
-                return node.meta.labels.get(topology_key)
-        return None
+        from lws_tpu.api.node import CLUSTER_NAMESPACE
+
+        node = self.store.try_get("Node", CLUSTER_NAMESPACE, pod.spec.node_name)
+        if node is None:
+            return None
+        return node.meta.labels.get(topology_key)
 
     def _ensure_service(self, lws, name: str, selector: dict[str, str], owner) -> None:
         if self.store.try_get("Service", lws.meta.namespace, name) is None:

@@ -1,0 +1,6 @@
+"""Flagship workloads: llama-class decoder (dense + MoE) with dp/pp/tp/sp/ep
+shardings, and the training step. These are the models the orchestration layer
+deploys onto LWS groups (group = slice, subgroup = stage)."""
+
+from lws_tpu.models.llama import LlamaConfig, init_params, forward, loss_fn, param_shardings  # noqa: F401
+from lws_tpu.models.train import TrainState, make_train_step, init_train_state  # noqa: F401

@@ -1,0 +1,421 @@
+"""Llama-class decoder, TPU-first: pure-JAX pytree params, stacked-layer scan
+(one compiled block body regardless of depth), bf16 compute on the MXU,
+GSPMD shardings over the (dp, pp, tp) mesh:
+
+  * tp  — Megatron-style: qkv/gate/up column-split, o/down row-split, vocab
+          split on embed/lm_head; XLA inserts the ICI all-reduces.
+  * sp  — activations' sequence dim sharded over `tp` between blocks
+          (with_sharding_constraint), so norms/residuals are sequence-parallel.
+  * pp  — the stacked layer axis is sharded over `pp`: each stage holds
+          n_layers/pp layer slices; the scan streams through stages
+          (weight-gathered pipeline; explicit-ppermute GPipe is a planned
+          optimization, the sharding contract is identical).
+  * ep  — MoE experts dim sharded over `tp` (expert parallelism); GShard-style
+          dense dispatch/combine einsums keep shapes static for XLA.
+
+The reference orchestrates such workloads but contains none (SURVEY §0);
+this model is the TPU-native counterpart of its vLLM Llama examples
+(docs/examples/vllm/TPU/lws.yaml).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    d_ff: int = 5632
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16  # compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32
+    # MoE (0 experts = dense FFN everywhere).
+    n_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    aux_loss_coef: float = 0.01
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def n_params(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        attn = d * self.n_heads * self.head_dim + 2 * d * self.n_kv_heads * self.head_dim + self.n_heads * self.head_dim * d
+        if self.n_experts:
+            ffn = self.n_experts * 3 * d * f + d * self.n_experts
+        else:
+            ffn = 3 * d * f
+        per_layer = attn + ffn + 2 * d
+        return v * d * 2 + self.n_layers * per_layer + d
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    hd, nh, nkv, L = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    k = iter(jax.random.split(key, 16))
+    pd = cfg.param_dtype
+
+    def norm_init(*shape):
+        return jnp.ones(shape, pd)
+
+    def dense_init(key, *shape, scale=None):
+        fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+        scale = scale if scale is not None else fan_in**-0.5
+        return (jax.random.normal(key, shape) * scale).astype(pd)
+
+    layer = {
+        "attn_norm": norm_init(L, d),
+        "wq": dense_init(next(k), L, d, nh * hd),
+        "wk": dense_init(next(k), L, d, nkv * hd),
+        "wv": dense_init(next(k), L, d, nkv * hd),
+        "wo": dense_init(next(k), L, nh * hd, d, scale=(nh * hd) ** -0.5 / (2 * L) ** 0.5),
+        "ffn_norm": norm_init(L, d),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        layer["router"] = dense_init(next(k), L, d, E)
+        layer["w_gate"] = dense_init(next(k), L, E, d, f)
+        layer["w_up"] = dense_init(next(k), L, E, d, f)
+        layer["w_down"] = dense_init(next(k), L, E, f, d, scale=f**-0.5 / (2 * L) ** 0.5)
+    else:
+        layer["w_gate"] = dense_init(next(k), L, d, f)
+        layer["w_up"] = dense_init(next(k), L, d, f)
+        layer["w_down"] = dense_init(next(k), L, f, d, scale=f**-0.5 / (2 * L) ** 0.5)
+
+    return {
+        "embed": dense_init(next(k), v, d, scale=1.0),
+        "layers": layer,
+        "final_norm": norm_init(d),
+        "lm_head": dense_init(next(k), d, v),
+    }
+
+
+def param_shardings(cfg: LlamaConfig) -> dict:
+    """PartitionSpec tree matching init_params (see module docstring)."""
+    layer = {
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "ffn_norm": P("pp", None),
+    }
+    if cfg.n_experts:
+        layer["router"] = P("pp", None, None)
+        layer["w_gate"] = P("pp", "tp", None, None)
+        layer["w_up"] = P("pp", "tp", None, None)
+        layer["w_down"] = P("pp", "tp", None, None)
+    else:
+        layer["w_gate"] = P("pp", None, "tp")
+        layer["w_up"] = P("pp", None, "tp")
+        layer["w_down"] = P("pp", "tp", None)
+    return {
+        "embed": P("tp", None),
+        "layers": layer,
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, S, H, hd]; rotate-half RoPE in f32."""
+    hd = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, hd // 2, dtype=jnp.float32) / (hd // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, hd/2]
+    cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def gqa_attention(q, k, v, causal: bool = True):
+    """q: [B,S,H,hd], k/v: [B,S,Hkv,hd] — grouped-query attention, f32 softmax."""
+    B, S, H, hd = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    q = q.reshape(B, S, Hkv, G, hd)
+    scores = jnp.einsum("bqkgh,bskh->bkgqs", q, k).astype(jnp.float32) * hd**-0.5
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, v)
+    return out.reshape(B, S, H, hd)
+
+
+def _dense_ffn(x, w_gate, w_up, w_down):
+    h = jax.nn.silu(x @ w_gate) * (x @ w_up)
+    return h @ w_down
+
+
+def _moe_ffn(x, router, w_gate, w_up, w_down, cfg: LlamaConfig):
+    """GShard-style top-k MoE with static-shape dense dispatch/combine.
+
+    x: [B,S,D]; router: [D,E]; w_gate/w_up: [E,D,F]; w_down: [E,F,D].
+    Experts dim E is sharded over `tp` (ep); XLA turns the dispatch einsum
+    into an all-to-all over ICI. Returns (y, aux_loss).
+    """
+    B, S, D = x.shape
+    E, K = cfg.n_experts, cfg.top_k
+    C = max(1, int(cfg.capacity_factor * S * K / E))
+
+    logits = jnp.einsum("bsd,de->bse", x, router).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    remaining = probs
+    expert_count = jnp.zeros((B, E), jnp.float32)
+    dispatch = jnp.zeros((B, S, E, C), x.dtype)
+    gates_sum = jnp.zeros((B, S), jnp.float32)
+    combine_gates = jnp.zeros((B, S, E), jnp.float32)
+    for _ in range(K):
+        idx = jnp.argmax(remaining, axis=-1)  # [B,S]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B,S,E]
+        pos = jnp.cumsum(onehot, axis=1) - onehot + expert_count[:, None, :]
+        keep = onehot * (pos < C)
+        expert_count = expert_count + keep.sum(axis=1)
+        gate = (probs * keep).sum(axis=-1)  # [B,S]
+        pos_idx = (pos * keep).sum(axis=-1).astype(jnp.int32)  # [B,S]
+        slot = jax.nn.one_hot(pos_idx, C, dtype=x.dtype) * keep.sum(-1, keepdims=True).astype(x.dtype)
+        dispatch = dispatch + keep.astype(x.dtype)[..., None] * slot[:, :, None, :]
+        combine_gates = combine_gates + keep * gate[..., None]
+        gates_sum = gates_sum + gate
+        remaining = remaining * (1.0 - onehot)
+
+    denom = jnp.maximum(gates_sum, 1e-9)[..., None]
+    combine = (combine_gates / denom).astype(x.dtype)[..., None] * dispatch  # [B,S,E,C]
+
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+    try:
+        # ep: experts dim onto `tp` — the dispatch above becomes an all-to-all.
+        expert_in = jax.lax.with_sharding_constraint(expert_in, P("tp", "dp", None, None))
+    except RuntimeError:
+        pass
+    h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate)) * jnp.einsum(
+        "ebcd,edf->ebcf", expert_in, w_up
+    )
+    expert_out = jnp.einsum("ebcf,efd->ebcd", h, w_down)
+    y = jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
+
+    # Load-balancing aux loss (Switch): E * mean(fraction_e * prob_e).
+    token_frac = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32), axis=(0, 1))
+    prob_frac = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(token_frac * prob_frac)
+    return y, aux
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+
+def _block(x, positions, lp, cfg: LlamaConfig):
+    """One decoder block; lp = this layer's param slice."""
+    B, S, D = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(h.dtype)).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"].astype(h.dtype)).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"].astype(h.dtype)).reshape(B, S, nkv, hd)
+    q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
+    attn = gqa_attention(q, k, v).reshape(B, S, nh * hd)
+    x = x + attn @ lp["wo"].astype(attn.dtype)
+    x = _seq_shard(x)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    if cfg.n_experts:
+        y, aux = _moe_ffn(
+            h,
+            lp["router"].astype(h.dtype),
+            lp["w_gate"].astype(h.dtype),
+            lp["w_up"].astype(h.dtype),
+            lp["w_down"].astype(h.dtype),
+            cfg,
+        )
+    else:
+        y = _dense_ffn(h, lp["w_gate"].astype(h.dtype), lp["w_up"].astype(h.dtype), lp["w_down"].astype(h.dtype))
+        aux = jnp.zeros((), jnp.float32)
+    x = x + y
+    return _seq_shard(x), aux
+
+
+def _seq_shard(x):
+    """Sequence parallelism: shard [B,S,D] activations as (dp, tp, -) between
+    blocks so norms/residuals are sequence-parallel; GSPMD inserts the
+    gather/reduce-scatter pairs around attention/matmuls. No-op outside a
+    mesh context (single-chip serving/bench)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, P("dp", "tp", None))
+    except RuntimeError:
+        return x
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> tuple[jax.Array, jax.Array]:
+    """tokens [B,S] -> (logits [B,S,V] f32, aux_loss scalar)."""
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _seq_shard(x)
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(3,))
+
+    def body(carry, lp):
+        x, aux = carry
+        x, a = block(x, positions, lp, cfg)
+        return (x, aux + a), None
+
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, aux / cfg.n_layers
+
+
+def loss_fn(params: dict, batch: dict, cfg: LlamaConfig) -> tuple[jax.Array, dict]:
+    """Causal LM loss; batch = {"tokens": [B,S+1] int32} (shift inside)."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = (targets >= 0).astype(jnp.float32)
+    ce = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    total = ce + cfg.aux_loss_coef * aux
+    return total, {"ce": ce, "aux": aux}
+
+
+# ---------------------------------------------------------------------------
+# KV-cached inference path (serving)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KVCache:
+    """Per-layer stacked KV cache: k/v [L, B, T, Hkv, hd]; pos = tokens filled."""
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        pos=jnp.zeros((), jnp.int32),
+    )
+
+
+def _cached_attention(q, cache_k, cache_v, pos):
+    """q [B,S,H,hd] attends to cache[:, :T]; keys at key_pos <= pos + q_idx."""
+    B, S, H, hd = q.shape
+    T, Hkv = cache_k.shape[1], cache_k.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, S, Hkv, G, hd)
+    scores = jnp.einsum("bqkgh,bskh->bkgqs", qg, cache_k).astype(jnp.float32) * hd**-0.5
+    key_pos = jnp.arange(T)
+    q_pos = pos + jnp.arange(S)
+    mask = key_pos[None, :] <= q_pos[:, None]  # [S, T]
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, cache_v)
+    return out.reshape(B, S, H, hd)
+
+
+def _block_with_cache(x, positions, pos, layer_idx, lp, cache: KVCache, cfg: LlamaConfig):
+    """One block against the FULL stacked cache: the write is a tiny
+    [1,B,S,Hkv,hd] dynamic-update-slice into the loop-carried buffer (aliased
+    in place by XLA), never a whole-layer copy — decode stays
+    bandwidth-roofline-shaped instead of doubling its HBM traffic."""
+    B, S, D = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(h.dtype)).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"].astype(h.dtype)).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"].astype(h.dtype)).reshape(B, S, nkv, hd)
+    q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
+
+    new_k = jax.lax.dynamic_update_slice(
+        cache.k, k.astype(cache.k.dtype)[None], (layer_idx, 0, pos, 0, 0)
+    )
+    new_v = jax.lax.dynamic_update_slice(
+        cache.v, v.astype(cache.v.dtype)[None], (layer_idx, 0, pos, 0, 0)
+    )
+    cache = KVCache(k=new_k, v=new_v, pos=cache.pos)
+    cache_k_l = jax.lax.dynamic_index_in_dim(cache.k, layer_idx, 0, keepdims=False)
+    cache_v_l = jax.lax.dynamic_index_in_dim(cache.v, layer_idx, 0, keepdims=False)
+
+    attn = _cached_attention(q, cache_k_l, cache_v_l, pos).reshape(B, S, nh * hd)
+    x = x + attn @ lp["wo"].astype(attn.dtype)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    if cfg.n_experts:
+        y, _ = _moe_ffn(
+            h,
+            lp["router"].astype(h.dtype),
+            lp["w_gate"].astype(h.dtype),
+            lp["w_up"].astype(h.dtype),
+            lp["w_down"].astype(h.dtype),
+            cfg,
+        )
+    else:
+        y = _dense_ffn(h, lp["w_gate"].astype(h.dtype), lp["w_up"].astype(h.dtype), lp["w_down"].astype(h.dtype))
+    return x + y, cache
+
+
+def forward_with_cache(
+    params: dict, tokens: jax.Array, cache: KVCache, cfg: LlamaConfig
+) -> tuple[jax.Array, KVCache]:
+    """Append `tokens` [B,S] at cache.pos; returns (logits for the LAST token
+    [B,V] f32, updated cache). Used for both prefill (S>1) and decode (S=1)."""
+    B, S = tokens.shape
+    pos = cache.pos
+    positions = pos + jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def body(carry, lp):
+        x, cache, layer_idx = carry
+        x, cache = _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg)
+        return (x, cache, layer_idx + 1), None
+
+    (x, cache, _), _ = jax.lax.scan(
+        body, (x, cache, jnp.zeros((), jnp.int32)), params["layers"]
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, KVCache(k=cache.k, v=cache.v, pos=pos + S)

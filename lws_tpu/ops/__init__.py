@@ -1,0 +1,6 @@
+"""TPU kernels: pallas flash attention (single-chip hot path) and ring
+attention over a context-parallel mesh axis (long-context). Reference jnp
+implementations back every kernel for CPU testing and GSPMD paths."""
+
+from lws_tpu.ops.attention import flash_attention, reference_attention  # noqa: F401
+from lws_tpu.ops.ring import ring_attention  # noqa: F401

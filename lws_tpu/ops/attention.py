@@ -1,0 +1,154 @@
+"""Flash attention (pallas, TPU): online-softmax tiling so the [S, S] score
+matrix never materializes in HBM — scores live in VMEM tiles feeding the MXU.
+
+Layout: q [B, S, H, D], k/v [B, S, Hkv, D] (GQA: Hkv | H). Grid is
+(B, H, S/block_q); each program streams K/V blocks for its (b, kv-head) with
+f32 accumulators. Causal programs stop at their diagonal block (no wasted
+FLOPs on the upper triangle).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """jnp GQA attention (f32 softmax) — numerics oracle + CPU/GSPMD path."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, S, Hkv, G, D)
+    scores = jnp.einsum("bqkgh,bskh->bkgqs", qg, k).astype(jnp.float32) * D**-0.5
+    if causal:
+        Sk = k.shape[1]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(Sk)[None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, v)
+    return out.reshape(B, S, H, D)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, causal: bool):
+    from jax.experimental import pallas as pl
+
+    block_q = q_ref.shape[2]
+    seq_k = k_ref.shape[2]  # k_ref block is [1, 1, Skv, D]
+    d = q_ref.shape[-1]
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d]
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    n_kb = seq_k // block_k
+    if causal:
+        # Only blocks up to (and including) the diagonal contribute.
+        upper = jax.lax.div(q_start + block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, n_kb)
+    else:
+        upper = n_kb
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)  # [bk, d]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+):
+    """q [B,S,H,D], k/v [B,Skv,Hkv,D] -> [B,S,H,D]. Pads S/Skv to block
+    multiples internally (padded keys are masked out)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    Skv, Hkv = k.shape[1], k.shape[2]
+    G = H // Hkv
+    block_q = min(block_q, max(128, 1 << (S - 1).bit_length()) if S < 128 else block_q)
+    block_q = min(block_q, _round_up(S, 128))
+    block_k = min(block_k, _round_up(Skv, 128))
+
+    s_pad = _round_up(S, block_q)
+    skv_pad = _round_up(Skv, block_k)
+    if s_pad != S:
+        q = jnp.pad(q, ((0, 0), (0, s_pad - S), (0, 0), (0, 0)))
+    if skv_pad != Skv:
+        # Padded keys sit at positions >= Skv; with causal masking every real
+        # query (pos < S <= Skv under self-attention) ignores them. For
+        # non-causal, mask via a huge negative bias trick: zero K works only
+        # with explicit masking, so pad K with zeros and rely on causal; the
+        # non-causal path requires Skv % block_k == 0.
+        if not causal:
+            raise ValueError("non-causal flash requires Skv divisible by block_k")
+        k = jnp.pad(k, ((0, 0), (0, skv_pad - Skv), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, skv_pad - Skv), (0, 0), (0, 0)))
+
+    grid = (B, H, s_pad // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, scale=D**-0.5, causal=causal
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, s_pad, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, skv_pad, D), lambda b, h, i, G=G: (b, h // G, 0, 0)),
+            pl.BlockSpec((1, 1, skv_pad, D), lambda b, h, i, G=G: (b, h // G, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        interpret=interpret,
+        # all inputs indexed as [B, heads, S, D]
+    )(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+    out = out.transpose(0, 2, 1, 3)  # [B, s_pad, H, D]
+    return out[:, :S]
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def attention(q, k, v, causal: bool = True, impl: str = "auto"):
+    """Dispatch: pallas flash on TPU backends, reference elsewhere."""
+    if impl == "reference":
+        return reference_attention(q, k, v, causal)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal)
+    backend = jax.default_backend()
+    if backend in ("tpu", "axon"):
+        return flash_attention(q, k, v, causal)
+    return reference_attention(q, k, v, causal)

@@ -1,0 +1,93 @@
+"""Ring attention: exact causal attention over a context-parallel mesh axis.
+
+Sequence is sharded over `axis` (each rank holds S/n contiguous tokens of
+q/k/v). K/V chunks rotate around the ICI ring via ppermute; each rank folds
+every chunk into its online-softmax accumulators, so memory stays O(S/n) per
+chip and the [S, S] matrix never exists anywhere. This is the long-context
+first-class path (SURVEY §5 "long-context / sequence parallelism": cp is a
+jax Mesh axis within a slice; the orchestration contract already guarantees
+rank order == ICI neighbor order via TPU_WORKER_ID).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_attention(q, k, v, q_offset, k_offset, causal):
+    """Partial (unnormalized) attention of a q chunk against one k/v chunk.
+    Returns (m, l, acc): row max, row sum, weighted values — f32."""
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, Sq, Hkv, G, D).astype(jnp.float32) * D**-0.5
+    scores = jnp.einsum("bqkgh,bskh->bkgqs", qg, k.astype(jnp.float32))
+    if causal:
+        q_pos = q_offset + jnp.arange(Sq)
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+    m = scores.max(axis=-1)  # [B,Hkv,G,Sq]
+    p = jnp.exp(scores - m[..., None])
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bkgqs,bskh->bkgqh", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention_inner(q, k, v, axis_name: str, causal: bool = True):
+    """To be called INSIDE shard_map: q/k/v are this rank's sequence chunks."""
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    chunk = Sq  # equal chunking
+    q_offset = rank * chunk
+
+    m = jnp.full((B, Hkv, G, Sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, Hkv, G, Sq), jnp.float32)
+    acc = jnp.zeros((B, Hkv, G, Sq, D), jnp.float32)
+
+    def step(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        src_rank = (rank - i) % n
+        k_offset = src_rank * chunk
+        cm, cl, cacc = _chunk_attention(q, k_cur, v_cur, q_offset, k_offset, causal)
+        m_new = jnp.maximum(m, cm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(cm - m_new)
+        l_new = l * alpha + cl * beta
+        acc_new = acc * alpha[..., None] + cacc * beta[..., None]
+        # Rotate k/v to the next rank around the ring (ICI neighbor hop).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, acc_new, k_nxt, v_nxt
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m, l, acc, k, v))
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (never in causal self-attn)
+    out = (acc / l[..., None]).astype(q.dtype)  # [B,Hkv,G,Sq,D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, D)
+
+
+def ring_attention(q, k, v, mesh, axis: str = "cp", causal: bool = True):
+    """q [B,S,H,D], k/v [B,S,Hkv,D] fully or seq-sharded; runs the ring over
+    `axis` of `mesh` and returns [B,S,H,D] sharded the same way."""
+    from jax import shard_map
+
+    spec = P(None, axis, None, None)
+    inner = functools.partial(ring_attention_inner, axis_name=axis, causal=causal)
+    fn = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

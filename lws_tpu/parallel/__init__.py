@@ -1,0 +1,12 @@
+"""Compute-plane parallelism: env-contract bootstrap -> jax.distributed,
+mesh construction (dp/pp/tp axes; sp rides tp via sequence sharding, ep rides
+tp via expert sharding), and sharding helpers.
+
+This is the consumer side of the orchestration contract: the pod webhook
+writes LWS_*/TPU_*/JAX_* into containers (SURVEY §3.3); this package turns
+them into an initialized runtime and a device mesh whose axes map onto the
+group topology (group = slice, subgroup = sub-slice stage).
+"""
+
+from lws_tpu.parallel.bootstrap import BootstrapInfo, bootstrap_info_from_env, initialize_from_env  # noqa: F401
+from lws_tpu.parallel.mesh import MeshSpec, build_mesh, mesh_from_bootstrap  # noqa: F401

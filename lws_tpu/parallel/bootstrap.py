@@ -1,0 +1,65 @@
+"""Distributed bootstrap from the injected env contract.
+
+`jax.distributed.initialize()` needs (coordinator, num_processes, process_id);
+the pod webhook already published exactly these as JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID (with LWS_LEADER_ADDRESS / LWS_GROUP_SIZE /
+LWS_WORKER_INDEX as the underlying generic contract, ref
+pkg/utils/pod/pod_utils.go:131-179). The reference leaves this glue to the
+workload (Ray in docs/examples/vllm/TPU/lws.yaml:30-34); here it is one call.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from lws_tpu.api import contract
+
+
+@dataclass(frozen=True)
+class BootstrapInfo:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    subgroup_size: Optional[int] = None
+    subgroup_index: Optional[int] = None
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def bootstrap_info_from_env(env: Optional[dict[str, str]] = None) -> BootstrapInfo:
+    e = os.environ if env is None else env
+    coordinator = e.get(contract.JAX_COORDINATOR_ADDRESS)
+    if coordinator is None:
+        leader = e.get(contract.LWS_LEADER_ADDRESS)
+        coordinator = (
+            f"{leader}:{contract.JAX_COORDINATOR_PORT_DEFAULT}" if leader else "localhost:0"
+        )
+    num = int(e.get(contract.JAX_NUM_PROCESSES, e.get(contract.LWS_GROUP_SIZE, "1")))
+    pid = int(e.get(contract.JAX_PROCESS_ID, e.get(contract.LWS_WORKER_INDEX, "0")))
+    sub_size = e.get(contract.LWS_SUBGROUP_SIZE)
+    sub_index = e.get(contract.LWS_SUBGROUP_INDEX)
+    return BootstrapInfo(
+        coordinator_address=coordinator,
+        num_processes=num,
+        process_id=pid,
+        subgroup_size=int(sub_size) if sub_size is not None else None,
+        subgroup_index=int(sub_index) if sub_index is not None else None,
+    )
+
+
+def initialize_from_env(env: Optional[dict[str, str]] = None) -> BootstrapInfo:
+    """Initialize jax.distributed from the env contract (no-op single-host)."""
+    info = bootstrap_info_from_env(env)
+    if info.is_distributed:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=info.coordinator_address,
+            num_processes=info.num_processes,
+            process_id=info.process_id,
+        )
+    return info

@@ -1,0 +1,70 @@
+"""Device mesh construction: orchestration shape -> jax.sharding.Mesh.
+
+Axis conventions used across models/ops:
+  dp — data parallel (LWS replica-internal batch split)
+  pp — pipeline stages (subgroups map here: subgroup i = stage i, sub-slice
+       exclusive topology keeps each stage on its own ICI island)
+  tp — tensor parallel (within a subgroup / slice; ICI all-reduces)
+Sequence parallelism (sp) shards activations' sequence dim over `tp` between
+blocks; expert parallelism (ep) shards the experts dim over `tp`. Context
+parallelism for ring attention uses a dedicated `cp` axis (see ops.ring).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.tp
+
+    def axis_names(self) -> tuple[str, ...]:
+        return ("dp", "pp", "tp")
+
+
+def auto_meshspec(n_devices: int, prefer_tp: int = 0, pp: int = 1) -> MeshSpec:
+    """Factor n_devices into (dp, pp, tp): tp gets the largest power-of-two
+    up to prefer_tp (or up to n/pp if unset), dp absorbs the rest."""
+    assert n_devices % pp == 0, f"{n_devices} devices not divisible by pp={pp}"
+    rest = n_devices // pp
+    tp = prefer_tp or rest
+    while rest % tp != 0:
+        tp //= 2
+    tp = max(1, tp)
+    return MeshSpec(dp=rest // tp, pp=pp, tp=tp)
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) != spec.size:
+        raise ValueError(f"mesh spec {spec} needs {spec.size} devices, have {len(devs)}")
+    arr = np.array(devs).reshape(spec.dp, spec.pp, spec.tp)
+    return Mesh(arr, spec.axis_names())
+
+
+def mesh_from_bootstrap(info, devices: Optional[Sequence] = None, pp_from_subgroups: bool = True):
+    """Build the group-wide mesh from the bootstrap contract: with subgroups,
+    pp = number of subgroups (sub-slice stages) and tp = chips per subgroup;
+    otherwise tp = all chips of the slice."""
+    import jax
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if pp_from_subgroups and info.subgroup_size and info.num_processes > info.subgroup_size:
+        n_subgroups = info.num_processes // info.subgroup_size
+        if n % n_subgroups == 0:
+            return build_mesh(MeshSpec(dp=1, pp=n_subgroups, tp=n // n_subgroups), devs)
+    return build_mesh(MeshSpec(dp=1, pp=1, tp=n), devs)

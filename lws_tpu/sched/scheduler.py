@@ -40,7 +40,7 @@ class Scheduler:
         if gang_name:
             self._schedule_gang(pod.meta.namespace, gang_name)
         else:
-            nodes = self._nodes(pod.meta.namespace)
+            nodes = self._nodes()
             bound = self._bound_pods(pod.meta.namespace)
             node = self._feasible_node(pod, nodes, bound, extra_assigned={})
             if node is not None:
@@ -63,7 +63,7 @@ class Scheduler:
         min_member = group.spec.min_member
         if not pending:
             return
-        nodes = self._nodes(namespace)
+        nodes = self._nodes()
         bound = self._bound_pods(namespace)
         allowed: Optional[set[str]] = None
         members_chips = sum(p.spec.effective_tpu_chips() for p in members)
@@ -138,8 +138,8 @@ class Scheduler:
         return None
 
     # ---- feasibility -------------------------------------------------------
-    def _nodes(self, namespace: str) -> list[Node]:
-        # Nodes are cluster-scoped hardware: never filter by namespace.
+    def _nodes(self) -> list[Node]:
+        # Nodes are cluster-scoped hardware (api.node.CLUSTER_NAMESPACE).
         return [
             n
             for n in self.store.list("Node")

@@ -8,7 +8,7 @@ NODE_TPU_SLICE_LABEL value, each with chips_per_host chips
 from __future__ import annotations
 
 from lws_tpu.api import contract
-from lws_tpu.api.node import Node, NodeSpec
+from lws_tpu.api.node import CLUSTER_NAMESPACE, Node, NodeSpec
 from lws_tpu.core.store import new_meta
 
 
@@ -25,7 +25,7 @@ def make_slice_nodes(
     topology: str = "4x4",
     chips_per_host: int = 4,
     accelerator: str = "v5e",
-    namespace: str = "default",
+    namespace: str = CLUSTER_NAMESPACE,
 ) -> list[Node]:
     hosts = slice_host_count(topology, chips_per_host)
     nodes = []

@@ -1,0 +1,5 @@
+"""Serving: KV-cache inference engine with a prefill/decode split — the
+workload shape DisaggregatedSet roles orchestrate (prefill slice produces the
+KV cache; decode slice consumes it)."""
+
+from lws_tpu.serving.engine import Engine, GenerationResult  # noqa: F401

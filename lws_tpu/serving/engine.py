@@ -1,0 +1,122 @@
+"""Inference engine: jitted prefill + decode over a static-shape KV cache.
+
+Prefill is compute-bound (MXU, whole prompt in one pass); decode is
+HBM-bandwidth-bound (every step streams params + cache). The two phases are
+separable — `prefill()` returns the cache that `decode()` consumes, which is
+exactly the KV handoff a DisaggregatedSet prefill/decode deployment performs
+across slices (over DCN, endpoints published by the DS service manager).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lws_tpu.models.llama import KVCache, LlamaConfig, forward_with_cache, init_cache
+
+
+def host_sync(x) -> None:
+    """Force completion via a host transfer — `block_until_ready` is not a
+    reliable fence on relay-backed remote TPU backends."""
+    np.asarray(x)
+
+
+@dataclass
+class GenerationResult:
+    tokens: jax.Array  # [B, steps]
+    ttft_s: float
+    decode_s: float
+    decode_steps: int
+    decode_tokens_per_s: float
+
+
+class Engine:
+    def __init__(self, cfg: LlamaConfig, params: dict, batch_size: int = 1, max_len: int = 2048):
+        self.cfg = cfg
+        self.params = params
+        self.batch_size = batch_size
+        self.max_len = max_len
+
+        cfg_static = cfg
+
+        @jax.jit
+        def _prefill(params, tokens, cache):
+            logits, cache = forward_with_cache(params, tokens, cache, cfg_static)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def _decode(params, tokens, cache):
+            logits, cache = forward_with_cache(params, tokens[:, None], cache, cfg_static)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        @partial(jax.jit, donate_argnums=(2,), static_argnums=(3,))
+        def _decode_n(params, tokens, cache, n):
+            # Whole decode loop on-device: one dispatch for n steps (no
+            # per-step host round trips — critical on relay-backed links).
+            def body(carry, _):
+                token, cache = carry
+                logits, cache = forward_with_cache(params, token[:, None], cache, cfg_static)
+                token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (token, cache), token
+
+            (token, cache), toks = jax.lax.scan(body, (tokens, cache), None, length=n)
+            return token, cache, toks.swapaxes(0, 1)  # [B, n]
+
+        self._prefill = _prefill
+        self._decode = _decode
+        self._decode_n = _decode_n
+
+    def new_cache(self) -> KVCache:
+        return init_cache(self.cfg, self.batch_size, self.max_len)
+
+    def prefill(self, tokens: jax.Array) -> tuple[jax.Array, KVCache]:
+        """tokens [B, S] -> (first generated token [B], cache)."""
+        return self._prefill(self.params, tokens, self.new_cache())
+
+    def decode(self, tokens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
+        """tokens [B] -> (next token [B], cache)."""
+        return self._decode(self.params, tokens, cache)
+
+    def decode_n(self, tokens: jax.Array, cache: KVCache, n: int):
+        """n chained greedy steps in ONE device call; returns
+        (last token [B], cache, all tokens [B, n])."""
+        return self._decode_n(self.params, tokens, cache, n)
+
+    def generate(self, prompt: jax.Array, max_new_tokens: int) -> GenerationResult:
+        """Greedy generation with timing split (TTFT vs steady decode).
+
+        Decode steps are chained without intermediate syncs (the token feeds
+        the next step), with one host-transfer fence at the end; the timing
+        therefore includes one fixed sync overhead — callers benching on
+        high-latency links should difference two runs (see bench.py)."""
+        t0 = time.perf_counter()
+        token, cache = self.prefill(prompt)
+        host_sync(token)
+        ttft = time.perf_counter() - t0
+
+        out = [token]
+        # Warm the decode path (compile) before timing.
+        token, cache = self.decode(token, cache)
+        out.append(token)
+        host_sync(token)
+
+        t1 = time.perf_counter()
+        steps = max(0, max_new_tokens - 2)
+        for _ in range(steps):
+            token, cache = self.decode(token, cache)
+            out.append(token)
+        host_sync(token)
+        dt = time.perf_counter() - t1
+        tok_per_s = (steps * self.batch_size) / dt if steps else 0.0
+        return GenerationResult(
+            tokens=jnp.stack(out, axis=1),
+            ttft_s=ttft,
+            decode_s=dt,
+            decode_steps=steps,
+            decode_tokens_per_s=tok_per_s,
+        )

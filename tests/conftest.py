@@ -1,13 +1,21 @@
-"""Test harness: force a virtual 8-device CPU platform BEFORE jax initializes.
+"""Test harness: force a virtual 8-device CPU platform.
 
 Compute-plane tests exercise real dp/pp/ep/tp/sp shardings on this virtual
 mesh (the reference proves multi-node logic without real nodes the same way —
 SURVEY §4.2); bench.py (not run under pytest) uses the real TPU chip.
+
+Note: the axon TPU plugin (when present) overrides `jax_platforms` via
+jax.config at registration, so the env var alone is not enough — we must
+update the config after importing jax, before any backend use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

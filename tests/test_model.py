@@ -1,0 +1,106 @@
+"""Compute-plane tests on the virtual 8-device CPU mesh: forward shapes,
+training convergence, dense + MoE, and the full dp/pp/tp/sp/ep sharded step."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lws_tpu.models import LlamaConfig, forward, init_params
+from lws_tpu.models.train import init_train_state, make_optimizer, make_train_step
+from lws_tpu.parallel import MeshSpec, build_mesh
+
+
+def tiny_cfg(**kw):
+    defaults = dict(
+        vocab_size=128,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=64,
+        remat=False,
+    )
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+def test_forward_shapes_single_device():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits, aux = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert jnp.isfinite(logits).all()
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    t1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    t2 = t1.at[0, -1].set(99)
+    l1, _ = forward(params, t1, cfg)
+    l2, _ = forward(params, t2, cfg)
+    assert jnp.allclose(l1[0, :-1], l2[0, :-1], atol=1e-5)
+    assert not jnp.allclose(l1[0, -1], l2[0, -1], atol=1e-5)
+
+
+@pytest.mark.parametrize("moe", [False, True])
+def test_train_step_full_mesh(moe):
+    """The flagship training step with all five strategies live: dp=2, pp=2,
+    tp=2 (sp rides tp on activations; ep rides tp on experts when moe)."""
+    cfg = tiny_cfg(n_experts=4 if moe else 0, top_k=2)
+    mesh = build_mesh(MeshSpec(dp=2, pp=2, tp=2))
+    opt = make_optimizer(lr=1e-2)
+    state = init_train_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    batch = {
+        "tokens": jax.random.randint(jax.random.key(1), (4, 17), 0, cfg.vocab_size).astype(jnp.int32)
+    }
+    params, opt_state, loss0, m0 = step(state.params, state.opt_state, batch)
+    losses = [float(loss0)]
+    for _ in range(5):
+        params, opt_state, loss, _ = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+    assert all(jnp.isfinite(jnp.asarray(losses)))
+
+
+def test_params_actually_sharded():
+    cfg = tiny_cfg()
+    mesh = build_mesh(MeshSpec(dp=2, pp=2, tp=2))
+    opt = make_optimizer()
+    state = init_train_state(cfg, mesh, opt)
+    wq = state.params["layers"]["wq"]  # [L, d, nh*hd] sharded (pp, -, tp)
+    assert len(wq.sharding.device_set) == 8 or wq.sharding.is_fully_replicated is False
+    spec = wq.sharding.spec
+    assert spec[0] == "pp" and spec[2] == "tp"
+    # Each device holds 1/(pp*tp) of the tensor.
+    shard = wq.addressable_shards[0].data
+    assert shard.shape == (cfg.n_layers // 2, cfg.d_model, cfg.n_heads * cfg.head_dim // 2)
+
+
+def test_mesh_shapes_other_factorizations():
+    cfg = tiny_cfg()
+    mesh = build_mesh(MeshSpec(dp=1, pp=1, tp=8))
+    opt = make_optimizer()
+    state = init_train_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    batch = {"tokens": jnp.zeros((2, 9), jnp.int32)}
+    _, _, loss, _ = step(state.params, state.opt_state, batch)
+    assert jnp.isfinite(loss)
+
+
+def test_graft_entry_contract():
+    import importlib.util, pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location("graft_entry", root / "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out.ndim == 3
+    mod.dryrun_multichip(8)

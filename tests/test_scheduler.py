@@ -20,7 +20,7 @@ def make_cp_with_slices(n_slices=2, topology="2x4", **kw):
 def node_slice(cp, pod_name):
     pod = cp.store.get("Pod", "default", pod_name)
     assert pod.spec.node_name, f"{pod_name} not scheduled"
-    node = cp.store.get("Node", "default", pod.spec.node_name)
+    node = cp.store.get("Node", "_cluster", pod.spec.node_name)
     return node.meta.labels[contract.NODE_TPU_SLICE_LABEL]
 
 

@@ -1,0 +1,65 @@
+"""Serving engine correctness: cached decode must reproduce teacher-forced
+full-forward greedy decoding exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lws_tpu.models import forward, init_params
+from lws_tpu.models.llama import LlamaConfig
+from lws_tpu.serving import Engine
+
+
+def tiny_cfg():
+    return LlamaConfig(
+        vocab_size=101,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=64,
+        dtype=jnp.float32,  # exact comparison
+        remat=False,
+    )
+
+
+def test_cached_decode_matches_full_forward():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, batch_size=2, max_len=32)
+    prompt = jax.random.randint(jax.random.key(1), (2, 7), 0, cfg.vocab_size).astype(jnp.int32)
+
+    result = engine.generate(prompt, max_new_tokens=8)
+    generated = np.asarray(result.tokens)
+
+    # Oracle: greedy via full recompute each step.
+    seq = prompt
+    expected = []
+    for _ in range(8):
+        logits, _ = forward(params, seq, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        expected.append(nxt)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    expected = np.asarray(jnp.stack(expected, axis=1))
+
+    np.testing.assert_array_equal(generated, expected)
+
+
+def test_prefill_decode_handoff():
+    """The cache returned by prefill is a self-contained pytree — the
+    disaggregated handoff unit between prefill and decode roles."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    prefill_engine = Engine(cfg, params, batch_size=1, max_len=32)
+    decode_engine = Engine(cfg, params, batch_size=1, max_len=32)
+
+    prompt = jnp.array([[5, 9, 2, 11]], jnp.int32)
+    token, cache = prefill_engine.prefill(prompt)
+    # Simulate the cross-slice transfer: round-trip through host memory.
+    cache_host = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), cache)
+    token2, _ = decode_engine.decode(token, cache_host)
+
+    # Same result decoding on the original engine.
+    token3, _ = prefill_engine.decode(token, cache)
+    np.testing.assert_array_equal(np.asarray(token2), np.asarray(token3))
