@@ -30,18 +30,20 @@ HBM_BYTES_PER_S = {
 def detect_generation() -> str:
     import os
 
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return "cpu"
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
     if gen:
         return gen
-    import jax
-
     kind = jax.devices()[0].device_kind.lower()
-    for g in ("v5p", "v5e", "v4"):
-        if g in kind or g.replace("v", "v5 lite") in kind:
-            return g
-    if "lite" in kind:
+    if "lite" in kind or "v5e" in kind:
         return "v5e"
-    return "cpu" if jax.default_backend() == "cpu" else "v5e"
+    for g in ("v5p", "v4"):
+        if g in kind:
+            return g
+    return "v5e"
 
 
 def main() -> None:

@@ -1,0 +1,3 @@
+from lws_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,212 @@
+"""CLI (≈ cmd/main.go entry point + hack/plan-steps dev tool).
+
+  python -m lws_tpu serve  --config cfg.yaml [-f manifests.yaml ...]
+  python -m lws_tpu apply  -f manifests.yaml [--server HOST:PORT]
+  python -m lws_tpu get    KIND [NAME] [--server HOST:PORT] [-o yaml]
+  python -m lws_tpu delete KIND NAMESPACE NAME [--server HOST:PORT]
+  python -m lws_tpu scale  NAME REPLICAS [--server HOST:PORT]
+  python -m lws_tpu plan-steps --initial 4,4 --target 4,4 [--surge 1,1] [--unavailable 0,0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def _http(server: str, method: str, path: str, body: bytes | None = None):
+    req = urllib.request.Request(f"http://{server}{path}", data=body, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode()
+        try:
+            detail = json.loads(detail).get("error", detail)
+        except (ValueError, AttributeError):
+            pass
+        raise SystemExit(f"error: {e.code}: {detail}") from None
+    except urllib.error.URLError as e:
+        raise SystemExit(f"error: cannot reach server {server}: {e.reason}") from None
+
+
+def cmd_serve(args) -> int:
+    from lws_tpu.config import Configuration, load_configuration
+    from lws_tpu.manifest import load_manifests
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+
+    cfg = load_configuration(args.config) if args.config else Configuration()
+    cp = ControlPlane(
+        scheduler_provider=cfg.gang_scheduling_management.scheduler_provider,
+        enable_scheduler=cfg.enable_scheduler,
+        auto_ready=(cfg.backend == "fake"),
+    )
+    if cfg.backend == "local":
+        import threading
+
+        from lws_tpu.runtime.local import LocalBackend
+
+        backend = LocalBackend(cp.store)
+        cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+
+        def _poll_exits():
+            # Process exits are not store events; poll them into pod status.
+            while True:
+                time.sleep(2.0)
+                try:
+                    backend.poll_all()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=_poll_exits, daemon=True).start()
+
+    for path in args.filename or []:
+        for obj in load_manifests(path):
+            cp.store.create(obj)
+            print(f"created {obj.kind}/{obj.meta.name}")
+
+    server = ApiServer(cp, port=args.port)
+    server.start()
+    cp.manager.start()
+    print(f"lws-tpu control plane serving on http://127.0.0.1:{server.port} "
+          f"(backend={cfg.backend}, scheduler={cfg.enable_scheduler})")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        cp.manager.stop()
+        server.stop()
+    return 0
+
+
+def cmd_apply(args) -> int:
+    with open(args.filename) as f:
+        body = f.read().encode()
+    out = _http(args.server, "POST", "/apply", body)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_get(args) -> int:
+    if args.name:
+        out = _http(args.server, "GET", f"/apis/{args.kind}/{args.namespace}/{args.name}")
+        if args.output == "yaml":
+            import yaml
+
+            print(yaml.safe_dump(out, sort_keys=False))
+        else:
+            print(json.dumps(out, indent=1))
+        return 0
+    objs = _http(args.server, "GET", f"/apis/{args.kind}")
+    for o in objs:
+        status = o.get("status") or {}
+        if "ready_replicas" in status:
+            detail = f"ready={status['ready_replicas']}"
+        elif "phase" in status:
+            detail = f"phase={status['phase']}\tready={status.get('ready')}"
+        else:
+            detail = ""
+        print(f"{o['metadata']['namespace']}/{o['metadata']['name']}\t{detail}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    print(json.dumps(_http(args.server, "DELETE", f"/apis/{args.kind}/{args.namespace}/{args.name}")))
+    return 0
+
+
+def cmd_scale(args) -> int:
+    body = json.dumps({"replicas": args.replicas}).encode()
+    print(json.dumps(_http(args.server, "POST", f"/scale/{args.namespace}/{args.name}", body)))
+    return 0
+
+
+def cmd_plan_steps(args) -> int:
+    """≈ hack/plan-steps/main.go: print the DS rollout step table."""
+    from lws_tpu.controllers.disagg.planner import (
+        ComputeAllSteps,
+        RollingUpdateConfig,
+        default_rolling_update_config,
+    )
+
+    initial = [int(x) for x in args.initial.split(",")]
+    target = [int(x) for x in args.target.split(",")]
+    if len(initial) != len(target):
+        print("initial and target must have the same number of roles", file=sys.stderr)
+        return 1
+    config = default_rolling_update_config(len(initial))
+    if args.surge:
+        for i, s in enumerate(args.surge.split(",")):
+            config[i] = RollingUpdateConfig(max_surge=int(s), max_unavailable=config[i].max_unavailable)
+    if args.unavailable:
+        for i, u in enumerate(args.unavailable.split(",")):
+            config[i] = RollingUpdateConfig(max_surge=config[i].max_surge, max_unavailable=int(u))
+    steps = ComputeAllSteps(initial, target, config)
+    width = max(len(str(target)), len(str(initial)))
+    print(f"{'step':>4}  {'old':>{width}}  {'new':>{width}}")
+    for i, s in enumerate(steps):
+        print(f"{i:>4}  {str(s.past):>{width}}  {str(s.new):>{width}}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="lws-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("serve", help="run the control plane + API server")
+    sp.add_argument("--config", default=None)
+    sp.add_argument("-f", "--filename", action="append")
+    sp.add_argument("--port", type=int, default=9443)
+    sp.set_defaults(fn=cmd_serve)
+
+    ap = sub.add_parser("apply")
+    ap.add_argument("-f", "--filename", required=True)
+    ap.add_argument("--server", default="127.0.0.1:9443")
+    ap.set_defaults(fn=cmd_apply)
+
+    gp = sub.add_parser("get")
+    gp.add_argument("kind")
+    gp.add_argument("name", nargs="?")
+    gp.add_argument("--namespace", "-n", default="default")
+    gp.add_argument("--server", default="127.0.0.1:9443")
+    gp.add_argument("-o", "--output", default="json")
+    gp.set_defaults(fn=cmd_get)
+
+    dp = sub.add_parser("delete")
+    dp.add_argument("kind")
+    dp.add_argument("namespace")
+    dp.add_argument("name")
+    dp.add_argument("--server", default="127.0.0.1:9443")
+    dp.set_defaults(fn=cmd_delete)
+
+    scp = sub.add_parser("scale")
+    scp.add_argument("name")
+    scp.add_argument("replicas", type=int)
+    scp.add_argument("--namespace", "-n", default="default")
+    scp.add_argument("--server", default="127.0.0.1:9443")
+    scp.set_defaults(fn=cmd_scale)
+
+    pp = sub.add_parser("plan-steps", help="print a DisaggregatedSet rollout step table")
+    pp.add_argument("--initial", required=True)
+    pp.add_argument("--target", required=True)
+    pp.add_argument("--surge", default="")
+    pp.add_argument("--unavailable", default="")
+    pp.set_defaults(fn=cmd_plan_steps)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # Piped into head/less that exited: not an error.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

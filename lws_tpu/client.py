@@ -1,0 +1,68 @@
+"""Typed client (≈ client-go generated clientset, SURVEY §2.9): convenience
+API over a Store/ControlPlane for external programs and tests."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from lws_tpu.api import contract
+from lws_tpu.api.disagg import DisaggregatedSet
+from lws_tpu.api.pod import Pod
+from lws_tpu.api.types import LeaderWorkerSet
+from lws_tpu.core.store import Store
+
+
+class Client:
+    def __init__(self, store: Store, namespace: str = "default") -> None:
+        self.store = store
+        self.namespace = namespace
+
+    # ---- LeaderWorkerSet ----------------------------------------------
+    def create_lws(self, lws: LeaderWorkerSet) -> LeaderWorkerSet:
+        return self.store.create(lws)  # type: ignore[return-value]
+
+    def get_lws(self, name: str) -> Optional[LeaderWorkerSet]:
+        return self.store.try_get("LeaderWorkerSet", self.namespace, name)  # type: ignore[return-value]
+
+    def list_lws(self) -> list[LeaderWorkerSet]:
+        return self.store.list("LeaderWorkerSet", self.namespace)  # type: ignore[return-value]
+
+    def update_lws(self, lws: LeaderWorkerSet) -> LeaderWorkerSet:
+        return self.store.update(lws)  # type: ignore[return-value]
+
+    def delete_lws(self, name: str) -> None:
+        self.store.delete("LeaderWorkerSet", self.namespace, name)
+
+    def scale_lws(self, name: str, replicas: int) -> LeaderWorkerSet:
+        """The scale subresource (≈ leaderworkerset_types.go:416): what an
+        HPA-equivalent autoscaler drives, selecting leader pods via
+        status.hpa_pod_selector."""
+        lws = self.store.get("LeaderWorkerSet", self.namespace, name)
+        lws.spec.replicas = replicas
+        return self.store.update(lws)  # type: ignore[return-value]
+
+    # ---- DisaggregatedSet ---------------------------------------------
+    def create_ds(self, ds: DisaggregatedSet) -> DisaggregatedSet:
+        return self.store.create(ds)  # type: ignore[return-value]
+
+    def get_ds(self, name: str) -> Optional[DisaggregatedSet]:
+        return self.store.try_get("DisaggregatedSet", self.namespace, name)  # type: ignore[return-value]
+
+    def update_ds(self, ds: DisaggregatedSet) -> DisaggregatedSet:
+        return self.store.update(ds)  # type: ignore[return-value]
+
+    def delete_ds(self, name: str) -> None:
+        self.store.delete("DisaggregatedSet", self.namespace, name)
+
+    # ---- pods / observation -------------------------------------------
+    def pods_of(self, lws_name: str) -> list[Pod]:
+        return self.store.list(  # type: ignore[return-value]
+            "Pod", self.namespace, labels={contract.SET_NAME_LABEL_KEY: lws_name}
+        )
+
+    def leader_pods_of(self, lws_name: str) -> list[Pod]:
+        return self.store.list(  # type: ignore[return-value]
+            "Pod",
+            self.namespace,
+            labels={contract.SET_NAME_LABEL_KEY: lws_name, contract.WORKER_INDEX_LABEL_KEY: "0"},
+        )

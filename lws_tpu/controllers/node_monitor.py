@@ -1,0 +1,44 @@
+"""Node failure detection: the TPU-preemption analog of pod-level failure.
+
+When a node goes NotReady (slice preempted/maintenance), every pod bound to
+it is marked Failed — which trips the group's all-or-nothing restart policy
+(SURVEY §3.5) so the whole group reschedules onto healthy capacity. The
+reference relies on the kubelet/node-lifecycle controller for this; here it
+is first-class.
+"""
+
+from __future__ import annotations
+
+from lws_tpu.api.node import Node
+from lws_tpu.api.pod import PodPhase
+from lws_tpu.core.events import EventRecorder
+from lws_tpu.core.manager import Result
+from lws_tpu.core.store import Key, Store
+
+
+class NodeMonitor:
+    name = "node-monitor"
+
+    def __init__(self, store: Store, recorder: EventRecorder) -> None:
+        self.store = store
+        self.recorder = recorder
+
+    def reconcile(self, key: Key) -> Result | None:
+        node = self.store.try_get("Node", key[1], key[2])
+        if node is None or not isinstance(node, Node):
+            return None
+        if node.status.ready:
+            return None
+        for pod in self.store.list("Pod"):
+            if pod.spec.node_name != node.meta.name:
+                continue
+            if pod.status.phase == PodPhase.FAILED:
+                continue
+            pod.status.phase = PodPhase.FAILED
+            pod.status.ready = False
+            pod.status.message = f"node {node.meta.name} not ready"
+            self.store.update_status(pod)
+            self.recorder.event(
+                pod, "Warning", "NodeFailure", f"node {node.meta.name} went NotReady"
+            )
+        return None

@@ -64,12 +64,31 @@ class _Registration:
 
 
 class Manager:
-    def __init__(self, store: Store) -> None:
+    def __init__(self, store: Store, metrics=None) -> None:
         self.store = store
+        self.metrics = metrics
         self._registrations: list[_Registration] = []
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         store.watch(self._on_event)
+
+    def _timed_reconcile(self, reg: _Registration, key: Key):
+        if self.metrics is None:
+            return reg.reconciler.reconcile(key)
+        labels = {"controller": reg.reconciler.name}
+        start = time.perf_counter()
+        try:
+            result = reg.reconciler.reconcile(key)
+        except ConflictError:
+            # Benign optimistic-concurrency loss: requeued, not an error.
+            raise
+        except Exception:
+            self.metrics.inc("lws_reconcile_errors_total", labels)
+            raise
+        finally:
+            self.metrics.inc("lws_reconcile_total", labels)
+            self.metrics.observe("lws_reconcile_duration_seconds", time.perf_counter() - start, labels)
+        return result
 
     def register(self, reconciler: Reconciler, watches: dict[str, MapFn]) -> None:
         self._registrations.append(_Registration(reconciler, watches))
@@ -101,7 +120,7 @@ class Manager:
                 progressed = True
                 processed += 1
                 try:
-                    result = reg.reconciler.reconcile(key)
+                    result = self._timed_reconcile(reg, key)
                 except ConflictError:
                     reg.enqueue(key)
                     continue
@@ -125,7 +144,7 @@ class Manager:
                     time.sleep(poll_interval)
                     continue
                 try:
-                    result = reg.reconciler.reconcile(key)
+                    result = self._timed_reconcile(reg, key)
                 except ConflictError:
                     reg.enqueue(key)
                     continue

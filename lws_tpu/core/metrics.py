@@ -1,0 +1,82 @@
+"""Minimal metrics registry with Prometheus text exposition
+(≈ controller-runtime's metrics server; SURVEY §5 adds reconcile latency
+metrics as the one custom signal worth having)."""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Histogram:
+    buckets: tuple = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+    counts: list = field(default_factory=list)
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self):
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, v: float) -> None:
+        self.total += v
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
+        self._histograms: dict[tuple[str, tuple], _Histogram] = {}
+
+    def inc(self, name: str, labels: dict[str, str] | None = None, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[(name, _lk(labels))] += value
+
+    def observe(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
+        with self._lock:
+            key = (name, _lk(labels))
+            if key not in self._histograms:
+                self._histograms[key] = _Histogram()
+            self._histograms[key].observe(value)
+
+    def counter_value(self, name: str, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            return self._counters.get((name, _lk(labels)), 0.0)
+
+    def render(self) -> str:
+        """Prometheus text format."""
+        lines = []
+        with self._lock:
+            for (name, labels), value in sorted(self._counters.items()):
+                lines.append(f"{name}{_fmt(labels)} {value}")
+            for (name, labels), h in sorted(self._histograms.items()):
+                cum = 0
+                for b, c in zip(h.buckets, h.counts):
+                    cum += c
+                    lines.append(f'{name}_bucket{_fmt(labels, le=str(b))} {cum}')
+                lines.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {h.n}')
+                lines.append(f"{name}_sum{_fmt(labels)} {h.total}")
+                lines.append(f"{name}_count{_fmt(labels)} {h.n}")
+        return "\n".join(lines) + "\n"
+
+
+def _lk(labels: dict[str, str] | None) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt(labels: tuple, le: str | None = None) -> str:
+    items = list(labels)
+    if le is not None:
+        items.append(("le", le))
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"

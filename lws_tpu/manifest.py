@@ -1,0 +1,211 @@
+"""YAML/dict manifest codec: the user-facing declarative format
+(camelCase, shaped like the reference CRDs so reference users feel at home —
+ref config/samples/leaderworkerset_tpu.yaml, docs/examples/vllm/TPU/lws.yaml).
+
+`from_manifest(dict) -> TypedObject` and `to_manifest(obj) -> dict` cover
+LeaderWorkerSet, DisaggregatedSet, and Node.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from lws_tpu.api.disagg import (
+    DisaggregatedRoleSpec,
+    DisaggregatedSet,
+    DisaggregatedSetSpec,
+    LeaderWorkerSetTemplateSpec,
+    TemplateObjectMeta,
+)
+from lws_tpu.api.meta import ObjectMeta, to_plain
+from lws_tpu.api.node import CLUSTER_NAMESPACE, Node, NodeSpec
+from lws_tpu.api.pod import (
+    Container,
+    EnvVar,
+    PodSpec,
+    PodTemplateSpec,
+    TemplateMeta,
+    VolumeClaimTemplate,
+)
+from lws_tpu.api.types import (
+    LeaderWorkerSet,
+    LeaderWorkerSetSpec,
+    LeaderWorkerTemplate,
+    NetworkConfig,
+    RestartPolicy,
+    RollingUpdateConfiguration,
+    RolloutStrategy,
+    RolloutStrategyType,
+    StartupPolicy,
+    SubdomainPolicy,
+    SubGroupPolicy,
+    SubGroupPolicyType,
+)
+
+API_GROUP = "lws.tpu/v1"
+
+
+def _meta(raw: dict, default_namespace: str = "default") -> ObjectMeta:
+    m = raw.get("metadata", {})
+    return ObjectMeta(
+        name=m.get("name", ""),
+        namespace=m.get("namespace", default_namespace),
+        labels=dict(m.get("labels", {})),
+        annotations=dict(m.get("annotations", {})),
+    )
+
+
+def _container(raw: dict) -> Container:
+    return Container(
+        name=raw.get("name", "main"),
+        image=raw.get("image", ""),
+        command=list(raw.get("command", [])),
+        env=[EnvVar(e["name"], str(e.get("value", ""))) for e in raw.get("env", [])],
+        resources={k: int(v) for k, v in (raw.get("resources", {}) or {}).items()},
+        ports={k: int(v) for k, v in (raw.get("ports", {}) or {}).items()},
+    )
+
+
+def _pod_template(raw: Optional[dict]) -> PodTemplateSpec:
+    raw = raw or {}
+    meta = raw.get("metadata", {})
+    spec = raw.get("spec", {})
+    return PodTemplateSpec(
+        metadata=TemplateMeta(
+            labels=dict(meta.get("labels", {})),
+            annotations=dict(meta.get("annotations", {})),
+        ),
+        spec=PodSpec(
+            containers=[_container(c) for c in spec.get("containers", [{}])],
+            init_containers=[_container(c) for c in spec.get("initContainers", [])],
+            node_selector=dict(spec.get("nodeSelector", {})),
+        ),
+    )
+
+
+def _vcts(raw: list) -> list[VolumeClaimTemplate]:
+    return [
+        VolumeClaimTemplate(
+            name=v["name"],
+            storage=str(v.get("storage", "")),
+            storage_class=v.get("storageClass", ""),
+            access_modes=list(v.get("accessModes", ["ReadWriteOnce"])),
+        )
+        for v in raw
+    ]
+
+
+def _lws_spec(raw: dict) -> LeaderWorkerSetSpec:
+    lwt_raw = raw.get("leaderWorkerTemplate", {})
+    lwt = LeaderWorkerTemplate(
+        worker_template=_pod_template(lwt_raw.get("workerTemplate")),
+        leader_template=(
+            _pod_template(lwt_raw["leaderTemplate"]) if lwt_raw.get("leaderTemplate") else None
+        ),
+        size=int(lwt_raw.get("size", 1)),
+        restart_policy=RestartPolicy(lwt_raw.get("restartPolicy", "RecreateGroupOnPodRestart")),
+        volume_claim_templates=_vcts(lwt_raw.get("volumeClaimTemplates", [])),
+    )
+    sgp = lwt_raw.get("subGroupPolicy")
+    if sgp:
+        lwt.sub_group_policy = SubGroupPolicy(
+            type=SubGroupPolicyType(sgp["subGroupPolicyType"]) if sgp.get("subGroupPolicyType") else None,
+            sub_group_size=int(sgp["subGroupSize"]) if sgp.get("subGroupSize") is not None else None,
+        )
+    pvc_pol = lwt_raw.get("persistentVolumeClaimRetentionPolicy")
+    if pvc_pol:
+        lwt.pvc_retention_policy_when_deleted = pvc_pol.get("whenDeleted", "Retain")
+        lwt.pvc_retention_policy_when_scaled = pvc_pol.get("whenScaled", "Retain")
+
+    spec = LeaderWorkerSetSpec(
+        replicas=int(raw.get("replicas", 1)),
+        leader_worker_template=lwt,
+        startup_policy=StartupPolicy(raw.get("startupPolicy", "LeaderCreated")),
+    )
+    rs = raw.get("rolloutStrategy")
+    if rs:
+        ruc = rs.get("rollingUpdateConfiguration")
+        spec.rollout_strategy = RolloutStrategy(
+            type=RolloutStrategyType(rs.get("type", "RollingUpdate")),
+            rolling_update_configuration=RollingUpdateConfiguration(
+                partition=int(ruc.get("partition", 0)),
+                max_unavailable=_int_or_percent(ruc.get("maxUnavailable", 1)),
+                max_surge=_int_or_percent(ruc.get("maxSurge", 0)),
+            )
+            if ruc
+            else None,
+        )
+    nc = raw.get("networkConfig")
+    if nc:
+        spec.network_config = NetworkConfig(
+            subdomain_policy=SubdomainPolicy(nc["subdomainPolicy"]) if nc.get("subdomainPolicy") else None
+        )
+    return spec
+
+
+def _int_or_percent(v):
+    if isinstance(v, str) and not v.endswith("%"):
+        return int(v)
+    return v
+
+
+def from_manifest(raw: dict):
+    kind = raw.get("kind")
+    if kind == "LeaderWorkerSet":
+        return LeaderWorkerSet(meta=_meta(raw), spec=_lws_spec(raw.get("spec", {})))
+    if kind == "DisaggregatedSet":
+        roles = []
+        for r in raw.get("spec", {}).get("roles", []):
+            tmpl = r.get("template", {})
+            roles.append(
+                DisaggregatedRoleSpec(
+                    name=r["name"],
+                    replicas=int(r.get("replicas", 1)),
+                    template=LeaderWorkerSetTemplateSpec(
+                        metadata=TemplateObjectMeta(
+                            labels=dict(tmpl.get("metadata", {}).get("labels", {})),
+                            annotations=dict(tmpl.get("metadata", {}).get("annotations", {})),
+                        ),
+                        spec=_lws_spec(tmpl.get("spec", {})),
+                    ),
+                )
+            )
+        return DisaggregatedSet(meta=_meta(raw), spec=DisaggregatedSetSpec(roles=roles))
+    if kind == "Node":
+        spec = raw.get("spec", {})
+        return Node(
+            meta=_meta(raw, default_namespace=CLUSTER_NAMESPACE),
+            spec=NodeSpec(capacity={k: int(v) for k, v in spec.get("capacity", {}).items()}),
+        )
+    raise ValueError(f"unsupported manifest kind {kind!r}")
+
+
+def load_manifests(path: str) -> list:
+    """Load one or more `---`-separated YAML documents."""
+    import yaml
+
+    with open(path) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    return [from_manifest(d) for d in docs]
+
+
+def to_manifest(obj) -> dict:
+    """Plain-dict view of any stored object (for `get -o yaml` / API)."""
+    out: dict[str, Any] = {
+        "apiVersion": API_GROUP,
+        "kind": obj.kind,
+        "metadata": {
+            "name": obj.meta.name,
+            "namespace": obj.meta.namespace,
+            "uid": obj.meta.uid,
+            "resourceVersion": obj.meta.resource_version,
+            "generation": obj.meta.generation,
+            "labels": dict(obj.meta.labels),
+            "annotations": dict(obj.meta.annotations),
+        },
+        "spec": to_plain(getattr(obj, "spec", None)),
+    }
+    status = getattr(obj, "status", None)
+    if status is not None:
+        out["status"] = to_plain(status)
+    return out

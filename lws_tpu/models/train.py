@@ -35,24 +35,45 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> optax.Gradien
 
 
 def state_shardings(cfg: LlamaConfig, mesh, optimizer) -> TrainState:
-    """Sharding tree for TrainState: opt state mirrors param layout."""
+    """Sharding tree for TrainState: opt state mirrors the param layout.
+
+    Optimizer moment trees embed the param tree (adam's mu/nu have paths like
+    (0, mu, layers, wq)), so each opt leaf is matched to a param spec by the
+    longest path *suffix* — structural, immune to shape collisions like
+    embed [v,d] vs lm_head [d,v] when v == d. Unmatched leaves replicate.
+    """
+    from jax.tree_util import tree_flatten_with_path
+
     pspecs = param_shardings(cfg)
     params_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs)
     sample_params = jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
     opt_shape = jax.eval_shape(optimizer.init, sample_params)
 
-    def opt_leaf_sharding(leaf):
-        # Moment tensors share the param layout; scalars replicate.
-        spec_by_shape = {}
+    def key_str(k):
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                return str(getattr(k, attr))
+        return str(k)
 
-        def visit(path_spec, p_leaf):
-            spec_by_shape.setdefault(p_leaf.shape, path_spec)
+    param_paths, _ = tree_flatten_with_path(sample_params)
+    spec_leaves, _ = jax.tree.flatten(pspecs)
+    path_to_spec = {
+        tuple(key_str(k) for k in path): spec
+        for (path, _), spec in zip(param_paths, spec_leaves)
+    }
 
-        jax.tree.map(visit, pspecs, sample_params)
-        spec = spec_by_shape.get(leaf.shape, P())
-        return NamedSharding(mesh, spec)
-
-    opt_sh = jax.tree.map(opt_leaf_sharding, opt_shape)
+    opt_leaves, opt_treedef = tree_flatten_with_path(opt_shape)
+    opt_sh_leaves = []
+    for path, leaf in opt_leaves:
+        keys = tuple(key_str(k) for k in path)
+        spec = P()
+        for i in range(len(keys)):
+            candidate = path_to_spec.get(keys[i:])
+            if candidate is not None:
+                spec = candidate
+                break
+        opt_sh_leaves.append(NamedSharding(mesh, spec))
+    opt_sh = jax.tree.unflatten(opt_treedef, opt_sh_leaves)
     return TrainState(
         step=NamedSharding(mesh, P()),  # type: ignore[arg-type]
         params=params_sh,

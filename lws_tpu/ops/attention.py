@@ -98,7 +98,6 @@ def flash_attention(
     B, S, H, D = q.shape
     Skv, Hkv = k.shape[1], k.shape[2]
     G = H // Hkv
-    block_q = min(block_q, max(128, 1 << (S - 1).bit_length()) if S < 128 else block_q)
     block_q = min(block_q, _round_up(S, 128))
     block_k = min(block_k, _round_up(Skv, 128))
 

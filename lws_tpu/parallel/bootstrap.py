@@ -54,9 +54,20 @@ def bootstrap_info_from_env(env: Optional[dict[str, str]] = None) -> BootstrapIn
 def initialize_from_env(env: Optional[dict[str, str]] = None) -> BootstrapInfo:
     """Initialize jax.distributed from the env contract (no-op single-host)."""
     info = bootstrap_info_from_env(env)
-    if info.is_distributed:
-        import jax
+    import jax
 
+    # Honor an explicit JAX_PLATFORMS from the pod env even when a site-wide
+    # accelerator plugin overrode platform selection via jax.config at
+    # interpreter start (observed with relay-backed TPU plugins): the env
+    # contract must win inside workers.
+    platforms = (os.environ if env is None else env).get("JAX_PLATFORMS")
+    if platforms:
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except Exception:  # noqa: BLE001 — best effort; backend may be fixed
+            pass
+
+    if info.is_distributed:
         jax.distributed.initialize(
             coordinator_address=info.coordinator_address,
             num_processes=info.num_processes,

@@ -59,16 +59,22 @@ class ControlPlane:
         enable_scheduler: bool = False,
         auto_ready: bool = False,
         require_binding: bool = False,
+        store: Optional[Store] = None,
     ) -> None:
-        self.store = Store()
+        from lws_tpu.core.metrics import MetricsRegistry
+
+        # A pre-existing store = controller restart over live state; call
+        # resync() after construction.
+        self.store = store if store is not None else Store()
         self.recorder = EventRecorder()
+        self.metrics = MetricsRegistry()
 
         provider = make_scheduler_provider(scheduler_provider, self.store)
         register_lws_webhooks(self.store)
         register_pod_webhooks(self.store, provider)
         register_ds_webhooks(self.store)
 
-        self.manager = Manager(self.store)
+        self.manager = Manager(self.store, metrics=self.metrics)
         store = self.store
 
         def lws_key_by_label(obj) -> list[Key]:
@@ -161,6 +167,10 @@ class ControlPlane:
                     "PodGroup": unbound_pods,
                 },
             )
+            from lws_tpu.controllers.node_monitor import NodeMonitor
+
+            self.node_monitor = NodeMonitor(self.store, self.recorder)
+            self.manager.register(self.node_monitor, {"Node": lambda o: [o.key()]})
 
         if auto_ready:
             self.kubelet = FakeKubelet(self.store, require_binding=require_binding)
@@ -169,6 +179,25 @@ class ControlPlane:
     # ------------------------------------------------------------------
     def run_until_stable(self, max_iterations: int = 10000) -> int:
         return self.manager.run_until_stable(max_iterations)
+
+    def resync(self) -> None:
+        """Cold-start cache resync: enqueue every stored object to every
+        watching controller — required when standing up a fresh control plane
+        over pre-existing state (level-triggered restart semantics)."""
+        from lws_tpu.core.store import WatchEvent
+
+        for kind in (
+            "DisaggregatedSet",
+            "LeaderWorkerSet",
+            "GroupSet",
+            "Pod",
+            "Service",
+            "Node",
+            "PodGroup",
+            "ControllerRevision",
+        ):
+            for obj in self.store.list(kind):
+                self.manager._on_event(WatchEvent("MODIFIED", obj))
 
     def add_nodes(self, nodes: list[Node]) -> None:
         for node in nodes:

@@ -1,0 +1,131 @@
+"""LocalBackend: runs pods as real local processes.
+
+The "kubelet" of single-host deployments and e2e tests: when a pod appears it
+spawns the container's command with the pod's injected env (the full
+LWS_*/TPU_*/JAX_* bootstrap contract), marks the pod Running+ready, tracks the
+process, and reports exits back into pod status — a Failed exit increments
+container_restarts, which is exactly what trips the all-or-nothing restart
+policy (SURVEY §3.5) for real workloads.
+
+FQDN rewriting: rendezvous names like `<leader>.<subdomain>.<ns>` resolve via
+cluster DNS in a fleet; locally every pod is on this host, so values of
+address-bearing env vars get their host part rewritten to 127.0.0.1.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from lws_tpu.api import contract
+from lws_tpu.api.pod import Pod, PodPhase
+from lws_tpu.core.manager import Result
+from lws_tpu.core.store import Key, Store
+
+ADDRESS_ENV_VARS = (contract.LWS_LEADER_ADDRESS, contract.JAX_COORDINATOR_ADDRESS)
+
+
+class LocalBackend:
+    name = "local-backend"
+
+    def __init__(
+        self,
+        store: Store,
+        env_overrides: Optional[dict[str, str]] = None,
+        env_drop: tuple[str, ...] = (),
+        default_command: Optional[list[str]] = None,
+    ) -> None:
+        self.store = store
+        self.env_overrides = env_overrides or {}
+        self.env_drop = env_drop
+        self.default_command = default_command or ["sleep", "infinity"]
+        self._procs: dict[str, subprocess.Popen] = {}  # pod uid -> process
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def reconcile(self, key: Key) -> Result | None:
+        pod = self.store.try_get("Pod", key[1], key[2])
+        if pod is None or not isinstance(pod, Pod):
+            self._kill_orphans()
+            return None
+        with self._lock:
+            proc = self._procs.get(pod.meta.uid)
+        if proc is None:
+            if pod.status.phase == PodPhase.PENDING:
+                self._spawn(pod)
+            return None
+        code = proc.poll()
+        if code is None:
+            return None
+        # Process exited: report status (once).
+        if code == 0 and pod.status.phase != PodPhase.SUCCEEDED:
+            pod.status.phase = PodPhase.SUCCEEDED
+            pod.status.ready = False
+            self.store.update_status(pod)
+        elif code != 0 and pod.status.phase != PodPhase.FAILED:
+            pod.status.phase = PodPhase.FAILED
+            pod.status.ready = False
+            pod.status.container_restarts += 1
+            pod.status.message = f"process exited with code {code}"
+            self.store.update_status(pod)
+        return None
+
+    # ------------------------------------------------------------------
+    def _spawn(self, pod: Pod) -> None:
+        container = pod.spec.containers[0]
+        command = container.command or self.default_command
+        env = {k: v for k, v in os.environ.items() if k not in self.env_drop}
+        for e in container.env:
+            value = e.value.replace("$(POD_NAME)", pod.meta.name)  # downward-API-lite
+            if e.name in ADDRESS_ENV_VARS:
+                value = _localize(value)
+            env[e.name] = value
+        env["POD_NAME"] = pod.meta.name
+        env.update(self.env_overrides)
+        try:
+            proc = subprocess.Popen(command, env=env)
+        except OSError as err:
+            pod.status.phase = PodPhase.FAILED
+            pod.status.message = f"spawn failed: {err}"
+            self.store.update_status(pod)
+            return
+        with self._lock:
+            self._procs[pod.meta.uid] = proc
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.ready = True
+        pod.status.address = "127.0.0.1"
+        self.store.update_status(pod)
+
+    def _kill_orphans(self) -> None:
+        """Kill processes whose pods no longer exist (group teardown)."""
+        live_uids = {p.meta.uid for p in self.store.list("Pod")}
+        with self._lock:
+            dead = [uid for uid in self._procs if uid not in live_uids]
+            for uid in dead:
+                proc = self._procs.pop(uid)
+                if proc.poll() is None:
+                    proc.terminate()
+
+    def poll_all(self) -> None:
+        """Re-examine every tracked process (call from a ticker or tests)."""
+        for pod in self.store.list("Pod"):
+            self.reconcile(pod.key())
+        self._kill_orphans()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for proc in self._procs.values():
+                if proc.poll() is None:
+                    proc.terminate()
+            self._procs.clear()
+
+
+def _localize(value: str) -> str:
+    """Rewrite `host[:port]` to `127.0.0.1[:port]`."""
+    if ":" in value:
+        _, port = value.rsplit(":", 1)
+        if port.isdigit():
+            return f"127.0.0.1:{port}"
+    return "127.0.0.1"

@@ -1,0 +1,118 @@
+"""HTTP API server: the remote face of the control plane
+(≈ the apiserver+webhook endpoint + healthz/readyz + metrics of
+cmd/main.go:252-262,336-348 rolled into one in-process server).
+
+Endpoints:
+  GET  /healthz | /readyz             liveness/readiness
+  GET  /metrics                       Prometheus text
+  POST /apply                         YAML/JSON manifest (create-or-update)
+  GET  /apis/{kind}                   list (JSON manifests)
+  GET  /apis/{kind}/{ns}/{name}       get
+  DELETE /apis/{kind}/{ns}/{name}     delete
+  POST /scale/{ns}/{name}             {"replicas": N} on a LeaderWorkerSet
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lws_tpu.core.store import AdmissionError, NotFoundError
+from lws_tpu.manifest import from_manifest, to_manifest
+
+
+class ApiServer:
+    def __init__(self, control_plane, port: int = 9443, host: str = "127.0.0.1") -> None:
+        self.control_plane = control_plane
+        cp = control_plane
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: str, ctype: str = "application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _json(self, code: int, obj):
+                self._send(code, json.dumps(obj, indent=1, default=str))
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("/") if p]
+                if self.path in ("/healthz", "/readyz"):
+                    self._send(200, "ok", "text/plain")
+                elif self.path == "/metrics":
+                    self._send(200, cp.metrics.render(), "text/plain")
+                elif len(parts) == 2 and parts[0] == "apis":
+                    objs = cp.store.list(parts[1])
+                    self._json(200, [to_manifest(o) for o in objs])
+                elif len(parts) == 4 and parts[0] == "apis":
+                    obj = cp.store.try_get(parts[1], parts[2], parts[3])
+                    if obj is None:
+                        self._json(404, {"error": f"{parts[1]} {parts[2]}/{parts[3]} not found"})
+                    else:
+                        self._json(200, to_manifest(obj))
+                else:
+                    self._json(404, {"error": "unknown path"})
+
+            def do_DELETE(self):
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) == 4 and parts[0] == "apis":
+                    cp.store.delete(parts[1], parts[2], parts[3])
+                    self._json(200, {"deleted": f"{parts[1]}/{parts[2]}/{parts[3]}"})
+                else:
+                    self._json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length).decode()
+                parts = [p for p in self.path.split("/") if p]
+                try:
+                    if parts[:1] == ["apply"]:
+                        import yaml
+
+                        applied = []
+                        for doc in yaml.safe_load_all(body):
+                            if not doc:
+                                continue
+                            obj = from_manifest(doc)
+                            existing = cp.store.try_get(
+                                obj.kind, obj.meta.namespace, obj.meta.name
+                            )
+                            if existing is None:
+                                stored = cp.store.create(obj)
+                            else:
+                                obj.meta.resource_version = existing.meta.resource_version
+                                obj.meta.uid = existing.meta.uid
+                                # Spec-only apply: never wipe live status.
+                                if hasattr(existing, "status"):
+                                    obj.status = existing.status
+                                stored = cp.store.update(obj)
+                            applied.append(f"{stored.kind}/{stored.meta.name}")
+                        self._json(200, {"applied": applied})
+                    elif len(parts) == 3 and parts[0] == "scale":
+                        replicas = int(json.loads(body)["replicas"])
+                        lws = cp.store.get("LeaderWorkerSet", parts[1], parts[2])
+                        lws.spec.replicas = replicas
+                        cp.store.update(lws)
+                        self._json(200, {"scaled": parts[2], "replicas": replicas})
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except (AdmissionError, ValueError) as e:
+                    self._json(422, {"error": str(e)})
+                except NotFoundError as e:
+                    self._json(404, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+
+    def start(self) -> None:
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()

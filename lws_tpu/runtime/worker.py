@@ -1,0 +1,61 @@
+"""Default multi-host worker workloads, driven purely by the injected env
+contract — the acceptance smoke of SURVEY §7 stage 3 / BASELINE config #2:
+"JAX multi-host psum smoke test, leader as coordinator".
+
+  python -m lws_tpu.runtime.worker psum
+
+reads JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID (injected
+by the pod webhook), initializes jax.distributed with the leader as
+coordinator, and all-reduces (process_id + 1) across the group. Writes
+"<result>" to $LWS_TPU_RESULT_FILE when it matches n(n+1)/2.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def run_psum() -> int:
+    from lws_tpu.parallel import initialize_from_env
+
+    info = initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+
+    n = info.num_processes
+    n_local = jax.local_device_count()
+    local = jnp.full((n_local,), float(info.process_id + 1)) / n_local
+    arr = jax.make_array_from_process_local_data(NamedSharding(mesh, P("x")), np.asarray(local))
+    total = float(jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)[()])
+
+    expected = n * (n + 1) / 2
+    ok = abs(total - expected) < 1e-6
+    out = os.environ.get("LWS_TPU_RESULT_FILE")
+    if out:
+        with open(out, "w") as f:
+            f.write(f"process={info.process_id} total={total} expected={expected} ok={ok}\n")
+    print(f"[worker {info.process_id}/{n}] psum={total} expected={expected} ok={ok}")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "psum"
+    if cmd == "psum":
+        return run_psum()
+    if cmd == "sleep":
+        import time
+
+        time.sleep(float(sys.argv[2]) if len(sys.argv) > 2 else 3600)
+        return 0
+    print(f"unknown worker command {cmd!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -100,13 +100,14 @@ class Engine:
         ttft = time.perf_counter() - t0
 
         out = [token]
-        # Warm the decode path (compile) before timing.
-        token, cache = self.decode(token, cache)
-        out.append(token)
-        host_sync(token)
+        if max_new_tokens > 1:
+            # Warm the decode path (compile) before timing.
+            token, cache = self.decode(token, cache)
+            out.append(token)
+            host_sync(token)
 
         t1 = time.perf_counter()
-        steps = max(0, max_new_tokens - 2)
+        steps = max(0, max_new_tokens - len(out))
         for _ in range(steps):
             token, cache = self.decode(token, cache)
             out.append(token)

@@ -1,0 +1,212 @@
+"""L6/L7: component config loading, YAML manifests, HTTP API server, typed
+client, plan-steps CLI."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lws_tpu.client import Client
+from lws_tpu.config import load_configuration
+from lws_tpu.manifest import from_manifest, load_manifests, to_manifest
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.runtime.server import ApiServer
+from lws_tpu.testing import LWSBuilder, make_all_groups_ready
+
+
+LWS_YAML = """
+apiVersion: lws.tpu/v1
+kind: LeaderWorkerSet
+metadata:
+  name: vllm
+spec:
+  replicas: 2
+  startupPolicy: LeaderCreated
+  networkConfig:
+    subdomainPolicy: Shared
+  rolloutStrategy:
+    type: RollingUpdate
+    rollingUpdateConfiguration:
+      maxUnavailable: 1
+      maxSurge: 1
+  leaderWorkerTemplate:
+    size: 4
+    restartPolicy: RecreateGroupOnPodRestart
+    subGroupPolicy:
+      subGroupSize: 2
+    workerTemplate:
+      spec:
+        containers:
+        - name: jax
+          image: vllm-tpu:latest
+          resources:
+            google.com/tpu: 4
+"""
+
+
+def test_config_load(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        "apiVersion: config.lws.tpu/v1alpha1\nkind: Configuration\n"
+        "backend: fake\nenableScheduler: false\n"
+        "gangSchedulingManagement:\n  schedulerProvider: gang\n"
+    )
+    cfg = load_configuration(str(p))
+    assert cfg.backend == "fake"
+    assert cfg.enable_scheduler is False
+    assert cfg.gang_scheduling_management.scheduler_provider == "gang"
+    assert cfg.client_qps == 500  # defaulted (≈ defaults.go:35-36)
+
+
+def test_config_rejects_unknown_fields(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("backnd: fake\n")  # typo must not pass silently
+    with pytest.raises(ValueError, match="unknown configuration fields"):
+        load_configuration(str(p))
+
+
+def test_config_rejects_unknown_provider(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("gangSchedulingManagement:\n  schedulerProvider: volcano2\n")
+    with pytest.raises(ValueError, match="unknown schedulerProvider"):
+        load_configuration(str(p))
+
+
+def test_manifest_roundtrip_and_apply(tmp_path):
+    import yaml
+
+    obj = from_manifest(yaml.safe_load(LWS_YAML))
+    assert obj.spec.replicas == 2
+    assert obj.spec.leader_worker_template.size == 4
+    assert obj.spec.leader_worker_template.sub_group_policy.sub_group_size == 2
+    assert obj.spec.rollout_strategy.rolling_update_configuration.max_surge == 1
+    assert obj.spec.leader_worker_template.worker_template.spec.containers[0].tpu_chips() == 4
+
+    cp = ControlPlane(auto_ready=True)
+    cp.create(obj)
+    cp.run_until_stable()
+    pods = cp.store.list("Pod")
+    assert len(pods) == 8
+
+    manifest = to_manifest(cp.store.get("LeaderWorkerSet", "default", "vllm"))
+    assert manifest["kind"] == "LeaderWorkerSet"
+    assert manifest["status"]["replicas"] == 2
+
+
+def test_load_manifests_multidoc(tmp_path):
+    p = tmp_path / "m.yaml"
+    p.write_text(LWS_YAML + "\n---\n" + LWS_YAML.replace("name: vllm", "name: vllm2"))
+    objs = load_manifests(str(p))
+    assert [o.meta.name for o in objs] == ["vllm", "vllm2"]
+
+
+def test_http_api_server_lifecycle():
+    cp = ControlPlane(auto_ready=True)
+    server = ApiServer(cp, port=0)  # ephemeral port
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        def get(path):
+            with urllib.request.urlopen(base + path) as r:
+                return r.read().decode()
+
+        def post(path, body: bytes):
+            req = urllib.request.Request(base + path, data=body, method="POST")
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read().decode())
+
+        assert get("/healthz") == "ok"
+
+        out = post("/apply", LWS_YAML.encode())
+        assert out["applied"] == ["LeaderWorkerSet/vllm"]
+        cp.run_until_stable()
+
+        listed = json.loads(get("/apis/LeaderWorkerSet"))
+        assert listed[0]["metadata"]["name"] == "vllm"
+        fetched = json.loads(get("/apis/Pod/default/vllm-0"))
+        assert fetched["metadata"]["labels"]["leaderworkerset.lws.tpu/worker-index"] == "0"
+
+        post("/scale/default/vllm", json.dumps({"replicas": 1}).encode())
+        cp.run_until_stable()
+        assert len(cp.store.list("Pod")) == 4
+
+        metrics = get("/metrics")
+        assert 'lws_reconcile_total{controller="lws"}' in metrics
+        assert "lws_reconcile_duration_seconds_count" in metrics
+
+        req = urllib.request.Request(f"{base}/apis/LeaderWorkerSet/default/vllm", method="DELETE")
+        with urllib.request.urlopen(req):
+            pass
+        cp.run_until_stable()
+        assert cp.store.list("Pod") == []
+    finally:
+        server.stop()
+
+
+def test_http_apply_validation_422():
+    cp = ControlPlane()
+    server = ApiServer(cp, port=0)
+    server.start()
+    try:
+        bad = LWS_YAML.replace("name: vllm", "name: Bad_Name")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/apply", data=bad.encode(), method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 422
+    finally:
+        server.stop()
+
+
+def test_typed_client_scale():
+    cp = ControlPlane(auto_ready=True)
+    client = Client(cp.store)
+    client.create_lws(LWSBuilder().replicas(1).size(2).build())
+    cp.run_until_stable()
+    make_all_groups_ready(cp, "sample")
+    assert client.get_lws("sample").status.ready_replicas == 1
+    client.scale_lws("sample", 3)
+    cp.run_until_stable()
+    assert len(client.pods_of("sample")) == 6
+    assert len(client.leader_pods_of("sample")) == 3
+
+
+def test_plan_steps_cli(capsys):
+    from lws_tpu.cli import main
+
+    assert main(["plan-steps", "--initial", "2,2", "--target", "2,2"]) == 0
+    out = capsys.readouterr().out
+    assert "[2, 2]" in out and "[0, 0]" in out
+    lines = [l for l in out.strip().splitlines()[1:]]
+    assert lines[0].split()[0] == "0"
+    assert "[0, 0]  [2, 2]" in lines[-1]
+
+
+def test_config_rejects_nested_unknown_fields(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("metrics:\n  prot: 1234\n")  # typo inside a section
+    with pytest.raises(ValueError, match="unknown configuration fields in metrics"):
+        load_configuration(str(p))
+
+
+def test_http_reapply_preserves_status():
+    cp = ControlPlane(auto_ready=True)
+    server = ApiServer(cp, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        def post(path, body: bytes):
+            req = urllib.request.Request(base + path, data=body, method="POST")
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read().decode())
+
+        post("/apply", LWS_YAML.encode())
+        cp.run_until_stable()
+        before = cp.store.get("LeaderWorkerSet", "default", "vllm").status.ready_replicas
+        assert before == 2
+        post("/apply", LWS_YAML.encode())  # unchanged re-apply
+        after = cp.store.get("LeaderWorkerSet", "default", "vllm").status.ready_replicas
+        assert after == before, "apply must never wipe live status"
+    finally:
+        server.stop()

@@ -1,0 +1,81 @@
+"""e2e: the control plane launches REAL local processes wired purely by the
+injected env contract, and they perform a distributed JAX psum with the leader
+as coordinator (SURVEY §7 stage 3 acceptance / BASELINE config #2)."""
+
+import os
+import pathlib
+import time
+
+import pytest
+
+from lws_tpu.api.pod import Container, EnvVar, PodSpec, PodTemplateSpec
+from lws_tpu.api.types import (
+    LeaderWorkerSet,
+    LeaderWorkerSetSpec,
+    LeaderWorkerTemplate,
+)
+from lws_tpu.core.store import new_meta
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.runtime.local import LocalBackend
+
+import sys
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+
+
+def test_real_process_group_runs_distributed_psum(tmp_path):
+    size = 2
+    template = PodTemplateSpec(
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="worker",
+                    command=[sys.executable, "-m", "lws_tpu.runtime.worker", "psum"],
+                    env=[EnvVar("LWS_TPU_RESULT_FILE", str(tmp_path / "$(POD_NAME).txt"))],
+                )
+            ]
+        )
+    )
+    lws = LeaderWorkerSet(
+        meta=new_meta("psum"),
+        spec=LeaderWorkerSetSpec(
+            replicas=1,
+            leader_worker_template=LeaderWorkerTemplate(worker_template=template, size=size),
+        ),
+    )
+
+    cp = ControlPlane()
+    backend = LocalBackend(
+        cp.store,
+        # Workers must run on the CPU backend of their own process: strip the
+        # TPU plugin trigger and force cpu (the chip is single-claim).
+        env_overrides={
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            "XLA_FLAGS": "",
+        },
+        env_drop=("PALLAS_AXON_POOL_IPS",),
+    )
+    cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+
+    try:
+        cp.create(lws)
+        cp.run_until_stable()
+
+        deadline = time.time() + 150
+        expected = {f"psum-0.txt", f"psum-0-1.txt"}
+        while time.time() < deadline:
+            backend.poll_all()
+            cp.run_until_stable()
+            have = {p.name for p in tmp_path.iterdir()}
+            if expected <= have:
+                break
+            time.sleep(1.0)
+        else:
+            pytest.fail(f"workers never finished; files: {list(tmp_path.iterdir())}")
+
+        for name in expected:
+            content = (tmp_path / name).read_text()
+            assert "ok=True" in content, f"{name}: {content}"
+    finally:
+        backend.shutdown()

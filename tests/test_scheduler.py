@@ -111,3 +111,45 @@ def test_gang_leader_ready_reserves_whole_slice():
     assert len(pods) == 4
     assert all(p.spec.node_name for p in pods)
     assert {node_slice(cp, p.meta.name) for p in pods} == {"big"}
+
+
+def test_node_failure_recreates_group_elsewhere():
+    """A slice going NotReady (preemption) fails its pods; the restart policy
+    recreates the whole group and it reschedules onto the healthy slice."""
+    cp = make_cp_with_slices(n_slices=2, topology="2x4")
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).exclusive_topology().build())
+    cp.run_until_stable()
+    before_slice = node_slice(cp, "sample-0")
+
+    # Preempt the slice hosting the group.
+    for node in cp.store.list("Node"):
+        if node.meta.labels[contract.NODE_TPU_SLICE_LABEL] == before_slice:
+            node.status.ready = False
+            cp.store.update_status(node)
+    cp.run_until_stable()
+
+    pods = lws_pods(cp.store, "sample")
+    assert len(pods) == 2
+    after = {node_slice(cp, p.meta.name) for p in pods}
+    assert after == {s for s in ("slice-0", "slice-1") if s != before_slice}
+    assert all(p.status.ready for p in pods)
+    assert "NodeFailure" in {e.reason for e in cp.recorder.events}
+
+
+def test_resync_recovers_fresh_control_plane():
+    """A brand-new control plane over pre-existing state converges after
+    resync (controller restart over live state, SURVEY §5 checkpoint/resume)."""
+    cp = make_cp_with_slices(n_slices=1, topology="2x4")
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+    # Create drift the old manager never sees, then stand up a NEW control
+    # plane sharing the store.
+    cp.store.delete("GroupSet", "default", "sample-0")
+    cp2 = ControlPlane(
+        enable_scheduler=True, auto_ready=True, require_binding=True, store=cp.store
+    )
+    cp2.resync()
+    cp2.run_until_stable()
+    assert cp2.store.try_get("GroupSet", "default", "sample-0") is not None
+    pods = lws_pods(cp2.store, "sample")
+    assert len(pods) == 2 and all(p.status.ready for p in pods)

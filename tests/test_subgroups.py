@@ -1,0 +1,128 @@
+"""Subgroups end-to-end: subgroup index/key labels, per-subgroup TPU hostname
+windows, LeaderExcluded, sub-slice exclusive placement — the TP x PP
+orchestration shape (SURVEY §2.10, BASELINE config #4)."""
+
+from lws_tpu.api import contract
+from lws_tpu.api.types import SubGroupPolicyType
+from lws_tpu.core.store import AdmissionError
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.sched import make_slice_nodes
+from lws_tpu.testing import LWSBuilder, lws_pods
+
+import pytest
+
+
+def env_map(pod):
+    return {e.name: e.value for e in pod.spec.containers[0].env}
+
+
+def test_subgroup_labels_and_tpu_windows():
+    # size=8, subGroupSize=4, leader holds TPUs: subgroup 0 = leader+1..3,
+    # subgroup 1 = 4..7 with shifted window.
+    cp = ControlPlane(auto_ready=True)
+    cp.create(
+        LWSBuilder().replicas(1).size(8).tpu_chips(4)
+        .leader_template(tpu_chips=4).subgroup(4).build()
+    )
+    cp.run_until_stable()
+    pods = {p.meta.name: p for p in lws_pods(cp.store, "sample")}
+    assert len(pods) == 8
+
+    leader = pods["sample-0"]
+    assert leader.meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "0"
+    assert env_map(leader)[contract.TPU_WORKER_ID] == "0"
+    assert env_map(leader)[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-0.sample,sample-0-1.sample,sample-0-2.sample,sample-0-3.sample"
+    )
+
+    w2 = pods["sample-0-2"]
+    assert w2.meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "0"
+    assert (
+        w2.meta.labels[contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY]
+        == leader.meta.labels[contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY]
+    )
+
+    w5 = pods["sample-0-5"]
+    assert w5.meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "1"
+    assert w5.meta.labels[contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY] != (
+        leader.meta.labels[contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY]
+    )
+    env5 = env_map(w5)
+    assert env5[contract.TPU_WORKER_ID] == "1"  # 5 % 4
+    # Window shifted left because the leader holds TPUs.
+    assert env5[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-0-4.sample,sample-0-5.sample,sample-0-6.sample,sample-0-7.sample"
+    )
+    # Subgroup hints surfaced to JAX bootstrap.
+    assert env5[contract.LWS_SUBGROUP_SIZE] == "4"
+    assert env5[contract.LWS_SUBGROUP_INDEX] == "1"
+
+
+def test_leader_excluded_subgroups():
+    # size=9, sgs=4, LeaderExcluded: leader in no subgroup, workers 1..8 in 2.
+    cp = ControlPlane(auto_ready=True)
+    cp.create(
+        LWSBuilder().replicas(1).size(9).tpu_chips(4)
+        .leader_template(tpu_chips=0)  # LeaderExcluded: leader holds no chips
+        .subgroup(4, SubGroupPolicyType.LEADER_EXCLUDED).build()
+    )
+    cp.run_until_stable()
+    pods = {p.meta.name: p for p in lws_pods(cp.store, "sample")}
+    leader = pods["sample-0"]
+    assert contract.SUBGROUP_INDEX_LABEL_KEY not in leader.meta.labels
+    assert pods["sample-0-4"].meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "0"
+    assert pods["sample-0-5"].meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "1"
+    env4 = env_map(pods["sample-0-4"])
+    assert env4[contract.TPU_WORKER_ID] == "3"  # (4-1) % 4
+    assert env4[contract.TPU_WORKER_HOSTNAMES] == (
+        "sample-0-1.sample,sample-0-2.sample,sample-0-3.sample,sample-0-4.sample"
+    )
+
+
+def test_leader_excluded_with_tpu_leader_rejected():
+    cp = ControlPlane()
+    with pytest.raises(AdmissionError):
+        cp.create(
+            LWSBuilder().replicas(1).size(9).tpu_chips(4)
+            .leader_template(tpu_chips=4)
+            .subgroup(4, SubGroupPolicyType.LEADER_EXCLUDED).build()
+        )
+
+
+def test_subgroup_policy_immutable():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(8).subgroup(4).build())
+    cp.run_until_stable()
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.leader_worker_template.sub_group_policy.sub_group_size = 2
+    with pytest.raises(AdmissionError):
+        cp.store.update(lws)
+
+
+def test_subgroup_exclusive_placement_sub_slices():
+    """subgroup-exclusive-topology: each subgroup (TP island) lands on its own
+    slice — the PP x TP sub-slice shape of BASELINE config #4."""
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, require_binding=True)
+    for s in range(2):
+        cp.add_nodes(make_slice_nodes(f"sub-{s}", topology="2x4"))  # 2 hosts x 4 chips
+    cp.create(
+        LWSBuilder().replicas(1).size(4).tpu_chips(4)
+        .subgroup(2)
+        .annotation(contract.SUBGROUP_EXCLUSIVE_KEY_ANNOTATION_KEY, contract.NODE_TPU_SLICE_LABEL)
+        .build()
+    )
+    cp.run_until_stable()
+    pods = {p.meta.name: p for p in lws_pods(cp.store, "sample")}
+    assert len(pods) == 4
+
+    def slice_of(name):
+        pod = pods[name]
+        assert pod.spec.node_name, f"{name} unscheduled"
+        node = cp.store.get("Node", "_cluster", pod.spec.node_name)
+        return node.meta.labels[contract.NODE_TPU_SLICE_LABEL]
+
+    # Subgroup 0 = leader + worker 1; subgroup 1 = workers 2,3 (size 4, sgs 2).
+    sg0 = {slice_of("sample-0"), slice_of("sample-0-1")}
+    sg1 = {slice_of("sample-0-2"), slice_of("sample-0-3")}
+    assert len(sg0) == 1 and len(sg1) == 1
+    assert sg0 != sg1
