@@ -66,6 +66,7 @@ def main() -> None:
             dtype=jnp.bfloat16,
             param_dtype=jnp.bfloat16,
             remat=False,
+            unroll_cached_layers=True,
         )
         batch, prompt_len, decode_steps, max_len = 16, 1024, 256, 2048
     else:  # dev smoke (not the recorded benchmark)

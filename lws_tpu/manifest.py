@@ -177,6 +177,21 @@ def from_manifest(raw: dict):
             meta=_meta(raw, default_namespace=CLUSTER_NAMESPACE),
             spec=NodeSpec(capacity={k: int(v) for k, v in spec.get("capacity", {}).items()}),
         )
+    if kind == "Autoscaler":
+        from lws_tpu.api.autoscaler import Autoscaler, AutoscalerSpec
+
+        spec = raw.get("spec", {})
+        return Autoscaler(
+            meta=_meta(raw),
+            spec=AutoscalerSpec(
+                target=spec.get("target", ""),
+                min_replicas=int(spec.get("minReplicas", 1)),
+                max_replicas=int(spec.get("maxReplicas", 10)),
+                metric=spec.get("metric", "inflight"),
+                target_value=float(spec.get("targetValue", 1.0)),
+                scale_down_stabilization=int(spec.get("scaleDownStabilization", 3)),
+            ),
+        )
     raise ValueError(f"unsupported manifest kind {kind!r}")
 
 

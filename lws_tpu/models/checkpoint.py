@@ -1,0 +1,55 @@
+"""Checkpoint/restore for training state via orbax (SURVEY §5: the control
+plane is stateless by design; *workload* state checkpoints through the PVC
+volumes the GroupSet controller provisions — this module is what runs inside
+the pods, restoring shard-by-shard into the live mesh layout)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from lws_tpu.models.train import TrainState, state_shardings
+
+
+def save_checkpoint(path: str, state: TrainState) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        ckptr.save(
+            path,
+            {"step": state.step, "params": state.params, "opt_state": state.opt_state},
+            force=True,
+        )
+
+
+def restore_checkpoint(path: str, cfg, mesh, optimizer) -> Optional[TrainState]:
+    """Restore directly into the mesh's shard layout (each host reads only its
+    shards — no full-model host memory spike)."""
+    import orbax.checkpoint as ocp
+
+    shardings = state_shardings(cfg, mesh, optimizer)
+    from lws_tpu.models.llama import init_params
+
+    sample = jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
+    opt_shape = jax.eval_shape(optimizer.init, sample)
+    import jax.numpy as jnp
+
+    target = {
+        "step": jax.ShapeDtypeStruct((), jnp.int32, sharding=shardings.step),
+        "params": jax.tree.map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+            sample,
+            shardings.params,
+        ),
+        "opt_state": jax.tree.map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+            opt_shape,
+            shardings.opt_state,
+        ),
+    }
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        restored = ckptr.restore(path, target)
+    return TrainState(
+        step=restored["step"], params=restored["params"], opt_state=restored["opt_state"]
+    )

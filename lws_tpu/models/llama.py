@@ -48,6 +48,8 @@ class LlamaConfig:
     capacity_factor: float = 2.0
     aux_loss_coef: float = 0.01
     remat: bool = True
+    # Serving: unroll the cached-forward layer loop (static cache slices).
+    unroll_cached_layers: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -408,14 +410,22 @@ def forward_with_cache(
     positions = pos + jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"].astype(cfg.dtype)[tokens]
 
-    def body(carry, lp):
-        x, cache, layer_idx = carry
-        x, cache = _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg)
-        return (x, cache, layer_idx + 1), None
+    if cfg.unroll_cached_layers:
+        # Unrolled: static layer indices make every cache read/write a static
+        # slice XLA can alias in place — no per-layer gather on the decode
+        # hot path (bigger HLO, faster steps; right for serving).
+        for l in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[l], params["layers"])
+            x, cache = _block_with_cache(x, positions, pos, l, lp, cache, cfg)
+    else:
+        def body(carry, lp):
+            x, cache, layer_idx = carry
+            x, cache = _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg)
+            return (x, cache, layer_idx + 1), None
 
-    (x, cache, _), _ = jax.lax.scan(
-        body, (x, cache, jnp.zeros((), jnp.int32)), params["layers"]
-    )
+        (x, cache, _), _ = jax.lax.scan(
+            body, (x, cache, jnp.zeros((), jnp.int32)), params["layers"]
+        )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, KVCache(k=cache.k, v=cache.v, pos=pos + S)

@@ -154,6 +154,26 @@ class ControlPlane:
             },
         )
 
+        from lws_tpu.controllers.autoscaler_controller import AutoscalerReconciler
+
+        def autoscalers_watching(obj) -> list[Key]:
+            # Leader pod metric annotations / LWS changes retrigger autoscalers.
+            return [
+                asc.key()
+                for asc in store.list("Autoscaler", obj.meta.namespace)
+                if asc.spec.target == obj.meta.labels.get(contract.SET_NAME_LABEL_KEY, obj.meta.name)
+            ]
+
+        self.autoscaler_controller = AutoscalerReconciler(self.store, self.recorder)
+        self.manager.register(
+            self.autoscaler_controller,
+            {
+                "Autoscaler": lambda o: [o.key()],
+                "Pod": autoscalers_watching,
+                "LeaderWorkerSet": autoscalers_watching,
+            },
+        )
+
         if enable_scheduler:
             def unbound_pods(obj) -> list[Key]:
                 return [p.key() for p in store.list("Pod") if not p.spec.node_name]

@@ -101,6 +101,34 @@ class ApiServer:
                         lws.spec.replicas = replicas
                         cp.store.update(lws)
                         self._json(200, {"scaled": parts[2], "replicas": replicas})
+                    elif len(parts) == 3 and parts[0] == "report-metric":
+                        # Workload-side metric push: annotates the pod so the
+                        # autoscaler's HPA loop can read it.
+                        from lws_tpu.api.autoscaler import METRIC_ANNOTATION_PREFIX
+                        from lws_tpu.core.store import ConflictError
+
+                        payload = json.loads(body)
+                        if not isinstance(payload, dict) or not all(
+                            isinstance(v, (int, float)) for v in payload.values()
+                        ):
+                            raise ValueError(
+                                "report-metric body must be a JSON object of numbers"
+                            )
+                        for attempt in range(5):
+                            pod = cp.store.get("Pod", parts[1], parts[2])
+                            for metric, value in payload.items():
+                                pod.meta.annotations[METRIC_ANNOTATION_PREFIX + metric] = str(
+                                    float(value)
+                                )
+                            try:
+                                cp.store.update(pod)
+                                break
+                            except ConflictError:
+                                if attempt == 4:
+                                    raise ValueError(
+                                        "metric report lost repeated update races; retry"
+                                    ) from None
+                        self._json(200, {"reported": payload})
                     else:
                         self._json(404, {"error": "unknown path"})
                 except (AdmissionError, ValueError) as e:

@@ -1,0 +1,154 @@
+"""Hardening features: native autoscaler (HPA equivalent), PVC lifecycle from
+volume claim templates, orbax checkpoint save/restore into mesh shardings."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lws_tpu.api.autoscaler import METRIC_ANNOTATION_PREFIX, Autoscaler, AutoscalerSpec
+from lws_tpu.api.pod import VolumeClaimTemplate
+from lws_tpu.core.store import new_meta
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder, lws_pods, make_all_groups_ready
+
+
+def set_metric(cp, pod_name, metric, value):
+    pod = cp.store.get("Pod", "default", pod_name)
+    pod.meta.annotations[METRIC_ANNOTATION_PREFIX + metric] = str(value)
+    cp.store.update(pod)
+
+
+def test_autoscaler_scales_up_and_down_with_stabilization():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.create(
+        Autoscaler(
+            meta=new_meta("asc"),
+            spec=AutoscalerSpec(
+                target="sample", min_replicas=1, max_replicas=4,
+                metric="inflight", target_value=2.0, scale_down_stabilization=2,
+            ),
+        )
+    )
+    cp.run_until_stable()
+
+    # Load of 6 against target 2 -> scale 1 -> 3 immediately.
+    set_metric(cp, "sample-0", "inflight", 6.0)
+    cp.run_until_stable()
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.spec.replicas == 3
+    assert len(lws_pods(cp.store, "sample")) == 6
+
+    # Load redistributes to target: stable (new leaders without metrics count
+    # as at-target, so no compounding either).
+    for i in range(3):
+        set_metric(cp, f"sample-{i}", "inflight", 2.0)
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 3
+
+    # Low load: first distinct observation does NOT scale down (stabilization)
+    for i in range(3):
+        set_metric(cp, f"sample-{i}", "inflight", 0.1)
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 3
+    # ...the second distinct below-target observation crosses the window.
+    set_metric(cp, "sample-0", "inflight", 0.05)
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 1
+    assert "Scaled" in {e.reason for e in cp.recorder.events}
+
+
+def test_autoscaler_respects_bounds():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(2).size(1).build())
+    cp.create(
+        Autoscaler(
+            meta=new_meta("asc"),
+            spec=AutoscalerSpec(target="sample", min_replicas=1, max_replicas=3, target_value=1.0),
+        )
+    )
+    cp.run_until_stable()
+    set_metric(cp, "sample-0", "inflight", 100.0)
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 3  # capped
+
+
+def test_pvc_lifecycle_retention():
+    cp = ControlPlane(auto_ready=True)
+    lws = LWSBuilder().replicas(1).size(2).build()
+    lws.spec.leader_worker_template.volume_claim_templates = [
+        VolumeClaimTemplate(name="ckpt", storage="10Gi")
+    ]
+    lws.spec.leader_worker_template.pvc_retention_policy_when_deleted = "Delete"
+    cp.create(lws)
+    cp.run_until_stable()
+    pvcs = sorted(p.meta.name for p in cp.store.list("PersistentVolumeClaim"))
+    assert pvcs == ["ckpt-sample-0", "ckpt-sample-0-1"]
+
+    # Group recreation keeps the PVCs (stable identity storage)...
+    from lws_tpu.testing import restart_pod_container
+
+    restart_pod_container(cp.store, "default", "sample-0-1")
+    cp.run_until_stable()
+    assert len(cp.store.list("PersistentVolumeClaim")) == 2
+    # ...but whenDeleted=Delete cascades them away with the LWS.
+    cp.store.delete("LeaderWorkerSet", "default", "sample")
+    cp.run_until_stable()
+    assert cp.store.list("PersistentVolumeClaim") == []
+
+
+def test_checkpoint_roundtrip_sharded(tmp_path):
+    from lws_tpu.models import LlamaConfig
+    from lws_tpu.models.checkpoint import restore_checkpoint, save_checkpoint
+    from lws_tpu.models.train import init_train_state, make_optimizer, make_train_step
+    from lws_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=32, remat=False,
+    )
+    mesh = build_mesh(MeshSpec(dp=2, pp=2, tp=2))
+    opt = make_optimizer()
+    state = init_train_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    batch = {"tokens": jnp.ones((2, 9), jnp.int32)}
+    params, opt_state, loss1, _ = step(state.params, state.opt_state, batch)
+    state.params, state.opt_state = params, opt_state
+
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, state)
+    restored = restore_checkpoint(path, cfg, mesh, opt)
+
+    # Restored params land in the SAME sharding layout.
+    wq = restored.params["layers"]["wq"]
+    assert wq.sharding.spec[0] == "pp" and wq.sharding.spec[2] == "tp"
+    # And continue training deterministically vs the original.
+    p1, o1, loss_a, _ = step(restored.params, restored.opt_state, batch)
+    import numpy as np
+
+    p2, o2, loss_b, _ = step(
+        jax.tree.map(lambda x: x, params), jax.tree.map(lambda x: x, opt_state), batch
+    )
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+
+
+def test_autoscaler_steady_load_keeps_scaling():
+    """Regression: re-reports of the SAME value are fresh observations — the
+    loop must not stall on steady load (dedup is by observation, not value)."""
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(1).build())
+    cp.create(
+        Autoscaler(
+            meta=new_meta("asc"),
+            spec=AutoscalerSpec(target="sample", min_replicas=1, max_replicas=9, target_value=2.0),
+        )
+    )
+    cp.run_until_stable()
+    set_metric(cp, "sample-0", "inflight", 6.0)
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 3
+    # Load stays hot: ALL leaders re-report the same 6.0.
+    for i in range(3):
+        set_metric(cp, f"sample-{i}", "inflight", 6.0)
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 9

@@ -63,3 +63,18 @@ def test_prefill_decode_handoff():
     # Same result decoding on the original engine.
     token3, _ = prefill_engine.decode(token, cache)
     np.testing.assert_array_equal(np.asarray(token2), np.asarray(token3))
+
+
+def test_unrolled_cached_decode_matches_scan():
+    """The serving-optimized unrolled layer loop must be numerically identical
+    to the scanned path."""
+    import dataclasses
+
+    cfg = tiny_cfg()
+    cfg_unrolled = dataclasses.replace(cfg, unroll_cached_layers=True)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.array([[3, 1, 4, 1, 5]], jnp.int32)
+
+    r1 = Engine(cfg, params, batch_size=1, max_len=16).generate(prompt, 6)
+    r2 = Engine(cfg_unrolled, params, batch_size=1, max_len=16).generate(prompt, 6)
+    np.testing.assert_array_equal(np.asarray(r1.tokens), np.asarray(r2.tokens))
