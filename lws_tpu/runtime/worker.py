@@ -36,18 +36,70 @@ def run_psum() -> int:
 
     expected = n * (n + 1) / 2
     ok = abs(total - expected) < 1e-6
-    out = os.environ.get("LWS_TPU_RESULT_FILE")
-    if out:
-        with open(out, "w") as f:
-            f.write(f"process={info.process_id} total={total} expected={expected} ok={ok}\n")
+    _write_result(f"process={info.process_id} total={total} expected={expected} ok={ok}")
     print(f"[worker {info.process_id}/{n}] psum={total} expected={expected} ok={ok}")
     return 0 if ok else 1
+
+
+def run_tp_forward() -> int:
+    """BASELINE config #3 shape: the whole group forms ONE tensor-parallel
+    mesh over all its processes' devices and runs a sharded llama forward —
+    every process computes the identical replicated logits (the XLA program
+    all-reduces over the tp axis spanning process boundaries)."""
+    from lws_tpu.parallel import initialize_from_env
+
+    info = initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lws_tpu.models import LlamaConfig, forward, init_params, param_shardings
+    from lws_tpu.parallel import mesh_from_bootstrap
+
+    # The canonical contract->mesh mapping (tp over the slice; subgroups
+    # would become pp stages).
+    mesh = mesh_from_bootstrap(info)
+    n_dev = mesh.devices.size
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=32, dtype=jnp.float32, remat=False,
+    )
+    with jax.set_mesh(mesh):
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), param_shardings(cfg))
+        params = jax.jit(lambda: init_params(cfg, jax.random.key(7)), out_shardings=shardings)()
+        tokens = jnp.arange(16, dtype=jnp.int32)[None, :]
+        logits = jax.jit(
+            lambda p, t: forward(p, t, cfg)[0], out_shardings=NamedSharding(mesh, P())
+        )(params, tokens)
+        checksum = float(jnp.sum(jnp.abs(logits)))
+
+    line = (
+        f"process={info.process_id}/{info.num_processes} devices={n_dev} "
+        f"tp={n_dev} checksum={checksum:.4f}"
+    )
+    _write_result(line)
+    print(f"[worker] {line}")
+    return 0
+
+
+def _write_result(line: str) -> None:
+    """Atomic write: readers poll for the file and must never see it empty."""
+    out = os.environ.get("LWS_TPU_RESULT_FILE")
+    if not out:
+        return
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(line + "\n")
+    os.replace(tmp, out)
 
 
 def main() -> int:
     cmd = sys.argv[1] if len(sys.argv) > 1 else "psum"
     if cmd == "psum":
         return run_psum()
+    if cmd == "tp_forward":
+        return run_tp_forward()
     if cmd == "sleep":
         import time
 

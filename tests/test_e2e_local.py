@@ -45,37 +45,130 @@ def test_real_process_group_runs_distributed_psum(tmp_path):
     )
 
     cp = ControlPlane()
-    backend = LocalBackend(
-        cp.store,
-        # Workers must run on the CPU backend of their own process: strip the
-        # TPU plugin trigger and force cpu (the chip is single-claim).
-        env_overrides={
-            "JAX_PLATFORMS": "cpu",
-            "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
-            "XLA_FLAGS": "",
-        },
-        env_drop=("PALLAS_AXON_POOL_IPS",),
-    )
+    backend = make_backend(cp, tmp_path)
     cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
 
     try:
         cp.create(lws)
         cp.run_until_stable()
-
-        deadline = time.time() + 150
-        expected = {f"psum-0.txt", f"psum-0-1.txt"}
-        while time.time() < deadline:
-            backend.poll_all()
-            cp.run_until_stable()
-            have = {p.name for p in tmp_path.iterdir()}
-            if expected <= have:
-                break
-            time.sleep(1.0)
-        else:
-            pytest.fail(f"workers never finished; files: {list(tmp_path.iterdir())}")
-
+        expected = {"psum-0.txt", "psum-0-1.txt"}
+        wait_for_files(cp, backend, tmp_path, expected)
         for name in expected:
             content = (tmp_path / name).read_text()
             assert "ok=True" in content, f"{name}: {content}"
+    finally:
+        backend.shutdown()
+
+
+def make_backend(cp, tmp_path, extra_env=None):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "XLA_FLAGS": "",
+    }
+    env.update(extra_env or {})
+    return LocalBackend(cp.store, env_overrides=env, env_drop=("PALLAS_AXON_POOL_IPS",))
+
+
+def wait_for_files(cp, backend, tmp_path, expected, timeout=150):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        backend.poll_all()
+        cp.run_until_stable()
+        if expected <= {p.name for p in tmp_path.iterdir()}:
+            return
+        time.sleep(1.0)
+    pytest.fail(f"workers never finished; files: {list(tmp_path.iterdir())}")
+
+
+def test_real_process_group_runs_tp_sharded_model(tmp_path):
+    """The orchestrated group forms ONE tensor-parallel mesh across real
+    processes (2 procs x 2 virtual devices = tp=4) and runs a sharded llama
+    forward; both processes must compute identical replicated logits."""
+    template = PodTemplateSpec(
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="worker",
+                    command=[sys.executable, "-m", "lws_tpu.runtime.worker", "tp_forward"],
+                    env=[EnvVar("LWS_TPU_RESULT_FILE", str(tmp_path / "$(POD_NAME).txt"))],
+                )
+            ]
+        )
+    )
+    lws = LeaderWorkerSet(
+        meta=new_meta("tpserve"),
+        spec=LeaderWorkerSetSpec(
+            replicas=1,
+            leader_worker_template=LeaderWorkerTemplate(worker_template=template, size=2),
+        ),
+    )
+    cp = ControlPlane()
+    backend = make_backend(
+        cp, tmp_path, extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    )
+    cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+    try:
+        cp.create(lws)
+        cp.run_until_stable()
+        wait_for_files(cp, backend, tmp_path, {"tpserve-0.txt", "tpserve-0-1.txt"})
+        lines = sorted((tmp_path / n).read_text().strip() for n in ("tpserve-0.txt", "tpserve-0-1.txt"))
+        assert "devices=4 tp=4" in lines[0], lines
+        cks = {l.split("checksum=")[1] for l in lines}
+        assert len(cks) == 1, f"processes disagree: {lines}"
+        assert float(cks.pop()) > 0
+    finally:
+        backend.shutdown()
+
+
+def test_real_process_failure_recreates_group(tmp_path):
+    """Kill a real worker process: the backend reports the exit, the restart
+    policy recreates the whole group, and fresh processes come up."""
+    template = PodTemplateSpec(
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="worker",
+                    command=[sys.executable, "-m", "lws_tpu.runtime.worker", "sleep", "600"],
+                )
+            ]
+        )
+    )
+    lws = LeaderWorkerSet(
+        meta=new_meta("victim"),
+        spec=LeaderWorkerSetSpec(
+            replicas=1,
+            leader_worker_template=LeaderWorkerTemplate(worker_template=template, size=2),
+        ),
+    )
+    cp = ControlPlane()
+    backend = make_backend(cp, tmp_path)
+    cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+    try:
+        cp.create(lws)
+        cp.run_until_stable()
+        before = {p.meta.name: p.meta.uid for p in cp.store.list("Pod")}
+        assert set(before) == {"victim-0", "victim-0-1"}
+
+        # Kill the worker's real process out from under it.
+        worker_uid = before["victim-0-1"]
+        backend._procs[worker_uid].kill()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            backend.poll_all()
+            cp.run_until_stable()
+            after = {p.meta.name: p.meta.uid for p in cp.store.list("Pod")}
+            if (
+                set(after) == set(before)
+                and all(after[n] != before[n] for n in before)
+            ):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail(f"group never recreated: {cp.store.list('Pod')}")
+        # New processes are actually running.
+        for pod in cp.store.list("Pod"):
+            proc = backend._procs.get(pod.meta.uid)
+            assert proc is not None and proc.poll() is None
     finally:
         backend.shutdown()
