@@ -50,8 +50,13 @@ def cmd_serve(args) -> int:
 
         from lws_tpu.runtime.local import LocalBackend
 
-        backend = LocalBackend(cp.store)
+        import tempfile
+
+        log_dir = tempfile.mkdtemp(prefix="lws-tpu-logs-")
+        backend = LocalBackend(cp.store, log_dir=log_dir)
         cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+        cp.log_provider = backend.pod_logs
+        print(f"pod logs under {log_dir}")
 
         def _poll_exits():
             # Process exits are not store events; poll them into pod status.
@@ -119,6 +124,19 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    req = urllib.request.Request(f"http://{args.server}/logs/{args.namespace}/{args.name}")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            sys.stdout.write(resp.read().decode(errors="replace"))
+        return 0
+    except urllib.error.HTTPError as e:
+        print(f"error: {e.code}: {e.read().decode()}", file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        raise SystemExit(f"error: cannot reach server {args.server}: {e.reason}") from None
+
+
 def cmd_scale(args) -> int:
     body = json.dumps({"replicas": args.replicas}).encode()
     print(json.dumps(_http(args.server, "POST", f"/scale/{args.namespace}/{args.name}", body)))
@@ -182,6 +200,12 @@ def main(argv=None) -> int:
     dp.add_argument("name")
     dp.add_argument("--server", default="127.0.0.1:9443")
     dp.set_defaults(fn=cmd_delete)
+
+    lp = sub.add_parser("logs", help="captured stdout/stderr of a pod's process")
+    lp.add_argument("name")
+    lp.add_argument("--namespace", "-n", default="default")
+    lp.add_argument("--server", default="127.0.0.1:9443")
+    lp.set_defaults(fn=cmd_logs)
 
     scp = sub.add_parser("scale")
     scp.add_argument("name")

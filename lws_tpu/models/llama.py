@@ -240,6 +240,16 @@ def _moe_ffn(x, router, w_gate, w_up, w_down, cfg: LlamaConfig):
 
 def _block(x, positions, lp, cfg: LlamaConfig):
     """One decoder block; lp = this layer's param slice."""
+    x, aux = _block_core(x, positions, lp, cfg, gqa_attention, seq_shard=True)
+    return x, aux
+
+
+def _block_core(x, positions, lp, cfg: LlamaConfig, attn_fn, seq_shard: bool = False):
+    """Shared decoder block; `attn_fn(q, k, v) -> attention output`.
+
+    Every forward variant — training, cached decode, flash prefill —
+    parameterizes ONLY the attention step, so their projections, RoPE,
+    residuals and FFN math can never diverge."""
     B, S, D = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
 
@@ -248,9 +258,10 @@ def _block(x, positions, lp, cfg: LlamaConfig):
     k = (h @ lp["wk"].astype(h.dtype)).reshape(B, S, nkv, hd)
     v = (h @ lp["wv"].astype(h.dtype)).reshape(B, S, nkv, hd)
     q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
-    attn = gqa_attention(q, k, v).reshape(B, S, nh * hd)
+    attn = attn_fn(q, k, v).reshape(B, S, nh * hd)
     x = x + attn @ lp["wo"].astype(attn.dtype)
-    x = _seq_shard(x)
+    if seq_shard:
+        x = _seq_shard(x)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
     if cfg.n_experts:
@@ -266,7 +277,9 @@ def _block(x, positions, lp, cfg: LlamaConfig):
         y = _dense_ffn(h, lp["w_gate"].astype(h.dtype), lp["w_up"].astype(h.dtype), lp["w_down"].astype(h.dtype))
         aux = jnp.zeros((), jnp.float32)
     x = x + y
-    return _seq_shard(x), aux
+    if seq_shard:
+        x = _seq_shard(x)
+    return x, aux
 
 
 def _seq_shard(x):
@@ -363,41 +376,22 @@ def _block_with_cache(x, positions, pos, layer_idx, lp, cache: KVCache, cfg: Lla
     [1,B,S,Hkv,hd] dynamic-update-slice into the loop-carried buffer (aliased
     in place by XLA), never a whole-layer copy — decode stays
     bandwidth-roofline-shaped instead of doubling its HBM traffic."""
-    B, S, D = x.shape
-    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    updated = {}
 
-    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(h.dtype)).reshape(B, S, nh, hd)
-    k = (h @ lp["wk"].astype(h.dtype)).reshape(B, S, nkv, hd)
-    v = (h @ lp["wv"].astype(h.dtype)).reshape(B, S, nkv, hd)
-    q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
-
-    new_k = jax.lax.dynamic_update_slice(
-        cache.k, k.astype(cache.k.dtype)[None], (layer_idx, 0, pos, 0, 0)
-    )
-    new_v = jax.lax.dynamic_update_slice(
-        cache.v, v.astype(cache.v.dtype)[None], (layer_idx, 0, pos, 0, 0)
-    )
-    cache = KVCache(k=new_k, v=new_v, pos=cache.pos)
-    cache_k_l = jax.lax.dynamic_index_in_dim(cache.k, layer_idx, 0, keepdims=False)
-    cache_v_l = jax.lax.dynamic_index_in_dim(cache.v, layer_idx, 0, keepdims=False)
-
-    attn = _cached_attention(q, cache_k_l, cache_v_l, pos).reshape(B, S, nh * hd)
-    x = x + attn @ lp["wo"].astype(attn.dtype)
-
-    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-    if cfg.n_experts:
-        y, _ = _moe_ffn(
-            h,
-            lp["router"].astype(h.dtype),
-            lp["w_gate"].astype(h.dtype),
-            lp["w_up"].astype(h.dtype),
-            lp["w_down"].astype(h.dtype),
-            cfg,
+    def attn_fn(q, k, v):
+        new_k = jax.lax.dynamic_update_slice(
+            cache.k, k.astype(cache.k.dtype)[None], (layer_idx, 0, pos, 0, 0)
         )
-    else:
-        y = _dense_ffn(h, lp["w_gate"].astype(h.dtype), lp["w_up"].astype(h.dtype), lp["w_down"].astype(h.dtype))
-    return x + y, cache
+        new_v = jax.lax.dynamic_update_slice(
+            cache.v, v.astype(cache.v.dtype)[None], (layer_idx, 0, pos, 0, 0)
+        )
+        updated["cache"] = KVCache(k=new_k, v=new_v, pos=cache.pos)
+        cache_k_l = jax.lax.dynamic_index_in_dim(new_k, layer_idx, 0, keepdims=False)
+        cache_v_l = jax.lax.dynamic_index_in_dim(new_v, layer_idx, 0, keepdims=False)
+        return _cached_attention(q, cache_k_l, cache_v_l, pos)
+
+    x, _ = _block_core(x, positions, lp, cfg, attn_fn)
+    return x, updated["cache"]
 
 
 def forward_with_cache(
@@ -429,3 +423,49 @@ def forward_with_cache(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, KVCache(k=cache.k, v=cache.v, pos=pos + S)
+
+
+def forward_prefill(
+    params: dict, tokens: jax.Array, cache: KVCache, cfg: LlamaConfig
+) -> tuple[jax.Array, KVCache]:
+    """Prefill-specialized forward: the cache is EMPTY (pos==0 by contract),
+    so attention is plain causal over the prompt — flash attention on TPU —
+    instead of masked attention over the whole cache length. Per-layer K/V are
+    collected and written into the cache as one [L,B,S] slice. Honors
+    cfg.unroll_cached_layers (scan keeps compile time flat on deep models)."""
+    from lws_tpu.ops.attention import attention as attn_op
+
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def prefill_block(x, lp):
+        kv = {}
+
+        def attn_fn(q, k, v):
+            kv["k"], kv["v"] = k, v
+            return attn_op(q, k, v, causal=True)
+
+        x, _ = _block_core(x, positions, lp, cfg, attn_fn)
+        return x, kv["k"], kv["v"]
+
+    if cfg.unroll_cached_layers:
+        ks, vs = [], []
+        for l in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[l], params["layers"])
+            x, k, v = prefill_block(x, lp)
+            ks.append(k)
+            vs.append(v)
+        stacked_k, stacked_v = jnp.stack(ks), jnp.stack(vs)
+    else:
+        def body(x, lp):
+            x, k, v = prefill_block(x, lp)
+            return x, (k, v)
+
+        x, (stacked_k, stacked_v) = jax.lax.scan(body, x, params["layers"])
+
+    new_k = cache.k.at[:, :, :S].set(stacked_k.astype(cache.k.dtype))
+    new_v = cache.v.at[:, :, :S].set(stacked_v.astype(cache.v.dtype))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + S)

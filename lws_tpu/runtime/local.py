@@ -36,13 +36,36 @@ class LocalBackend:
         env_overrides: Optional[dict[str, str]] = None,
         env_drop: tuple[str, ...] = (),
         default_command: Optional[list[str]] = None,
+        log_dir: Optional[str] = None,
     ) -> None:
         self.store = store
         self.env_overrides = env_overrides or {}
         self.env_drop = env_drop
         self.default_command = default_command or ["sleep", "infinity"]
+        self.log_dir = log_dir
         self._procs: dict[str, subprocess.Popen] = {}  # pod uid -> process
         self._lock = threading.Lock()
+
+    def pod_logs(self, namespace: str, name: str) -> Optional[str]:
+        """Captured stdout/stderr of the CURRENT pod incarnation (logs are
+        keyed by uid so a recreated pod never shows its predecessor's output)."""
+        if self.log_dir is None:
+            return None
+        pod = self.store.try_get("Pod", namespace, name)
+        if pod is None:
+            return None
+        path = self._log_path(pod)
+        if path is None or not os.path.exists(path):
+            return None
+        with open(path, errors="replace") as f:
+            return f.read()
+
+    def _log_path(self, pod: Pod) -> Optional[str]:
+        if self.log_dir is None:
+            return None
+        return os.path.join(
+            self.log_dir, f"{pod.meta.namespace}_{pod.meta.name}_{pod.meta.uid}.log"
+        )
 
     # ------------------------------------------------------------------
     def reconcile(self, key: Key) -> Result | None:
@@ -84,8 +107,12 @@ class LocalBackend:
             env[e.name] = value
         env["POD_NAME"] = pod.meta.name
         env.update(self.env_overrides)
+        stdout = None
+        if self.log_dir is not None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stdout = open(self._log_path(pod), "ab")  # noqa: SIM115 — owned by the child process
         try:
-            proc = subprocess.Popen(command, env=env)
+            proc = subprocess.Popen(command, env=env, stdout=stdout, stderr=stdout)
         except OSError as err:
             pod.status.phase = PodPhase.FAILED
             pod.status.message = f"spawn failed: {err}"

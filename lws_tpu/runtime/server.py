@@ -57,6 +57,13 @@ class ApiServer:
                         self._json(404, {"error": f"{parts[1]} {parts[2]}/{parts[3]} not found"})
                     else:
                         self._json(200, to_manifest(obj))
+                elif len(parts) == 3 and parts[0] == "logs":
+                    provider = getattr(cp, "log_provider", None)
+                    logs = provider(parts[1], parts[2]) if provider else None
+                    if logs is None:
+                        self._json(404, {"error": f"no logs for {parts[1]}/{parts[2]}"})
+                    else:
+                        self._send(200, logs, "text/plain")
                 else:
                     self._json(404, {"error": "unknown path"})
 

@@ -17,7 +17,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from lws_tpu.models.llama import KVCache, LlamaConfig, forward_with_cache, init_cache
+from lws_tpu.models.llama import (
+    KVCache,
+    LlamaConfig,
+    forward_prefill,
+    forward_with_cache,
+    init_cache,
+)
 
 
 def host_sync(x) -> None:
@@ -46,7 +52,10 @@ class Engine:
 
         @jax.jit
         def _prefill(params, tokens, cache):
-            logits, cache = forward_with_cache(params, tokens, cache, cfg_static)
+            # Engine.prefill always starts on an empty cache, so the
+            # flash-attention prefill path applies (causal over the prompt
+            # only, not masked attention over the whole cache length).
+            logits, cache = forward_prefill(params, tokens, cache, cfg_static)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
         @partial(jax.jit, donate_argnums=(2,))
