@@ -12,6 +12,9 @@ GSPMD shardings over the (dp, pp, tp) mesh:
           optimization, the sharding contract is identical).
   * ep  — MoE experts dim sharded over `tp` (expert parallelism); GShard-style
           dense dispatch/combine einsums keep shapes static for XLA.
+  * cp  — cfg.context_parallel runs exact ring attention over the mesh's `cp`
+          axis (ops/ring.py): sequence chunks rotate around the ICI ring, so
+          attention memory stays O(S/cp) per chip — the long-context path.
 
 The reference orchestrates such workloads but contains none (SURVEY §0);
 this model is the TPU-native counterpart of its vLLM Llama examples
@@ -50,6 +53,9 @@ class LlamaConfig:
     remat: bool = True
     # Serving: unroll the cached-forward layer loop (static cache slices).
     unroll_cached_layers: bool = False
+    # Long context: exact ring attention over the mesh's `cp` axis (sequence
+    # chunks rotate around the ICI ring; memory stays O(S/cp) per chip).
+    context_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -240,8 +246,37 @@ def _moe_ffn(x, router, w_gate, w_up, w_down, cfg: LlamaConfig):
 
 def _block(x, positions, lp, cfg: LlamaConfig):
     """One decoder block; lp = this layer's param slice."""
-    x, aux = _block_core(x, positions, lp, cfg, gqa_attention, seq_shard=True)
+    if cfg.context_parallel:
+        from lws_tpu.ops.ring import ring_attention
+
+        _warn_if_trivial_cp()
+
+        def attn_fn(q, k, v):
+            # Ring attention over `cp` (ambient mesh), heads co-sharded on tp.
+            return ring_attention(q, k, v, axis="cp", batch_axis="dp", head_axis="tp")
+    else:
+        attn_fn = gqa_attention
+    x, aux = _block_core(x, positions, lp, cfg, attn_fn, seq_shard=True)
     return x, aux
+
+
+def _warn_if_trivial_cp() -> None:
+    """context_parallel over a size-1 cp axis silently degrades to a 1-rank
+    ring (attention memory stays O(S)); tell the user once."""
+    import warnings
+
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        cp = dict(zip(mesh.axis_names, mesh.axis_sizes)).get("cp", 1)
+    except Exception:  # noqa: BLE001 — no mesh context
+        return
+    if cp <= 1:
+        warnings.warn(
+            "cfg.context_parallel=True but the mesh's cp axis has size 1 — "
+            "ring attention degenerates to dense attention; build the mesh "
+            "with MeshSpec(cp=...) or mesh_from_bootstrap(..., cp=...)",
+            stacklevel=3,
+        )
 
 
 def _block_core(x, positions, lp, cfg: LlamaConfig, attn_fn, seq_shard: bool = False):
@@ -288,8 +323,12 @@ def _seq_shard(x):
     gather/reduce-scatter pairs around attention/matmuls. No-op outside a
     mesh context (single-chip serving/bench)."""
     try:
+        return jax.lax.with_sharding_constraint(x, P("dp", ("cp", "tp"), None))
+    except ValueError:
+        # Mesh without a cp axis (hand-built 3-axis meshes): tp-only seq shard.
         return jax.lax.with_sharding_constraint(x, P("dp", "tp", None))
     except RuntimeError:
+        # No mesh in context (single-chip serving): skip the constraint.
         return x
 
 

@@ -76,12 +76,24 @@ def ring_attention_inner(q, k, v, axis_name: str, causal: bool = True):
     return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, D)
 
 
-def ring_attention(q, k, v, mesh, axis: str = "cp", causal: bool = True):
-    """q [B,S,H,D], k/v [B,S,Hkv,D] fully or seq-sharded; runs the ring over
-    `axis` of `mesh` and returns [B,S,H,D] sharded the same way."""
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh=None,
+    axis: str = "cp",
+    causal: bool = True,
+    batch_axis=None,
+    head_axis=None,
+):
+    """q [B,S,H,D], k/v [B,S,Hkv,D]; runs the ring over `axis` and returns
+    [B,S,H,D] sharded the same way. `mesh=None` uses the ambient mesh context
+    (composable inside a GSPMD-jitted model). `batch_axis`/`head_axis`
+    optionally co-shard batch (dp) and heads (tp) so ring attention slots into
+    a dp x cp x tp layout."""
     from jax import shard_map
 
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, head_axis, None)
     inner = functools.partial(ring_attention_inner, axis_name=axis, causal=causal)
     fn = shard_map(
         inner,

@@ -23,25 +23,29 @@ class MeshSpec:
     dp: int = 1
     pp: int = 1
     tp: int = 1
+    # Context parallelism (ring attention over sequence chunks). Kept as a
+    # distinct axis from tp: cp shards the SEQUENCE through attention itself
+    # (ppermute ring), tp shards heads/features.
+    cp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.pp * self.tp
+        return self.dp * self.pp * self.tp * self.cp
 
     def axis_names(self) -> tuple[str, ...]:
-        return ("dp", "pp", "tp")
+        return ("dp", "pp", "cp", "tp")
 
 
-def auto_meshspec(n_devices: int, prefer_tp: int = 0, pp: int = 1) -> MeshSpec:
-    """Factor n_devices into (dp, pp, tp): tp gets the largest power-of-two
-    up to prefer_tp (or up to n/pp if unset), dp absorbs the rest."""
-    assert n_devices % pp == 0, f"{n_devices} devices not divisible by pp={pp}"
-    rest = n_devices // pp
+def auto_meshspec(n_devices: int, prefer_tp: int = 0, pp: int = 1, cp: int = 1) -> MeshSpec:
+    """Factor n_devices into (dp, pp, cp, tp): tp gets the largest power-of-two
+    up to prefer_tp (or up to n/(pp*cp) if unset), dp absorbs the rest."""
+    assert n_devices % (pp * cp) == 0, f"{n_devices} devices not divisible by pp*cp={pp * cp}"
+    rest = n_devices // (pp * cp)
     tp = prefer_tp or rest
     while rest % tp != 0:
         tp //= 2
     tp = max(1, tp)
-    return MeshSpec(dp=rest // tp, pp=pp, tp=tp)
+    return MeshSpec(dp=rest // tp, pp=pp, cp=cp, tp=tp)
 
 
 def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
@@ -51,20 +55,28 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
     devs = list(devices) if devices is not None else jax.devices()
     if len(devs) != spec.size:
         raise ValueError(f"mesh spec {spec} needs {spec.size} devices, have {len(devs)}")
-    arr = np.array(devs).reshape(spec.dp, spec.pp, spec.tp)
+    arr = np.array(devs).reshape(spec.dp, spec.pp, spec.cp, spec.tp)
     return Mesh(arr, spec.axis_names())
 
 
-def mesh_from_bootstrap(info, devices: Optional[Sequence] = None, pp_from_subgroups: bool = True):
+def mesh_from_bootstrap(
+    info, devices: Optional[Sequence] = None, pp_from_subgroups: bool = True, cp: int = 1
+):
     """Build the group-wide mesh from the bootstrap contract: with subgroups,
     pp = number of subgroups (sub-slice stages) and tp = chips per subgroup;
-    otherwise tp = all chips of the slice."""
+    otherwise tp = all chips of the slice. `cp` carves a context-parallel
+    axis out of tp for long-context ring attention (the production path to
+    cp > 1 — pair with cfg.context_parallel)."""
     import jax
 
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
+    if n % cp != 0:
+        raise ValueError(f"{n} devices not divisible by cp={cp}")
     if pp_from_subgroups and info.subgroup_size and info.num_processes > info.subgroup_size:
         n_subgroups = info.num_processes // info.subgroup_size
-        if n % n_subgroups == 0:
-            return build_mesh(MeshSpec(dp=1, pp=n_subgroups, tp=n // n_subgroups), devs)
-    return build_mesh(MeshSpec(dp=1, pp=1, tp=n), devs)
+        if n % (n_subgroups * cp) == 0:
+            return build_mesh(
+                MeshSpec(dp=1, pp=n_subgroups, cp=cp, tp=n // n_subgroups // cp), devs
+            )
+    return build_mesh(MeshSpec(dp=1, pp=1, cp=cp, tp=n // cp), devs)
