@@ -1,0 +1,77 @@
+"""Training throughput microbench (single chip): tokens/s and MFU for the
+flagship model's train step (adamw, remat, bf16 compute / f32 params).
+
+Not the driver-recorded benchmark (that is bench.py at the repo root); this is
+the training-side evidence: `python benchmarks/train_bench.py`.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+PEAK_BF16_FLOPS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "cpu": 1e12}
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from bench import detect_generation
+    from lws_tpu.models.llama import LlamaConfig
+    from lws_tpu.models.train import init_train_state, make_optimizer, make_train_step
+    from lws_tpu.parallel import MeshSpec, build_mesh
+    from lws_tpu.serving.engine import host_sync
+
+    on_accel = jax.default_backend() != "cpu"
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32000, d_model=1536, n_layers=12, n_heads=12, n_kv_heads=6,
+            d_ff=4096, max_seq_len=2048, remat=True,
+        )
+        batch, seq, steps = 4, 1024, 8
+    else:
+        cfg = LlamaConfig(
+            vocab_size=256, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=256, max_seq_len=128, remat=True,
+        )
+        batch, seq, steps = 2, 64, 3
+
+    mesh = build_mesh(MeshSpec(), jax.devices()[:1])
+    opt = make_optimizer()
+    state = init_train_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    batch_data = {
+        "tokens": jax.random.randint(jax.random.key(0), (batch, seq + 1), 0, cfg.vocab_size).astype(jnp.int32)
+    }
+    n_params = cfg.n_params()
+    print(f"[train_bench] {n_params/1e9:.2f}B params, batch={batch} x seq={seq}", file=sys.stderr)
+
+    params, opt_state, loss, _ = step(state.params, state.opt_state, batch_data)
+    host_sync(loss)  # compile
+
+    def run(n):
+        nonlocal params, opt_state
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, opt_state, loss, _ = step(params, opt_state, batch_data)
+        host_sync(loss)
+        return time.perf_counter() - t0
+
+    run(1)
+    t1, tn = run(1), run(steps)
+    step_s = (tn - t1) / (steps - 1)
+    tokens_per_s = batch * seq / step_s
+    # 6ND: fwd 2ND + bwd 4ND (attention extra ~ +15% ignored -> conservative MFU).
+    flops_per_step = 6 * n_params * batch * seq
+    gen = detect_generation()
+    mfu = flops_per_step / step_s / PEAK_BF16_FLOPS.get(gen, PEAK_BF16_FLOPS["v5e"])
+    print(
+        f"train: {step_s*1e3:.1f} ms/step, {tokens_per_s:,.0f} tokens/s/chip, "
+        f"MFU {mfu:.1%} ({gen}, loss {float(loss):.3f})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,12 +39,27 @@ def cmd_serve(args) -> int:
     from lws_tpu.runtime import ControlPlane
     from lws_tpu.runtime.server import ApiServer
 
+    import os
+
+    from lws_tpu.core.serialize import load_store, save_store
+
     cfg = load_configuration(args.config) if args.config else Configuration()
     cp = ControlPlane(
         scheduler_provider=cfg.gang_scheduling_management.scheduler_provider,
         enable_scheduler=cfg.enable_scheduler,
         auto_ready=(cfg.backend == "fake"),
     )
+    if args.state_file and os.path.exists(args.state_file):
+        try:
+            n = load_store(cp.store, args.state_file)
+        except (ValueError, KeyError, TypeError) as e:
+            # Refusing to start beats silently discarding cluster state.
+            raise SystemExit(
+                f"error: state file {args.state_file} is corrupt ({e}); "
+                "move it aside to start fresh"
+            ) from None
+        print(f"restored {n} objects from {args.state_file}")
+        cp.resync()
     if cfg.backend == "local":
         import threading
 
@@ -79,12 +94,20 @@ def cmd_serve(args) -> int:
     cp.manager.start()
     print(f"lws-tpu control plane serving on http://127.0.0.1:{server.port} "
           f"(backend={cfg.backend}, scheduler={cfg.enable_scheduler})")
+    dirty = {"flag": False}
+    if args.state_file:
+        cp.store.watch(lambda _ev: dirty.__setitem__("flag", True))
     try:
         while True:
-            time.sleep(3600)
+            time.sleep(5 if args.state_file else 3600)
+            if args.state_file and dirty["flag"]:
+                dirty["flag"] = False
+                save_store(cp.store, args.state_file)
     except KeyboardInterrupt:
         cp.manager.stop()
         server.stop()
+        if args.state_file:
+            save_store(cp.store, args.state_file)
     return 0
 
 
@@ -179,6 +202,8 @@ def main(argv=None) -> int:
     sp.add_argument("--config", default=None)
     sp.add_argument("-f", "--filename", action="append")
     sp.add_argument("--port", type=int, default=9443)
+    sp.add_argument("--state-file", default=None,
+                    help="persist the object store here; restored on restart")
     sp.set_defaults(fn=cmd_serve)
 
     ap = sub.add_parser("apply")

@@ -265,11 +265,10 @@ def _warn_if_trivial_cp() -> None:
     ring (attention memory stays O(S)); tell the user once."""
     import warnings
 
-    try:
-        mesh = jax.sharding.get_abstract_mesh()
-        cp = dict(zip(mesh.axis_names, mesh.axis_sizes)).get("cp", 1)
-    except Exception:  # noqa: BLE001 — no mesh context
-        return
+    mesh = jax.sharding.get_abstract_mesh()
+    if not mesh.axis_names:
+        return  # no mesh at all: shard_map will raise the real error shortly
+    cp = dict(zip(mesh.axis_names, mesh.axis_sizes)).get("cp", 1)
     if cp <= 1:
         warnings.warn(
             "cfg.context_parallel=True but the mesh's cp axis has size 1 — "

@@ -75,8 +75,14 @@ def mesh_from_bootstrap(
         raise ValueError(f"{n} devices not divisible by cp={cp}")
     if pp_from_subgroups and info.subgroup_size and info.num_processes > info.subgroup_size:
         n_subgroups = info.num_processes // info.subgroup_size
-        if n % (n_subgroups * cp) == 0:
-            return build_mesh(
-                MeshSpec(dp=1, pp=n_subgroups, cp=cp, tp=n // n_subgroups // cp), devs
+        if n % (n_subgroups * cp) != 0:
+            # Never silently drop the pp axis: subgroup i = stage i is the
+            # documented bootstrap contract.
+            raise ValueError(
+                f"{n} devices not divisible by subgroups({n_subgroups}) x cp({cp}); "
+                "adjust cp or the subgroup layout"
             )
+        return build_mesh(
+            MeshSpec(dp=1, pp=n_subgroups, cp=cp, tp=n // n_subgroups // cp), devs
+        )
     return build_mesh(MeshSpec(dp=1, pp=1, cp=cp, tp=n // cp), devs)

@@ -26,6 +26,45 @@ from lws_tpu.core.store import Key, Store
 
 ADDRESS_ENV_VARS = (contract.LWS_LEADER_ADDRESS, contract.JAX_COORDINATOR_ADDRESS)
 
+# Pid of the pod's process, recorded so a restarted backend can re-adopt it.
+PID_ANNOTATION_KEY = "local.lws.tpu/pid"
+
+
+class _ReadoptedProcess:
+    """Handle to a process spawned by a PREVIOUS backend incarnation: alive
+    checks via signal 0; an exit while unowned reads as failure (we cannot
+    reap its true status), which correctly trips the restart policy."""
+
+    def __init__(self, pid: int) -> None:
+        self.pid = pid
+
+    def poll(self) -> Optional[int]:
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            return 1
+        except PermissionError:
+            return None
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, 15)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    kill = terminate
+
+
+def _pid_belongs_to_pod(pid: int, pod_name: str) -> bool:
+    """Guard against pid reuse: the process env must carry our POD_NAME."""
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as f:
+            env = f.read().split(b"\0")
+        return f"POD_NAME={pod_name}".encode() in env
+    except OSError:
+        return False
+
 
 class LocalBackend:
     name = "local-backend"
@@ -78,9 +117,18 @@ class LocalBackend:
         if proc is None:
             if pod.status.phase == PodPhase.PENDING:
                 self._spawn(pod)
+                return None
+            if pod.status.phase == PodPhase.RUNNING:
+                # Control-plane restart: re-adopt the live process (or report
+                # it dead so the restart policy recreates the group).
+                self._readopt(pod)
             return None
         code = proc.poll()
         if code is None:
+            if pod.status.phase == PodPhase.PENDING:
+                # Level-triggered repair: an earlier Running write lost its
+                # optimistic-concurrency race; apply it now.
+                self._mark_running(pod.meta.namespace, pod.meta.name, pod.meta.uid, proc.pid)
             return None
         # Process exited: report status (once).
         if code == 0 and pod.status.phase != PodPhase.SUCCEEDED:
@@ -120,9 +168,54 @@ class LocalBackend:
             return
         with self._lock:
             self._procs[pod.meta.uid] = proc
-        pod.status.phase = PodPhase.RUNNING
-        pod.status.ready = True
-        pod.status.address = "127.0.0.1"
+        self._mark_running(pod.meta.namespace, pod.meta.name, pod.meta.uid, proc.pid)
+
+    def _mark_running(self, namespace: str, name: str, uid: str, pid: int) -> None:
+        """Record pid + Running status on the EXACT pod incarnation we spawned
+        for; retries update races (further repair happens level-triggered in
+        reconcile). A same-name/new-uid pod (group recreated mid-flight) must
+        never inherit this process."""
+        from lws_tpu.core.store import ConflictError
+
+        for _ in range(5):
+            fresh = self.store.try_get("Pod", namespace, name)
+            if fresh is None or fresh.meta.uid != uid:
+                # Our pod incarnation is gone: the process is an orphan.
+                with self._lock:
+                    proc = self._procs.pop(uid, None)
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+                return
+            try:
+                fresh.meta.annotations[PID_ANNOTATION_KEY] = str(pid)
+                fresh = self.store.update(fresh)
+                fresh.status.phase = PodPhase.RUNNING
+                fresh.status.ready = True
+                fresh.status.address = "127.0.0.1"
+                self.store.update_status(fresh)
+                return
+            except ConflictError:
+                continue
+
+    def _readopt(self, pod: Pod) -> None:
+        raw_pid = pod.meta.annotations.get(PID_ANNOTATION_KEY)
+        pid = int(raw_pid) if raw_pid and raw_pid.isdigit() else None
+        if pid is not None and _pid_belongs_to_pod(pid, pod.meta.name):
+            with self._lock:
+                self._procs[pod.meta.uid] = _ReadoptedProcess(pid)
+            return
+        # Process gone or unverifiable: make sure it is not merely
+        # unverifiable-but-alive (pid reuse aside, an unreadable /proc entry)
+        # before the restart policy spawns a replacement next to it.
+        if pid is not None:
+            try:
+                os.kill(pid, 15)
+            except (ProcessLookupError, PermissionError):
+                pass
+        pod.status.phase = PodPhase.FAILED
+        pod.status.ready = False
+        pod.status.message = "process lost across control-plane restart"
+        pod.status.container_restarts += 1
         self.store.update_status(pod)
 
     def _kill_orphans(self) -> None:

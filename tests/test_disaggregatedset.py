@@ -165,3 +165,33 @@ def test_ds_name_length_bounded_at_admission():
     cp = ControlPlane()
     with pytest.raises(AdmissionError):
         cp.create(make_ds(name="a" * 50))  # derived service name would exceed 63
+
+
+def test_per_role_percentage_budgets_drive_step_size():
+    """Per-role maxSurge as a percentage (ref executor.go:235-260): 50% of 4
+    replicas -> surge batches of 2, so the rollout takes fewer steps."""
+    from lws_tpu.api.types import RollingUpdateConfiguration, RolloutStrategy
+
+    cp = ControlPlane(auto_ready=True)
+    roles = [role("prefill", replicas=4), role("decode", replicas=4)]
+    for r in roles:
+        r.template.spec.rollout_strategy = RolloutStrategy(
+            rolling_update_configuration=RollingUpdateConfiguration(max_surge="50%")
+        )
+    ds = cp.create(make_ds(roles=roles))
+    cp.run_until_stable()
+    rev1 = dsutils.compute_revision(ds.spec.roles)
+
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:v2"
+    cp.store.update(fetched)
+    rev2 = dsutils.compute_revision(fetched.spec.roles)
+    cp.run_until_stable()
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-{rev2}-prefill", f"llmd-{rev2}-decode"}
+    assert all(l.spec.replicas == 4 and l.status.ready_replicas == 4 for l in children.values())
+    # Surge of 2 per step: scale-up events should show jumps of 2.
+    ups = [e.message for e in cp.recorder.events if e.reason == "ScalingUp" and "prefill" in e.message]
+    assert any("from 0 to 2" in m for m in ups), ups

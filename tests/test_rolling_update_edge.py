@@ -1,0 +1,113 @@
+"""Rolling-update edge tables (≈ the wider maxSurge/maxUnavailable/partition
+combinations of test/integration/controllers/leaderworkerset_test.go)."""
+
+import pytest
+
+from lws_tpu.api import contract
+from lws_tpu.api.types import CONDITION_AVAILABLE
+from lws_tpu.core.store import AdmissionError
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder, condition_status, lws_pods
+from tests.test_rolling_update import image_of, settle_and_make_ready, update_image
+
+
+def test_percentage_budgets():
+    # 50% of 4 -> maxUnavailable 2; 25% -> surge ceil(1).
+    cp = ControlPlane()
+    cp.create(
+        LWSBuilder().replicas(4).size(2).image("img:v1")
+        .rollout(max_unavailable="50%", max_surge="25%").build()
+    )
+    settle_and_make_ready(cp)
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.replicas == 5  # surge ceil(25% of 4) = 1
+    settle_and_make_ready(cp)
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.replicas == 4
+    for i in range(4):
+        assert image_of(cp, f"sample-{i}") == "img:v2"
+
+
+def test_surge_with_partition_keeps_burst_until_done():
+    """Partition + maxSurge: burst replicas remain until the partition is
+    reset (ref RollingUpdateConfiguration docs: 'bursted replicas will keep
+    remaining until ... the partition field is reset to 0')."""
+    cp = ControlPlane()
+    cp.create(
+        LWSBuilder().replicas(4).size(2).image("img:v1")
+        .rollout(max_unavailable=1, max_surge=1, partition=2).build()
+    )
+    settle_and_make_ready(cp)
+    update_image(cp, "sample", "img:v2")
+    settle_and_make_ready(cp)
+
+    assert image_of(cp, "sample-2") == "img:v2"
+    assert image_of(cp, "sample-3") == "img:v2"
+    assert image_of(cp, "sample-0") == "img:v1"
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert condition_status(lws, CONDITION_AVAILABLE) is True
+
+    lws.spec.rollout_strategy.rolling_update_configuration.partition = 0
+    cp.store.update(lws)
+    settle_and_make_ready(cp)
+    for i in range(4):
+        assert image_of(cp, f"sample-{i}") == "img:v2"
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.replicas == 4
+    assert gs.spec.update_strategy.partition == 0
+
+
+def test_scale_down_during_rolling_update():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(4).size(2).image("img:v1").build())
+    settle_and_make_ready(cp)
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.replicas = 2
+    cp.store.update(lws)
+    settle_and_make_ready(cp)
+    assert len(lws_pods(cp.store, "sample")) == 4  # 2 groups x size 2
+    for name in ("sample-0", "sample-1"):
+        assert image_of(cp, name) == "img:v2"
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 2
+    assert condition_status(lws, CONDITION_AVAILABLE) is True
+
+
+def test_size_change_is_a_rolling_update():
+    """Changing size is a template change: groups are rebuilt group-by-group
+    with the new worker count."""
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(2).size(2).build())
+    settle_and_make_ready(cp)
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.leader_worker_template.size = 3
+    cp.store.update(lws)
+    settle_and_make_ready(cp)
+    pods = sorted(p.meta.name for p in lws_pods(cp.store, "sample"))
+    assert pods == ["sample-0", "sample-0-1", "sample-0-2", "sample-1", "sample-1-1", "sample-1-2"]
+    for p in lws_pods(cp.store, "sample"):
+        assert p.meta.annotations[contract.SIZE_ANNOTATION_KEY] == "3"
+
+
+def test_both_zero_budgets_rejected():
+    cp = ControlPlane()
+    with pytest.raises(AdmissionError):
+        cp.create(LWSBuilder().rollout(max_unavailable=0, max_surge=0).build())
+
+
+def test_replicas_zero_with_percent_budgets():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(
+        LWSBuilder().replicas(0).size(2).rollout(max_unavailable="50%", max_surge="50%").build()
+    )
+    cp.run_until_stable()
+    assert lws_pods(cp.store, "sample") == []
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.replicas = 2
+    cp.store.update(lws)
+    cp.run_until_stable()
+    assert len(lws_pods(cp.store, "sample")) == 4
