@@ -52,7 +52,7 @@ def main() -> None:
     host_sync(loss)  # compile
 
     def run(n):
-        nonlocal params, opt_state
+        nonlocal params, opt_state, loss
         t0 = time.perf_counter()
         for _ in range(n):
             params, opt_state, loss, _ = step(params, opt_state, batch_data)

@@ -86,17 +86,24 @@ def cmd_serve(args) -> int:
 
     for path in args.filename or []:
         for obj in load_manifests(path):
-            cp.store.create(obj)
-            print(f"created {obj.kind}/{obj.meta.name}")
+            # Apply semantics: a restart with the same -f manifests over a
+            # restored state file must not crash on already-existing objects.
+            if cp.store.try_get(obj.kind, obj.meta.namespace, obj.meta.name) is None:
+                cp.store.create(obj)
+                print(f"created {obj.kind}/{obj.meta.name}")
+            else:
+                print(f"exists {obj.kind}/{obj.meta.name} (restored)")
 
     server = ApiServer(cp, port=args.port)
+    dirty = {"flag": True}  # always persist once after boot
+    if args.state_file:
+        # Register BEFORE the manager threads start: the first burst of
+        # post-restore reconcile writes must mark the state dirty too.
+        cp.store.watch(lambda _ev: dirty.__setitem__("flag", True))
     server.start()
     cp.manager.start()
     print(f"lws-tpu control plane serving on http://127.0.0.1:{server.port} "
           f"(backend={cfg.backend}, scheduler={cfg.enable_scheduler})")
-    dirty = {"flag": False}
-    if args.state_file:
-        cp.store.watch(lambda _ev: dirty.__setitem__("flag", True))
     try:
         while True:
             time.sleep(5 if args.state_file else 3600)

@@ -145,6 +145,17 @@ class LocalBackend:
 
     # ------------------------------------------------------------------
     def _spawn(self, pod: Pod) -> None:
+        # A PENDING pod may still own a live pre-restart process (the snapshot
+        # predated _mark_running's writes): adopt it instead of double-spawning
+        # two workers onto the same chips/ports.
+        raw_pid = pod.meta.annotations.get(PID_ANNOTATION_KEY)
+        if raw_pid and raw_pid.isdigit():
+            pid = int(raw_pid)
+            if _pid_belongs_to_pod(pid, pod.meta.name):
+                with self._lock:
+                    self._procs[pod.meta.uid] = _ReadoptedProcess(pid)
+                self._mark_running(pod.meta.namespace, pod.meta.name, pod.meta.uid, pid)
+                return
         container = pod.spec.containers[0]
         command = container.command or self.default_command
         env = {k: v for k, v in os.environ.items() if k not in self.env_drop}

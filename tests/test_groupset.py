@@ -1,0 +1,126 @@
+"""Direct GroupSet controller semantics (the statefulset-controller role the
+reference outsources to Kubernetes): ordinals, parallel creation, partition
+rolling updates within the unavailability budget, PVC provisioning."""
+
+from lws_tpu.api import contract
+from lws_tpu.api.groupset import GroupSet, GroupSetSpec, GroupSetUpdateStrategy, groupset_ready
+from lws_tpu.api.pod import Container, PodSpec, PodTemplateSpec, TemplateMeta, VolumeClaimTemplate
+from lws_tpu.controllers.groupset_controller import GroupSetReconciler
+from lws_tpu.core.events import EventRecorder
+from lws_tpu.core.store import Store, new_meta
+from lws_tpu.testing import set_pod_ready
+
+
+def make_gs(name="gs", replicas=3, start=0, image="a:v1", partition=0, max_unavailable=1):
+    return GroupSet(
+        meta=new_meta(name),
+        spec=GroupSetSpec(
+            replicas=replicas,
+            start_ordinal=start,
+            template=PodTemplateSpec(
+                metadata=TemplateMeta(labels={"app": name}),
+                spec=PodSpec(containers=[Container(image=image)]),
+            ),
+            service_name=name,
+            update_strategy=GroupSetUpdateStrategy(partition=partition, max_unavailable=max_unavailable),
+        ),
+    )
+
+
+def harness():
+    store = Store()
+    rec = GroupSetReconciler(store, EventRecorder())
+    return store, rec
+
+
+def reconcile(store, rec, name="gs"):
+    rec.reconcile(("GroupSet", "default", name))
+
+
+def test_creates_ordinal_range_in_parallel():
+    store, rec = harness()
+    store.create(make_gs(replicas=3, start=1))
+    reconcile(store, rec)
+    names = sorted(p.meta.name for p in store.list("Pod"))
+    assert names == ["gs-1", "gs-2", "gs-3"]  # start_ordinal=1 (worker sets)
+    gs = store.get("GroupSet", "default", "gs")
+    assert gs.status.replicas == 3
+    assert gs.status.update_revision
+
+
+def test_scale_down_removes_highest_ordinals():
+    store, rec = harness()
+    gs = store.create(make_gs(replicas=4))
+    reconcile(store, rec)
+    gs = store.get("GroupSet", "default", "gs")
+    gs.spec.replicas = 2
+    store.update(gs)
+    reconcile(store, rec)
+    assert sorted(p.meta.name for p in store.list("Pod")) == ["gs-0", "gs-1"]
+
+
+def test_rolling_update_highest_first_within_budget():
+    store, rec = harness()
+    store.create(make_gs(replicas=3))
+    reconcile(store, rec)
+    for p in store.list("Pod"):
+        set_pod_ready(store, "default", p.meta.name)
+    reconcile(store, rec)
+    gs = store.get("GroupSet", "default", "gs")
+    assert groupset_ready(gs)
+
+    gs.spec.template.spec.containers[0].image = "a:v2"
+    store.update(gs)
+    reconcile(store, rec)  # deletes the highest old-revision pod (budget 1)
+    assert sorted(p.meta.name for p in store.list("Pod")) == ["gs-0", "gs-1"]
+    reconcile(store, rec)  # recreates it from the new template
+    pods = {p.meta.name: p for p in store.list("Pod")}
+    assert pods["gs-2"].spec.containers[0].image == "a:v2"
+    assert pods["gs-0"].spec.containers[0].image == "a:v1"
+    reconcile(store, rec)
+    pods = {p.meta.name: p for p in store.list("Pod")}
+    assert pods["gs-1"].spec.containers[0].image == "a:v1"  # still held back
+
+    set_pod_ready(store, "default", "gs-2")
+    reconcile(store, rec)  # budget freed: deletes gs-1
+    reconcile(store, rec)  # recreates gs-1 from the new template
+    pods = {p.meta.name: p for p in store.list("Pod")}
+    assert pods["gs-1"].spec.containers[0].image == "a:v2"
+
+
+def test_partition_floor_respected():
+    store, rec = harness()
+    store.create(make_gs(replicas=3, partition=2))
+    reconcile(store, rec)
+    for p in store.list("Pod"):
+        set_pod_ready(store, "default", p.meta.name)
+    gs = store.get("GroupSet", "default", "gs")
+    gs.spec.template.spec.containers[0].image = "a:v2"
+    store.update(gs)
+    for _ in range(4):
+        reconcile(store, rec)
+        for p in store.list("Pod"):
+            if not p.status.ready:
+                set_pod_ready(store, "default", p.meta.name)
+    pods = {p.meta.name: p for p in store.list("Pod")}
+    assert pods["gs-2"].spec.containers[0].image == "a:v2"
+    assert pods["gs-0"].spec.containers[0].image == "a:v1"
+    assert pods["gs-1"].spec.containers[0].image == "a:v1"
+
+
+def test_pvcs_created_per_pod():
+    store, rec = harness()
+    gs = make_gs(replicas=2)
+    gs.spec.volume_claim_templates = [VolumeClaimTemplate(name="data", storage="1Gi")]
+    gs.spec.pvc_retention_policy_when_scaled = "Delete"
+    store.create(gs)
+    reconcile(store, rec)
+    assert sorted(p.meta.name for p in store.list("PersistentVolumeClaim")) == [
+        "data-gs-0", "data-gs-1",
+    ]
+    fresh = store.get("GroupSet", "default", "gs")
+    fresh.spec.replicas = 1
+    store.update(fresh)
+    reconcile(store, rec)
+    # whenScaled=Delete: the removed ordinal's PVC goes too.
+    assert sorted(p.meta.name for p in store.list("PersistentVolumeClaim")) == ["data-gs-0"]

@@ -1,0 +1,48 @@
+"""LWS defaulting parity (≈ pkg/webhooks/leaderworkerset_webhook.go:52-85 +
+its unit tests): every default the reference applies, applied here."""
+
+from lws_tpu.api.types import (
+    RestartPolicy,
+    RolloutStrategyType,
+    StartupPolicy,
+    SubdomainPolicy,
+    SubGroupPolicyType,
+)
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder
+
+
+def test_defaults_applied_on_create():
+    cp = ControlPlane()
+    lws = cp.create(LWSBuilder().subgroup(3, None).size(3).build())
+    spec = lws.spec
+    assert spec.rollout_strategy.type == RolloutStrategyType.ROLLING_UPDATE
+    cfg = spec.rollout_strategy.rolling_update_configuration
+    assert (cfg.partition, cfg.max_unavailable, cfg.max_surge) == (0, 1, 0)
+    assert spec.startup_policy == StartupPolicy.LEADER_CREATED
+    assert spec.network_config.subdomain_policy == SubdomainPolicy.SHARED
+    # Subgroup policy type defaults to LeaderWorker when a policy is set.
+    assert spec.leader_worker_template.sub_group_policy.type == SubGroupPolicyType.LEADER_WORKER
+
+
+def test_deprecated_default_restart_policy_maps_to_none():
+    cp = ControlPlane()
+    lws = cp.create(
+        LWSBuilder().restart_policy(RestartPolicy.DEPRECATED_DEFAULT).build()
+    )
+    assert lws.spec.leader_worker_template.restart_policy == RestartPolicy.NONE
+
+
+def test_defaults_do_not_override_user_choices():
+    cp = ControlPlane()
+    lws = cp.create(
+        LWSBuilder()
+        .rollout(max_unavailable=2, max_surge=3, partition=1)
+        .startup_policy(StartupPolicy.LEADER_READY)
+        .subdomain_policy(SubdomainPolicy.UNIQUE_PER_REPLICA)
+        .build()
+    )
+    cfg = lws.spec.rollout_strategy.rolling_update_configuration
+    assert (cfg.partition, cfg.max_unavailable, cfg.max_surge) == (1, 2, 3)
+    assert lws.spec.startup_policy == StartupPolicy.LEADER_READY
+    assert lws.spec.network_config.subdomain_policy == SubdomainPolicy.UNIQUE_PER_REPLICA
