@@ -56,6 +56,9 @@ class LlamaConfig:
     # Long context: exact ring attention over the mesh's `cp` axis (sequence
     # chunks rotate around the ICI ring; memory stays O(S/cp) per chip).
     context_parallel: bool = False
+    # Training: GPipe microbatch pipelining over `pp` (0 = weight-gathered
+    # scan). Must divide the global batch; see models/pipeline.py.
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -338,16 +341,28 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> tuple[jax.Arra
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = _seq_shard(x)
 
-    block = _block
-    if cfg.remat:
-        block = jax.checkpoint(_block, static_argnums=(3,))
+    if cfg.pipeline_microbatches > 0:
+        if cfg.context_parallel:
+            raise NotImplementedError("pipeline_microbatches with context_parallel")
+        if cfg.n_experts:
+            # The GSPMD partitioner CHECK-fails on the MoE all-to-all inside
+            # the partial-auto pipeline body (xla spmd_partitioner_util.cc);
+            # keep MoE on the weight-gathered pp path until that is resolved.
+            raise NotImplementedError("pipeline_microbatches with n_experts (use the scan pp path)")
+        from lws_tpu.models.pipeline import pipeline_forward
 
-    def body(carry, lp):
-        x, aux = carry
-        x, a = block(x, positions, lp, cfg)
-        return (x, aux + a), None
+        x, aux = pipeline_forward(params["layers"], x, positions, cfg, _block)
+    else:
+        block = _block
+        if cfg.remat:
+            block = jax.checkpoint(_block, static_argnums=(3,))
 
-    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+        def body(carry, lp):
+            x, aux = carry
+            x, a = block(x, positions, lp, cfg)
+            return (x, aux + a), None
+
+        (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, aux / cfg.n_layers

@@ -1,0 +1,129 @@
+"""GPipe microbatch pipelining over the `pp` mesh axis.
+
+The default pp path streams the P("pp")-sharded layer stack through a scan
+(weight-gathered: layer weights move to the data). This module moves the data
+to the weights instead: shard_map manual over `pp` only (dp/cp/tp stay
+auto/GSPMD inside the body), the classic GPipe schedule —
+
+    step t: stage 0 ingests microbatch t; every stage applies its local
+    layers; activations ppermute to the next stage; the last stage banks
+    microbatch t-(pp-1).
+
+M + pp - 1 steps total, bubble fraction (pp-1)/(M+pp-1). Activations hop one
+ICI neighbor per step (the mesh reshape puts adjacent pp ranks on adjacent
+sub-slices — the subgroup exclusive-topology contract). Differentiable: the
+time loop is a lax.scan and ppermute has a transpose rule, so jax.grad
+produces the mirrored reverse schedule.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+# XLA CPU aborts on the transpose of bf16 collectives (ppermute/psum —
+# reproduced minimally, including GSPMD-inserted tp all-reduces inside the
+# partial-auto body). CPU is the test platform only, so the whole pipeline
+# body runs f32 there; TPU keeps bf16 end to end.
+def _cpu_safe_dtype(dtype):
+    if dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
+        return jnp.float32
+    return dtype
+
+
+def pipeline_forward(params_layers, x, positions, cfg, block_fn):
+    """x: [B, S, D] embedded activations; returns ([B, S, D], aux).
+
+    params_layers: the stacked per-layer params pytree ([L, ...] leaves,
+    sharded P("pp", ...)). block_fn(x, positions, lp, cfg) -> (x, aux) is the
+    shared decoder block. cfg.pipeline_microbatches = M must divide B.
+    """
+    M = cfg.pipeline_microbatches
+    B, S, D = x.shape
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by pipeline_microbatches={M}")
+    mb = B // M
+    import dataclasses
+
+    orig_dtype = x.dtype
+    safe = _cpu_safe_dtype(x.dtype)
+    if safe != x.dtype:
+        x = x.astype(safe)
+        cfg = dataclasses.replace(cfg, dtype=safe)
+    x_mb = x.reshape(M, mb, S, D)
+    pos_mb = positions.reshape(M, mb, S)
+
+    fn = jax.shard_map(
+        partial(_pipeline_body, cfg=cfg, block_fn=block_fn),
+        in_specs=(P("pp"), P(), P()),
+        out_specs=(P(), P()),
+        axis_names={"pp"},  # dp/cp/tp remain auto (GSPMD inside the body)
+        check_vma=False,
+    )
+    out_mb, aux = fn(params_layers, x_mb, pos_mb)
+    return out_mb.reshape(B, S, D).astype(orig_dtype), aux
+
+
+def _pipeline_body(local_layers, x_mb, pos_mb, *, cfg, block_fn):
+    """Runs on one pp rank: local_layers are this stage's [L/pp, ...] slice."""
+    stage = jax.lax.axis_index("pp")
+    n_stage = jax.lax.axis_size("pp")
+    M = x_mb.shape[0]
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    def apply_stage(x, positions):
+        def body(carry, lp):
+            x, aux = carry
+            x, a = block_fn(x, positions, lp, cfg)
+            return (x, aux + a), None
+
+        (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), local_layers)
+        return x, aux
+
+    if cfg.remat:
+        apply_stage = jax.checkpoint(apply_stage)
+
+    def step(carry, t):
+        state, aux_total = carry
+        mb_in = jnp.minimum(t, M - 1)
+        inp = jnp.where(stage == 0, x_mb[mb_in], state)
+        # Positions travel with the schedule: the microbatch reaching stage s
+        # at step t entered at step t-s.
+        mb_here = jnp.clip(t - stage, 0, M - 1)
+        out, aux = apply_stage(inp, pos_mb[mb_here])
+        # Bubble steps process garbage; mask their aux contribution.
+        valid = (t - stage >= 0) & (t - stage < M)
+        aux_total = aux_total + jnp.where(valid, aux, 0.0)
+        state = jax.lax.ppermute(out, "pp", perm)
+        # The last stage banks its finished microbatch.
+        mb_out = t - (n_stage - 1)
+        banked = jnp.where((stage == n_stage - 1) & (mb_out >= 0), out, jnp.zeros_like(out))
+        return (state, aux_total), (banked, mb_out)
+
+    init = (jnp.zeros_like(x_mb[0]), jnp.zeros((), jnp.float32))
+    (_, aux_total), (banked, mb_idx) = jax.lax.scan(
+        step, init, jnp.arange(M + n_stage - 1)
+    )
+    # Scatter banked outputs [T, mb, S, D] into microbatch order; only the
+    # last stage holds real data — broadcast it to every stage so the result
+    # is replicated over pp (out_specs P()).
+    out_mb = jnp.zeros_like(x_mb)
+    out_mb = out_mb.at[jnp.clip(mb_idx, 0, M - 1)].add(
+        jnp.where((mb_idx >= 0)[:, None, None, None], banked, 0.0)
+    )
+    out_mb = _bcast_from_last(out_mb, n_stage)
+    # Rank-0 psum under grad-with-kept-primal aborts XLA CPU; reduce a
+    # shaped (1,) array and squeeze outside the collective.
+    aux_total = jax.lax.psum(aux_total[None], "pp")[0] / jnp.maximum(M, 1)
+    return out_mb, aux_total
+
+
+def _bcast_from_last(x, n_stage):
+    """Replicate the last stage's value to all pp ranks (psum of a mask)."""
+    stage = jax.lax.axis_index("pp")
+    contrib = jnp.where(stage == n_stage - 1, x, jnp.zeros_like(x))
+    return jax.lax.psum(contrib, "pp")

@@ -141,3 +141,54 @@ def test_train_step_with_cp_axis():
     params, opt_state, l0, _ = step(state.params, state.opt_state, batch)
     params, opt_state, l1, _ = step(params, opt_state, batch)
     assert jnp.isfinite(l0) and jnp.isfinite(l1) and float(l1) < float(l0)
+
+
+def test_gpipe_pipeline_matches_dense():
+    """GPipe microbatch pipelining must be numerically identical to the
+    weight-gathered scan path (f32)."""
+    import dataclasses
+
+    cfg = tiny_cfg(n_layers=4, dtype=jnp.float32)
+    cfg_pipe = dataclasses.replace(cfg, pipeline_microbatches=2)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(2), (4, 16), 0, cfg.vocab_size).astype(jnp.int32)
+    dense_logits, _ = forward(params, tokens, cfg)
+    mesh = build_mesh(MeshSpec(dp=2, pp=2, tp=2))
+    with jax.set_mesh(mesh):
+        piped_logits, _ = jax.jit(lambda p, t: forward(p, t, cfg_pipe))(params, tokens)
+    assert jnp.allclose(dense_logits, piped_logits, atol=1e-4), (
+        float(jnp.abs(dense_logits - piped_logits).max())
+    )
+
+
+def test_gpipe_train_step_learns():
+    """Gradients flow through the ppermute schedule (reverse pipeline); the
+    full train step learns under the production default remat."""
+    cfg = tiny_cfg(n_layers=4, pipeline_microbatches=4, remat=True)
+    mesh = build_mesh(MeshSpec(dp=1, pp=4, tp=2))
+    opt = make_optimizer(lr=1e-2)
+    state = init_train_state(cfg, mesh, opt)
+    step = make_train_step(cfg, mesh, opt)
+    batch = {
+        "tokens": jax.random.randint(jax.random.key(3), (8, 17), 0, cfg.vocab_size).astype(jnp.int32)
+    }
+    params, opt_state, l0, _ = step(state.params, state.opt_state, batch)
+    losses = [float(l0)]
+    for _ in range(4):
+        params, opt_state, l, _ = step(params, opt_state, batch)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+
+
+def test_gpipe_moe_rejected_clearly():
+    """MoE + GPipe trips a GSPMD partitioner CHECK-abort (XLA bug, see
+    STATUS.md); the combination must fail with a clear error instead."""
+    import pytest as _pytest
+
+    from lws_tpu.models import init_params
+    from lws_tpu.models.llama import forward as _forward
+
+    cfg = tiny_cfg(n_experts=4, top_k=2, pipeline_microbatches=2)
+    params = init_params(cfg, jax.random.key(0))
+    with _pytest.raises(NotImplementedError, match="n_experts"):
+        _forward(params, jnp.ones((4, 16), jnp.int32), cfg)
