@@ -46,7 +46,37 @@ def detect_generation() -> str:
     return "v5e"
 
 
+def _probe_backend(timeout_s: int = 240) -> None:
+    """Backend init on relay-backed TPU plugins blocks indefinitely (in C,
+    unkillable by SIGALRM) when the remote side is down. Probe it in a
+    subprocess with a hard timeout so the bench fails loudly instead of
+    hanging the driver."""
+    import os
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return  # dev mode: no TPU backend will be touched
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            check=True,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"error: TPU backend initialization did not complete in {timeout_s}s "
+            "(remote relay unavailable?) — aborting bench"
+        ) from None
+    except subprocess.CalledProcessError as e:
+        raise SystemExit(
+            f"error: TPU backend initialization failed: {e.stderr.decode()[-400:]}"
+        ) from None
+
+
 def main() -> None:
+    _probe_backend()
+
     import jax
     import jax.numpy as jnp
 

@@ -188,3 +188,25 @@ def test_lws_delete_cascades_everything():
     assert cp.store.list("GroupSet") == []
     assert cp.store.list("Service") == []
     assert cp.store.list("ControllerRevision") == []
+
+
+def test_threaded_manager_mode():
+    """The background-thread manager (live `serve` mode) reconciles to the
+    same fixed point as run_until_stable."""
+    import time
+
+    cp = make_cp(auto_ready=True)
+    cp.manager.start(poll_interval=0.005)
+    try:
+        cp.create(LWSBuilder("threaded").replicas(2).size(2).build())
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            lws = cp.store.get("LeaderWorkerSet", "default", "threaded")
+            if lws.status.ready_replicas == 2:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"never became ready: {lws.status}")
+        assert len(lws_pods(cp.store, "threaded")) == 4
+    finally:
+        cp.manager.stop()
