@@ -22,9 +22,15 @@ DS_ROLE_LABEL_KEY = f"{DOMAIN}/role"
 DS_REVISION_LABEL_KEY = f"{DOMAIN}/revision"
 # Snapshot of per-role replicas at rollout start (the planner baseline).
 DS_INITIAL_REPLICAS_ANNOTATION_KEY = f"{DOMAIN}/initial-replicas"
+# Slice identity (KEP-846): which copy of the whole role topology this
+# LWS/pod/service belongs to. A slice is the durable outer identity; the
+# revision is ephemeral within it.
+DS_SLICE_LABEL_KEY = f"{DOMAIN}/slice"
 
 MIN_ROLES = 2
 MAX_ROLES = 10
+# KEP-846: bound the per-reconcile slice fan-out.
+MAX_SLICES = 64
 
 
 @dataclass
@@ -49,6 +55,10 @@ class DisaggregatedRoleSpec:
 @dataclass
 class DisaggregatedSetSpec:
     roles: list[DisaggregatedRoleSpec] = field(default_factory=list)
+    # KEP-846: number of independent copies of the whole role topology. Each
+    # slice rolls out on its own clock; changing slices is a scale operation
+    # (excluded from the revision hash, never triggers a rollout).
+    slices: int = 1
 
 
 @dataclass

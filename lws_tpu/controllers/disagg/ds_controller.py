@@ -35,33 +35,52 @@ class DSReconciler:
             return None
 
         revision = dsutils.compute_revision(ds.spec.roles)
-        # One snapshot drives cleanup + the rollout decision; a second list
-        # after mutations feeds services/status.
-        snapshot = self.lws_manager.list(ds.meta.namespace, ds.meta.name)
-        snapshot = self._cleanup_drained_lws(ds, revision, snapshot)
+        self._scale_down_slices(ds)
+        # Each slice is an independent rollout domain (KEP-846): run the
+        # whole per-DS pipeline once per slice, scoped by the slice label.
+        for slice_idx in range(max(1, ds.spec.slices)):
+            snapshot = self.lws_manager.list(ds.meta.namespace, ds.meta.name, slice_idx=slice_idx)
+            snapshot = self._cleanup_drained_lws(ds, revision, snapshot)
 
-        old_revisions, new_revision = dsutils.split_revisions(snapshot, revision)
-        total_old = sum(
-            old_revisions.total_replicas_for_role(role) for role in dsutils.get_role_names(ds)
-        )
-        if old_revisions and total_old > 0:
-            self.executor.reconcile(ds, revision, old_revisions, new_revision)
-        else:
-            self._reconcile_simple(ds, revision)
+            old_revisions, new_revision = dsutils.split_revisions(snapshot, revision)
+            total_old = sum(
+                old_revisions.total_replicas_for_role(role) for role in dsutils.get_role_names(ds)
+            )
+            if old_revisions and total_old > 0:
+                self.executor.reconcile(ds, slice_idx, revision, old_revisions, new_revision)
+            else:
+                self._reconcile_simple(ds, slice_idx, revision)
 
-        all_lws = self.lws_manager.list(ds.meta.namespace, ds.meta.name)
-        revision_roles = dsutils.group_by_revision(all_lws)
-        self.service_manager.reconcile_services(ds, revision_roles, revision)
-        self._update_status(ds, all_lws, revision)
+            slice_lws = self.lws_manager.list(ds.meta.namespace, ds.meta.name, slice_idx=slice_idx)
+            revision_roles = dsutils.group_by_revision(slice_lws)
+            self.service_manager.reconcile_services(ds, slice_idx, revision_roles, revision)
+
+        self._update_status(ds, self.lws_manager.list(ds.meta.namespace, ds.meta.name), revision)
         return None
 
+    # ---- slice scale-down (KEP-846: plain deletion, no drain — slices are
+    # independent, there is no cross-slice invariant to protect) -----------
+    def _scale_down_slices(self, ds: DisaggregatedSet) -> None:
+        from lws_tpu.controllers.disagg.lws_manager import slice_of
+
+        want = max(1, ds.spec.slices)
+        for lws in self.lws_manager.list(ds.meta.namespace, ds.meta.name):
+            if slice_of(lws) >= want:
+                self.lws_manager.delete(ds.meta.namespace, lws.meta.name)
+                self.recorder.event(ds, "Normal", "SliceRemoved", f"Deleted {lws.meta.name}")
+        for svc in self.store.list(
+            "Service", ds.meta.namespace, labels={disagg.DS_NAME_LABEL_KEY: ds.meta.name}
+        ):
+            if slice_of(svc) >= want:
+                self.store.delete("Service", svc.meta.namespace, svc.meta.name)
+
     # ---- simple path (ref :135-187) ------------------------------------
-    def _reconcile_simple(self, ds: DisaggregatedSet, revision: str) -> None:
+    def _reconcile_simple(self, ds: DisaggregatedSet, slice_idx: int, revision: str) -> None:
         for role, config in dsutils.get_role_configs(ds).items():
-            name = dsutils.generate_name(ds.meta.name, role, revision)
+            name = dsutils.generate_name(ds.meta.name, slice_idx, role, revision)
             existing = self.lws_manager.get(ds.meta.namespace, name)
             if existing is None:
-                self.lws_manager.create(ds, role, config, revision, replicas=config.replicas)
+                self.lws_manager.create(ds, slice_idx, role, config, revision, replicas=config.replicas)
             elif existing.spec.replicas != config.replicas:
                 self.lws_manager.scale(ds.meta.namespace, name, config.replicas)
 

@@ -28,21 +28,26 @@ class RollingUpdateExecutor:
         self.lws_manager = lws_manager
         self.recorder = recorder
 
-    # ---- entry point (ref executor.go:56-83) ---------------------------
-    def reconcile(self, ds: DisaggregatedSet, revision: str, old_revisions, new_revision) -> None:
+    # ---- entry point (ref executor.go:56-83; slice-scoped per KEP-846) --
+    def reconcile(
+        self, ds: DisaggregatedSet, slice_idx: int, revision: str, old_revisions, new_revision
+    ) -> None:
         role_names = dsutils.get_role_names(ds)
         role_configs = dsutils.get_role_configs(ds)
         if not old_revisions:
             return
         if new_revision is None:
-            self._init_rolling_update(ds, revision, role_names, role_configs, old_revisions)
+            self._init_rolling_update(ds, slice_idx, revision, role_names, role_configs, old_revisions)
             return
-        self._reconcile_rolling_update(ds, old_revisions, new_revision)
+        self._reconcile_rolling_update(ds, slice_idx, old_revisions, new_revision)
 
     # ---- init (ref :85-123) --------------------------------------------
-    def _init_rolling_update(self, ds, revision, role_names, role_configs, old_revisions) -> None:
+    def _init_rolling_update(
+        self, ds, slice_idx, revision, role_names, role_configs, old_revisions
+    ) -> None:
         self.recorder.event(
-            ds, "Normal", "RollingUpdateStarted", f"Started rolling update to revision {revision}"
+            ds, "Normal", "RollingUpdateStarted",
+            f"Started rolling update of slice {slice_idx} to revision {revision}",
         )
         for group in old_revisions:
             for role, lws in group.roles.items():
@@ -50,12 +55,12 @@ class RollingUpdateExecutor:
                     ds.meta.namespace, lws.meta.name, dsutils.get_lws_replicas(lws)
                 )
         for role in role_names:
-            name = dsutils.generate_name(ds.meta.name, role, revision)
+            name = dsutils.generate_name(ds.meta.name, slice_idx, role, revision)
             if self.lws_manager.get(ds.meta.namespace, name) is None:
-                self.lws_manager.create(ds, role, role_configs[role], revision, replicas=0)
+                self.lws_manager.create(ds, slice_idx, role, role_configs[role], revision, replicas=0)
 
     # ---- one step (ref :130-171) ---------------------------------------
-    def _reconcile_rolling_update(self, ds, old_revisions, new_revision) -> None:
+    def _reconcile_rolling_update(self, ds, slice_idx, old_revisions, new_revision) -> None:
         spec_role_names = dsutils.get_role_names(ds)
         spec_role_set = set(spec_role_names)
         old_role_set = {role for g in old_revisions for role in g.roles}
@@ -77,7 +82,9 @@ class RollingUpdateExecutor:
             )
             return
 
-        self._scale_up_new(ds, new_revision, all_role_names, spec_role_set, current_new, step.new)
+        self._scale_up_new(
+            ds, slice_idx, new_revision, all_role_names, spec_role_set, current_new, step.new
+        )
         self._scale_down_old(ds, old_revisions, all_role_names, current_old, step.past)
 
     # ---- planner state (ref :199-260) ----------------------------------
@@ -124,11 +131,13 @@ class RollingUpdateExecutor:
         return True
 
     # ---- scaling (ref :306-398) ----------------------------------------
-    def _scale_up_new(self, ds, new_revision, all_role_names, spec_role_set, current, target) -> None:
+    def _scale_up_new(
+        self, ds, slice_idx, new_revision, all_role_names, spec_role_set, current, target
+    ) -> None:
         for i, role in enumerate(all_role_names):
             if role not in spec_role_set or current[i] >= target[i]:
                 continue
-            name = dsutils.generate_name(ds.meta.name, role, new_revision.revision)
+            name = dsutils.generate_name(ds.meta.name, slice_idx, role, new_revision.revision)
             self.lws_manager.scale(ds.meta.namespace, name, target[i])
             self.recorder.event(
                 ds, "Normal", "ScalingUp",

@@ -25,21 +25,29 @@ class LWSManager:
         obj = self.store.try_get("LeaderWorkerSet", namespace, name)
         return obj if isinstance(obj, LeaderWorkerSet) else None
 
-    def list(self, namespace: str, ds_name: str, role: str = "") -> list[LeaderWorkerSet]:
+    def list(
+        self, namespace: str, ds_name: str, role: str = "", slice_idx: int | None = None
+    ) -> list[LeaderWorkerSet]:
         labels = {disagg.DS_NAME_LABEL_KEY: ds_name}
         if role:
             labels[disagg.DS_ROLE_LABEL_KEY] = role
-        return self.store.list("LeaderWorkerSet", namespace, labels=labels)  # type: ignore[return-value]
+        out = self.store.list("LeaderWorkerSet", namespace, labels=labels)
+        if slice_idx is not None:
+            # KEP-846 bucketing: children with no slice label count as slice 0
+            # (e.g. state files written before the slices feature).
+            out = [l for l in out if slice_of(l) == slice_idx]
+        return out  # type: ignore[return-value]
 
     def create(
         self,
         ds: DisaggregatedSet,
+        slice_idx: int,
         role: str,
         config: DisaggregatedRoleSpec,
         revision: str,
         replicas: int,
     ) -> LeaderWorkerSet:
-        labels = dsutils.generate_labels(ds.meta.name, role, revision)
+        labels = dsutils.generate_labels(ds.meta.name, slice_idx, role, revision)
         spec = copy.deepcopy(config.template.spec)
         spec.replicas = replicas
         # Pods inherit the DS identity through their templates
@@ -51,7 +59,7 @@ class LWSManager:
         annotations = dict(config.template.metadata.annotations)
         lws = LeaderWorkerSet(
             meta=new_meta(
-                dsutils.generate_name(ds.meta.name, role, revision),
+                dsutils.generate_name(ds.meta.name, slice_idx, role, revision),
                 ds.meta.namespace,
                 labels=meta_labels,
                 annotations=annotations,
@@ -78,3 +86,9 @@ class LWSManager:
             return
         lws.meta.annotations[disagg.DS_INITIAL_REPLICAS_ANNOTATION_KEY] = str(replicas)
         self.store.update(lws)
+
+
+def slice_of(obj) -> int:
+    """Slice index of a managed child; label-less children bucket into 0."""
+    raw = obj.meta.labels.get(disagg.DS_SLICE_LABEL_KEY, "0")
+    return int(raw) if raw.isdigit() else 0

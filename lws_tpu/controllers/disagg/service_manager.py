@@ -23,9 +23,12 @@ class ServiceManager:
     def reconcile_services(
         self,
         ds: DisaggregatedSet,
+        slice_idx: int,
         revision_roles: dsutils.RevisionRolesList,
         target_revision: str,
     ) -> None:
+        """Per-slice (KEP-846): selectors are slice-scoped so role-to-role
+        pairing (the KV handoff) stays within a slice."""
         role_names = dsutils.get_role_names(ds)
         ready_revisions = {
             g.revision for g in revision_roles if self._revision_ready(g, role_names)
@@ -36,8 +39,8 @@ class ServiceManager:
             return  # keep old services until the new revision can serve
 
         for role in role_names:
-            self._ensure_service(ds, role, target_revision)
-        self._cleanup_drained_services(ds, ready_revisions, target_revision)
+            self._ensure_service(ds, slice_idx, role, target_revision)
+        self._cleanup_drained_services(ds, slice_idx, ready_revisions, target_revision)
 
     @staticmethod
     def _revision_ready(group: dsutils.RevisionRoles, role_names: list[str]) -> bool:
@@ -47,11 +50,11 @@ class ServiceManager:
                 return False
         return True
 
-    def _ensure_service(self, ds: DisaggregatedSet, role: str, revision: str) -> None:
-        name = dsutils.generate_service_name(ds.meta.name, role, revision)
+    def _ensure_service(self, ds: DisaggregatedSet, slice_idx: int, role: str, revision: str) -> None:
+        name = dsutils.generate_service_name(ds.meta.name, slice_idx, role, revision)
         if self.store.try_get("Service", ds.meta.namespace, name) is not None:
             return
-        labels = dsutils.generate_labels(ds.meta.name, role, revision)
+        labels = dsutils.generate_labels(ds.meta.name, slice_idx, role, revision)
         self.store.create(
             Service(
                 meta=new_meta(name, ds.meta.namespace, labels=labels, owners=[ds]),
@@ -62,12 +65,18 @@ class ServiceManager:
         )
 
     def _cleanup_drained_services(
-        self, ds: DisaggregatedSet, ready_revisions: set[str], target_revision: str
+        self, ds: DisaggregatedSet, slice_idx: int, ready_revisions: set[str], target_revision: str
     ) -> None:
         keep = set(ready_revisions) | {target_revision}
-        services = self.store.list(
-            "Service", ds.meta.namespace, labels={disagg.DS_NAME_LABEL_KEY: ds.meta.name}
-        )
+        from lws_tpu.controllers.disagg.lws_manager import slice_of
+
+        services = [
+            svc
+            for svc in self.store.list(
+                "Service", ds.meta.namespace, labels={disagg.DS_NAME_LABEL_KEY: ds.meta.name}
+            )
+            if slice_of(svc) == slice_idx
+        ]
         for svc in services:
             revision = svc.meta.labels.get(disagg.DS_REVISION_LABEL_KEY, "")
             if revision not in keep:

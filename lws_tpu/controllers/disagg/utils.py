@@ -28,19 +28,21 @@ def compute_revision(roles: list[DisaggregatedRoleSpec]) -> str:
     return stable_hash(payload)[:8]
 
 
-def generate_name(ds_name: str, role: str, revision: str) -> str:
-    """`<ds>-<revision>-<role>` (≈ utils.go:92)."""
-    return f"{ds_name}-{revision}-{role}"
+def generate_name(ds_name: str, slice_idx: int, role: str, revision: str) -> str:
+    """`<ds>-<slice>-<revision>-<role>` (KEP-846: slice before revision —
+    the slice is the durable identity, the revision is ephemeral)."""
+    return f"{ds_name}-{slice_idx}-{revision}-{role}"
 
 
-def generate_service_name(ds_name: str, role: str, revision: str) -> str:
-    """`<ds>-<revision>-<role>-prv` (≈ service_manager.go:217-219)."""
-    return f"{ds_name}-{revision}-{role}-prv"
+def generate_service_name(ds_name: str, slice_idx: int, role: str, revision: str) -> str:
+    """`<ds>-<slice>-<revision>-<role>-prv`."""
+    return f"{ds_name}-{slice_idx}-{revision}-{role}-prv"
 
 
-def generate_labels(ds_name: str, role: str, revision: str) -> dict[str, str]:
+def generate_labels(ds_name: str, slice_idx: int, role: str, revision: str) -> dict[str, str]:
     return {
         disagg.DS_NAME_LABEL_KEY: ds_name,
+        disagg.DS_SLICE_LABEL_KEY: str(slice_idx),
         disagg.DS_ROLE_LABEL_KEY: role,
         disagg.DS_REVISION_LABEL_KEY: revision,
     }

@@ -154,8 +154,9 @@ def from_manifest(raw: dict):
     if kind == "LeaderWorkerSet":
         return LeaderWorkerSet(meta=_meta(raw), spec=_lws_spec(raw.get("spec", {})))
     if kind == "DisaggregatedSet":
+        spec = raw.get("spec", {})
         roles = []
-        for r in raw.get("spec", {}).get("roles", []):
+        for r in spec.get("roles", []):
             tmpl = r.get("template", {})
             roles.append(
                 DisaggregatedRoleSpec(
@@ -170,7 +171,10 @@ def from_manifest(raw: dict):
                     ),
                 )
             )
-        return DisaggregatedSet(meta=_meta(raw), spec=DisaggregatedSetSpec(roles=roles))
+        return DisaggregatedSet(
+            meta=_meta(raw),
+            spec=DisaggregatedSetSpec(roles=roles, slices=int(spec.get("slices", 1))),
+        )
     if kind == "Node":
         spec = raw.get("spec", {})
         return Node(

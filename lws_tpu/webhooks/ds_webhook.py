@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from lws_tpu.api.disagg import MAX_ROLES, MIN_ROLES, DisaggregatedSet
+from lws_tpu.api.disagg import MAX_ROLES, MAX_SLICES, MIN_ROLES, DisaggregatedSet
 from lws_tpu.api.types import RolloutStrategyType
 from lws_tpu.core.store import AdmissionError, Store
 from lws_tpu.webhooks.lws_webhook import DNS1035
@@ -16,11 +16,14 @@ def validate_ds(ds: DisaggregatedSet, old: Optional[DisaggregatedSet]) -> None:
     if not DNS1035.match(ds.meta.name):
         raise AdmissionError(f"invalid name {ds.meta.name!r}: must be a valid DNS-1035 label")
     roles = ds.spec.roles
+    if not (1 <= ds.spec.slices <= MAX_SLICES):
+        raise AdmissionError(f"slices must be between 1 and {MAX_SLICES}")
     # Derived names must stay valid DNS labels: the longest is the private
-    # service `<ds>-<rev8>-<role>-prv` — reject at DS admission rather than
-    # crash-looping reconcile when the child LWS is refused.
+    # service `<ds>-<slice>-<rev8>-<role>-prv` — reject at DS admission rather
+    # than crash-looping reconcile when the child LWS is refused.
+    slice_digits = len(str(max(1, ds.spec.slices) - 1))
     for r in roles:
-        derived = len(ds.meta.name) + 1 + 8 + 1 + len(r.name) + 4
+        derived = len(ds.meta.name) + 1 + slice_digits + 1 + 8 + 1 + len(r.name) + 4
         if derived > 63:
             raise AdmissionError(
                 f"name {ds.meta.name!r} + role {r.name!r} too long: derived service name "

@@ -52,7 +52,7 @@ def test_simple_create_builds_role_lws():
     cp.run_until_stable()
     revision = dsutils.compute_revision(ds.spec.roles)
     children = child_lws(cp)
-    assert set(children) == {f"llmd-{revision}-prefill", f"llmd-{revision}-decode"}
+    assert set(children) == {f"llmd-0-{revision}-prefill", f"llmd-0-{revision}-decode"}
     for name, lws in children.items():
         assert lws.spec.replicas == 2
         assert lws.meta.labels[disagg.DS_REVISION_LABEL_KEY] == revision
@@ -60,7 +60,7 @@ def test_simple_create_builds_role_lws():
     pods = cp.store.list("Pod", "default", labels={disagg.DS_NAME_LABEL_KEY: "llmd"})
     assert len(pods) == 8  # 2 roles x 2 replicas x size 2
     # Private services appear once all roles ready.
-    svc = cp.store.try_get("Service", "default", f"llmd-{revision}-prefill-prv")
+    svc = cp.store.try_get("Service", "default", f"llmd-0-{revision}-prefill-prv")
     assert svc is not None
     assert svc.spec.selector[disagg.DS_ROLE_LABEL_KEY] == "prefill"
     # Status aggregated.
@@ -78,8 +78,8 @@ def test_scale_role_is_not_a_new_revision():
     cp.store.update(fetched)
     cp.run_until_stable()
     children = child_lws(cp)
-    assert set(children) == {f"llmd-{rev1}-prefill", f"llmd-{rev1}-decode"}
-    assert children[f"llmd-{rev1}-prefill"].spec.replicas == 4
+    assert set(children) == {f"llmd-0-{rev1}-prefill", f"llmd-0-{rev1}-decode"}
+    assert children[f"llmd-0-{rev1}-prefill"].spec.replicas == 4
 
 
 def test_rolling_update_lockstep_and_drain():
@@ -100,14 +100,14 @@ def test_rolling_update_lockstep_and_drain():
 
     # Old revision fully drained + GC'd; new revision at target on both roles.
     children = child_lws(cp)
-    assert set(children) == {f"llmd-{rev2}-prefill", f"llmd-{rev2}-decode"}, children.keys()
+    assert set(children) == {f"llmd-0-{rev2}-prefill", f"llmd-0-{rev2}-decode"}, children.keys()
     for lws in children.values():
         assert lws.spec.replicas == 2
         assert lws.status.ready_replicas == 2
     # Old services gone, new services exist.
-    assert cp.store.try_get("Service", "default", f"llmd-{rev1}-prefill-prv") is None
-    assert cp.store.try_get("Service", "default", f"llmd-{rev2}-prefill-prv") is not None
-    assert cp.store.try_get("Service", "default", f"llmd-{rev2}-decode-prv") is not None
+    assert cp.store.try_get("Service", "default", f"llmd-0-{rev1}-prefill-prv") is None
+    assert cp.store.try_get("Service", "default", f"llmd-0-{rev2}-prefill-prv") is not None
+    assert cp.store.try_get("Service", "default", f"llmd-0-{rev2}-decode-prv") is not None
     reasons = {e.reason for e in cp.recorder.events}
     assert {"RollingUpdateStarted", "ScalingUp", "ScalingDown", "LWSDeleted"} <= reasons
     status = cp.store.get("DisaggregatedSet", "default", "llmd")
@@ -129,7 +129,7 @@ def test_rolling_update_role_added_and_removed():
     cp.run_until_stable()
 
     children = child_lws(cp)
-    assert set(children) == {f"llmd-{rev2}-prefill", f"llmd-{rev2}-worker"}, children.keys()
+    assert set(children) == {f"llmd-0-{rev2}-prefill", f"llmd-0-{rev2}-worker"}, children.keys()
     for lws in children.values():
         assert lws.status.ready_replicas == 2
 
@@ -190,8 +190,86 @@ def test_per_role_percentage_budgets_drive_step_size():
     rev2 = dsutils.compute_revision(fetched.spec.roles)
     cp.run_until_stable()
     children = child_lws(cp)
-    assert set(children) == {f"llmd-{rev2}-prefill", f"llmd-{rev2}-decode"}
+    assert set(children) == {f"llmd-0-{rev2}-prefill", f"llmd-0-{rev2}-decode"}
     assert all(l.spec.replicas == 4 and l.status.ready_replicas == 4 for l in children.values())
     # Surge of 2 per step: scale-up events should show jumps of 2.
     ups = [e.message for e in cp.recorder.events if e.reason == "ScalingUp" and "prefill" in e.message]
     assert any("from 0 to 2" in m for m in ups), ups
+
+
+def test_slices_fan_out_and_roll_independently():
+    """KEP-846: slices replicate the whole role topology; each slice is its
+    own rollout domain with slice-scoped services."""
+    cp = ControlPlane(auto_ready=True)
+    ds = make_ds()
+    ds.spec.slices = 3
+    ds = cp.create(ds)
+    cp.run_until_stable()
+    rev1 = dsutils.compute_revision(ds.spec.roles)
+
+    children = child_lws(cp)
+    assert set(children) == {
+        f"llmd-{s}-{rev1}-{r}" for s in range(3) for r in ("prefill", "decode")
+    }
+    # Per-slice services, slice-scoped selectors (KV pairing stays in-slice).
+    for s in range(3):
+        svc = cp.store.get("Service", "default", f"llmd-{s}-{rev1}-prefill-prv")
+        assert svc.spec.selector[disagg.DS_SLICE_LABEL_KEY] == str(s)
+    # Pods carry the slice identity through their templates.
+    pods = cp.store.list("Pod", "default", labels={disagg.DS_SLICE_LABEL_KEY: "2"})
+    assert len(pods) == 8  # 2 roles x 2 replicas x size 2
+    # Status aggregates across slices.
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    assert {r.name: r.ready_replicas for r in fetched.status.roles} == {"prefill": 6, "decode": 6}
+
+    # Template change: every slice converges to the new revision.
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:v2"
+    cp.store.update(fetched)
+    rev2 = dsutils.compute_revision(fetched.spec.roles)
+    cp.run_until_stable()
+    children = child_lws(cp)
+    assert set(children) == {
+        f"llmd-{s}-{rev2}-{r}" for s in range(3) for r in ("prefill", "decode")
+    }
+
+
+def test_slice_scale_down_is_plain_deletion():
+    cp = ControlPlane(auto_ready=True)
+    ds = make_ds()
+    ds.spec.slices = 3
+    ds = cp.create(ds)
+    cp.run_until_stable()
+    rev = dsutils.compute_revision(ds.spec.roles)
+
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    fetched.spec.slices = 1
+    cp.store.update(fetched)
+    cp.run_until_stable()
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-0-{rev}-prefill", f"llmd-0-{rev}-decode"}
+    # Lower slice untouched (same uids), higher slices' services gone.
+    assert cp.store.try_get("Service", "default", f"llmd-2-{rev}-prefill-prv") is None
+    assert cp.store.try_get("Service", "default", f"llmd-0-{rev}-prefill-prv") is not None
+    assert len(cp.store.list("Pod", "default", labels={disagg.DS_NAME_LABEL_KEY: "llmd"})) == 8
+
+
+def test_slices_change_is_not_a_rollout():
+    """Changing slices is a scale operation: existing slices' LWS keep their
+    uids (no recreation) and the revision is unchanged."""
+    cp = ControlPlane(auto_ready=True)
+    ds = cp.create(make_ds())
+    cp.run_until_stable()
+    rev = dsutils.compute_revision(ds.spec.roles)
+    before = {n: l.meta.uid for n, l in child_lws(cp).items()}
+
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    fetched.spec.slices = 2
+    cp.store.update(fetched)
+    cp.run_until_stable()
+    after = child_lws(cp)
+    assert set(after) == set(before) | {f"llmd-1-{rev}-prefill", f"llmd-1-{rev}-decode"}
+    for name, uid in before.items():
+        assert after[name].meta.uid == uid, f"{name} was recreated"
