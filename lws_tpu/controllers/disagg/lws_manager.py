@@ -35,7 +35,7 @@ class LWSManager:
         if slice_idx is not None:
             # KEP-846 bucketing: children with no slice label count as slice 0
             # (e.g. state files written before the slices feature).
-            out = [l for l in out if slice_of(l) == slice_idx]
+            out = [l for l in out if dsutils.slice_of(l) == slice_idx]
         return out  # type: ignore[return-value]
 
     def create(
@@ -86,9 +86,3 @@ class LWSManager:
             return
         lws.meta.annotations[disagg.DS_INITIAL_REPLICAS_ANNOTATION_KEY] = str(replicas)
         self.store.update(lws)
-
-
-def slice_of(obj) -> int:
-    """Slice index of a managed child; label-less children bucket into 0."""
-    raw = obj.meta.labels.get(disagg.DS_SLICE_LABEL_KEY, "0")
-    return int(raw) if raw.isdigit() else 0

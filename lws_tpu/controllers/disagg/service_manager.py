@@ -68,14 +68,12 @@ class ServiceManager:
         self, ds: DisaggregatedSet, slice_idx: int, ready_revisions: set[str], target_revision: str
     ) -> None:
         keep = set(ready_revisions) | {target_revision}
-        from lws_tpu.controllers.disagg.lws_manager import slice_of
-
         services = [
             svc
             for svc in self.store.list(
                 "Service", ds.meta.namespace, labels={disagg.DS_NAME_LABEL_KEY: ds.meta.name}
             )
-            if slice_of(svc) == slice_idx
+            if dsutils.slice_of(svc) == slice_idx
         ]
         for svc in services:
             revision = svc.meta.labels.get(disagg.DS_REVISION_LABEL_KEY, "")

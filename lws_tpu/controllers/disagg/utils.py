@@ -48,6 +48,13 @@ def generate_labels(ds_name: str, slice_idx: int, role: str, revision: str) -> d
     }
 
 
+def slice_of(obj) -> int:
+    """Slice index of a managed child (LWS/Service/pod); label-less children
+    bucket into slice 0 (KEP-846 adoption semantics)."""
+    raw = obj.meta.labels.get(disagg.DS_SLICE_LABEL_KEY, "0")
+    return int(raw) if raw.isdigit() else 0
+
+
 def get_role_names(ds: DisaggregatedSet) -> list[str]:
     return [r.name for r in ds.spec.roles]
 

@@ -51,15 +51,13 @@ def bootstrap_info_from_env(env: Optional[dict[str, str]] = None) -> BootstrapIn
     )
 
 
-def initialize_from_env(env: Optional[dict[str, str]] = None) -> BootstrapInfo:
-    """Initialize jax.distributed from the env contract (no-op single-host)."""
-    info = bootstrap_info_from_env(env)
+def assert_platform_from_env(env: Optional[dict[str, str]] = None) -> None:
+    """Honor an explicit JAX_PLATFORMS from the pod env even when a site-wide
+    accelerator plugin overrode platform selection via jax.config at
+    interpreter start (observed with relay-backed TPU plugins): the env
+    contract must win inside workers. Call before first backend use."""
     import jax
 
-    # Honor an explicit JAX_PLATFORMS from the pod env even when a site-wide
-    # accelerator plugin overrode platform selection via jax.config at
-    # interpreter start (observed with relay-backed TPU plugins): the env
-    # contract must win inside workers.
     platforms = (os.environ if env is None else env).get("JAX_PLATFORMS")
     if platforms:
         try:
@@ -67,7 +65,14 @@ def initialize_from_env(env: Optional[dict[str, str]] = None) -> BootstrapInfo:
         except Exception:  # noqa: BLE001 — best effort; backend may be fixed
             pass
 
+
+def initialize_from_env(env: Optional[dict[str, str]] = None) -> BootstrapInfo:
+    """Initialize jax.distributed from the env contract (no-op single-host)."""
+    info = bootstrap_info_from_env(env)
+    assert_platform_from_env(env)
+
     if info.is_distributed:
+        import jax
         jax.distributed.initialize(
             coordinator_address=info.coordinator_address,
             num_processes=info.num_processes,

@@ -1,0 +1,133 @@
+"""Disaggregated serving workers: the llm-d shape (BASELINE config #5) as
+runnable processes under a DisaggregatedSet.
+
+  python -m lws_tpu.serving.disagg_worker prefill --handoff DIR
+  python -m lws_tpu.serving.disagg_worker decode  --handoff DIR
+
+The prefill role consumes prompt files (`<id>.prompt.npy`), runs
+`Engine.prefill`, and writes the KV cache + first token as a handoff bundle
+(`<id>.kv.npz`). The decode role consumes bundles, runs `Engine.decode_n`,
+and writes `<id>.tokens.npy`. The handoff directory stands in for the
+cross-slice DCN transfer; the endpoints real deployments would dial are the
+DS's per-(slice, revision, role) `-prv` services.
+
+Both roles build the SAME model from a shared seed (in production: the same
+checkpoint), so prefill's cache is exactly what decode expects — verified by
+tests/test_e2e_disagg.py against a single-engine oracle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+
+def _claim(path: str, worker_id: str):
+    """Atomically claim a work file: replicas of a role share the handoff dir
+    and race on the same files; os.rename decides the winner, losers skip."""
+    claimed = f"{path}.claimed.{worker_id}"
+    try:
+        os.rename(path, claimed)
+        return claimed
+    except FileNotFoundError:
+        return None
+
+
+def build_engine(batch: int, max_len: int):
+    from lws_tpu.parallel.bootstrap import assert_platform_from_env
+
+    assert_platform_from_env()  # the pod env's JAX_PLATFORMS must win
+
+    import jax
+    import jax.numpy as jnp
+
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving import Engine
+
+    cfg = LlamaConfig(
+        vocab_size=101, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=max_len, dtype=jnp.float32, remat=False,
+    )
+    params = init_params(cfg, jax.random.key(1234))
+    return Engine(cfg, params, batch_size=batch, max_len=max_len)
+
+
+def run_prefill(handoff: str, once: bool) -> int:
+    engine = build_engine(batch=1, max_len=32)
+    print(f"[prefill {os.environ.get('POD_NAME', '?')}] ready, watching {handoff}")
+    me = os.environ.get("POD_NAME", str(os.getpid()))
+    while True:
+        work = [f for f in os.listdir(handoff) if f.endswith(".prompt.npy")]
+        for fname in sorted(work):
+            req_id = fname.split(".")[0]
+            path = _claim(os.path.join(handoff, fname), me)
+            if path is None:
+                continue  # a replica beat us to it
+            prompt = np.load(path)
+            token, cache = engine.prefill(prompt.reshape(1, -1))
+            out = os.path.join(handoff, f"{req_id}.kv.npz")
+            tmp = out + ".tmp.npz"  # keep the .npz suffix so np.savez doesn't append one
+            np.savez(
+                tmp,
+                k=np.asarray(cache.k), v=np.asarray(cache.v),
+                pos=np.asarray(cache.pos), token=np.asarray(token),
+            )
+            os.replace(tmp, out)
+            os.remove(path)
+            print(f"[prefill] handed off {req_id} (pos={int(cache.pos)})", flush=True)
+            if once:
+                return 0
+        time.sleep(0.2)
+
+
+def run_decode(handoff: str, steps: int, once: bool) -> int:
+    import jax.numpy as jnp
+
+    from lws_tpu.models.llama import KVCache
+
+    engine = build_engine(batch=1, max_len=32)
+    print(f"[decode {os.environ.get('POD_NAME', '?')}] ready, watching {handoff}")
+    me = os.environ.get("POD_NAME", str(os.getpid()))
+    while True:
+        work = [f for f in os.listdir(handoff) if f.endswith(".kv.npz")]
+        for fname in sorted(work):
+            req_id = fname.split(".")[0]
+            path = _claim(os.path.join(handoff, fname), me)
+            if path is None:
+                continue
+            bundle = np.load(path)
+            cache = KVCache(
+                k=jnp.asarray(bundle["k"]), v=jnp.asarray(bundle["v"]),
+                pos=jnp.asarray(bundle["pos"]),
+            )
+            token = jnp.asarray(bundle["token"])
+            _, _, tokens = engine.decode_n(token, cache, steps)
+            full = np.concatenate([np.asarray(bundle["token"])[:, None], np.asarray(tokens)], axis=1)
+            out = os.path.join(handoff, f"{req_id}.tokens.npy")
+            np.save(out + ".tmp.npy", full)
+            os.replace(out + ".tmp.npy", out)
+            os.remove(path)
+            print(f"[decode] finished {req_id}: {full[0][:8]}...")
+            if once:
+                return 0
+        time.sleep(0.2)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("role", choices=["prefill", "decode"])
+    parser.add_argument("--handoff", default=os.environ.get("LWS_TPU_HANDOFF_DIR", "/tmp/lws-handoff"))
+    parser.add_argument("--steps", type=int, default=6)
+    parser.add_argument("--once", action="store_true")
+    args = parser.parse_args()
+    os.makedirs(args.handoff, exist_ok=True)
+    if args.role == "prefill":
+        return run_prefill(args.handoff, args.once)
+    return run_decode(args.handoff, args.steps, args.once)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
