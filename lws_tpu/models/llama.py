@@ -409,16 +409,18 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
 
 
 def _cached_attention(q, cache_k, cache_v, pos):
-    """q [B,S,H,hd] attends to cache[:, :T]; keys at key_pos <= pos + q_idx."""
+    """q [B,S,H,hd] attends to cache[:, :T]; keys at key_pos <= pos + q_idx.
+    `pos` may be a scalar (whole-batch offset) or [B] (per-slot positions for
+    continuous batching)."""
     B, S, H, hd = q.shape
     T, Hkv = cache_k.shape[1], cache_k.shape[2]
     G = H // Hkv
     qg = q.reshape(B, S, Hkv, G, hd)
     scores = jnp.einsum("bqkgh,bskh->bkgqs", qg, cache_k).astype(jnp.float32) * hd**-0.5
     key_pos = jnp.arange(T)
-    q_pos = pos + jnp.arange(S)
-    mask = key_pos[None, :] <= q_pos[:, None]  # [S, T]
-    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    q_pos = jnp.reshape(pos, (-1, 1)) + jnp.arange(S)  # [1,S] or [B,S]
+    mask = key_pos[None, None, :] <= q_pos[:, :, None]  # [1|B, S, T]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype)
     out = jnp.einsum("bkgqs,bskh->bqkgh", probs, cache_v)
     return out.reshape(B, S, H, hd)
@@ -522,3 +524,49 @@ def forward_prefill(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + S)
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching: per-slot cache positions (sequences at different
+# lengths decode together; new requests join mid-stream).
+
+
+def forward_decode_slotted(
+    params: dict, tokens: jax.Array, cache: KVCache, pos_b: jax.Array, cfg: LlamaConfig
+) -> tuple[jax.Array, KVCache]:
+    """One decode step with per-slot positions: tokens [B], pos_b [B] is each
+    slot's current length. K/V scatter at each slot's own offset; attention
+    masks per slot (continuous batching). Honors cfg.unroll_cached_layers
+    like the other cached paths. cache.pos is unused here — slot state lives
+    in pos_b, owned by the BatchEngine."""
+    B = tokens.shape[0]
+    positions = pos_b[:, None]  # [B,1] — rope at each slot's own position
+    x = params["embed"].astype(cfg.dtype)[tokens[:, None]]
+    batch_idx = jnp.arange(B)
+
+    def slot_block(x, layer_idx, lp, cache):
+        def attn_fn(q, k, v):
+            new_k = cache.k.at[layer_idx, batch_idx, pos_b].set(k[:, 0].astype(cache.k.dtype))
+            new_v = cache.v.at[layer_idx, batch_idx, pos_b].set(v[:, 0].astype(cache.v.dtype))
+            slot_block.cache = KVCache(k=new_k, v=new_v, pos=cache.pos)
+            return _cached_attention(q, new_k[layer_idx], new_v[layer_idx], pos_b)
+
+        x, _ = _block_core(x, positions, lp, cfg, attn_fn)
+        return x, slot_block.cache
+
+    if cfg.unroll_cached_layers:
+        for l in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[l], params["layers"])
+            x, cache = slot_block(x, l, lp, cache)
+    else:
+        def body(carry, lp):
+            x, cache, layer_idx = carry
+            x, cache = slot_block(x, layer_idx, lp, cache)
+            return (x, cache, layer_idx + 1), None
+
+        (x, cache, _), _ = jax.lax.scan(
+            body, (x, cache, jnp.zeros((), jnp.int32)), params["layers"]
+        )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, cache

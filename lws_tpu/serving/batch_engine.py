@@ -1,0 +1,143 @@
+"""Continuous batching: sequences at different lengths decode together and
+new requests join mid-stream (slot-based, static shapes for XLA).
+
+The fixed-size slot batch keeps every decode step identically shaped (no
+recompilation); admission prefills a request alone and scatters its KV rows
+into a free slot; per-slot position vectors drive RoPE, masking, and cache
+scatter (models.llama.forward_decode_slotted). Inactive slots compute but
+their outputs are ignored and their cache rows are overwritten on admission —
+the standard static-shape continuous-batching trade.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lws_tpu.models.llama import (
+    KVCache,
+    LlamaConfig,
+    forward_decode_slotted,
+    forward_prefill,
+    init_cache,
+)
+
+
+@dataclass
+class Request:
+    request_id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    tokens: list[int] = field(default_factory=list)
+    slot: int = -1
+
+    @property
+    def done(self) -> bool:
+        return len(self.tokens) >= self.max_new_tokens
+
+
+class BatchEngine:
+    """Slot-based continuously-batched greedy engine."""
+
+    def __init__(self, cfg: LlamaConfig, params: dict, slots: int = 8, max_len: int = 512):
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len
+        self._ids = itertools.count()
+        self._free = list(range(slots))
+        self._active: dict[int, Request] = {}  # slot -> request
+        self._completed: dict[int, Request] = {}
+
+        self.cache = init_cache(cfg, slots, max_len)
+        self.pos_b = jnp.zeros((slots,), jnp.int32)
+        self.tokens = jnp.zeros((slots,), jnp.int32)
+
+        cfg_static = cfg
+
+        @jax.jit
+        def _prefill_one(params, prompt):
+            cache = init_cache(cfg_static, 1, max_len)
+            logits, cache = forward_prefill(params, prompt, cache, cfg_static)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _insert(slot_cache, cache, pos_b, tokens, slot, plen, first_token):
+            cache = KVCache(
+                k=cache.k.at[:, slot].set(slot_cache.k[:, 0]),
+                v=cache.v.at[:, slot].set(slot_cache.v[:, 0]),
+                pos=cache.pos,
+            )
+            return cache, pos_b.at[slot].set(plen), tokens.at[slot].set(first_token)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _step(params, cache, tokens, pos_b, active):
+            logits, cache = forward_decode_slotted(params, tokens, cache, pos_b, cfg_static)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            tokens = jnp.where(active, nxt, tokens)
+            pos_b = jnp.where(active, pos_b + 1, pos_b)
+            return cache, tokens, pos_b
+
+        self._prefill_one = _prefill_one
+        self._insert = _insert
+        self._step_fn = _step
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> Optional[int]:
+        """Admit a request into a free slot; returns request id (None = full)."""
+        if not self._free:
+            return None
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        slot = self._free.pop(0)
+        req = Request(next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot)
+
+        first, slot_cache = self._prefill_one(self.params, jnp.asarray(prompt)[None, :])
+        self.cache, self.pos_b, self.tokens = self._insert(
+            slot_cache, self.cache, self.pos_b, self.tokens, slot, len(prompt), first[0]
+        )
+        req.tokens.append(int(first[0]))
+        self._active[slot] = req
+        return req.request_id
+
+    def step(self) -> None:
+        """One decode step across every active slot."""
+        if not self._active:
+            return
+        active = jnp.asarray(
+            [s in self._active and not self._active[s].done for s in range(self.slots)]
+        )
+        self.cache, self.tokens, self.pos_b = self._step_fn(
+            self.params, self.cache, self.tokens, self.pos_b, active
+        )
+        host_tokens = np.asarray(self.tokens)
+        host_pos = np.asarray(self.pos_b)
+        for slot, req in list(self._active.items()):
+            if req.done:
+                continue
+            req.tokens.append(int(host_tokens[slot]))
+            if req.done or int(host_pos[slot]) >= self.max_len - 1:
+                self._completed[req.request_id] = req
+                del self._active[slot]
+                self._free.append(slot)
+
+    def run_until_drained(self, max_steps: int = 10000) -> None:
+        for _ in range(max_steps):
+            if not self._active:
+                return
+            self.step()
+        raise RuntimeError("engine did not drain")
+
+    def result(self, request_id: int) -> Optional[list[int]]:
+        req = self._completed.get(request_id)
+        return list(req.tokens) if req is not None else None
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
