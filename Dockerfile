@@ -1,0 +1,16 @@
+# Control-plane image (≈ the reference's manager image). The compute plane
+# ships in workload images; this one runs `serve`.
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY lws_tpu ./lws_tpu
+COPY examples ./examples
+RUN pip install --no-cache-dir pyyaml numpy && pip install --no-cache-dir -e . \
+    && python -c "import lws_tpu"
+
+# jax/flax are intentionally NOT installed here: the control plane does not
+# need them; workload images (FROM a jax TPU base) add them.
+EXPOSE 9443
+ENTRYPOINT ["python", "-m", "lws_tpu", "serve"]
+CMD ["--config", "examples/config.yaml", "--state-file", "/var/lib/lws-tpu/state.json"]

@@ -8,4 +8,4 @@ compute plane (`lws_tpu.parallel`, `lws_tpu.models`, `lws_tpu.ops`,
 See ARCHITECTURE.md at the repo root.
 """
 
-__version__ = "0.1.0"
+from lws_tpu.version import VERSION as __version__  # noqa: E402

@@ -102,7 +102,9 @@ def cmd_serve(args) -> int:
         cp.store.watch(lambda _ev: dirty.__setitem__("flag", True))
     server.start()
     cp.manager.start()
-    print(f"lws-tpu control plane serving on http://127.0.0.1:{server.port} "
+    from lws_tpu.version import user_agent
+
+    print(f"{user_agent()} serving on http://127.0.0.1:{server.port} "
           f"(backend={cfg.backend}, scheduler={cfg.enable_scheduler})")
     try:
         while True:

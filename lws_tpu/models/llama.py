@@ -459,29 +459,20 @@ def forward_with_cache(
     positions = pos + jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"].astype(cfg.dtype)[tokens]
 
-    if cfg.unroll_cached_layers:
-        # Unrolled: static layer indices make every cache read/write a static
-        # slice XLA can alias in place — no per-layer gather on the decode
-        # hot path (bigger HLO, faster steps; right for serving).
-        for l in range(cfg.n_layers):
-            lp = jax.tree.map(lambda a: a[l], params["layers"])
-            x, cache = _block_with_cache(x, positions, pos, l, lp, cache, cfg)
-    else:
-        def body(carry, lp):
-            x, cache, layer_idx = carry
-            x, cache = _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg)
-            return (x, cache, layer_idx + 1), None
-
-        (x, cache, _), _ = jax.lax.scan(
-            body, (x, cache, jnp.zeros((), jnp.int32)), params["layers"]
-        )
+    # Unrolled mode: static layer indices make every cache read/write a
+    # static slice XLA aliases in place (bigger HLO, faster steps — serving);
+    # scan keeps compile time flat on deep models.
+    x, cache = _cached_layer_loop(
+        x, cache, params, cfg,
+        lambda x, layer_idx, lp, cache: _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg),
+    )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, KVCache(k=cache.k, v=cache.v, pos=pos + S)
 
 
 def forward_prefill(
-    params: dict, tokens: jax.Array, cache: KVCache, cfg: LlamaConfig
+    params: dict, tokens: jax.Array, cache: KVCache, cfg: LlamaConfig, last_pos=None
 ) -> tuple[jax.Array, KVCache]:
     """Prefill-specialized forward: the cache is EMPTY (pos==0 by contract),
     so attention is plain causal over the prompt — flash attention on TPU —
@@ -522,8 +513,15 @@ def forward_prefill(
     new_k = cache.k.at[:, :, :S].set(stacked_k.astype(cache.k.dtype))
     new_v = cache.v.at[:, :, :S].set(stacked_v.astype(cache.v.dtype))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + S)
+    if last_pos is None:
+        last = x[:, -1]
+        advanced = S
+    else:
+        # Padded prompts (length bucketing): logits at the true last token.
+        last = jax.lax.dynamic_index_in_dim(x, last_pos, 1, keepdims=False)
+        advanced = last_pos + 1
+    logits = (last @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + advanced)
 
 
 # ---------------------------------------------------------------------------
@@ -536,37 +534,44 @@ def forward_decode_slotted(
 ) -> tuple[jax.Array, KVCache]:
     """One decode step with per-slot positions: tokens [B], pos_b [B] is each
     slot's current length. K/V scatter at each slot's own offset; attention
-    masks per slot (continuous batching). Honors cfg.unroll_cached_layers
-    like the other cached paths. cache.pos is unused here — slot state lives
-    in pos_b, owned by the BatchEngine."""
+    masks per slot (continuous batching). cache.pos is unused here — slot
+    state lives in pos_b, owned by the BatchEngine."""
     B = tokens.shape[0]
     positions = pos_b[:, None]  # [B,1] — rope at each slot's own position
     x = params["embed"].astype(cfg.dtype)[tokens[:, None]]
     batch_idx = jnp.arange(B)
 
     def slot_block(x, layer_idx, lp, cache):
+        updated = {}
+
         def attn_fn(q, k, v):
             new_k = cache.k.at[layer_idx, batch_idx, pos_b].set(k[:, 0].astype(cache.k.dtype))
             new_v = cache.v.at[layer_idx, batch_idx, pos_b].set(v[:, 0].astype(cache.v.dtype))
-            slot_block.cache = KVCache(k=new_k, v=new_v, pos=cache.pos)
+            updated["cache"] = KVCache(k=new_k, v=new_v, pos=cache.pos)
             return _cached_attention(q, new_k[layer_idx], new_v[layer_idx], pos_b)
 
         x, _ = _block_core(x, positions, lp, cfg, attn_fn)
-        return x, slot_block.cache
+        return x, updated["cache"]
 
-    if cfg.unroll_cached_layers:
-        for l in range(cfg.n_layers):
-            lp = jax.tree.map(lambda a: a[l], params["layers"])
-            x, cache = slot_block(x, l, lp, cache)
-    else:
-        def body(carry, lp):
-            x, cache, layer_idx = carry
-            x, cache = slot_block(x, layer_idx, lp, cache)
-            return (x, cache, layer_idx + 1), None
-
-        (x, cache, _), _ = jax.lax.scan(
-            body, (x, cache, jnp.zeros((), jnp.int32)), params["layers"]
-        )
+    x, cache = _cached_layer_loop(x, cache, params, cfg, slot_block)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, cache
+
+
+def _cached_layer_loop(x, cache, params, cfg: LlamaConfig, block):
+    """Shared unroll-vs-scan scaffold for the cached forwards: block(x,
+    layer_idx, lp, cache) -> (x, cache)."""
+    if cfg.unroll_cached_layers:
+        for l in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[l], params["layers"])
+            x, cache = block(x, l, lp, cache)
+        return x, cache
+
+    def body(carry, lp):
+        x, cache, layer_idx = carry
+        x, cache = block(x, layer_idx, lp, cache)
+        return (x, cache, layer_idx + 1), None
+
+    (x, cache, _), _ = jax.lax.scan(body, (x, cache, jnp.zeros((), jnp.int32)), params["layers"])
+    return x, cache

@@ -27,7 +27,12 @@ class ApiServer:
         self.control_plane = control_plane
         cp = control_plane
 
+        from lws_tpu.version import user_agent
+
         class Handler(BaseHTTPRequestHandler):
+            server_version = user_agent()  # identifies the control plane
+            sys_version = ""
+
             def log_message(self, *args):  # quiet
                 pass
 

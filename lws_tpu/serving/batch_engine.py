@@ -62,9 +62,11 @@ class BatchEngine:
         cfg_static = cfg
 
         @jax.jit
-        def _prefill_one(params, prompt):
+        def _prefill_one(params, prompt, last_pos):
             cache = init_cache(cfg_static, 1, max_len)
-            logits, cache = forward_prefill(params, prompt, cache, cfg_static)
+            logits, cache = forward_prefill(
+                params, prompt, cache, cfg_static, last_pos=last_pos
+            )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
         @partial(jax.jit, donate_argnums=(1,))
@@ -98,12 +100,30 @@ class BatchEngine:
         slot = self._free.pop(0)
         req = Request(next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot)
 
-        first, slot_cache = self._prefill_one(self.params, jnp.asarray(prompt)[None, :])
+        plen = len(prompt)
+        # Bucket prompt lengths (next power of two) so admission compiles a
+        # handful of executables instead of one per distinct length; the
+        # padded tail is never attendable (mask is key_pos <= pos) and decode
+        # overwrites it position by position.
+        bucket = 8
+        while bucket < plen:
+            bucket *= 2
+        bucket = min(bucket, self.max_len)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:plen] = prompt
+        first, slot_cache = self._prefill_one(
+            self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
+        )
         self.cache, self.pos_b, self.tokens = self._insert(
-            slot_cache, self.cache, self.pos_b, self.tokens, slot, len(prompt), first[0]
+            slot_cache, self.cache, self.pos_b, self.tokens, slot, plen, first[0]
         )
         req.tokens.append(int(first[0]))
-        self._active[slot] = req
+        if req.done:
+            # max_new_tokens == 1: the prefill token alone finishes it.
+            self._completed[req.request_id] = req
+            self._free.append(slot)
+        else:
+            self._active[slot] = req
         return req.request_id
 
     def step(self) -> None:
@@ -117,12 +137,10 @@ class BatchEngine:
             self.params, self.cache, self.tokens, self.pos_b, active
         )
         host_tokens = np.asarray(self.tokens)
-        host_pos = np.asarray(self.pos_b)
         for slot, req in list(self._active.items()):
-            if req.done:
-                continue
             req.tokens.append(int(host_tokens[slot]))
-            if req.done or int(host_pos[slot]) >= self.max_len - 1:
+            # Position is host-derivable: prompt length + generated tokens.
+            if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
                 self._completed[req.request_id] = req
                 del self._active[slot]
                 self._free.append(slot)
