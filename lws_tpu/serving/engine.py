@@ -26,6 +26,36 @@ from lws_tpu.models.llama import (
 )
 
 
+@dataclass(frozen=True)
+class SamplingParams:
+    """temperature == 0 is greedy; top_k/top_p restrict the candidate set."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+
+def sample_logits(logits: jax.Array, key, params: SamplingParams) -> jax.Array:
+    """logits [B, V] -> token ids [B]."""
+    if params.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    V = logits.shape[-1]
+    logits = logits / params.temperature
+    if params.top_k > 0 and params.top_k < V:
+        # lax.top_k: O(V) threshold instead of a full-vocab sort.
+        kth = jax.lax.top_k(logits, params.top_k)[0][:, -1][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if params.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with mass >= top_p (always >= 1 token).
+        cutoff_idx = jnp.sum(cumulative < params.top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 def host_sync(x) -> None:
     """Force completion via a host transfer — `block_until_ready` is not a
     reliable fence on relay-backed remote TPU backends."""
@@ -42,62 +72,86 @@ class GenerationResult:
 
 
 class Engine:
-    def __init__(self, cfg: LlamaConfig, params: dict, batch_size: int = 1, max_len: int = 2048):
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: dict,
+        batch_size: int = 1,
+        max_len: int = 2048,
+        sampling: SamplingParams = SamplingParams(),
+        seed: int = 0,
+    ):
         self.cfg = cfg
         self.params = params
         self.batch_size = batch_size
         self.max_len = max_len
+        self._sampling = sampling  # baked into the jitted paths below
+        self._key = jax.random.key(seed)
 
         cfg_static = cfg
+        sampling_static = sampling
 
         @jax.jit
-        def _prefill(params, tokens, cache):
+        def _prefill(params, tokens, cache, key):
             # Engine.prefill always starts on an empty cache, so the
             # flash-attention prefill path applies (causal over the prompt
             # only, not masked attention over the whole cache length).
             logits, cache = forward_prefill(params, tokens, cache, cfg_static)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return sample_logits(logits, key, sampling_static), cache
 
         @partial(jax.jit, donate_argnums=(2,))
-        def _decode(params, tokens, cache):
+        def _decode(params, tokens, cache, key):
             logits, cache = forward_with_cache(params, tokens[:, None], cache, cfg_static)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return sample_logits(logits, key, sampling_static), cache
 
         @partial(jax.jit, donate_argnums=(2,), static_argnums=(3,))
-        def _decode_n(params, tokens, cache, n):
+        def _decode_n(params, tokens, cache, n, key):
             # Whole decode loop on-device: one dispatch for n steps (no
             # per-step host round trips — critical on relay-backed links).
-            def body(carry, _):
+            def body(carry, step_key):
                 token, cache = carry
                 logits, cache = forward_with_cache(params, token[:, None], cache, cfg_static)
-                token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                token = sample_logits(logits, step_key, sampling_static)
                 return (token, cache), token
 
-            (token, cache), toks = jax.lax.scan(body, (tokens, cache), None, length=n)
+            (token, cache), toks = jax.lax.scan(
+                body, (tokens, cache), jax.random.split(key, n)
+            )
             return token, cache, toks.swapaxes(0, 1)  # [B, n]
 
         self._prefill = _prefill
         self._decode = _decode
         self._decode_n = _decode_n
 
+    @property
+    def sampling(self) -> SamplingParams:
+        """Read-only: sampling is compiled into the jitted decode paths at
+        construction; build a new Engine to change it."""
+        return self._sampling
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
     def new_cache(self) -> KVCache:
         return init_cache(self.cfg, self.batch_size, self.max_len)
 
     def prefill(self, tokens: jax.Array) -> tuple[jax.Array, KVCache]:
         """tokens [B, S] -> (first generated token [B], cache)."""
-        return self._prefill(self.params, tokens, self.new_cache())
+        return self._prefill(self.params, tokens, self.new_cache(), self._next_key())
 
     def decode(self, tokens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
         """tokens [B] -> (next token [B], cache)."""
-        return self._decode(self.params, tokens, cache)
+        return self._decode(self.params, tokens, cache, self._next_key())
 
     def decode_n(self, tokens: jax.Array, cache: KVCache, n: int):
-        """n chained greedy steps in ONE device call; returns
+        """n chained sampling steps in ONE device call; returns
         (last token [B], cache, all tokens [B, n])."""
-        return self._decode_n(self.params, tokens, cache, n)
+        return self._decode_n(self.params, tokens, cache, n, self._next_key())
 
     def generate(self, prompt: jax.Array, max_new_tokens: int) -> GenerationResult:
-        """Greedy generation with timing split (TTFT vs steady decode).
+        """Generation under the engine's SamplingParams (greedy by default),
+        with timing split (TTFT vs steady decode).
 
         Decode steps are chained without intermediate syncs (the token feeds
         the next step), with one host-transfer fence at the end; the timing

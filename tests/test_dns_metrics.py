@@ -1,0 +1,65 @@
+"""Rendezvous DNS view + metrics registry units."""
+
+from lws_tpu.api import contract
+from lws_tpu.core import DnsView
+from lws_tpu.core.metrics import MetricsRegistry
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder, set_pod_ready
+
+
+def test_dns_resolves_group_members_before_ready():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(1).size(3).build())
+    cp.run_until_stable()
+    dns = DnsView(cp.store)
+    # Publish-before-ready: every member resolvable while still Pending.
+    for name in ("sample-0", "sample-0-1", "sample-0-2"):
+        pod = dns.resolve(f"{name}.sample.default")
+        assert pod is not None and not pod.status.ready
+    # The exact name the injected env points at resolves too.
+    leader = cp.store.get("Pod", "default", "sample-0")
+    env = {e.name: e.value for e in leader.spec.containers[0].env}
+    assert dns.resolve(env[contract.LWS_LEADER_ADDRESS]) is not None
+
+
+def test_dns_negative_lookups():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.run_until_stable()
+    dns = DnsView(cp.store)
+    assert dns.resolve("nope.sample.default") is None          # no such pod
+    assert dns.resolve("sample-0.nosvc.default") is None       # no such service
+    assert dns.resolve("sample-0.sample.other") is None        # wrong namespace
+    assert dns.resolve("garbage") is None                      # malformed
+
+
+def test_dns_endpoints_span_selector():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(2).size(2).build())
+    cp.run_until_stable()
+    dns = DnsView(cp.store)
+    svc = cp.store.get("Service", "default", "sample")
+    assert len(dns.endpoints(svc)) == 4  # all pods, ready or not
+
+
+def test_metrics_render_prometheus_text():
+    reg = MetricsRegistry()
+    reg.inc("lws_reconcile_total", {"controller": "lws"})
+    reg.inc("lws_reconcile_total", {"controller": "lws"})
+    reg.observe("lws_reconcile_duration_seconds", 0.003, {"controller": "lws"})
+    reg.observe("lws_reconcile_duration_seconds", 2.0, {"controller": "lws"})
+    text = reg.render()
+    assert 'lws_reconcile_total{controller="lws"} 2.0' in text
+    assert 'lws_reconcile_duration_seconds_bucket{controller="lws",le="0.005"} 1' in text
+    assert 'lws_reconcile_duration_seconds_bucket{controller="lws",le="+Inf"} 2' in text
+    assert 'lws_reconcile_duration_seconds_count{controller="lws"} 2' in text
+    assert reg.counter_value("lws_reconcile_total", {"controller": "lws"}) == 2.0
+
+
+def test_reconcile_metrics_flow_through_control_plane():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.run_until_stable()
+    assert cp.metrics.counter_value("lws_reconcile_total", {"controller": "lws"}) > 0
+    assert cp.metrics.counter_value("lws_reconcile_total", {"controller": "groupset"}) > 0
+    assert cp.metrics.counter_value("lws_reconcile_errors_total", {"controller": "lws"}) == 0

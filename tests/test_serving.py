@@ -78,3 +78,33 @@ def test_unrolled_cached_decode_matches_scan():
     r1 = Engine(cfg, params, batch_size=1, max_len=16).generate(prompt, 6)
     r2 = Engine(cfg_unrolled, params, batch_size=1, max_len=16).generate(prompt, 6)
     np.testing.assert_array_equal(np.asarray(r1.tokens), np.asarray(r2.tokens))
+
+
+def test_sampling_modes():
+    """Greedy default unchanged; temperature sampling is seed-deterministic
+    and varies across seeds; top_k=1 degenerates to greedy."""
+    from lws_tpu.serving.engine import SamplingParams
+
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.array([[5, 9, 2]], jnp.int32)
+
+    greedy = Engine(cfg, params, batch_size=1, max_len=32).generate(prompt, 6)
+    topk1 = Engine(
+        cfg, params, batch_size=1, max_len=32,
+        sampling=SamplingParams(temperature=0.8, top_k=1),
+    ).generate(prompt, 6)
+    np.testing.assert_array_equal(np.asarray(greedy.tokens), np.asarray(topk1.tokens))
+
+    s1 = Engine(cfg, params, batch_size=1, max_len=32,
+                sampling=SamplingParams(temperature=1.5), seed=7).generate(prompt, 12)
+    s1b = Engine(cfg, params, batch_size=1, max_len=32,
+                 sampling=SamplingParams(temperature=1.5), seed=7).generate(prompt, 12)
+    s2 = Engine(cfg, params, batch_size=1, max_len=32,
+                sampling=SamplingParams(temperature=1.5), seed=8).generate(prompt, 12)
+    np.testing.assert_array_equal(np.asarray(s1.tokens), np.asarray(s1b.tokens))
+    assert not np.array_equal(np.asarray(s1.tokens), np.asarray(s2.tokens))
+
+    nucleus = Engine(cfg, params, batch_size=1, max_len=32,
+                     sampling=SamplingParams(temperature=1.0, top_p=0.9), seed=3).generate(prompt, 6)
+    assert np.asarray(nucleus.tokens).shape == (1, 6)
