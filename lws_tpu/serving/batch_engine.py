@@ -46,6 +46,8 @@ class BatchEngine:
     """Slot-based continuously-batched greedy engine."""
 
     def __init__(self, cfg: LlamaConfig, params: dict, slots: int = 8, max_len: int = 512):
+        if cfg.kv_quant:
+            raise NotImplementedError("kv_quant is not supported by BatchEngine yet")
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -71,10 +73,12 @@ class BatchEngine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def _insert(slot_cache, cache, pos_b, tokens, slot, plen, first_token):
-            cache = KVCache(
+            import dataclasses as _dc
+
+            cache = _dc.replace(
+                cache,
                 k=cache.k.at[:, slot].set(slot_cache.k[:, 0]),
                 v=cache.v.at[:, slot].set(slot_cache.v[:, 0]),
-                pos=cache.pos,
             )
             return cache, pos_b.at[slot].set(plen), tokens.at[slot].set(first_token)
 

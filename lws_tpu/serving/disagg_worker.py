@@ -70,10 +70,13 @@ def run_prefill(handoff: str, once: bool) -> int:
             token, cache = engine.prefill(prompt.reshape(1, -1))
             out = os.path.join(handoff, f"{req_id}.kv.npz")
             tmp = out + ".tmp.npz"  # keep the .npz suffix so np.savez doesn't append one
+            extra = {}
+            if cache.k_scale is not None:  # kv_quant caches carry scales
+                extra = {"k_scale": np.asarray(cache.k_scale), "v_scale": np.asarray(cache.v_scale)}
             np.savez(
                 tmp,
                 k=np.asarray(cache.k), v=np.asarray(cache.v),
-                pos=np.asarray(cache.pos), token=np.asarray(token),
+                pos=np.asarray(cache.pos), token=np.asarray(token), **extra,
             )
             os.replace(tmp, out)
             os.remove(path)
@@ -102,6 +105,8 @@ def run_decode(handoff: str, steps: int, once: bool) -> int:
             cache = KVCache(
                 k=jnp.asarray(bundle["k"]), v=jnp.asarray(bundle["v"]),
                 pos=jnp.asarray(bundle["pos"]),
+                k_scale=jnp.asarray(bundle["k_scale"]) if "k_scale" in bundle else None,
+                v_scale=jnp.asarray(bundle["v_scale"]) if "v_scale" in bundle else None,
             )
             token = jnp.asarray(bundle["token"])
             _, _, tokens = engine.decode_n(token, cache, steps)
