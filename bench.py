@@ -46,16 +46,21 @@ def detect_generation() -> str:
     return "v5e"
 
 
-def _probe_backend(timeout_s: int = 240) -> None:
+_BACKEND_PROBE_CACHE: dict[int, tuple[bool, str]] = {}
+
+
+def backend_available(timeout_s: int = 240) -> tuple[bool, str]:
     """Backend init on relay-backed TPU plugins blocks indefinitely (in C,
     unkillable by SIGALRM) when the remote side is down. Probe it in a
-    subprocess with a hard timeout so the bench fails loudly instead of
-    hanging the driver."""
+    subprocess with a hard timeout; returns (ok, detail). Memoized per
+    process — repeat callers don't re-pay the probe."""
     import os
     import subprocess
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        return  # dev mode: no TPU backend will be touched
+        return True, "dev mode (JAX_PLATFORMS=cpu)"
+    if _BACKEND_PROBE_CACHE:
+        return next(iter(_BACKEND_PROBE_CACHE.values()))
     try:
         subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -63,15 +68,19 @@ def _probe_backend(timeout_s: int = 240) -> None:
             check=True,
             capture_output=True,
         )
+        result = (True, "ok")
     except subprocess.TimeoutExpired:
-        raise SystemExit(
-            f"error: TPU backend initialization did not complete in {timeout_s}s "
-            "(remote relay unavailable?) — aborting bench"
-        ) from None
+        result = (False, f"initialization did not complete in {timeout_s}s (relay unavailable?)")
     except subprocess.CalledProcessError as e:
-        raise SystemExit(
-            f"error: TPU backend initialization failed: {e.stderr.decode()[-400:]}"
-        ) from None
+        result = (False, f"initialization failed: {e.stderr.decode()[-400:]}")
+    _BACKEND_PROBE_CACHE[0] = result
+    return result
+
+
+def _probe_backend(timeout_s: int = 240) -> None:
+    ok, detail = backend_available(timeout_s)
+    if not ok:
+        raise SystemExit(f"error: TPU backend {detail} — aborting bench")
 
 
 def main() -> None:
