@@ -17,8 +17,15 @@ import time
 import urllib.request
 
 
+def _server_base(server: str) -> str:
+    """Accept both `host:port` and a full `http://host:port` URL."""
+    if server.startswith(("http://", "https://")):
+        return server.rstrip("/")
+    return f"http://{server}"
+
+
 def _http(server: str, method: str, path: str, body: bytes | None = None):
-    req = urllib.request.Request(f"http://{server}{path}", data=body, method=method)
+    req = urllib.request.Request(f"{_server_base(server)}{path}", data=body, method=method)
     try:
         with urllib.request.urlopen(req) as resp:
             return json.loads(resp.read().decode())
@@ -157,7 +164,7 @@ def cmd_delete(args) -> int:
 
 
 def cmd_logs(args) -> int:
-    req = urllib.request.Request(f"http://{args.server}/logs/{args.namespace}/{args.name}")
+    req = urllib.request.Request(f"{_server_base(args.server)}/logs/{args.namespace}/{args.name}")
     try:
         with urllib.request.urlopen(req) as resp:
             sys.stdout.write(resp.read().decode(errors="replace"))
@@ -172,6 +179,17 @@ def cmd_logs(args) -> int:
 def cmd_scale(args) -> int:
     body = json.dumps({"replicas": args.replicas}).encode()
     print(json.dumps(_http(args.server, "POST", f"/scale/{args.namespace}/{args.name}", body)))
+    return 0
+
+
+def cmd_cordon(args) -> int:
+    body = json.dumps({"unschedulable": not args.uncordon}).encode()
+    print(json.dumps(_http(args.server, "POST", f"/cordon/{args.node}", body)))
+    return 0
+
+
+def cmd_drain(args) -> int:
+    print(json.dumps(_http(args.server, "POST", f"/drain/{args.node}", b"{}")))
     return 0
 
 
@@ -247,6 +265,17 @@ def main(argv=None) -> int:
     scp.add_argument("--namespace", "-n", default="default")
     scp.add_argument("--server", default="127.0.0.1:9443")
     scp.set_defaults(fn=cmd_scale)
+
+    cp_ = sub.add_parser("cordon", help="mark a node unschedulable (or --uncordon)")
+    cp_.add_argument("node")
+    cp_.add_argument("--uncordon", action="store_true")
+    cp_.add_argument("--server", default="127.0.0.1:9443")
+    cp_.set_defaults(fn=cmd_cordon)
+
+    dr = sub.add_parser("drain", help="cordon a node and evict its pods (groups recreate elsewhere)")
+    dr.add_argument("node")
+    dr.add_argument("--server", default="127.0.0.1:9443")
+    dr.set_defaults(fn=cmd_drain)
 
     pp = sub.add_parser("plan-steps", help="print a DisaggregatedSet rollout step table")
     pp.add_argument("--initial", required=True)

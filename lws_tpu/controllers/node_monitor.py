@@ -16,6 +16,39 @@ from lws_tpu.core.manager import Result
 from lws_tpu.core.store import Key, Store
 
 
+def evict_pods_on_node(store: Store, node_name: str, message: str, recorder=None, reason: str = "Evicted") -> list[str]:
+    """Fail every non-Failed pod bound to `node_name` (shared by the node
+    monitor and the drain endpoint). Conflict-retries per pod; pods deleted
+    underneath (sibling eviction via restart policy) are skipped."""
+    from lws_tpu.core.store import ConflictError, NotFoundError
+
+    evicted: list[str] = []
+    for pod in store.list("Pod"):
+        if pod.spec.node_name != node_name or pod.status.phase in (
+            PodPhase.FAILED, PodPhase.SUCCEEDED,  # kubectl drain ignores completed pods
+        ):
+            continue
+        for _ in range(5):
+            try:
+                fresh = store.get("Pod", pod.meta.namespace, pod.meta.name)
+            except NotFoundError:
+                break  # already deleted (e.g. group teardown beat us)
+            if fresh.status.phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
+                break
+            fresh.status.phase = PodPhase.FAILED
+            fresh.status.ready = False
+            fresh.status.message = message
+            try:
+                store.update_status(fresh)
+                evicted.append(fresh.meta.name)
+                if recorder is not None:
+                    recorder.event(fresh, "Warning", reason, message)
+                break
+            except ConflictError:
+                continue
+    return evicted
+
+
 class NodeMonitor:
     name = "node-monitor"
 
@@ -29,16 +62,8 @@ class NodeMonitor:
             return None
         if node.status.ready:
             return None
-        for pod in self.store.list("Pod"):
-            if pod.spec.node_name != node.meta.name:
-                continue
-            if pod.status.phase == PodPhase.FAILED:
-                continue
-            pod.status.phase = PodPhase.FAILED
-            pod.status.ready = False
-            pod.status.message = f"node {node.meta.name} not ready"
-            self.store.update_status(pod)
-            self.recorder.event(
-                pod, "Warning", "NodeFailure", f"node {node.meta.name} went NotReady"
-            )
+        evict_pods_on_node(
+            self.store, node.meta.name, f"node {node.meta.name} not ready",
+            recorder=self.recorder, reason="NodeFailure",
+        )
         return None

@@ -8,6 +8,8 @@ LeaderWorkerSet, DisaggregatedSet, and Node.
 
 from __future__ import annotations
 
+import re
+
 from typing import Any, Optional
 
 from lws_tpu.api.disagg import (
@@ -55,13 +57,47 @@ def _meta(raw: dict, default_namespace: str = "default") -> ObjectMeta:
     )
 
 
+_QUANTITY_RE = re.compile(r"^([0-9.eE+-]+?)(m|[kKMGTPE]i?|)$")
+_QUANTITY_SUFFIX = {
+    "": 1, "m": 1e-3,
+    "k": 1e3, "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+
+
+def _quantity(value) -> int:
+    """Parse a k8s resource quantity ("4", "100m", "1Gi") to base units.
+    Sub-unit values (milli) floor to 0 — only whole-chip resources
+    (google.com/tpu) participate in scheduling here."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    m = _QUANTITY_RE.match(str(value).strip())
+    if not m:
+        raise ValueError(f"invalid resource quantity {value!r}")
+    return int(float(m.group(1)) * _QUANTITY_SUFFIX[m.group(2)])
+
+
+def _resources(raw: Optional[dict]) -> dict[str, int]:
+    """Accept both the flat form (`resources: {google.com/tpu: 4}`) and the
+    k8s nested form (`resources: {limits: {...}, requests: {...}}`) that
+    reference manifests use (limits win over requests, as in kube)."""
+    raw = raw or {}
+    if raw and set(raw) <= {"limits", "requests"} and all(
+        isinstance(v, dict) for v in raw.values()
+    ):
+        merged = dict(raw.get("requests") or {})
+        merged.update(raw.get("limits") or {})
+        raw = merged
+    return {k: _quantity(v) for k, v in raw.items()}
+
+
 def _container(raw: dict) -> Container:
     return Container(
         name=raw.get("name", "main"),
         image=raw.get("image", ""),
         command=list(raw.get("command", [])),
         env=[EnvVar(e["name"], str(e.get("value", ""))) for e in raw.get("env", [])],
-        resources={k: int(v) for k, v in (raw.get("resources", {}) or {}).items()},
+        resources=_resources(raw.get("resources")),
         ports={k: int(v) for k, v in (raw.get("ports", {}) or {}).items()},
     )
 

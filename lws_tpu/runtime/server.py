@@ -10,6 +10,10 @@ Endpoints:
   GET  /apis/{kind}/{ns}/{name}       get
   DELETE /apis/{kind}/{ns}/{name}     delete
   POST /scale/{ns}/{name}             {"replicas": N} on a LeaderWorkerSet
+  POST /report-metric/{ns}/{pod}      {"metric": value} -> pod annotation (autoscaler)
+  POST /cordon/{node}                 {"unschedulable": bool} (default true)
+  POST /drain/{node}                  cordon + evict (groups recreate elsewhere)
+  GET  /logs/{ns}/{pod}               captured pod stdout/stderr
 """
 
 from __future__ import annotations
@@ -18,8 +22,58 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from lws_tpu.core.store import AdmissionError, NotFoundError
+from lws_tpu.core.store import AdmissionError, ConflictError, NotFoundError
 from lws_tpu.manifest import from_manifest, to_manifest
+
+
+_CANONICAL_KINDS = (
+    "LeaderWorkerSet", "DisaggregatedSet", "GroupSet", "Pod", "Node",
+    "Service", "PodGroup", "ControllerRevision", "PersistentVolumeClaim",
+    "Autoscaler",
+)
+_KIND_ALIASES = {
+    **{k.lower(): k for k in _CANONICAL_KINDS},
+    **{k.lower() + "s": k for k in _CANONICAL_KINDS},
+    "lws": "LeaderWorkerSet",
+    "ds": "DisaggregatedSet",
+    "pvc": "PersistentVolumeClaim",
+    "pvcs": "PersistentVolumeClaim",
+    "revision": "ControllerRevision",
+    "revisions": "ControllerRevision",
+}
+
+
+def _kind(raw: str) -> str:
+    """kubectl-style kind resolution: `pods`, `Pod`, `lws`, ... all work."""
+    kind = _KIND_ALIASES.get(raw.lower())
+    if kind is None:
+        raise ValueError(
+            f"unknown kind {raw!r}; one of {', '.join(sorted(_KIND_ALIASES))}"
+        )
+    return kind
+
+
+def _retry_conflicts(attempt_fn, what: str) -> None:
+    """Run a read-modify-update attempt up to 5 times across optimistic-
+    concurrency races with background controllers."""
+    for _ in range(5):
+        try:
+            attempt_fn()
+            return
+        except ConflictError:
+            continue
+    raise ValueError(f"{what} lost repeated update races; retry")
+
+
+def _set_cordon(store, node_name: str, unschedulable: bool) -> None:
+    from lws_tpu.api.node import CLUSTER_NAMESPACE
+
+    def attempt():
+        node = store.get("Node", CLUSTER_NAMESPACE, node_name)
+        node.spec.unschedulable = unschedulable
+        store.update(node)
+
+    _retry_conflicts(attempt, f"cordon of {node_name}")
 
 
 class ApiServer:
@@ -54,10 +108,18 @@ class ApiServer:
                 elif self.path == "/metrics":
                     self._send(200, cp.metrics.render(), "text/plain")
                 elif len(parts) == 2 and parts[0] == "apis":
-                    objs = cp.store.list(parts[1])
+                    try:
+                        objs = cp.store.list(_kind(parts[1]))
+                    except ValueError as e:
+                        self._json(404, {"error": str(e)})
+                        return
                     self._json(200, [to_manifest(o) for o in objs])
                 elif len(parts) == 4 and parts[0] == "apis":
-                    obj = cp.store.try_get(parts[1], parts[2], parts[3])
+                    try:
+                        obj = cp.store.try_get(_kind(parts[1]), parts[2], parts[3])
+                    except ValueError as e:
+                        self._json(404, {"error": str(e)})
+                        return
                     if obj is None:
                         self._json(404, {"error": f"{parts[1]} {parts[2]}/{parts[3]} not found"})
                     else:
@@ -75,7 +137,11 @@ class ApiServer:
             def do_DELETE(self):
                 parts = [p for p in self.path.split("/") if p]
                 if len(parts) == 4 and parts[0] == "apis":
-                    cp.store.delete(parts[1], parts[2], parts[3])
+                    try:
+                        cp.store.delete(_kind(parts[1]), parts[2], parts[3])
+                    except (ValueError, NotFoundError) as e:
+                        self._json(404, {"error": str(e)})
+                        return
                     self._json(200, {"deleted": f"{parts[1]}/{parts[2]}/{parts[3]}"})
                 else:
                     self._json(404, {"error": "unknown path"})
@@ -113,11 +179,33 @@ class ApiServer:
                         lws.spec.replicas = replicas
                         cp.store.update(lws)
                         self._json(200, {"scaled": parts[2], "replicas": replicas})
+                    elif len(parts) == 2 and parts[0] == "cordon":
+                        payload = json.loads(body) if body else {}
+                        if not isinstance(payload, dict):
+                            raise ValueError("cordon body must be a JSON object")
+                        unschedulable = payload.get("unschedulable", True)
+                        if not isinstance(unschedulable, bool):
+                            raise ValueError(
+                                "cordon field 'unschedulable' must be a JSON bool"
+                            )
+                        _set_cordon(cp.store, parts[1], unschedulable)
+                        self._json(200, {"node": parts[1], "unschedulable": unschedulable})
+                    elif len(parts) == 2 and parts[0] == "drain":
+                        # Cordon + evict: pods on the node are failed so their
+                        # groups recreate onto other capacity (slice
+                        # maintenance; same path preemption takes).
+                        from lws_tpu.controllers.node_monitor import evict_pods_on_node
+
+                        _set_cordon(cp.store, parts[1], True)
+                        evicted = evict_pods_on_node(
+                            cp.store, parts[1], f"drained from node {parts[1]}",
+                            recorder=cp.recorder, reason="Drained",
+                        )
+                        self._json(200, {"node": parts[1], "evicted": evicted})
                     elif len(parts) == 3 and parts[0] == "report-metric":
                         # Workload-side metric push: annotates the pod so the
                         # autoscaler's HPA loop can read it.
                         from lws_tpu.api.autoscaler import METRIC_ANNOTATION_PREFIX
-                        from lws_tpu.core.store import ConflictError
 
                         payload = json.loads(body)
                         if not isinstance(payload, dict) or not all(
@@ -126,20 +214,15 @@ class ApiServer:
                             raise ValueError(
                                 "report-metric body must be a JSON object of numbers"
                             )
-                        for attempt in range(5):
+                        def attempt():
                             pod = cp.store.get("Pod", parts[1], parts[2])
                             for metric, value in payload.items():
                                 pod.meta.annotations[METRIC_ANNOTATION_PREFIX + metric] = str(
                                     float(value)
                                 )
-                            try:
-                                cp.store.update(pod)
-                                break
-                            except ConflictError:
-                                if attempt == 4:
-                                    raise ValueError(
-                                        "metric report lost repeated update races; retry"
-                                    ) from None
+                            cp.store.update(pod)
+
+                        _retry_conflicts(attempt, "metric report")
                         self._json(200, {"reported": payload})
                     else:
                         self._json(404, {"error": "unknown path"})
@@ -147,6 +230,10 @@ class ApiServer:
                     self._json(422, {"error": str(e)})
                 except NotFoundError as e:
                     self._json(404, {"error": str(e)})
+                except (TypeError, KeyError, AttributeError) as e:
+                    # Malformed manifest/payload shapes must come back as a
+                    # JSON error, not a dropped connection.
+                    self._json(400, {"error": f"{type(e).__name__}: {e}"})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port

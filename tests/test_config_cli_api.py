@@ -210,3 +210,118 @@ def test_http_reapply_preserves_status():
         assert after == before, "apply must never wipe live status"
     finally:
         server.stop()
+
+
+def test_http_cordon_and_drain_endpoints():
+    from lws_tpu.api.node import CLUSTER_NAMESPACE
+    from lws_tpu.sched import make_slice_nodes
+
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, require_binding=True)
+    for s_ in range(2):
+        cp.add_nodes(make_slice_nodes(f"slice-{s_}", topology="2x4"))
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+    server = ApiServer(cp, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        def post(path, body=b"{}"):
+            req = urllib.request.Request(base + path, data=body, method="POST")
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read().decode())
+
+        hosting = cp.store.get("Pod", "default", "sample-0").spec.node_name
+        out = post(f"/drain/{hosting}")
+        assert out["node"] == hosting and "sample" in " ".join(out["evicted"])
+        cp.run_until_stable()
+        # Group recreated away from the drained node.
+        for p_ in cp.store.list("Pod", "default"):
+            assert p_.spec.node_name != hosting
+        assert cp.store.get("Node", CLUSTER_NAMESPACE, hosting).spec.unschedulable
+
+        out = post(f"/cordon/{hosting}", json.dumps({"unschedulable": False}).encode())
+        assert out["unschedulable"] is False
+        assert not cp.store.get("Node", CLUSTER_NAMESPACE, hosting).spec.unschedulable
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post("/drain/ghost")
+        assert e.value.code == 404
+
+        # Payload validation: a string "false" must be rejected, not coerced
+        # to True (bool("false") is True) and silently cordon.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(f"/cordon/{hosting}", json.dumps({"unschedulable": "false"}).encode())
+        assert e.value.code == 422
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(f"/cordon/{hosting}", b"[1, 2]")
+        assert e.value.code == 422
+    finally:
+        server.stop()
+
+
+def test_http_kind_aliases_and_unknown_kind():
+    """kubectl-style kind resolution on /apis: plural/lower aliases resolve,
+    unknown kinds 404 with the alias list instead of silently returning []."""
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.run_until_stable()
+    server = ApiServer(cp, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        def get(path):
+            with urllib.request.urlopen(base + path) as r:
+                return json.loads(r.read().decode())
+
+        assert len(get("/apis/pods")) == 2
+        assert get("/apis/lws")[0]["metadata"]["name"] == "sample"
+        assert get("/apis/leaderworkersets/default/sample")["kind"] == "LeaderWorkerSet"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/apis/widgets")
+        assert e.value.code == 404 and "unknown kind" in e.value.read().decode()
+    finally:
+        server.stop()
+
+
+def test_apply_accepts_k8s_nested_resource_quantities():
+    """Reference-style manifests use resources.limits with quantity strings
+    ("100m", "1Gi"); they must apply, with limits winning over requests."""
+    from lws_tpu.manifest import from_manifest
+
+    lws = from_manifest({
+        "apiVersion": "leaderworkerset.x-k8s.io/v1",
+        "kind": "LeaderWorkerSet",
+        "metadata": {"name": "q"},
+        "spec": {"leaderWorkerTemplate": {"size": 2, "workerTemplate": {"spec": {
+            "containers": [{"name": "w", "resources": {
+                "requests": {"cpu": "100m", "google.com/tpu": "2"},
+                "limits": {"google.com/tpu": "4", "memory": "1Gi"},
+            }}],
+        }}}},
+    })
+    res = lws.spec.leader_worker_template.worker_template.spec.containers[0].resources
+    assert res["google.com/tpu"] == 4      # limits win
+    assert res["memory"] == 2**30
+    assert res["cpu"] == 0                 # sub-unit floors; not scheduled here
+
+
+def test_drain_skips_succeeded_pods():
+    """Draining must not resurrect completed workloads (kubectl drain parity:
+    succeeded pods are ignored, not failed-and-restarted)."""
+    from lws_tpu.api.pod import PodPhase
+    from lws_tpu.controllers.node_monitor import evict_pods_on_node
+    from lws_tpu.sched import make_slice_nodes
+
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, require_binding=True)
+    cp.add_nodes(make_slice_nodes("s0", topology="2x4"))
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+    done = cp.store.get("Pod", "default", "sample-0-1")
+    node = done.spec.node_name
+    done.status.phase = PodPhase.SUCCEEDED
+    done.status.ready = False
+    cp.store.update_status(done)
+
+    evicted = evict_pods_on_node(cp.store, node, "drain test")
+    assert "sample-0-1" not in evicted
+    assert cp.store.get("Pod", "default", "sample-0-1").status.phase == PodPhase.SUCCEEDED
