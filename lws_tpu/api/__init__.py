@@ -26,6 +26,7 @@ from lws_tpu.api.groupset import (  # noqa: F401
 )
 from lws_tpu.api.service import Service, ServiceSpec  # noqa: F401
 from lws_tpu.api.node import Node  # noqa: F401
+from lws_tpu.api.lease import Lease, LeaseSpec  # noqa: F401
 from lws_tpu.api.revision import ControllerRevision  # noqa: F401
 from lws_tpu.api.types import (  # noqa: F401
     LeaderWorkerSet,

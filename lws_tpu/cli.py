@@ -17,6 +17,13 @@ import time
 import urllib.request
 
 
+_TLS_CONTEXT = None  # set by main() from --cacert/--insecure
+
+
+def _url_context(url: str):
+    return _TLS_CONTEXT if url.startswith("https://") else None
+
+
 def _server_base(server: str) -> str:
     """Accept both `host:port` and a full `http://host:port` URL."""
     if server.startswith(("http://", "https://")):
@@ -25,9 +32,10 @@ def _server_base(server: str) -> str:
 
 
 def _http(server: str, method: str, path: str, body: bytes | None = None):
-    req = urllib.request.Request(f"{_server_base(server)}{path}", data=body, method=method)
+    url = f"{_server_base(server)}{path}"
+    req = urllib.request.Request(url, data=body, method=method)
     try:
-        with urllib.request.urlopen(req) as resp:
+        with urllib.request.urlopen(req, context=_url_context(url)) as resp:
             return json.loads(resp.read().decode())
     except urllib.error.HTTPError as e:
         detail = e.read().decode()
@@ -101,17 +109,25 @@ def cmd_serve(args) -> int:
             else:
                 print(f"exists {obj.kind}/{obj.meta.name} (restored)")
 
-    server = ApiServer(cp, port=args.port)
+    tls = None
+    if args.tls_dir:
+        from lws_tpu.core.certs import CertManager
+
+        tls = CertManager(args.tls_dir)
+        paths = tls.ensure()
+        print(f"serving TLS; clients trust {paths.ca_cert}")
+    server = ApiServer(cp, port=args.port, tls=tls)
     dirty = {"flag": True}  # always persist once after boot
     if args.state_file:
         # Register BEFORE the manager threads start: the first burst of
         # post-restore reconcile writes must mark the state dirty too.
         cp.store.watch(lambda _ev: dirty.__setitem__("flag", True))
     server.start()
-    cp.manager.start()
+    cp.start()
     from lws_tpu.version import user_agent
 
-    print(f"{user_agent()} serving on http://127.0.0.1:{server.port} "
+    scheme = "https" if tls else "http"
+    print(f"{user_agent()} serving on {scheme}://127.0.0.1:{server.port} "
           f"(backend={cfg.backend}, scheduler={cfg.enable_scheduler})")
     try:
         while True:
@@ -120,7 +136,7 @@ def cmd_serve(args) -> int:
                 dirty["flag"] = False
                 save_store(cp.store, args.state_file)
     except KeyboardInterrupt:
-        cp.manager.stop()
+        cp.stop()
         server.stop()
         if args.state_file:
             save_store(cp.store, args.state_file)
@@ -164,9 +180,10 @@ def cmd_delete(args) -> int:
 
 
 def cmd_logs(args) -> int:
-    req = urllib.request.Request(f"{_server_base(args.server)}/logs/{args.namespace}/{args.name}")
+    url = f"{_server_base(args.server)}/logs/{args.namespace}/{args.name}"
+    req = urllib.request.Request(url)
     try:
-        with urllib.request.urlopen(req) as resp:
+        with urllib.request.urlopen(req, context=_url_context(url)) as resp:
             sys.stdout.write(resp.read().decode(errors="replace"))
         return 0
     except urllib.error.HTTPError as e:
@@ -222,7 +239,12 @@ def cmd_plan_steps(args) -> int:
 
 
 def main(argv=None) -> int:
+    global _TLS_CONTEXT
     p = argparse.ArgumentParser(prog="lws-tpu")
+    p.add_argument("--cacert", default=None,
+                   help="CA bundle to trust for https:// servers")
+    p.add_argument("--insecure", action="store_true",
+                   help="skip TLS verification for https:// servers")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("serve", help="run the control plane + API server")
@@ -231,6 +253,9 @@ def main(argv=None) -> int:
     sp.add_argument("--port", type=int, default=9443)
     sp.add_argument("--state-file", default=None,
                     help="persist the object store here; restored on restart")
+    sp.add_argument("--tls-dir", default=None,
+                    help="serve HTTPS with an auto-generated, auto-rotated "
+                         "self-signed cert kept in this directory")
     sp.set_defaults(fn=cmd_serve)
 
     ap = sub.add_parser("apply")
@@ -285,6 +310,10 @@ def main(argv=None) -> int:
     pp.set_defaults(fn=cmd_plan_steps)
 
     args = p.parse_args(argv)
+    if args.cacert or args.insecure:
+        from lws_tpu.core.certs import client_context
+
+        _TLS_CONTEXT = client_context(args.cacert)
     try:
         return args.fn(args)
     except BrokenPipeError:

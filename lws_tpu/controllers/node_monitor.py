@@ -17,12 +17,16 @@ from lws_tpu.core.store import Key, Store
 
 
 def evict_pods_on_node(store: Store, node_name: str, message: str, recorder=None, reason: str = "Evicted") -> list[str]:
-    """Fail every non-Failed pod bound to `node_name` (shared by the node
+    """Fail every running pod bound to `node_name` (shared by the node
     monitor and the drain endpoint). Conflict-retries per pod; pods deleted
-    underneath (sibling eviction via restart policy) are skipped."""
+    underneath (sibling eviction via restart policy) are skipped; completed
+    pods are left alone. Pods still contended after all retries raise
+    ValueError (drain returns 422: re-issue the idempotent drain) rather than
+    silently surviving the drain."""
     from lws_tpu.core.store import ConflictError, NotFoundError
 
     evicted: list[str] = []
+    contended: list[str] = []
     for pod in store.list("Pod"):
         if pod.spec.node_name != node_name or pod.status.phase in (
             PodPhase.FAILED, PodPhase.SUCCEEDED,  # kubectl drain ignores completed pods
@@ -46,6 +50,13 @@ def evict_pods_on_node(store: Store, node_name: str, message: str, recorder=None
                 break
             except ConflictError:
                 continue
+        else:
+            contended.append(pod.meta.name)
+    if contended:
+        raise ValueError(
+            f"could not evict {', '.join(sorted(contended))} from {node_name} "
+            "(persistent write contention); retry the drain"
+        )
     return evicted
 
 
@@ -62,8 +73,11 @@ class NodeMonitor:
             return None
         if node.status.ready:
             return None
-        evict_pods_on_node(
-            self.store, node.meta.name, f"node {node.meta.name} not ready",
-            recorder=self.recorder, reason="NodeFailure",
-        )
+        try:
+            evict_pods_on_node(
+                self.store, node.meta.name, f"node {node.meta.name} not ready",
+                recorder=self.recorder, reason="NodeFailure",
+            )
+        except ValueError:
+            return Result(requeue=True)  # contended pods: try again
         return None

@@ -64,9 +64,13 @@ class _Registration:
 
 
 class Manager:
-    def __init__(self, store: Store, metrics=None) -> None:
+    def __init__(self, store: Store, metrics=None, gate=None) -> None:
+        """`gate`: optional () -> bool checked before dispatching work; while
+        False (e.g. a standby awaiting leader election) queued items are held,
+        not dropped. Applies to BOTH run_until_stable and threaded mode."""
         self.store = store
         self.metrics = metrics
+        self.gate = gate
         self._registrations: list[_Registration] = []
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -110,6 +114,8 @@ class Manager:
         race — the standard controller-runtime pattern); any other exception
         propagates so tests fail loudly instead of looping.
         """
+        if self.gate is not None and not self.gate():
+            return 0  # standby: hold queued work until elected
         processed = 0
         for _ in range(max_iterations):
             progressed = False
@@ -139,6 +145,9 @@ class Manager:
 
         def worker(reg: _Registration) -> None:
             while not self._stop.is_set():
+                if self.gate is not None and not self.gate():
+                    time.sleep(poll_interval * 10)  # standby: hold the queue
+                    continue
                 key = reg.pop()
                 if key is None:
                     time.sleep(poll_interval)

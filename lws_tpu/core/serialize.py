@@ -20,6 +20,7 @@ from lws_tpu.api.meta import to_plain
 
 def _registry() -> dict[str, type]:
     from lws_tpu.api.autoscaler import Autoscaler
+    from lws_tpu.api.lease import Lease
     from lws_tpu.api.disagg import DisaggregatedSet
     from lws_tpu.api.groupset import GroupSet
     from lws_tpu.api.node import Node
@@ -35,6 +36,7 @@ def _registry() -> dict[str, type]:
         for cls in (
             LeaderWorkerSet, DisaggregatedSet, Pod, GroupSet, Service, Node,
             PodGroup, PersistentVolumeClaim, ControllerRevision, Autoscaler,
+            Lease,
         )
     }
 

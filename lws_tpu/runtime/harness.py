@@ -60,6 +60,9 @@ class ControlPlane:
         auto_ready: bool = False,
         require_binding: bool = False,
         store: Optional[Store] = None,
+        leader_election: bool = False,
+        identity: Optional[str] = None,
+        **election_kw,
     ) -> None:
         from lws_tpu.core.metrics import MetricsRegistry
 
@@ -75,6 +78,25 @@ class ControlPlane:
         register_ds_webhooks(self.store)
 
         self.manager = Manager(self.store, metrics=self.metrics)
+
+        # HA: with leader_election on, this manager reconciles only while it
+        # holds the cluster Lease (reference cmd/main.go:95-106 semantics —
+        # standbys watch but stay passive until the lease expires).
+        self.elector = None
+        if leader_election:
+            import os
+            import uuid
+
+            from lws_tpu.core.election import LeaderElector
+
+            self.elector = LeaderElector(
+                self.store,
+                identity=identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}",
+                **election_kw,
+            )
+            # Gate ALL dispatch (deterministic and threaded) on holding the
+            # lease — a standby that reconciled would be a split brain.
+            self.manager.gate = self.elector.is_leader
         store = self.store
 
         def lws_key_by_label(obj) -> list[Key]:
@@ -198,7 +220,21 @@ class ControlPlane:
 
     # ------------------------------------------------------------------
     def run_until_stable(self, max_iterations: int = 10000) -> int:
+        if self.elector is not None:
+            self.elector.tick()
         return self.manager.run_until_stable(max_iterations)
+
+    def start(self) -> None:
+        """Threaded mode: election loop (if configured) + controller workers.
+        The manager's gate keeps standby workers passive until elected."""
+        if self.elector is not None:
+            self.elector.start()
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.manager.stop()
+        if self.elector is not None:
+            self.elector.stop()
 
     def resync(self) -> None:
         """Cold-start cache resync: enqueue every stored object to every

@@ -29,7 +29,7 @@ from lws_tpu.manifest import from_manifest, to_manifest
 _CANONICAL_KINDS = (
     "LeaderWorkerSet", "DisaggregatedSet", "GroupSet", "Pod", "Node",
     "Service", "PodGroup", "ControllerRevision", "PersistentVolumeClaim",
-    "Autoscaler",
+    "Autoscaler", "Lease",
 )
 _KIND_ALIASES = {
     **{k.lower(): k for k in _CANONICAL_KINDS},
@@ -77,8 +77,13 @@ def _set_cordon(store, node_name: str, unschedulable: bool) -> None:
 
 
 class ApiServer:
-    def __init__(self, control_plane, port: int = 9443, host: str = "127.0.0.1") -> None:
+    def __init__(
+        self, control_plane, port: int = 9443, host: str = "127.0.0.1", tls=None
+    ) -> None:
+        """`tls`: an optional lws_tpu.core.certs.CertManager; when given the
+        server speaks HTTPS with its (auto-generated, auto-rotated) cert."""
         self.control_plane = control_plane
+        self.tls = tls
         cp = control_plane
 
         from lws_tpu.version import user_agent
@@ -235,7 +240,23 @@ class ApiServer:
                     # JSON error, not a dropped connection.
                     self._json(400, {"error": f"{type(e).__name__}: {e}"})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls is None:
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
+        else:
+            # Wrap per-accepted-connection, not the listening socket: rotation
+            # (CertManager regenerating at 2/3 lifetime) must reach clients
+            # without a server restart, and a baked-in listener context would
+            # pin the original cert forever.
+            class _TLSHTTPServer(ThreadingHTTPServer):
+                _ctx = tls.server_context()
+
+                def get_request(inner):
+                    sock, addr = ThreadingHTTPServer.get_request(inner)
+                    if tls.needs_rotation():
+                        type(inner)._ctx = tls.server_context()  # re-ensures
+                    return inner._ctx.wrap_socket(sock, server_side=True), addr
+
+            self._httpd = _TLSHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
 
     def start(self) -> None:
