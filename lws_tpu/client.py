@@ -1,5 +1,9 @@
-"""Typed client (≈ client-go generated clientset, SURVEY §2.9): convenience
-API over a Store/ControlPlane for external programs and tests."""
+"""Typed clients (≈ client-go generated clientset, SURVEY §2.9).
+
+`Client` wraps an in-process Store (what controller code and tests use).
+`RemoteClient` speaks the ApiServer's HTTP(S) API — the out-of-process
+clientset — and `Informer` maintains a list+watch-synchronized local cache
+over it (≈ client-go informers/listers: resync-on-expiry, event handlers)."""
 
 from __future__ import annotations
 
@@ -66,3 +70,191 @@ class Client:
             self.namespace,
             labels={contract.SET_NAME_LABEL_KEY: lws_name, contract.WORKER_INDEX_LABEL_KEY: "0"},
         )
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, detail: str) -> None:
+        super().__init__(f"{code}: {detail}")
+        self.code = code
+        self.detail = detail
+
+
+class RemoteClient:
+    """HTTP(S) clientset against a running ApiServer (reference parity:
+    client-go/clientset/versioned). All methods raise ApiError on non-2xx."""
+
+    def __init__(self, base_url: str, ca_cert: Optional[str] = None,
+                 insecure: bool = False) -> None:
+        self.base_url = base_url.rstrip("/")
+        # https trust: explicit CA bundle > explicit insecure > system store.
+        # (No flag must NEVER silently mean "no verification".)
+        self._context = None
+        if self.base_url.startswith("https://") and (ca_cert or insecure):
+            from lws_tpu.core.certs import client_context
+
+            self._context = client_context(None if insecure else ca_cert)
+
+    def _request(self, method: str, path: str, body: Optional[bytes] = None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(self.base_url + path, data=body, method=method)
+        try:
+            with urllib.request.urlopen(req, context=self._context) as resp:
+                return _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode()
+            try:
+                detail = _json.loads(detail).get("error", detail)
+            except ValueError:
+                pass
+            raise ApiError(e.code, detail) from None
+
+    # -- objects ---------------------------------------------------------
+
+    def list(self, kind: str) -> list[dict]:
+        return self._request("GET", f"/apis/{kind}")
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        return self._request("GET", f"/apis/{kind}/{namespace}/{name}")
+
+    def delete(self, kind: str, namespace: str, name: str) -> dict:
+        return self._request("DELETE", f"/apis/{kind}/{namespace}/{name}")
+
+    def apply(self, manifest_yaml: str) -> dict:
+        return self._request("POST", "/apply", manifest_yaml.encode())
+
+    def apply_object(self, obj) -> dict:
+        import yaml
+
+        from lws_tpu.manifest import to_manifest
+
+        return self.apply(yaml.safe_dump(to_manifest(obj), sort_keys=False))
+
+    # -- subresources ----------------------------------------------------
+
+    def scale(self, namespace: str, name: str, replicas: int) -> dict:
+        import json as _json
+
+        body = _json.dumps({"replicas": replicas}).encode()
+        return self._request("POST", f"/scale/{namespace}/{name}", body)
+
+    def cordon(self, node: str, unschedulable: bool = True) -> dict:
+        import json as _json
+
+        body = _json.dumps({"unschedulable": unschedulable}).encode()
+        return self._request("POST", f"/cordon/{node}", body)
+
+    def drain(self, node: str) -> dict:
+        return self._request("POST", f"/drain/{node}", b"{}")
+
+    def report_metric(self, namespace: str, pod: str, metrics: dict) -> dict:
+        import json as _json
+
+        return self._request(
+            "POST", f"/report-metric/{namespace}/{pod}", _json.dumps(metrics).encode()
+        )
+
+    # -- watch -----------------------------------------------------------
+
+    def watch(self, since: int, timeout: float = 30.0) -> dict:
+        """One long-poll: {"events": [...], "next": seq} or {"expired": True}."""
+        return self._request("GET", f"/watch?since={since}&timeout={timeout}")
+
+    def current_seq(self) -> int:
+        return self._request("GET", "/watch?since=-1")["next"]
+
+
+class Informer:
+    """List+watch cache over a RemoteClient (≈ client-go shared informer +
+    lister): `sync()` pulls pending events into the local cache, relisting
+    when the server's watch window expired. Deterministic — call `sync()`
+    yourself or use `start()` for a background thread."""
+
+    KINDS = ("LeaderWorkerSet", "DisaggregatedSet", "GroupSet", "Pod",
+             "Service", "Node", "PodGroup", "Autoscaler")
+
+    def __init__(self, client: RemoteClient, kinds: Optional[tuple[str, ...]] = None,
+                 on_event=None) -> None:
+        import threading
+
+        self.client = client
+        self.kinds = kinds or self.KINDS
+        self.on_event = on_event
+        self.cache: dict[tuple[str, str, str], dict] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._stop = None
+        self._thread = None
+
+    @staticmethod
+    def _key(manifest: dict) -> tuple[str, str, str]:
+        meta = manifest.get("metadata", {})
+        return (manifest["kind"], meta.get("namespace", "default"), meta["name"])
+
+    def relist(self) -> None:
+        # Bookmark FIRST, list second: events racing the relist are replayed
+        # onto the fresh cache (replay is idempotent), never lost.
+        seq = self.client.current_seq()
+        cache: dict[tuple[str, str, str], dict] = {}
+        for kind in self.kinds:
+            for manifest in self.client.list(kind):
+                cache[self._key(manifest)] = manifest
+        with self._lock:
+            self._seq = seq
+            self.cache = cache
+
+    def sync(self, timeout: float = 0.0) -> int:
+        """Apply events since the last bookmark; returns how many applied."""
+        out = self.client.watch(self._seq, timeout=timeout)
+        if out.get("expired"):
+            self.relist()
+            return 0
+        applied = 0
+        with self._lock:
+            for ev in out["events"]:
+                manifest = ev["object"]
+                if manifest["kind"] not in self.kinds:
+                    continue
+                key = self._key(manifest)
+                if ev["type"] == "DELETED":
+                    self.cache.pop(key, None)
+                else:
+                    self.cache[key] = manifest
+                applied += 1
+                if self.on_event:
+                    self.on_event(ev["type"], manifest)
+            self._seq = out["next"]
+        return applied
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            return self.cache.get((kind, namespace, name))
+
+    def list(self, kind: str) -> list[dict]:
+        with self._lock:
+            return [m for (k, _, _), m in self.cache.items() if k == kind]
+
+    def start(self, poll_timeout: float = 10.0) -> None:
+        import threading
+
+        self.relist()
+        self._stop = threading.Event()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.sync(timeout=poll_timeout)
+                except (ApiError, OSError):
+                    self._stop.wait(1.0)  # server briefly away: retry
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="informer")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

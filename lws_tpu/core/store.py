@@ -64,8 +64,17 @@ class Store:
     def register_validator(self, kind: str, fn) -> None:
         self._validators.setdefault(kind, []).append(fn)
 
-    def watch(self, fn: Callable[[WatchEvent], None]) -> None:
+    def watch(self, fn: Callable[[WatchEvent], None]) -> Callable[[], None]:
+        """Subscribe to all mutations; returns an unsubscribe handle."""
         self._watchers.append(fn)
+
+        def unsubscribe() -> None:
+            try:
+                self._watchers.remove(fn)
+            except ValueError:
+                pass
+
+        return unsubscribe
 
     # ---- reads -------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> TypedObject:

@@ -185,8 +185,83 @@ def _int_or_percent(v):
     return v
 
 
+_OPAQUE_KEYS = frozenset({
+    # Free-form maps whose keys are user data, not field names.
+    "labels", "annotations", "nodeSelector", "node_selector",
+    "resources", "ports", "capacity", "metrics",
+})
+
+
+def _spec_key_styles(spec) -> tuple[bool, bool]:
+    """Recursively scan FIELD-NAME keys for (snake_case, camelCase) markers,
+    skipping free-form maps (labels etc.) whose keys are user-chosen."""
+    snake = camel = False
+    stack = [spec]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, dict):
+            for k, v in x.items():
+                if "_" in k:
+                    snake = True
+                elif k != k.lower():
+                    camel = True
+                if k not in _OPAQUE_KEYS:
+                    stack.append(v)
+        elif isinstance(x, list):
+            stack.extend(x)
+    return snake, camel
+
+
+def _is_native_manifest(raw: dict) -> bool:
+    """`to_manifest` output (GET /apis, `get -o yaml`) carries the store's
+    snake_case plain form; hand-written k8s-style manifests use camelCase.
+    Routing on the STRUCTURE (never on resourceVersion presence — kubectl
+    exports keep it too) lets `get | apply` round-trip with full fidelity
+    while camelCase manifests always take the k8s parser."""
+    spec = raw.get("spec")
+    if not isinstance(spec, dict):
+        return False
+    snake, camel = _spec_key_styles(spec)
+    if snake and camel:
+        raise ValueError(
+            "manifest mixes snake_case and camelCase field names; "
+            "use one form consistently"
+        )
+    if snake:
+        return True
+    if camel:
+        return False
+    # Structurally ambiguous (e.g. a bare Node spec): both parsers agree on
+    # these shapes; prefer the native path only for our own exports.
+    return "resourceVersion" in raw.get("metadata", {})
+
+
+def _from_native_manifest(raw: dict):
+    from lws_tpu.core.serialize import _registry, from_plain
+
+    cls = _registry().get(raw.get("kind"))
+    if cls is None:
+        raise ValueError(f"unknown kind {raw.get('kind')!r}")
+    m = raw.get("metadata", {})
+    plain: dict = {
+        "meta": {
+            "name": m.get("name", ""),
+            "namespace": m.get("namespace", "default"),
+            "labels": dict(m.get("labels", {})),
+            "annotations": dict(m.get("annotations", {})),
+        },
+        "spec": raw.get("spec") or {},
+    }
+    if "status" in raw and raw["status"] is not None:
+        plain["status"] = raw["status"]
+    obj = from_plain(cls, plain)
+    return obj
+
+
 def from_manifest(raw: dict):
     kind = raw.get("kind")
+    if kind in ("LeaderWorkerSet", "DisaggregatedSet", "Node", "Autoscaler") and _is_native_manifest(raw):
+        return _from_native_manifest(raw)
     if kind == "LeaderWorkerSet":
         return LeaderWorkerSet(meta=_meta(raw), spec=_lws_spec(raw.get("spec", {})))
     if kind == "DisaggregatedSet":

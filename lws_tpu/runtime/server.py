@@ -78,13 +78,39 @@ def _set_cordon(store, node_name: str, unschedulable: bool) -> None:
 
 class ApiServer:
     def __init__(
-        self, control_plane, port: int = 9443, host: str = "127.0.0.1", tls=None
+        self,
+        control_plane,
+        port: int = 9443,
+        host: str = "127.0.0.1",
+        tls=None,
+        watch_buffer: int = 4096,
     ) -> None:
         """`tls`: an optional lws_tpu.core.certs.CertManager; when given the
-        server speaks HTTPS with its (auto-generated, auto-rotated) cert."""
+        server speaks HTTPS with its (auto-generated, auto-rotated) cert.
+        `watch_buffer`: events retained for /watch replay; clients that fall
+        further behind are told to relist (k8s "410 Gone" semantics)."""
+        import collections
+
         self.control_plane = control_plane
         self.tls = tls
         cp = control_plane
+
+        # Watch plumbing (≈ the apiserver's watch cache): every store event
+        # gets a server-local sequence number; /watch long-polls on it.
+        events = collections.deque(maxlen=watch_buffer)
+        events_cond = threading.Condition()
+        seq_box = {"seq": 0}
+
+        def _record_event(ev) -> None:
+            with events_cond:
+                seq_box["seq"] += 1
+                events.append(
+                    {"seq": seq_box["seq"], "type": ev.type, "object": to_manifest(ev.obj)}
+                )
+                events_cond.notify_all()
+
+        self._unwatch = cp.store.watch(_record_event)
+        self._events, self._events_cond, self._seq_box = events, events_cond, seq_box
 
         from lws_tpu.version import user_agent
 
@@ -107,7 +133,8 @@ class ApiServer:
                 self._send(code, json.dumps(obj, indent=1, default=str))
 
             def do_GET(self):
-                parts = [p for p in self.path.split("/") if p]
+                path = self.path.split("?", 1)[0]
+                parts = [p for p in path.split("/") if p]
                 if self.path in ("/healthz", "/readyz"):
                     self._send(200, "ok", "text/plain")
                 elif self.path == "/metrics":
@@ -129,6 +156,34 @@ class ApiServer:
                         self._json(404, {"error": f"{parts[1]} {parts[2]}/{parts[3]} not found"})
                     else:
                         self._json(200, to_manifest(obj))
+                elif parts[:1] == ["watch"]:
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        since = int(q.get("since", ["0"])[0])
+                        timeout = min(float(q.get("timeout", ["30"])[0]), 60.0)
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad watch params: {e}"})
+                        return
+                    with events_cond:
+                        if since < 0:  # bookmark request: where is "now"?
+                            self._json(200, {"events": [], "next": seq_box["seq"]})
+                            return
+                        oldest = events[0]["seq"] if events else seq_box["seq"] + 1
+                        if since > seq_box["seq"] or (
+                            since + 1 < oldest and seq_box["seq"] > since
+                        ):
+                            # Bookmark from the future (server restarted) or
+                            # fallen out of the ring: client must relist
+                            # (k8s 410 Gone on an unknown resourceVersion).
+                            self._json(200, {"expired": True, "next": seq_box["seq"]})
+                            return
+                        if seq_box["seq"] <= since:
+                            events_cond.wait(timeout)
+                        batch = [e for e in events if e["seq"] > since]
+                    nxt = batch[-1]["seq"] if batch else since
+                    self._json(200, {"events": batch, "next": nxt})
                 elif len(parts) == 3 and parts[0] == "logs":
                     provider = getattr(cp, "log_provider", None)
                     logs = provider(parts[1], parts[2]) if provider else None
@@ -140,7 +195,8 @@ class ApiServer:
                     self._json(404, {"error": "unknown path"})
 
             def do_DELETE(self):
-                parts = [p for p in self.path.split("/") if p]
+                path = self.path.split("?", 1)[0]
+                parts = [p for p in path.split("/") if p]
                 if len(parts) == 4 and parts[0] == "apis":
                     try:
                         cp.store.delete(_kind(parts[1]), parts[2], parts[3])
@@ -154,7 +210,8 @@ class ApiServer:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length).decode()
-                parts = [p for p in self.path.split("/") if p]
+                path = self.path.split("?", 1)[0]
+                parts = [p for p in path.split("/") if p]
                 try:
                     if parts[:1] == ["apply"]:
                         import yaml
@@ -254,7 +311,14 @@ class ApiServer:
                     sock, addr = ThreadingHTTPServer.get_request(inner)
                     if tls.needs_rotation():
                         type(inner)._ctx = tls.server_context()  # re-ensures
-                    return inner._ctx.wrap_socket(sock, server_side=True), addr
+                    # Defer the handshake to the per-connection thread (first
+                    # read) and bound it: a client that connects and stalls
+                    # must not block the accept loop for everyone else.
+                    sock.settimeout(60)
+                    wrapped = inner._ctx.wrap_socket(
+                        sock, server_side=True, do_handshake_on_connect=False
+                    )
+                    return wrapped, addr
 
             self._httpd = _TLSHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
@@ -264,3 +328,4 @@ class ApiServer:
 
     def stop(self) -> None:
         self._httpd.shutdown()
+        self._unwatch()  # stop serializing store events into a dead buffer

@@ -325,3 +325,33 @@ def test_drain_skips_succeeded_pods():
     evicted = evict_pods_on_node(cp.store, node, "drain test")
     assert "sample-0-1" not in evicted
     assert cp.store.get("Pod", "default", "sample-0-1").status.phase == PodPhase.SUCCEEDED
+
+
+def test_mixed_case_manifest_rejected():
+    """A manifest mixing camelCase and snake_case field names is ambiguous
+    between the k8s parser and the native round-trip path: reject loudly
+    instead of guessing (guessing wrong silently drops spec fields)."""
+    from lws_tpu.manifest import from_manifest
+
+    with pytest.raises(ValueError, match="mixes"):
+        from_manifest({
+            "kind": "LeaderWorkerSet",
+            "metadata": {"name": "x"},
+            "spec": {"leaderWorkerTemplate": {"size": 2},
+                     "startup_policy": "LeaderCreated"},
+        })
+
+
+def test_camelcase_manifest_with_resource_version_takes_k8s_parser():
+    """kubectl-style exports keep metadata.resourceVersion; its presence must
+    NOT shunt a camelCase manifest onto the snake_case path (which would
+    silently produce an all-defaults spec)."""
+    from lws_tpu.manifest import from_manifest
+
+    lws = from_manifest({
+        "kind": "LeaderWorkerSet",
+        "metadata": {"name": "x", "resourceVersion": 42},
+        "spec": {"replicas": 3, "leaderWorkerTemplate": {"size": 4}},
+    })
+    assert lws.spec.replicas == 3
+    assert lws.spec.leader_worker_template.size == 4

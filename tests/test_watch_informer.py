@@ -1,0 +1,157 @@
+"""/watch long-poll + RemoteClient + Informer (≈ client-go clientset,
+informers and listers over the apiserver watch cache, SURVEY §2.9)."""
+
+import threading
+import time
+
+import pytest
+
+from lws_tpu.client import ApiError, Informer, RemoteClient
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.runtime.server import ApiServer
+from lws_tpu.testing import LWSBuilder
+
+
+def make_server(**kw):
+    cp = ControlPlane(auto_ready=True)
+    server = ApiServer(cp, port=0, **kw)
+    server.start()
+    return cp, server, RemoteClient(f"http://127.0.0.1:{server.port}")
+
+
+def test_watch_replays_buffered_events():
+    cp, server, client = make_server()
+    try:
+        cp.create(LWSBuilder().replicas(1).size(2).build())
+        cp.run_until_stable()
+        out = client.watch(since=0, timeout=0.1)
+        types = {(e["object"]["kind"], e["type"]) for e in out["events"]}
+        assert ("LeaderWorkerSet", "ADDED") in types
+        assert ("Pod", "ADDED") in types
+        assert out["next"] == out["events"][-1]["seq"]
+        # Nothing new after the bookmark: empty poll, bookmark unchanged.
+        again = client.watch(since=out["next"], timeout=0.1)
+        assert again["events"] == [] and again["next"] == out["next"]
+    finally:
+        server.stop()
+
+
+def test_watch_long_poll_blocks_until_event():
+    cp, server, client = make_server()
+    try:
+        start_seq = client.current_seq()
+        got = {}
+
+        def poll():
+            got["out"] = client.watch(since=start_seq, timeout=10)
+
+        t = threading.Thread(target=poll)
+        t.start()
+        time.sleep(0.1)
+        cp.create(LWSBuilder("late").replicas(1).size(1).build())
+        t.join(timeout=5)
+        assert not t.is_alive()
+        kinds = {e["object"]["kind"] for e in got["out"]["events"]}
+        assert "LeaderWorkerSet" in kinds
+    finally:
+        server.stop()
+
+
+def test_watch_window_expiry_tells_client_to_relist():
+    cp, server, client = make_server(watch_buffer=4)
+    try:
+        cp.create(LWSBuilder().replicas(2).size(2).build())
+        cp.run_until_stable()  # >> 4 events
+        out = client.watch(since=1, timeout=0.1)
+        assert out.get("expired") is True
+    finally:
+        server.stop()
+
+
+def test_remote_client_typed_round_trip():
+    cp, server, client = make_server()
+    try:
+        client.apply_object(LWSBuilder().replicas(1).size(2).build())
+        cp.run_until_stable()
+        assert client.get("lws", "default", "sample")["spec"]["replicas"] == 1
+        assert len(client.list("pods")) == 2
+        client.scale("default", "sample", 2)
+        cp.run_until_stable()
+        assert len(client.list("pods")) == 4
+        with pytest.raises(ApiError) as e:
+            client.get("lws", "default", "ghost")
+        assert e.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_informer_cache_tracks_cluster_state():
+    cp, server, client = make_server()
+    try:
+        informer = Informer(client, kinds=("LeaderWorkerSet", "Pod"))
+        informer.relist()
+        assert informer.list("Pod") == []
+
+        cp.create(LWSBuilder().replicas(1).size(2).build())
+        cp.run_until_stable()
+        informer.sync()
+        assert len(informer.list("Pod")) == 2
+        assert informer.get("LeaderWorkerSet", "default", "sample") is not None
+
+        cp.store.delete("LeaderWorkerSet", "default", "sample")
+        cp.run_until_stable()
+        informer.sync()
+        assert informer.get("LeaderWorkerSet", "default", "sample") is None
+        assert informer.list("Pod") == []  # cascade delete observed
+    finally:
+        server.stop()
+
+
+def test_informer_recovers_from_expired_window():
+    cp, server, client = make_server(watch_buffer=4)
+    try:
+        events = []
+        informer = Informer(client, kinds=("Pod",),
+                            on_event=lambda t, m: events.append(t))
+        informer.relist()
+        cp.create(LWSBuilder().replicas(2).size(2).build())
+        cp.run_until_stable()  # floods the 4-event ring
+        informer.sync()  # sees "expired" -> relists
+        assert len(informer.list("Pod")) == 4
+    finally:
+        server.stop()
+
+
+def test_watch_future_bookmark_expires():
+    """A bookmark ahead of the server (restart reset the sequence) must tell
+    the client to relist, not hang it on an unreachable sequence number."""
+    cp, server, client = make_server()
+    try:
+        out = client.watch(since=10_000, timeout=0.1)
+        assert out.get("expired") is True
+        # Informer recovers through the same path.
+        informer = Informer(client, kinds=("Pod",))
+        informer._seq = 10_000
+        cp.create(LWSBuilder().replicas(1).size(1).build())
+        cp.run_until_stable()
+        informer.sync()
+        assert len(informer.list("Pod")) == 1
+    finally:
+        server.stop()
+
+
+def test_watch_rejects_malformed_params():
+    _, server, client = make_server()
+    try:
+        with pytest.raises(ApiError) as e:
+            client._request("GET", "/watch?since=abc")
+        assert e.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_stopped_server_unsubscribes_from_store():
+    cp, server, _ = make_server()
+    n_before = len(cp.store._watchers)
+    server.stop()
+    assert len(cp.store._watchers) == n_before - 1
