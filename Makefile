@@ -1,0 +1,17 @@
+.PHONY: test native bench clean
+
+test:
+	python -m pytest tests/ -q
+
+native:  ## build the C runtime extensions into lws_tpu/core/
+	python native/build.py
+
+bench:
+	python bench.py
+
+bench-control-plane:
+	python benchmarks/control_plane_bench.py
+
+clean:
+	rm -f lws_tpu/core/_fastclone*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

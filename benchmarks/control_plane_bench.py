@@ -1,0 +1,84 @@
+"""Control-plane benchmark: convergence throughput of the reconcile stack.
+
+The reference publishes no perf numbers (SURVEY §6 / BASELINE.md); its
+measurable characteristics are control-plane: how fast N groups converge,
+how fast a fleet-wide rolling update completes. This measures ours on the
+same axes (in-process store, deterministic run_until_stable):
+
+  turnup:   create LWS(replicas=R, size=S) -> all R*S pods scheduled+ready
+  rollout:  template change -> every group recreated on the new revision
+
+Prints one JSON line per phase. Not the driver benchmark (bench.py is);
+run directly:  python benchmarks/control_plane_bench.py [-R 50] [-S 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.sched import make_slice_nodes
+from lws_tpu.testing import LWSBuilder, lws_pods
+
+
+def bench_turnup(replicas: int, size: int) -> dict:
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, require_binding=True)
+    for i in range(replicas):
+        cp.add_nodes(make_slice_nodes(f"slice-{i}", topology=f"{size}x4"))
+    cp.create(
+        LWSBuilder().replicas(replicas).size(size).tpu_chips(4)
+        .exclusive_topology().build()
+    )
+    t0 = time.perf_counter()
+    reconciles = cp.run_until_stable(max_iterations=1_000_000)
+    dt = time.perf_counter() - t0
+    pods = lws_pods(cp.store, "sample")
+    assert len(pods) == replicas * size and all(p.status.ready for p in pods)
+    return {
+        "metric": "group turnup (create -> scheduled+ready)",
+        "groups": replicas,
+        "pods": replicas * size,
+        "reconciles": reconciles,
+        "value": round(replicas / dt, 1),
+        "unit": "groups/s",
+        "wall_s": round(dt, 3),
+    }, cp
+
+
+def bench_rollout(cp: ControlPlane, replicas: int, size: int) -> dict:
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.leader_worker_template.worker_template.spec.containers[0].image = "worker:v2"
+    cp.store.update(lws)
+    t0 = time.perf_counter()
+    reconciles = cp.run_until_stable(max_iterations=1_000_000)
+    dt = time.perf_counter() - t0
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == replicas, lws.status
+    return {
+        "metric": "fleet rolling update (all groups to new revision)",
+        "groups": replicas,
+        "reconciles": reconciles,
+        "value": round(replicas / dt, 1),
+        "unit": "groups/s",
+        "wall_s": round(dt, 3),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-R", "--replicas", type=int, default=50)
+    ap.add_argument("-S", "--size", type=int, default=4)
+    args = ap.parse_args()
+
+    turnup, cp = bench_turnup(args.replicas, args.size)
+    print(json.dumps(turnup))
+    print(json.dumps(bench_rollout(cp, args.replicas, args.size)))
+
+
+if __name__ == "__main__":
+    main()

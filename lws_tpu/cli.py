@@ -193,6 +193,20 @@ def cmd_logs(args) -> int:
         raise SystemExit(f"error: cannot reach server {args.server}: {e.reason}") from None
 
 
+def cmd_events(args) -> int:
+    import time as _time
+
+    from urllib.parse import urlencode
+
+    q = {k: v for k, v in (("namespace", args.namespace), ("name", args.name)) if v}
+    path = "/events" + (f"?{urlencode(q)}" if q else "")
+    now = _time.time()
+    for ev in _http(args.server, "GET", path):
+        age = max(0, int(now - ev["timestamp"]))
+        print(f"{age}s	{ev['type']}	{ev['reason']}	{ev['object']}	{ev['message']}")
+    return 0
+
+
 def cmd_scale(args) -> int:
     body = json.dumps({"replicas": args.replicas}).encode()
     print(json.dumps(_http(args.server, "POST", f"/scale/{args.namespace}/{args.name}", body)))
@@ -308,6 +322,12 @@ def main(argv=None) -> int:
     pp.add_argument("--surge", default="")
     pp.add_argument("--unavailable", default="")
     pp.set_defaults(fn=cmd_plan_steps)
+
+    ep = sub.add_parser("events", help="controller decision trace (k8s Events)")
+    ep.add_argument("name", nargs="?")
+    ep.add_argument("--namespace", "-n", default=None)
+    ep.add_argument("--server", default="127.0.0.1:9443")
+    ep.set_defaults(fn=cmd_events)
 
     args = p.parse_args(argv)
     if args.cacert or args.insecure:

@@ -156,6 +156,14 @@ class RemoteClient:
             "POST", f"/report-metric/{namespace}/{pod}", _json.dumps(metrics).encode()
         )
 
+    def events(self, namespace: Optional[str] = None,
+               name: Optional[str] = None) -> list[dict]:
+        from urllib.parse import urlencode
+
+        q = {k: v for k, v in (("namespace", namespace), ("name", name)) if v}
+        suffix = f"?{urlencode(q)}" if q else ""
+        return self._request("GET", f"/events{suffix}")
+
     # -- watch -----------------------------------------------------------
 
     def watch(self, since: int, timeout: float = 30.0) -> dict:

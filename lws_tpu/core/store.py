@@ -12,7 +12,9 @@ rollout mid-flight exactly like the reference (SURVEY §5 checkpoint/resume).
 from __future__ import annotations
 
 import copy
+import enum
 import itertools
+import os
 import threading
 import time
 import uuid
@@ -36,6 +38,52 @@ class AlreadyExistsError(RuntimeError):
 
 class AdmissionError(ValueError):
     """Raised when a validating admission hook rejects a write."""
+
+
+_SCALARS = frozenset((str, int, float, bool, type(None)))
+
+
+def _py_clone(x):
+    """Deep copy specialized for API object trees (dataclasses, dicts, lists,
+    scalars, enums — trees by admission-time construction: built from plain
+    manifests/dataclasses, so no cycles or shared sub-references; a cyclic
+    object raises RecursionError rather than hanging). An order of magnitude
+    faster than copy.deepcopy, which dominated control-plane convergence
+    profiles; dispatch ordered by node frequency. Recurses via its own fixed
+    name so it stays a pure-Python reference implementation even when the
+    module-level `_clone` is rebound to the native extension."""
+    cls = x.__class__
+    if cls in _SCALARS:
+        return x
+    if cls is dict:
+        return {k: _py_clone(v) for k, v in x.items()}
+    if cls is list:
+        return [_py_clone(v) for v in x]
+    if getattr(cls, "__dataclass_fields__", None) is not None:
+        d = getattr(x, "__dict__", None)
+        if d is None:  # slots=True dataclass: match the native fallback
+            return copy.deepcopy(x)
+        new = cls.__new__(cls)
+        nd = new.__dict__
+        for k, v in d.items():
+            nd[k] = _py_clone(v)
+        return new
+    if isinstance(x, enum.Enum):
+        return x
+    if cls is tuple:
+        return tuple(_py_clone(v) for v in x)
+    return copy.deepcopy(x)  # anything exotic: full generality
+
+
+_clone = _py_clone
+if not os.environ.get("LWS_TPU_PURE_PY"):
+    try:  # native runtime core (build: `make native`); identical semantics
+        from lws_tpu.core import _fastclone as _native_fastclone
+
+        _native_fastclone.init(enum.Enum, copy.deepcopy)
+        _clone = _native_fastclone.clone
+    except ImportError:
+        pass
 
 
 @dataclass
@@ -82,7 +130,7 @@ class Store:
             obj = self._objects.get((kind, namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            return _clone(obj)
 
     def try_get(self, kind: str, namespace: str, name: str) -> Optional[TypedObject]:
         try:
@@ -105,13 +153,13 @@ class Store:
                     continue
                 if labels and any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(_clone(obj))
             out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
             return out
 
     # ---- writes ------------------------------------------------------------
     def create(self, obj: TypedObject) -> TypedObject:
-        obj = copy.deepcopy(obj)
+        obj = _clone(obj)
         with self._lock:
             key = obj.key()
             if key in self._objects:
@@ -122,8 +170,8 @@ class Store:
             obj.meta.generation = 1
             obj.meta.creation_timestamp = time.time()
             self._objects[key] = obj
-            stored = copy.deepcopy(obj)
-        self._notify(WatchEvent("ADDED", copy.deepcopy(stored)))
+            stored = _clone(obj)
+        self._notify(WatchEvent("ADDED", _clone(stored)))
         return stored
 
     def update(self, obj: TypedObject) -> TypedObject:
@@ -136,7 +184,7 @@ class Store:
         return self._update(obj, status_only=True)
 
     def _update(self, obj: TypedObject, status_only: bool) -> TypedObject:
-        obj = copy.deepcopy(obj)
+        obj = _clone(obj)
         with self._lock:
             key = obj.key()
             current = self._objects.get(key)
@@ -149,7 +197,7 @@ class Store:
                 )
             if status_only:
                 # Carry over everything but status from the stored object.
-                preserved = copy.deepcopy(current)
+                preserved = _clone(current)
                 preserved.status = obj.status  # type: ignore[attr-defined]
                 obj = preserved
             else:
@@ -162,8 +210,8 @@ class Store:
                     obj.meta.generation += 1
             obj.meta.resource_version = next(self._rv)
             self._objects[key] = obj
-            stored = copy.deepcopy(obj)
-        self._notify(WatchEvent("MODIFIED", copy.deepcopy(stored)))
+            stored = _clone(obj)
+        self._notify(WatchEvent("MODIFIED", _clone(stored)))
         return stored
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -189,7 +237,7 @@ class Store:
         ]
         for dep_key in dependents:
             self._delete_locked(dep_key, events)
-        events.append(WatchEvent("DELETED", copy.deepcopy(obj)))
+        events.append(WatchEvent("DELETED", _clone(obj)))
 
     # ---- helpers -----------------------------------------------------------
     @staticmethod

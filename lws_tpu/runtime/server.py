@@ -14,6 +14,7 @@ Endpoints:
   POST /cordon/{node}                 {"unschedulable": bool} (default true)
   POST /drain/{node}                  cordon + evict (groups recreate elsewhere)
   GET  /logs/{ns}/{pod}               captured pod stdout/stderr
+  GET  /events[?namespace=&name=]     controller decision trace (k8s Events)
 """
 
 from __future__ import annotations
@@ -156,6 +157,27 @@ class ApiServer:
                         self._json(404, {"error": f"{parts[1]} {parts[2]}/{parts[3]} not found"})
                     else:
                         self._json(200, to_manifest(obj))
+                elif parts[:1] == ["events"]:
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    ns = q.get("namespace", [None])[0]
+                    name = q.get("name", [None])[0]
+                    out = []
+                    for ev in list(cp.recorder.events):  # snapshot: threads append
+                        kind, ens, ename = ev.object_key
+                        if ns is not None and ens != ns:
+                            continue
+                        if name is not None and ename != name:
+                            continue
+                        out.append({
+                            "object": f"{kind}/{ens}/{ename}",
+                            "type": ev.type,
+                            "reason": ev.reason,
+                            "message": ev.message,
+                            "timestamp": ev.timestamp,
+                        })
+                    self._json(200, out)
                 elif parts[:1] == ["watch"]:
                     from urllib.parse import parse_qs, urlparse
 

@@ -1,0 +1,35 @@
+"""Build the native extensions into lws_tpu/core/ (run: `make native` or
+`python native/build.py`). Uses the CPython C API directly — no pybind11."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+TARGET_DIR = os.path.join(REPO, "lws_tpu", "core")
+
+
+def build() -> str:
+    include = sysconfig.get_path("include")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(TARGET_DIR, f"_fastclone{suffix}")
+    src = os.path.join(HERE, "fastclone.c")
+    cc = os.environ.get("CC", "gcc")
+    cmd = [
+        cc, "-O2", "-fPIC", "-shared", "-o", out, src, f"-I{include}",
+        "-Wall", "-Wextra",
+    ]
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    if shutil.which(os.environ.get("CC", "gcc")) is None:
+        print("no C compiler; skipping native build", file=sys.stderr)
+        raise SystemExit(0)
+    print(build())

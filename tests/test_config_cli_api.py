@@ -355,3 +355,23 @@ def test_camelcase_manifest_with_resource_version_takes_k8s_parser():
     })
     assert lws.spec.replicas == 3
     assert lws.spec.leader_worker_template.size == 4
+
+
+def test_events_endpoint_exposes_controller_trace():
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.run_until_stable()
+    server = ApiServer(cp, port=0)
+    server.start()
+    try:
+        from lws_tpu.client import RemoteClient
+
+        client = RemoteClient(f"http://127.0.0.1:{server.port}")
+        events = client.events()
+        assert events, "reconcile should have recorded events"
+        assert {"object", "type", "reason", "message", "timestamp"} <= set(events[0])
+        named = client.events(name="sample")
+        assert named and all(e["object"].endswith("/sample") for e in named)
+        assert client.events(namespace="nope") == []
+    finally:
+        server.stop()
