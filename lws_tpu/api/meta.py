@@ -60,7 +60,9 @@ class TypedObject:
         return (self.kind, self.meta.namespace, self.meta.name)
 
     def deepcopy(self):
-        return copy.deepcopy(self)
+        from lws_tpu.core.store import clone_object
+
+        return clone_object(self)
 
     def set_condition(self, cond: Condition, conditions: list[Condition]) -> bool:
         """Upsert by type; returns True if anything changed. Transition time

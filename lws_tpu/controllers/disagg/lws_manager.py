@@ -7,14 +7,13 @@ role services), scales via spec patch, and snapshots initial-replicas.
 
 from __future__ import annotations
 
-import copy
 from typing import Optional
 
 from lws_tpu.api import disagg
 from lws_tpu.api.disagg import DisaggregatedRoleSpec, DisaggregatedSet
 from lws_tpu.api.types import LeaderWorkerSet
 from lws_tpu.controllers.disagg import utils as dsutils
-from lws_tpu.core.store import Store, new_meta
+from lws_tpu.core.store import clone_object, Store, new_meta
 
 
 class LWSManager:
@@ -48,7 +47,7 @@ class LWSManager:
         replicas: int,
     ) -> LeaderWorkerSet:
         labels = dsutils.generate_labels(ds.meta.name, slice_idx, role, revision)
-        spec = copy.deepcopy(config.template.spec)
+        spec = clone_object(config.template.spec)
         spec.replicas = replicas
         # Pods inherit the DS identity through their templates
         # (≈ lws_manager.go:59-107 label injection).

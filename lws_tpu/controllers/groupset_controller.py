@@ -16,7 +16,7 @@ from lws_tpu.utils.common import stable_hash
 from lws_tpu.api.pvc import PersistentVolumeClaim, PVCSpec
 from lws_tpu.core.events import EventRecorder
 from lws_tpu.core.manager import Result
-from lws_tpu.core.store import Key, Store, new_meta
+from lws_tpu.core.store import clone_object, Key, Store, new_meta
 
 
 def template_hash(template: PodTemplateSpec) -> str:
@@ -122,13 +122,12 @@ class GroupSetReconciler:
 
     # ------------------------------------------------------------------
     def _create_pod(self, gs: GroupSet, ordinal: int, update_revision: str) -> Pod:
-        import copy
 
         name = gs.pod_name(ordinal)
         labels = dict(gs.spec.template.metadata.labels)
         labels[contract.GROUPSET_POD_REVISION_LABEL_KEY] = update_revision
         annotations = dict(gs.spec.template.metadata.annotations)
-        spec: PodSpec = copy.deepcopy(gs.spec.template.spec)
+        spec: PodSpec = clone_object(gs.spec.template.spec)
         if gs.spec.service_name:
             spec.subdomain = gs.spec.service_name
         pod = Pod(

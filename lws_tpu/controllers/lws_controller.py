@@ -7,7 +7,6 @@ headless service -> status/conditions -> truncate revisions when done.
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass
 from typing import Optional
 
@@ -28,7 +27,7 @@ from lws_tpu.api.types import (
 )
 from lws_tpu.core.events import EventRecorder
 from lws_tpu.core.manager import Result
-from lws_tpu.core.store import Key, Store, new_meta
+from lws_tpu.core.store import clone_object, Key, Store, new_meta
 from lws_tpu.utils import revision as revisionutils
 from lws_tpu.utils.common import nonzero, sort_by_index
 from lws_tpu.utils.podutils import pod_running_and_ready
@@ -204,7 +203,7 @@ class LWSReconciler:
             lws.spec.leader_worker_template.leader_template
             or lws.spec.leader_worker_template.worker_template
         )
-        template: PodTemplateSpec = copy.deepcopy(tmpl_src)
+        template: PodTemplateSpec = clone_object(tmpl_src)
         template.metadata.labels.update(
             {
                 contract.WORKER_INDEX_LABEL_KEY: "0",
@@ -250,7 +249,7 @@ class LWSReconciler:
             template=template,
             service_name=lws.meta.name,
             update_strategy=GroupSetUpdateStrategy(partition=partition, max_unavailable=gs_max_unavailable),
-            volume_claim_templates=copy.deepcopy(lws.spec.leader_worker_template.volume_claim_templates),
+            volume_claim_templates=clone_object(lws.spec.leader_worker_template.volume_claim_templates),
             pvc_retention_policy_when_deleted=lws.spec.leader_worker_template.pvc_retention_policy_when_deleted,
             pvc_retention_policy_when_scaled=lws.spec.leader_worker_template.pvc_retention_policy_when_scaled,
         )

@@ -12,7 +12,6 @@ make unbounded restart storms expensive).
 
 from __future__ import annotations
 
-import copy
 import json
 from typing import Optional
 
@@ -28,7 +27,7 @@ from lws_tpu.api.service import Service, ServiceSpec
 from lws_tpu.api.types import LeaderWorkerSet, RestartPolicy, StartupPolicy, SubdomainPolicy
 from lws_tpu.core.events import EventRecorder
 from lws_tpu.core.manager import Result
-from lws_tpu.core.store import Key, Store, new_meta
+from lws_tpu.core.store import clone_object, Key, Store, new_meta
 from lws_tpu.sched.provider import SchedulerProvider
 from lws_tpu.utils import revision as revisionutils
 from lws_tpu.utils.podutils import container_restarted, is_leader_pod, pod_running_and_ready
@@ -199,7 +198,7 @@ class PodReconciler:
     # ---- worker groupset construction (ref :386-458) --------------------
     def _construct_worker_groupset(self, leader_pod: Pod, lws: LeaderWorkerSet, revision) -> GroupSet:
         current_lws = revisionutils.apply_revision(lws, revision)
-        template = copy.deepcopy(current_lws.spec.leader_worker_template.worker_template)
+        template = clone_object(current_lws.spec.leader_worker_template.worker_template)
 
         group_index = leader_pod.meta.labels.get(contract.GROUP_INDEX_LABEL_KEY, "")
         group_key = leader_pod.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY, "")
@@ -250,7 +249,7 @@ class PodReconciler:
                 template=template,
                 service_name=service_name,
                 update_strategy=GroupSetUpdateStrategy(),
-                volume_claim_templates=copy.deepcopy(
+                volume_claim_templates=clone_object(
                     current_lws.spec.leader_worker_template.volume_claim_templates
                 ),
                 pvc_retention_policy_when_deleted=current_lws.spec.leader_worker_template.pvc_retention_policy_when_deleted,

@@ -12,6 +12,7 @@ deployments.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from dataclasses import dataclass, field
@@ -40,7 +41,7 @@ class _Registration:
     reconciler: Reconciler
     # kind -> mapping fn from event object to primary keys to enqueue.
     watches: dict[str, MapFn]
-    queue: list[Key] = field(default_factory=list)
+    queue: "collections.deque[Key]" = field(default_factory=lambda: collections.deque())
     queued: set[Key] = field(default_factory=set)
     lock: threading.Lock = field(default_factory=threading.Lock)
 
@@ -54,7 +55,7 @@ class _Registration:
         with self.lock:
             if not self.queue:
                 return None
-            key = self.queue.pop(0)
+            key = self.queue.popleft()
             self.queued.discard(key)
             return key
 

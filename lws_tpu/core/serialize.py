@@ -118,7 +118,7 @@ def restore_store(store, snapshot: dict) -> int:
                 if kind == "ControllerRevision" and "data" in plain:
                     plain["data"] = _revision_data_from_plain(plain["data"])
                 obj = from_plain(cls, plain)
-                store._objects[obj.key()] = obj
+                store._restore_object(obj)
                 max_rv = max(max_rv, obj.meta.resource_version)
                 count += 1
         # Resume the version counter past everything restored.

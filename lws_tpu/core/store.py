@@ -86,6 +86,12 @@ if not os.environ.get("LWS_TPU_PURE_PY"):
         pass
 
 
+def clone_object(x):
+    """Public fast deep-clone for API object trees (controllers cloning
+    templates etc. — same engine as the Store's isolation boundary)."""
+    return _clone(x)
+
+
 @dataclass
 class WatchEvent:
     type: str  # "ADDED" | "MODIFIED" | "DELETED"
@@ -98,6 +104,16 @@ Key = tuple[str, str, str]  # (kind, namespace, name)
 class Store:
     def __init__(self) -> None:
         self._objects: dict[Key, TypedObject] = {}
+        # Per-kind index: list() is the hottest store op (every reconcile
+        # scans peers); iterating only the kind's bucket beats a full scan.
+        self._by_kind: dict[str, dict[Key, TypedObject]] = {}
+        # Label index: (kind, label_key, label_value) -> keys. Controllers
+        # list by owner labels constantly (pods of an LWS, role members of a
+        # DS); without this every such list is a full scan of the kind.
+        self._label_index: dict[tuple[str, str, str], set[Key]] = {}
+        # Per-kind mutation counter: lets read-heavy consumers (scheduler)
+        # cache derived views and invalidate them precisely.
+        self._kind_version: dict[str, int] = {}
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
         self._watchers: list[Callable[[WatchEvent], None]] = []
@@ -111,6 +127,36 @@ class Store:
 
     def register_validator(self, kind: str, fn) -> None:
         self._validators.setdefault(kind, []).append(fn)
+
+    def _restore_object(self, obj: TypedObject) -> None:
+        """Snapshot restore: place an already-admitted object verbatim
+        (no admission, no events), maintaining all indexes."""
+        key = obj.key()
+        self._objects[key] = obj
+        self._by_kind.setdefault(key[0], {})[key] = obj
+        self._index_labels(key, obj)
+        self._bump_kind(key[0])  # invalidate kind_version-keyed caches
+
+    def kind_version(self, kind: str) -> int:
+        """Monotonic counter bumped on every create/update/delete of `kind`
+        (cache-invalidation token for derived views)."""
+        with self._lock:
+            return self._kind_version.get(kind, 0)
+
+    def _bump_kind(self, kind: str) -> None:
+        self._kind_version[kind] = self._kind_version.get(kind, 0) + 1
+
+    def _index_labels(self, key: Key, obj: TypedObject) -> None:
+        for lk, lv in obj.meta.labels.items():
+            self._label_index.setdefault((key[0], lk, lv), set()).add(key)
+
+    def _unindex_labels(self, key: Key, obj: TypedObject) -> None:
+        for lk, lv in obj.meta.labels.items():
+            bucket = self._label_index.get((key[0], lk, lv))
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._label_index[(key[0], lk, lv)]
 
     def watch(self, fn: Callable[[WatchEvent], None]) -> Callable[[], None]:
         """Subscribe to all mutations; returns an unsubscribe handle."""
@@ -146,14 +192,28 @@ class Store:
     ) -> list[TypedObject]:
         with self._lock:
             out = []
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
-                    continue
-                if namespace is not None and ns != namespace:
-                    continue
-                if labels and any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
-                    continue
-                out.append(_clone(obj))
+            if labels:
+                # Narrow by the smallest label bucket, then verify the rest.
+                buckets = [
+                    self._label_index.get((kind, lk, lv), set())
+                    for lk, lv in labels.items()
+                ]
+                candidates = min(buckets, key=len)
+                objects = self._objects
+                for key in candidates:
+                    obj = objects.get(key)
+                    if obj is None:
+                        continue
+                    if namespace is not None and key[1] != namespace:
+                        continue
+                    if any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
+                        continue
+                    out.append(_clone(obj))
+            else:
+                for (_, ns, _), obj in self._by_kind.get(kind, {}).items():
+                    if namespace is not None and ns != namespace:
+                        continue
+                    out.append(_clone(obj))
             out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
             return out
 
@@ -170,6 +230,9 @@ class Store:
             obj.meta.generation = 1
             obj.meta.creation_timestamp = time.time()
             self._objects[key] = obj
+            self._by_kind.setdefault(key[0], {})[key] = obj
+            self._index_labels(key, obj)
+            self._bump_kind(key[0])
             stored = _clone(obj)
         self._notify(WatchEvent("ADDED", _clone(stored)))
         return stored
@@ -209,7 +272,11 @@ class Store:
                 if self._spec_changed(current, obj):
                     obj.meta.generation += 1
             obj.meta.resource_version = next(self._rv)
+            self._unindex_labels(key, current)
             self._objects[key] = obj
+            self._by_kind.setdefault(key[0], {})[key] = obj
+            self._index_labels(key, obj)
+            self._bump_kind(key[0])
             stored = _clone(obj)
         self._notify(WatchEvent("MODIFIED", _clone(stored)))
         return stored
@@ -226,6 +293,10 @@ class Store:
 
     def _delete_locked(self, key: Key, events: list[WatchEvent]) -> None:
         obj = self._objects.pop(key, None)
+        self._by_kind.get(key[0], {}).pop(key, None)
+        if obj is not None:
+            self._unindex_labels(key, obj)
+            self._bump_kind(key[0])
         if obj is None:
             return
         # Cascade: anything whose controller owner is this object.

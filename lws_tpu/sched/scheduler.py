@@ -139,12 +139,21 @@ class Scheduler:
 
     # ---- feasibility -------------------------------------------------------
     def _nodes(self) -> list[Node]:
-        # Nodes are cluster-scoped hardware (api.node.CLUSTER_NAMESPACE).
-        return [
+        # Nodes are cluster-scoped hardware (api.node.CLUSTER_NAMESPACE);
+        # the fleet changes rarely next to pod churn, so the view is cached
+        # on the store's Node mutation counter (scheduling is O(pods) calls
+        # deep and re-listing per call dominated turnup profiles).
+        version = self.store.kind_version("Node")
+        cached = getattr(self, "_node_cache", None)
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        nodes = [
             n
             for n in self.store.list("Node")
             if isinstance(n, Node) and n.status.ready and not n.spec.unschedulable
         ]
+        self._node_cache = (version, nodes)
+        return nodes
 
     def _bound_pods(self, namespace: str) -> list[Pod]:
         return [p for p in self.store.list("Pod", namespace) if p.spec.node_name]

@@ -9,22 +9,21 @@ live spec (no mixed groups mid-rollout, ref revision_utils.go:168-184).
 
 from __future__ import annotations
 
-import copy
 from typing import Optional
 
 from lws_tpu.api import contract
 from lws_tpu.api.meta import to_plain
 from lws_tpu.api.revision import ControllerRevision
 from lws_tpu.api.types import LeaderWorkerSet
-from lws_tpu.core.store import Store, new_meta
+from lws_tpu.core.store import clone_object, Store, new_meta
 from lws_tpu.utils.common import stable_hash
 
 
 def revision_data(lws: LeaderWorkerSet) -> dict:
     """The revisable subset (≈ getPatch, revision_utils.go:265-297)."""
     return {
-        "leader_worker_template": copy.deepcopy(lws.spec.leader_worker_template),
-        "network_config": copy.deepcopy(lws.spec.network_config),
+        "leader_worker_template": clone_object(lws.spec.leader_worker_template),
+        "network_config": clone_object(lws.spec.network_config),
     }
 
 
@@ -93,9 +92,9 @@ def get_or_create_current_revision(store: Store, lws: LeaderWorkerSet) -> Contro
 def apply_revision(lws: LeaderWorkerSet, rev: ControllerRevision) -> LeaderWorkerSet:
     """Restore the revisable fields from a snapshot (≈ ApplyRevision,
     revision_utils.go:168-184)."""
-    restored = copy.deepcopy(lws)
-    restored.spec.leader_worker_template = copy.deepcopy(rev.data["leader_worker_template"])
-    restored.spec.network_config = copy.deepcopy(rev.data["network_config"])
+    restored = clone_object(lws)
+    restored.spec.leader_worker_template = clone_object(rev.data["leader_worker_template"])
+    restored.spec.network_config = clone_object(rev.data["network_config"])
     return restored
 
 

@@ -66,3 +66,22 @@ def test_restart_resumes_rolling_update(tmp_path):
     lws = cp2.store.get("LeaderWorkerSet", "default", "sample")
     assert lws.status.updated_replicas == 3
     assert len(cp2.store.list("ControllerRevision")) == 1
+
+
+def test_restore_invalidates_kind_version_caches():
+    """Snapshot restore must bump kind_version: version-keyed caches (e.g.
+    the scheduler node view) would otherwise serve pre-restore state."""
+    from lws_tpu.core.serialize import restore_store, snapshot_store
+    from lws_tpu.core.store import Store
+    from lws_tpu.sched import make_slice_nodes
+
+    src = Store()
+    for n in make_slice_nodes("s", topology="2x4"):
+        src.create(n)
+    snap = snapshot_store(src)
+
+    dst = Store()
+    v0 = dst.kind_version("Node")
+    restore_store(dst, snap)
+    assert dst.kind_version("Node") > v0
+    assert len(dst.list("Node")) == 2
