@@ -523,6 +523,29 @@ def forward_with_cache(
     return logits, _dc.replace(cache, pos=pos + S)
 
 
+def forward_prefill_chunk(
+    params: dict, tokens: jax.Array, cache: KVCache, cfg: LlamaConfig
+) -> tuple[jax.Array, KVCache]:
+    """One chunk of chunked prefill: append `tokens` [B,C] at cache.pos and
+    return the FULL normalized hidden states [B,C,d] (not just last-token
+    logits) so the caller can gather the true last prompt position out of a
+    padded final chunk. Peak attention memory is O(C * T) instead of the
+    O(S^2) of whole-prompt prefill — the long-context serving memory bound
+    (vLLM-style chunked prefill; the reference defers this to workloads)."""
+    B, S = tokens.shape
+    pos = cache.pos
+    positions = pos + jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x, cache = _cached_layer_loop(
+        x, cache, params, cfg,
+        lambda x, layer_idx, lp, cache: _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    import dataclasses as _dc
+
+    return x, _dc.replace(cache, pos=pos + S)
+
+
 def forward_prefill(
     params: dict, tokens: jax.Array, cache: KVCache, cfg: LlamaConfig, last_pos=None
 ) -> tuple[jax.Array, KVCache]:

@@ -119,6 +119,24 @@ class Engine:
             )
             return token, cache, toks.swapaxes(0, 1)  # [B, n]
 
+        @partial(jax.jit, donate_argnums=(2,))
+        def _prefill_chunk(params, tokens, cache):
+            # Chunked prefill step: compiled ONCE for the chunk shape and
+            # reused across chunks and requests.
+            from lws_tpu.models.llama import forward_prefill_chunk
+
+            return forward_prefill_chunk(params, tokens, cache, cfg_static)
+
+        @partial(jax.jit, donate_argnums=(1,), static_argnums=(3,))
+        def _finish_chunked(params, cache, hidden, last_off, key):
+            import dataclasses as _dc
+
+            h = hidden[:, last_off]
+            logits = (h @ params["lm_head"].astype(h.dtype)).astype(jnp.float32)
+            return sample_logits(logits, key, sampling_static), cache
+
+        self._prefill_chunk = _prefill_chunk
+        self._finish_chunked = _finish_chunked
         self._prefill = _prefill
         self._decode = _decode
         self._decode_n = _decode_n
@@ -139,6 +157,41 @@ class Engine:
     def prefill(self, tokens: jax.Array) -> tuple[jax.Array, KVCache]:
         """tokens [B, S] -> (first generated token [B], cache)."""
         return self._prefill(self.params, tokens, self.new_cache(), self._next_key())
+
+    def prefill_chunked(
+        self, tokens: jax.Array, chunk_size: int = 256
+    ) -> tuple[jax.Array, KVCache]:
+        """Long-context prefill: process the prompt in fixed-size chunks so
+        peak attention memory is O(chunk * cache) instead of O(S^2), with one
+        compile for the chunk shape. Semantically identical to prefill():
+        same first token (greedy), same cache contents up to the prompt
+        length. The final (padded) chunk's KV beyond the true prompt length
+        is masked out of the first decode step and overwritten by subsequent
+        ones, so padding never leaks into attention."""
+        import dataclasses as _dc
+
+        B, S = tokens.shape
+        if S <= chunk_size:
+            return self.prefill(tokens)
+        pad = (-S) % chunk_size
+        padded = jnp.pad(tokens, ((0, 0), (0, pad)))
+        if S + pad > self.max_len:
+            raise ValueError(
+                f"padded prompt {S + pad} exceeds max_len {self.max_len}; "
+                f"use a chunk_size dividing max_len or a shorter prompt"
+            )
+        cache = self.new_cache()
+        hidden = None
+        for i in range(0, S + pad, chunk_size):
+            hidden, cache = self._prefill_chunk(
+                self.params, padded[:, i : i + chunk_size], cache
+            )
+        token, cache = self._finish_chunked(
+            self.params, cache, hidden, (S - 1) % chunk_size, self._next_key()
+        )
+        # Rewind pos past the padding: decode appends at the true length,
+        # masking out (then overwriting) the padded tail's K/V.
+        return token, _dc.replace(cache, pos=jnp.asarray(S, cache.pos.dtype))
 
     def decode(self, tokens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
         """tokens [B] -> (next token [B], cache)."""
