@@ -201,6 +201,13 @@ class Informer:
         meta = manifest.get("metadata", {})
         return (manifest["kind"], meta.get("namespace", "default"), meta["name"])
 
+    @staticmethod
+    def _rv(manifest: dict) -> int:
+        try:
+            return int(manifest.get("metadata", {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            return 0
+
     def relist(self) -> None:
         # Bookmark FIRST, list second: events racing the relist are replayed
         # onto the fresh cache (replay is idempotent), never lost.
@@ -229,6 +236,12 @@ class Informer:
                 if ev["type"] == "DELETED":
                     self.cache.pop(key, None)
                 else:
+                    # Per-object staleness guard: a relist racing the watch
+                    # stream can land a newer version in the cache before an
+                    # older queued event is applied; never move backwards.
+                    cached = self.cache.get(key)
+                    if cached is not None and self._rv(cached) > self._rv(manifest):
+                        continue
                     self.cache[key] = manifest
                 applied += 1
                 if self.on_event:

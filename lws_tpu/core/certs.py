@@ -18,6 +18,7 @@ admission path is in-process, so the TLS surface is the API server itself:
 from __future__ import annotations
 
 import datetime
+import os
 import ssl
 from dataclasses import dataclass
 from pathlib import Path
@@ -138,14 +139,24 @@ class CertManager:
             ca_cert.public_bytes(serialization.Encoding.PEM)
         )
         self.paths.server_cert.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
-        self.paths.server_key.write_bytes(
-            key.private_bytes(
-                serialization.Encoding.PEM,
-                serialization.PrivateFormat.PKCS8,
-                serialization.NoEncryption(),
-            )
+        # 0600 from creation: a create-then-chmod sequence leaves a window
+        # where the private key is readable under a permissive umask.
+        key_pem = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
         )
-        self.paths.server_key.chmod(0o600)
+        fd = os.open(
+            self.paths.server_key, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600
+        )
+        try:
+            # O_CREAT's mode only applies to brand-new files; when rotating
+            # over an existing (possibly permissive) key file, tighten BEFORE
+            # the new key bytes land.
+            os.fchmod(fd, 0o600)
+            os.write(fd, key_pem)
+        finally:
+            os.close(fd)
 
 
 def client_context(ca_cert_path: Optional[str]) -> ssl.SSLContext:

@@ -13,6 +13,8 @@ deployments.
 from __future__ import annotations
 
 import collections
+import heapq
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -23,8 +25,8 @@ from lws_tpu.core.store import ConflictError, Key, Store, WatchEvent
 
 @dataclass
 class Result:
-    requeue: bool = False
-    requeue_after: float = 0.0
+    requeue: bool = False  # re-run immediately
+    requeue_after: float = 0.0  # re-run after N seconds (ignored if requeue)
 
 
 class Reconciler(Protocol):
@@ -44,6 +46,10 @@ class _Registration:
     queue: "collections.deque[Key]" = field(default_factory=lambda: collections.deque())
     queued: set[Key] = field(default_factory=set)
     lock: threading.Lock = field(default_factory=threading.Lock)
+    # Delayed requeues (Result.requeue_after): min-heap of (due, seq, key),
+    # promoted into the live queue once due (controller-runtime RequeueAfter).
+    delayed: list[tuple[float, int, Key]] = field(default_factory=list)
+    _seq: "itertools.count" = field(default_factory=lambda: itertools.count())
 
     def enqueue(self, key: Key) -> None:
         with self.lock:
@@ -51,8 +57,27 @@ class _Registration:
                 self.queued.add(key)
                 self.queue.append(key)
 
+    def enqueue_after(self, key: Key, delay: float) -> None:
+        with self.lock:
+            heapq.heappush(self.delayed, (time.monotonic() + delay, next(self._seq), key))
+
+    def _promote_due(self, now: float) -> None:
+        # Caller holds self.lock.
+        while self.delayed and self.delayed[0][0] <= now:
+            _, _, key = heapq.heappop(self.delayed)
+            if key not in self.queued:
+                self.queued.add(key)
+                self.queue.append(key)
+
+    def flush_delays(self) -> None:
+        """Promote ALL pending delayed requeues now (deterministic tests —
+        'time passed' without sleeping)."""
+        with self.lock:
+            self._promote_due(float("inf"))
+
     def pop(self) -> Optional[Key]:
         with self.lock:
+            self._promote_due(time.monotonic())
             if not self.queue:
                 return None
             key = self.queue.popleft()
@@ -61,6 +86,7 @@ class _Registration:
 
     def empty(self) -> bool:
         with self.lock:
+            self._promote_due(time.monotonic())
             return not self.queue
 
 
@@ -98,6 +124,12 @@ class Manager:
     def register(self, reconciler: Reconciler, watches: dict[str, MapFn]) -> None:
         self._registrations.append(_Registration(reconciler, watches))
 
+    def flush_delays(self) -> None:
+        """Promote every pending Result.requeue_after timer to runnable now
+        (deterministic mode's substitute for waiting on the wall clock)."""
+        for reg in self._registrations:
+            reg.flush_delays()
+
     # ---- event fan-out -----------------------------------------------------
     def _on_event(self, event: WatchEvent) -> None:
         for reg in self._registrations:
@@ -133,6 +165,8 @@ class Manager:
                     continue
                 if result and result.requeue:
                     reg.enqueue(key)
+                elif result and result.requeue_after > 0:
+                    reg.enqueue_after(key, result.requeue_after)
             if not progressed:
                 return processed
         raise RuntimeError(
@@ -165,6 +199,8 @@ class Manager:
                     continue
                 if result and result.requeue:
                     reg.enqueue(key)
+                elif result and result.requeue_after > 0:
+                    reg.enqueue_after(key, result.requeue_after)
 
         for reg in self._registrations:
             t = threading.Thread(target=worker, args=(reg,), daemon=True)

@@ -11,6 +11,7 @@ rollout mid-flight exactly like the reference (SURVEY §5 checkpoint/resume).
 
 from __future__ import annotations
 
+import collections
 import copy
 import enum
 import itertools
@@ -117,6 +118,18 @@ class Store:
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
         self._watchers: list[Callable[[WatchEvent], None]] = []
+        # Watch delivery: events are enqueued in commit order while the store
+        # lock is held and drained outside it under a dispatch lock, so
+        # concurrent writers can never deliver events out of commit order
+        # (the apiserver/client-go per-object resourceVersion guarantee).
+        # Nested writes — from admission hooks (which run under _lock) or from
+        # watchers (which run under _dispatch_lock) — only enqueue; the
+        # outermost write or drain delivers everything FIFO. This both keeps
+        # delivery order equal to commit order for every watcher and avoids
+        # lock-order inversion (_lock held while waiting on _dispatch_lock).
+        self._pending_events: collections.deque[WatchEvent] = collections.deque()
+        self._dispatch_lock = threading.Lock()
+        self._tls = threading.local()  # .write_depth, .draining
         # kind -> list of hooks, run inside create/update before storing.
         self._mutators: dict[str, list[Callable[[TypedObject, Optional[TypedObject]], None]]] = {}
         self._validators: dict[str, list[Callable[[TypedObject, Optional[TypedObject]], None]]] = {}
@@ -218,23 +231,34 @@ class Store:
             return out
 
     # ---- writes ------------------------------------------------------------
+    def _begin_write(self) -> None:
+        self._tls.write_depth = getattr(self._tls, "write_depth", 0) + 1
+
+    def _end_write(self) -> None:
+        self._tls.write_depth -= 1
+
     def create(self, obj: TypedObject) -> TypedObject:
         obj = _clone(obj)
-        with self._lock:
-            key = obj.key()
-            if key in self._objects:
-                raise AlreadyExistsError(f"{key} already exists")
-            self._admit(obj, None)
-            obj.meta.uid = obj.meta.uid or uuid.uuid4().hex[:12]
-            obj.meta.resource_version = next(self._rv)
-            obj.meta.generation = 1
-            obj.meta.creation_timestamp = time.time()
-            self._objects[key] = obj
-            self._by_kind.setdefault(key[0], {})[key] = obj
-            self._index_labels(key, obj)
-            self._bump_kind(key[0])
-            stored = _clone(obj)
-        self._notify(WatchEvent("ADDED", _clone(stored)))
+        self._begin_write()
+        try:
+            with self._lock:
+                key = obj.key()
+                if key in self._objects:
+                    raise AlreadyExistsError(f"{key} already exists")
+                self._admit(obj, None)
+                obj.meta.uid = obj.meta.uid or uuid.uuid4().hex[:12]
+                obj.meta.resource_version = next(self._rv)
+                obj.meta.generation = 1
+                obj.meta.creation_timestamp = time.time()
+                self._objects[key] = obj
+                self._by_kind.setdefault(key[0], {})[key] = obj
+                self._index_labels(key, obj)
+                self._bump_kind(key[0])
+                stored = _clone(obj)
+                self._pending_events.append(WatchEvent("ADDED", _clone(stored)))
+        finally:
+            self._end_write()
+        self._drain_events()
         return stored
 
     def update(self, obj: TypedObject) -> TypedObject:
@@ -248,6 +272,15 @@ class Store:
 
     def _update(self, obj: TypedObject, status_only: bool) -> TypedObject:
         obj = _clone(obj)
+        self._begin_write()
+        try:
+            stored = self._update_locked(obj, status_only)
+        finally:
+            self._end_write()
+        self._drain_events()
+        return stored
+
+    def _update_locked(self, obj: TypedObject, status_only: bool) -> TypedObject:
         with self._lock:
             key = obj.key()
             current = self._objects.get(key)
@@ -278,7 +311,7 @@ class Store:
             self._index_labels(key, obj)
             self._bump_kind(key[0])
             stored = _clone(obj)
-        self._notify(WatchEvent("MODIFIED", _clone(stored)))
+            self._pending_events.append(WatchEvent("MODIFIED", _clone(stored)))
         return stored
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -286,10 +319,14 @@ class Store:
         foreground-propagation the reference leans on for group teardown,
         ref pkg/controllers/pod_controller.go:258-263)."""
         events: list[WatchEvent] = []
-        with self._lock:
-            self._delete_locked((kind, namespace, name), events)
-        for ev in events:
-            self._notify(ev)
+        self._begin_write()
+        try:
+            with self._lock:
+                self._delete_locked((kind, namespace, name), events)
+                self._pending_events.extend(events)
+        finally:
+            self._end_write()
+        self._drain_events()
 
     def _delete_locked(self, key: Key, events: list[WatchEvent]) -> None:
         obj = self._objects.pop(key, None)
@@ -328,9 +365,31 @@ class Store:
         for fn in self._validators.get(obj.kind, []):
             fn(obj, old)
 
-    def _notify(self, event: WatchEvent) -> None:
-        for fn in list(self._watchers):
-            fn(event)
+    def _drain_events(self) -> None:
+        """Deliver queued watch events in commit order. Whichever thread gets
+        the dispatch lock drains everything pending (possibly including events
+        committed by other threads — they will find an empty queue and
+        return), so delivery order always equals commit order.
+
+        Nested calls — a write issued from inside an admission hook (store
+        lock held) or from inside a watcher (dispatch lock held) — return
+        immediately: their events are already queued and the outermost
+        drain/write delivers them after the current event finishes, so every
+        watcher sees the triggering event before its consequences."""
+        if getattr(self._tls, "write_depth", 0) > 0 or getattr(self._tls, "draining", False):
+            return
+        self._tls.draining = True
+        try:
+            while True:
+                with self._dispatch_lock:
+                    with self._lock:
+                        if not self._pending_events:
+                            return
+                        event = self._pending_events.popleft()
+                    for fn in list(self._watchers):
+                        fn(event)
+        finally:
+            self._tls.draining = False
 
     # ---- convenience -------------------------------------------------------
     def owned_by(self, kind: str, namespace: str, owner_uid: str) -> list[TypedObject]:

@@ -23,7 +23,12 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from lws_tpu.core.store import AdmissionError, ConflictError, NotFoundError
+from lws_tpu.core.store import (
+    AdmissionError,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
 from lws_tpu.manifest import from_manifest, to_manifest
 
 
@@ -54,16 +59,18 @@ def _kind(raw: str) -> str:
     return kind
 
 
-def _retry_conflicts(attempt_fn, what: str) -> None:
+def _retry_conflicts(attempt_fn, what: str):
     """Run a read-modify-update attempt up to 5 times across optimistic-
-    concurrency races with background controllers."""
-    for _ in range(5):
+    concurrency races with background controllers; returns the attempt's
+    result. Persistent losers surface as ConflictError → HTTP 409."""
+    for _ in range(4):
         try:
-            attempt_fn()
-            return
-        except ConflictError:
+            return attempt_fn()
+        except (ConflictError, AlreadyExistsError):
+            # AlreadyExists: a create lost a create-vs-create race; the next
+            # attempt re-reads and takes the update path.
             continue
-    raise ValueError(f"{what} lost repeated update races; retry")
+    return attempt_fn()  # last try: let the conflict propagate to the 409 path
 
 
 def _set_cordon(store, node_name: str, unschedulable: bool) -> None:
@@ -243,25 +250,34 @@ class ApiServer:
                             if not doc:
                                 continue
                             obj = from_manifest(doc)
-                            existing = cp.store.try_get(
-                                obj.kind, obj.meta.namespace, obj.meta.name
-                            )
-                            if existing is None:
-                                stored = cp.store.create(obj)
-                            else:
+
+                            def attempt(obj=obj):
+                                existing = cp.store.try_get(
+                                    obj.kind, obj.meta.namespace, obj.meta.name
+                                )
+                                if existing is None:
+                                    return cp.store.create(obj)
                                 obj.meta.resource_version = existing.meta.resource_version
                                 obj.meta.uid = existing.meta.uid
                                 # Spec-only apply: never wipe live status.
                                 if hasattr(existing, "status"):
                                     obj.status = existing.status
-                                stored = cp.store.update(obj)
+                                return cp.store.update(obj)
+
+                            stored = _retry_conflicts(
+                                attempt, f"apply of {obj.kind}/{obj.meta.name}"
+                            )
                             applied.append(f"{stored.kind}/{stored.meta.name}")
                         self._json(200, {"applied": applied})
                     elif len(parts) == 3 and parts[0] == "scale":
                         replicas = int(json.loads(body)["replicas"])
-                        lws = cp.store.get("LeaderWorkerSet", parts[1], parts[2])
-                        lws.spec.replicas = replicas
-                        cp.store.update(lws)
+
+                        def attempt():
+                            lws = cp.store.get("LeaderWorkerSet", parts[1], parts[2])
+                            lws.spec.replicas = replicas
+                            cp.store.update(lws)
+
+                        _retry_conflicts(attempt, f"scale of {parts[2]}")
                         self._json(200, {"scaled": parts[2], "replicas": replicas})
                     elif len(parts) == 2 and parts[0] == "cordon":
                         payload = json.loads(body) if body else {}
@@ -312,6 +328,8 @@ class ApiServer:
                         self._json(404, {"error": "unknown path"})
                 except (AdmissionError, ValueError) as e:
                     self._json(422, {"error": str(e)})
+                except (ConflictError, AlreadyExistsError) as e:
+                    self._json(409, {"error": str(e)})
                 except NotFoundError as e:
                     self._json(404, {"error": str(e)})
                 except (TypeError, KeyError, AttributeError) as e:

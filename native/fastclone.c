@@ -17,6 +17,7 @@
 static PyObject *enum_type = NULL;     /* enum.Enum */
 static PyObject *fallback = NULL;      /* copy.deepcopy */
 static PyObject *str_dcfields = NULL;  /* "__dataclass_fields__" */
+static PyObject *str_dunder_dict = NULL; /* "__dict__" */
 
 /* Depth bound: API objects are shallow trees (<20 levels). A cyclic object
  * would otherwise exhaust the C stack and crash the interpreter; past the
@@ -100,7 +101,23 @@ clone_dataclass(PyObject *x, PyTypeObject *tp, int depth)
         Py_DECREF(new);
         return NULL;
     }
+#if PY_VERSION_HEX >= 0x030D0000
+    /* 3.13+: objects use inline-values/managed-dict layouts where
+     * _PyObject_GetDictPtr materializes a dict a raw slot write would leak,
+     * and raw writes bypass the managed-dict bookkeeping. The generic
+     * setter handles both layouts correctly. */
+    if (PyObject_SetAttr(new, str_dunder_dict, cloned) < 0) {
+        Py_DECREF(cloned);
+        Py_DECREF(new);
+        return NULL;
+    }
+    Py_DECREF(cloned);
+#else
+    /* tp_alloc'd instances normally start with a NULL dict slot, but be
+     * defensive: never overwrite a live dict without releasing it. */
+    Py_XDECREF(*newdictptr);
     *newdictptr = cloned; /* owns the new dict */
+#endif
     return new;
 }
 
@@ -182,7 +199,8 @@ PyMODINIT_FUNC
 PyInit__fastclone(void)
 {
     str_dcfields = PyUnicode_InternFromString("__dataclass_fields__");
-    if (str_dcfields == NULL)
+    str_dunder_dict = PyUnicode_InternFromString("__dict__");
+    if (str_dcfields == NULL || str_dunder_dict == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -210,3 +210,48 @@ def test_threaded_manager_mode():
         assert len(lws_pods(cp.store, "threaded")) == 4
     finally:
         cp.manager.stop()
+
+
+def test_requeue_after_is_honored():
+    """Result.requeue_after re-runs the reconciler after the delay (timer
+    heap), and flush_delays() promotes timers deterministically."""
+    import time as _time
+
+    from lws_tpu.core.manager import Manager, Result
+    from lws_tpu.core.store import Store, new_meta
+    from lws_tpu.api.pod import Pod
+
+    store = Store()
+    calls = []
+    delay = {"value": 0.02}
+
+    class Periodic:
+        name = "periodic"
+
+        def reconcile(self, key):
+            calls.append(key)
+            if delay["value"]:
+                return Result(requeue_after=delay["value"])
+            return None
+
+    mgr = Manager(store)
+    mgr.register(Periodic(), {"Pod": lambda o: [o.key()]})
+    store.create(Pod(meta=new_meta("p0")))
+    assert mgr.run_until_stable() == 1
+    assert len(calls) == 1
+
+    # Not yet due: stable without a second call.
+    assert mgr.run_until_stable() == 0
+
+    # After the delay elapses the key is promoted and re-reconciled.
+    _time.sleep(0.03)
+    delay["value"] = 60  # park the next timer far in the future
+    assert mgr.run_until_stable() == 1
+    assert len(calls) == 2
+
+    # flush_delays() promotes the far-future timer without waiting.
+    delay["value"] = 0
+    assert mgr.run_until_stable() == 0
+    mgr.flush_delays()
+    assert mgr.run_until_stable() == 1
+    assert len(calls) == 3

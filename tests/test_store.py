@@ -116,3 +116,107 @@ def test_missing_get():
     with pytest.raises(NotFoundError):
         store.get("Pod", "default", "nope")
     assert store.try_get("Pod", "default", "nope") is None
+
+
+def test_watch_events_delivered_in_commit_order_across_threads():
+    """Concurrent writers must never deliver watch events out of commit
+    order (the apiserver/client-go per-object resourceVersion guarantee):
+    events are enqueued under the store lock and drained FIFO."""
+    import threading
+
+    store = Store()
+    seen = []
+    seen_lock = threading.Lock()
+
+    def on_event(ev):
+        with seen_lock:
+            seen.append((ev.obj.meta.name, ev.obj.meta.resource_version))
+
+    store.watch(on_event)
+
+    names = [f"p{i}" for i in range(8)]
+    for n in names:
+        store.create(make_pod(n))
+
+    def writer(name):
+        for _ in range(50):
+            while True:
+                try:
+                    pod = store.get("Pod", "default", name)
+                    pod.meta.annotations["n"] = str(pod.meta.resource_version)
+                    store.update(pod)
+                    break
+                except ConflictError:
+                    continue
+
+    threads = [threading.Thread(target=writer, args=(n,)) for n in names]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # Per-object: resource_versions strictly increase in delivery order.
+    per_obj = {}
+    for name, rv in seen:
+        assert per_obj.get(name, 0) < rv, f"stale event for {name}: {rv}"
+        per_obj[name] = rv
+    # Globally: delivery order equals commit order (rv assignment order).
+    rvs = [rv for _, rv in seen]
+    assert rvs == sorted(rvs)
+
+
+def test_watcher_writing_to_store_keeps_order():
+    """A watcher that writes back into the store (re-entrant dispatch) must
+    still see FIFO delivery, not a deadlock."""
+    store = Store()
+    seen = []
+
+    def on_event(ev):
+        seen.append((ev.type, ev.obj.meta.name))
+        if ev.obj.meta.name == "trigger" and ev.type == "ADDED":
+            store.create(make_pod("cascade"))
+
+    store.watch(on_event)
+    store.create(make_pod("trigger"))
+    assert seen == [("ADDED", "trigger"), ("ADDED", "cascade")]
+
+
+def test_nested_event_reaches_all_watchers_after_trigger():
+    """A watcher that writes in reaction to an event must not cause LATER
+    watchers to see the consequence before the trigger: the nested write only
+    enqueues; the outer drain finishes delivering the trigger first."""
+    store = Store()
+    w1_seen, w2_seen = [], []
+
+    def w1(ev):
+        w1_seen.append(ev.obj.meta.name)
+        if ev.obj.meta.name == "trigger":
+            store.create(make_pod("cascade"))
+
+    def w2(ev):
+        w2_seen.append(ev.obj.meta.name)
+
+    store.watch(w1)
+    store.watch(w2)
+    store.create(make_pod("trigger"))
+    assert w1_seen == ["trigger", "cascade"]
+    assert w2_seen == ["trigger", "cascade"]
+
+
+def test_admission_hook_writing_to_store_does_not_deadlock():
+    """A mutator that writes a side object (nested write under the store
+    lock) must neither deadlock nor deliver events out of commit order."""
+    store = Store()
+    seen = []
+    store.watch(lambda ev: seen.append(ev.obj.meta.name))
+
+    def mutator(obj, old):
+        if old is None and obj.meta.name == "main":
+            store.create(make_pod("side"))
+
+    store.register_mutator("Pod", mutator)
+    store.create(make_pod("main"))
+    # The side object commits first (inside main's admission), so its event
+    # is first in commit order.
+    assert seen == ["side", "main"]
+    assert store.try_get("Pod", "default", "side") is not None
