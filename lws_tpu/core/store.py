@@ -258,7 +258,10 @@ class Store:
                 self._pending_events.append(WatchEvent("ADDED", _clone(stored)))
         finally:
             self._end_write()
-        self._drain_events()
+            # In the finally: if admission rejected THIS write but a nested
+            # hook already committed side objects, their events must still
+            # reach watchers — otherwise caches go permanently stale.
+            self._drain_events()
         return stored
 
     def update(self, obj: TypedObject) -> TypedObject:
@@ -277,7 +280,7 @@ class Store:
             stored = self._update_locked(obj, status_only)
         finally:
             self._end_write()
-        self._drain_events()
+            self._drain_events()  # see create(): drain even on rejection
         return stored
 
     def _update_locked(self, obj: TypedObject, status_only: bool) -> TypedObject:
@@ -326,7 +329,7 @@ class Store:
                 self._pending_events.extend(events)
         finally:
             self._end_write()
-        self._drain_events()
+            self._drain_events()  # see create(): drain even on rejection
 
     def _delete_locked(self, key: Key, events: list[WatchEvent]) -> None:
         obj = self._objects.pop(key, None)

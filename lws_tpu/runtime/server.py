@@ -70,7 +70,10 @@ def _retry_conflicts(attempt_fn, what: str):
             # AlreadyExists: a create lost a create-vs-create race; the next
             # attempt re-reads and takes the update path.
             continue
-    return attempt_fn()  # last try: let the conflict propagate to the 409 path
+    try:
+        return attempt_fn()  # last try: conflict propagates to the 409 path
+    except ConflictError as e:
+        raise ConflictError(f"{what}: {e}") from e
 
 
 def _set_cordon(store, node_name: str, unschedulable: bool) -> None:

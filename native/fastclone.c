@@ -107,9 +107,13 @@ clone_dataclass(PyObject *x, PyTypeObject *tp, int depth)
      * and raw writes bypass the managed-dict bookkeeping. The generic
      * setter handles both layouts correctly. */
     if (PyObject_SetAttr(new, str_dunder_dict, cloned) < 0) {
+        /* Frozen dataclasses override __setattr__ to reject all writes,
+         * including __dict__; match the pure-Python fallback instead of
+         * raising where _py_clone would succeed. */
+        PyErr_Clear();
         Py_DECREF(cloned);
         Py_DECREF(new);
-        return NULL;
+        return PyObject_CallFunctionObjArgs(fallback, x, NULL);
     }
     Py_DECREF(cloned);
 #else

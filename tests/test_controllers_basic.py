@@ -223,7 +223,7 @@ def test_requeue_after_is_honored():
 
     store = Store()
     calls = []
-    delay = {"value": 0.02}
+    delay = {"value": 60}  # far future: "not yet due" can't race wall clock
 
     class Periodic:
         name = "periodic"
@@ -240,18 +240,19 @@ def test_requeue_after_is_honored():
     assert mgr.run_until_stable() == 1
     assert len(calls) == 1
 
-    # Not yet due: stable without a second call.
+    # Not yet due (timer parked 60s out): stable without a second call.
     assert mgr.run_until_stable() == 0
 
-    # After the delay elapses the key is promoted and re-reconciled.
-    _time.sleep(0.03)
-    delay["value"] = 60  # park the next timer far in the future
+    # flush_delays() promotes the far-future timer without waiting.
+    delay["value"] = 0.01  # next requeue is a short, real wall-clock timer
+    mgr.flush_delays()
     assert mgr.run_until_stable() == 1
     assert len(calls) == 2
 
-    # flush_delays() promotes the far-future timer without waiting.
+    # A short timer is promoted by real elapsed time (sleep strictly longer
+    # than the delay — the due direction can't race the clock).
     delay["value"] = 0
-    assert mgr.run_until_stable() == 0
-    mgr.flush_delays()
+    _time.sleep(0.05)
     assert mgr.run_until_stable() == 1
     assert len(calls) == 3
+    assert mgr.run_until_stable() == 0
