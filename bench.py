@@ -140,9 +140,16 @@ def main() -> None:
     import jax.numpy as jnp
 
     from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.models.quant import quantize_params, quantized_bytes
     from lws_tpu.serving import Engine
 
     on_accelerator = jax.default_backend() != "cpu"
+    # Serving-density switch: int8 KV + int8 weights measured against an
+    # honest roofline of the ACTUAL bytes streamed (int8 values + f32
+    # scales). Off until the pallas decode kernel makes int8 a win on chip —
+    # plain XLA materializes dequantized copies and loses the bandwidth it
+    # saves (measured: 2633 tok/s @ B=32 int8 vs 2681 @ B=16 bf16).
+    int8_mode = os.environ.get("BENCH_INT8", "0") == "1"
     if on_accelerator:
         cfg = LlamaConfig(
             vocab_size=32000,
@@ -156,8 +163,10 @@ def main() -> None:
             param_dtype=jnp.bfloat16,
             remat=False,
             unroll_cached_layers=True,
+            kv_quant=int8_mode,
         )
-        batch, prompt_len, decode_steps, max_len = 16, 1024, 256, 2048
+        batch = 32 if int8_mode else 16
+        prompt_len, decode_steps, max_len = 1024, 256, 2048
     else:  # dev smoke (not the recorded benchmark)
         cfg = LlamaConfig(
             vocab_size=512, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
@@ -172,6 +181,9 @@ def main() -> None:
 
     params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
     jax.block_until_ready(params)
+    if int8_mode:
+        params = jax.jit(quantize_params)(params)  # int8 weights, per-channel scales
+        jax.block_until_ready(params)
 
     engine = Engine(cfg, params, batch_size=batch, max_len=max_len)
     prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size).astype(
@@ -209,13 +221,18 @@ def main() -> None:
     tok_per_s = batch / step_s
     result = engine.generate(prompt, max_new_tokens=8)  # for TTFT reporting
 
-    # Roofline: decode streams params + K and V cache lines each step.
-    bytes_per_param = jnp.dtype(cfg.param_dtype).itemsize
-    cache_bytes = (
-        2 * cfg.n_layers * batch * max_len * cfg.n_kv_heads * cfg.head_dim
-        * jnp.dtype(cfg.dtype).itemsize
+    # Roofline: decode streams params + K and V cache lines each step. Both
+    # are counted at their ACTUAL stored widths (int8 values + f32 scales),
+    # not nominal dtype — quantization raises the roofline, it doesn't get a
+    # free pass against the old denominator.
+    param_bytes = quantized_bytes(params)
+    cache_shapes = jax.eval_shape(engine.new_cache)  # no device allocation
+    cache_bytes = sum(
+        a.size * jnp.dtype(a.dtype).itemsize
+        for a in jax.tree.leaves(cache_shapes)
+        if a.ndim > 0  # exclude the scalar pos
     )
-    bytes_per_step = n_params * bytes_per_param + cache_bytes
+    bytes_per_step = param_bytes + cache_bytes
     gen = detect_generation()
     bw = HBM_BYTES_PER_S.get(gen, HBM_BYTES_PER_S["v5e"])
     roofline_tok_s = bw / bytes_per_step * batch
@@ -224,7 +241,7 @@ def main() -> None:
           f"decode={tok_per_s:.0f} tok/s (roofline {roofline_tok_s:.0f})", file=sys.stderr)
 
     record = {
-        "metric": f"llama-{n_params/1e9:.1f}B-bf16 greedy decode throughput, single chip ({gen})",
+        "metric": f"llama-{n_params/1e9:.1f}B-{'int8w-int8kv' if int8_mode else 'bf16'} greedy decode throughput, single chip ({gen})",
         "value": round(tok_per_s, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_per_s / roofline_tok_s, 4),

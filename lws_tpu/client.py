@@ -99,7 +99,14 @@ class RemoteClient:
         import urllib.error
         import urllib.request
 
-        req = urllib.request.Request(self.base_url + path, data=body, method=method)
+        from lws_tpu.version import user_agent
+
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=body,
+            method=method,
+            headers={"User-Agent": user_agent()},  # ref useragent.go:36
+        )
         try:
             with urllib.request.urlopen(req, context=self._context) as resp:
                 return _json.loads(resp.read().decode())

@@ -131,6 +131,20 @@ class Manager:
             reg.flush_delays()
 
     # ---- event fan-out -----------------------------------------------------
+    def resync(self, kinds: Optional[list[str]] = None) -> None:
+        """Enqueue every stored object of `kinds` (default: every kind any
+        registration watches) to its watching controllers — the level-triggered
+        cold-start resync after standing up a manager over existing state
+        (≈ controller-runtime's initial cache List+sync)."""
+        if kinds is None:
+            seen: set[str] = set()
+            for reg in self._registrations:
+                seen.update(reg.watches)
+            kinds = sorted(seen)
+        for kind in kinds:
+            for obj in self.store.list(kind):
+                self._on_event(WatchEvent("MODIFIED", obj))
+
     def _on_event(self, event: WatchEvent) -> None:
         for reg in self._registrations:
             fn = reg.watches.get(event.obj.kind)

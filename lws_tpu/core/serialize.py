@@ -134,9 +134,34 @@ def save_store(store, path: str) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(snapshot_store(store), f)
+        f.flush()
+        os.fsync(f.fileno())  # durable before the rename makes it visible
     os.replace(tmp, path)
 
 
+class CorruptSnapshotError(ValueError):
+    """The state file exists but is not parseable — a torn write from a crash
+    that predates the atomic tmp+rename+fsync protocol, or disk corruption."""
+
+
 def load_store(store, path: str) -> int:
+    """Restore from `path`. A leftover `.tmp` (crash mid-snapshot — exactly
+    the TPU-preemption window KEP-820 worries about) is discarded: the main
+    file is the last COMPLETED snapshot and rename-atomicity guarantees it is
+    whole. A corrupt main file raises CorruptSnapshotError rather than
+    half-restoring."""
+    import os
+
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        os.unlink(tmp)  # torn partial snapshot: the main file supersedes it
     with open(path) as f:
-        return restore_store(store, json.load(f))
+        try:
+            snapshot = json.load(f)
+        except ValueError as e:
+            raise CorruptSnapshotError(
+                f"state file {path} is not valid JSON ({e}); refusing a "
+                "partial restore — recover from a replica or delete the file "
+                "to start empty"
+            ) from e
+    return restore_store(store, snapshot)

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from lws_tpu.models.quant import embed_lookup, expert_einsum, matmul as _mm
+
 
 @dataclass(frozen=True)
 class LlamaConfig:
@@ -187,8 +189,8 @@ def gqa_attention(q, k, v, causal: bool = True):
 
 
 def _dense_ffn(x, w_gate, w_up, w_down):
-    h = jax.nn.silu(x @ w_gate) * (x @ w_up)
-    return h @ w_down
+    h = jax.nn.silu(_mm(x, w_gate)) * _mm(x, w_up)
+    return _mm(h, w_down)
 
 
 def _moe_ffn(x, router, w_gate, w_up, w_down, cfg: LlamaConfig):
@@ -202,7 +204,7 @@ def _moe_ffn(x, router, w_gate, w_up, w_down, cfg: LlamaConfig):
     E, K = cfg.n_experts, cfg.top_k
     C = max(1, int(cfg.capacity_factor * S * K / E))
 
-    logits = jnp.einsum("bsd,de->bse", x, router).astype(jnp.float32)
+    logits = jnp.einsum("bsd,de->bse", x, router.astype(x.dtype)).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
 
     remaining = probs
@@ -233,10 +235,10 @@ def _moe_ffn(x, router, w_gate, w_up, w_down, cfg: LlamaConfig):
         expert_in = jax.lax.with_sharding_constraint(expert_in, P("tp", "dp", None, None))
     except RuntimeError:
         pass
-    h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate)) * jnp.einsum(
+    h = jax.nn.silu(expert_einsum("ebcd,edf->ebcf", expert_in, w_gate)) * expert_einsum(
         "ebcd,edf->ebcf", expert_in, w_up
     )
-    expert_out = jnp.einsum("ebcf,efd->ebcd", h, w_down)
+    expert_out = expert_einsum("ebcf,efd->ebcd", h, w_down)
     y = jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
 
     # Load-balancing aux loss (Switch): E * mean(fraction_e * prob_e).
@@ -294,27 +296,20 @@ def _block_core(x, positions, lp, cfg: LlamaConfig, attn_fn, seq_shard: bool = F
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(h.dtype)).reshape(B, S, nh, hd)
-    k = (h @ lp["wk"].astype(h.dtype)).reshape(B, S, nkv, hd)
-    v = (h @ lp["wv"].astype(h.dtype)).reshape(B, S, nkv, hd)
+    q = _mm(h, lp["wq"]).reshape(B, S, nh, hd)
+    k = _mm(h, lp["wk"]).reshape(B, S, nkv, hd)
+    v = _mm(h, lp["wv"]).reshape(B, S, nkv, hd)
     q, k = rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta)
     attn = attn_fn(q, k, v).reshape(B, S, nh * hd)
-    x = x + attn @ lp["wo"].astype(attn.dtype)
+    x = x + _mm(attn, lp["wo"])
     if seq_shard:
         x = _seq_shard(x)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
     if cfg.n_experts:
-        y, aux = _moe_ffn(
-            h,
-            lp["router"].astype(h.dtype),
-            lp["w_gate"].astype(h.dtype),
-            lp["w_up"].astype(h.dtype),
-            lp["w_down"].astype(h.dtype),
-            cfg,
-        )
+        y, aux = _moe_ffn(h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"], cfg)
     else:
-        y = _dense_ffn(h, lp["w_gate"].astype(h.dtype), lp["w_up"].astype(h.dtype), lp["w_down"].astype(h.dtype))
+        y = _dense_ffn(h, lp["w_gate"], lp["w_up"], lp["w_down"])
         aux = jnp.zeros((), jnp.float32)
     x = x + y
     if seq_shard:
@@ -341,7 +336,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> tuple[jax.Arra
     """tokens [B,S] -> (logits [B,S,V] f32, aux_loss scalar)."""
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     x = _seq_shard(x)
 
     if cfg.pipeline_microbatches > 0:
@@ -367,7 +362,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> tuple[jax.Arra
 
         (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
     return logits, aux / cfg.n_layers
 
 
@@ -507,7 +502,7 @@ def forward_with_cache(
     B, S = tokens.shape
     pos = cache.pos
     positions = pos + jnp.broadcast_to(jnp.arange(S), (B, S))
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
 
     # Unrolled mode: static layer indices make every cache read/write a
     # static slice XLA aliases in place (bigger HLO, faster steps — serving);
@@ -517,7 +512,7 @@ def forward_with_cache(
         lambda x, layer_idx, lp, cache: _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg),
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
     import dataclasses as _dc
 
     return logits, _dc.replace(cache, pos=pos + S)
@@ -535,7 +530,7 @@ def forward_prefill_chunk(
     B, S = tokens.shape
     pos = cache.pos
     positions = pos + jnp.broadcast_to(jnp.arange(S), (B, S))
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     x, cache = _cached_layer_loop(
         x, cache, params, cfg,
         lambda x, layer_idx, lp, cache: _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg),
@@ -558,7 +553,7 @@ def forward_prefill(
 
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
 
     def prefill_block(x, lp):
         kv = {}
@@ -611,7 +606,7 @@ def forward_prefill(
         # Padded prompts (length bucketing): logits at the true last token.
         last = jax.lax.dynamic_index_in_dim(x, last_pos, 1, keepdims=False)
         advanced = last_pos + 1
-    logits = (last @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = _mm(last, params["lm_head"]).astype(jnp.float32)
     return logits, _dc.replace(cache, pos=cache.pos + advanced)
 
 
@@ -634,7 +629,7 @@ def forward_decode_slotted(
         )
     B = tokens.shape[0]
     positions = pos_b[:, None]  # [B,1] — rope at each slot's own position
-    x = params["embed"].astype(cfg.dtype)[tokens[:, None]]
+    x = embed_lookup(params["embed"], tokens[:, None], cfg.dtype)
     batch_idx = jnp.arange(B)
 
     def slot_block(x, layer_idx, lp, cache):
@@ -651,7 +646,7 @@ def forward_decode_slotted(
 
     x, cache = _cached_layer_loop(x, cache, params, cfg, slot_block)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, -1] @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
 

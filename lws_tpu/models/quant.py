@@ -1,0 +1,120 @@
+"""int8 weight quantization for serving (per-output-channel scales).
+
+Decode is HBM-bandwidth-bound: every step streams all weights. Storing them
+int8 with a float scale per output channel halves (vs bf16) the weight bytes
+per step; the dequantize-convert fuses into the matmul operand read on TPU,
+so the MXU still computes in bf16 while HBM traffic is int8.
+
+The reference has no compute plane (SURVEY §0); this is the TPU-native
+counterpart of the weight quantization its vLLM examples enable on the
+workload side (docs/examples/vllm/TPU/lws.yaml serving density knobs).
+
+Layout contract: a weight of shape [..., D, F] (D = contraction dim) becomes
+q int8 [..., D, F] + scale f32 [..., F] where scale = amax(|w|, axis=-2)/127.
+Because the scale is per OUTPUT channel, `(x @ q) * scale == x @ (q * scale)`
+exactly — quantized matmuls drop into existing call sites unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QuantizedArray:
+    """int8 values + per-output-channel dequantization scales.
+
+    q: int8 [..., D, F]; scale: f32 [..., F]. Slicing leading (layer/expert)
+    dims via jax.tree.map slices q and scale consistently, so quantized
+    params flow through lax.scan / per-layer indexing like plain arrays.
+    """
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+def quantize_array(w: jax.Array, contract_axis: int = -2) -> QuantizedArray:
+    """Symmetric int8 quantization with scales over `contract_axis`."""
+    w32 = w.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(w32), axis=contract_axis), 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(w32 / jnp.expand_dims(scale, contract_axis)), -127, 127
+    ).astype(jnp.int8)
+    return QuantizedArray(q=q, scale=scale)
+
+
+def dequantize_array(w: QuantizedArray, dtype, contract_axis: int = -2) -> jax.Array:
+    return (w.q.astype(jnp.float32) * jnp.expand_dims(w.scale, contract_axis)).astype(dtype)
+
+
+# Weights quantized by quantize_params. Norms and the MoE router stay in
+# param_dtype: they are tiny and precision-critical.
+_MATMUL_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize a llama.init_params tree for serving. Matmul weights become
+    QuantizedArray ([L, D, F] -> q + scale [L, F]; MoE [L, E, D, F] -> scale
+    [L, E, F]); embed [V, D] is quantized per row (scale [V]) for lookups;
+    lm_head [D, V] per output column. Returns a new tree; the input is
+    untouched."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key in _MATMUL_KEYS:
+        if key in layers:
+            layers[key] = quantize_array(layers[key], contract_axis=-2)
+    out["layers"] = layers
+    # Embedding rows are read by token lookup: scale over D (axis -1).
+    out["embed"] = quantize_array(params["embed"], contract_axis=-1)
+    out["lm_head"] = quantize_array(params["lm_head"], contract_axis=-2)
+    return out
+
+
+def quantized_bytes(params: dict) -> int:
+    """Actual HBM bytes of a (possibly quantized) param tree — the honest
+    numerator for decode roofline accounting."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+def matmul(x: jax.Array, w, dtype=None) -> jax.Array:
+    """x @ w for plain or quantized w. The int8->compute-dtype convert fuses
+    into the dot's operand read; scale applies per output channel after."""
+    dtype = dtype or x.dtype
+    if isinstance(w, QuantizedArray):
+        return (x @ w.q.astype(dtype)) * w.scale.astype(dtype)
+    return x @ w.astype(dtype)
+
+
+def embed_lookup(embed, tokens: jax.Array, dtype) -> jax.Array:
+    """Embedding row gather for plain or per-row-quantized tables."""
+    if isinstance(embed, QuantizedArray):
+        rows = embed.q[tokens].astype(dtype)
+        return rows * embed.scale[tokens][..., None].astype(dtype)
+    return embed.astype(dtype)[tokens]
+
+
+def expert_einsum(spec: str, x: jax.Array, w, dtype=None) -> jax.Array:
+    """einsum over MoE expert weights [E, D, F] (spec contracts D, keeps E and
+    emits F last) for plain or quantized w; scale [E, F] broadcasts onto the
+    [e, ..., f] output."""
+    dtype = dtype or x.dtype
+    if isinstance(w, QuantizedArray):
+        y = jnp.einsum(spec, x, w.q.astype(dtype))
+        scale = w.scale.astype(dtype)  # [E, F] -> [e, 1, ..., f]
+        return y * scale.reshape(scale.shape[0], *([1] * (y.ndim - 2)), scale.shape[-1])
+    return jnp.einsum(spec, x, w.astype(dtype))

@@ -240,20 +240,7 @@ class ControlPlane:
         """Cold-start cache resync: enqueue every stored object to every
         watching controller — required when standing up a fresh control plane
         over pre-existing state (level-triggered restart semantics)."""
-        from lws_tpu.core.store import WatchEvent
-
-        for kind in (
-            "DisaggregatedSet",
-            "LeaderWorkerSet",
-            "GroupSet",
-            "Pod",
-            "Service",
-            "Node",
-            "PodGroup",
-            "ControllerRevision",
-        ):
-            for obj in self.store.list(kind):
-                self.manager._on_event(WatchEvent("MODIFIED", obj))
+        self.manager.resync()
 
     def add_nodes(self, nodes: list[Node]) -> None:
         for node in nodes:

@@ -85,3 +85,42 @@ def test_restore_invalidates_kind_version_caches():
     restore_store(dst, snap)
     assert dst.kind_version("Node") > v0
     assert len(dst.list("Node")) == 2
+
+
+def test_torn_tmp_snapshot_is_discarded(tmp_path):
+    """kill -9 mid-snapshot leaves a partial .tmp next to the last completed
+    state file; load must use the completed file and discard the torn tmp."""
+    import os
+
+    from lws_tpu.api.pod import Pod
+    from lws_tpu.core.serialize import load_store, save_store
+    from lws_tpu.core.store import Store, new_meta
+
+    path = str(tmp_path / "state.json")
+    src = Store()
+    src.create(Pod(meta=new_meta("p0")))
+    save_store(src, path)
+    # Simulate the torn write: a partial JSON .tmp from a crashed snapshot.
+    with open(path + ".tmp", "w") as f:
+        f.write('{"Pod": [{"meta": {"name": "half')
+
+    dst = Store()
+    assert load_store(dst, path) == 1
+    assert dst.get("Pod", "default", "p0") is not None
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_corrupt_state_file_raises_not_half_restores(tmp_path):
+    from lws_tpu.core.serialize import CorruptSnapshotError, load_store
+    from lws_tpu.core.store import Store
+
+    import pytest
+
+    path = str(tmp_path / "state.json")
+    with open(path, "w") as f:
+        f.write('{"LeaderWorkerSet": [{"meta"')  # truncated mid-object
+
+    dst = Store()
+    with pytest.raises(CorruptSnapshotError):
+        load_store(dst, path)
+    assert dst.list("Pod") == []
