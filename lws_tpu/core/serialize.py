@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
+import os
 import typing
 from typing import Any, Optional, Union, get_args, get_origin, get_type_hints
 
@@ -129,8 +130,6 @@ def restore_store(store, snapshot: dict) -> int:
 
 
 def save_store(store, path: str) -> None:
-    import os
-
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(snapshot_store(store), f)
@@ -150,18 +149,20 @@ def load_store(store, path: str) -> int:
     file is the last COMPLETED snapshot and rename-atomicity guarantees it is
     whole. A corrupt main file raises CorruptSnapshotError rather than
     half-restoring."""
-    import os
-
-    tmp = path + ".tmp"
-    if os.path.exists(tmp):
-        os.unlink(tmp)  # torn partial snapshot: the main file supersedes it
     with open(path) as f:
         try:
             snapshot = json.load(f)
         except ValueError as e:
+            # Keep any .tmp around here: if the main file is corrupt it may
+            # be the only near-complete local copy left to recover from.
             raise CorruptSnapshotError(
                 f"state file {path} is not valid JSON ({e}); refusing a "
-                "partial restore — recover from a replica or delete the file "
-                "to start empty"
+                "partial restore — recover from a replica, inspect "
+                f"{path + '.tmp'} if present, or delete the file to start "
+                "empty"
             ) from e
-    return restore_store(store, snapshot)
+    count = restore_store(store, snapshot)
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        os.unlink(tmp)  # torn partial snapshot: the restored main supersedes it
+    return count

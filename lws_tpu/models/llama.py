@@ -317,23 +317,50 @@ def _block_core(x, positions, lp, cfg: LlamaConfig, attn_fn, seq_shard: bool = F
     return x, aux
 
 
+# While True (set around the GPipe pipeline call), activation constraints
+# avoid the cp axis entirely — see _seq_shard.
+import contextvars
+
+_no_cp_activations = contextvars.ContextVar("_no_cp_activations", default=False)
+
+
 def _seq_shard(x):
-    """Sequence parallelism: shard [B,S,D] activations as (dp, tp, -) between
-    blocks so norms/residuals are sequence-parallel; GSPMD inserts the
+    """Sequence parallelism: shard [B,S,D] activations as (dp, (cp, tp), -)
+    between blocks so norms/residuals are sequence-parallel; GSPMD inserts the
     gather/reduce-scatter pairs around attention/matmuls. No-op outside a
     mesh context (single-chip serving/bench)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if not mesh.axis_names:
+        return x  # no mesh in context (single-chip serving)
+    manual = set(getattr(mesh, "manual_axes", ()))
+    if "pp" in manual or _no_cp_activations.get():
+        # GPipe path: values crossing (or inside) the manual-pp shard_map may
+        # not be sharded over cp — grouping cp with tp there trips a GSPMD
+        # device-group CHECK (spmd_partitioner_util.cc) — shard S over tp
+        # only; cp stays whole per microbatch.
+        spec = P("dp", "tp", None)
+    else:
+        seq = tuple(a for a in ("cp", "tp") if a in mesh.axis_names)
+        spec = P("dp", seq if seq else None, None)
     try:
-        return jax.lax.with_sharding_constraint(x, P("dp", ("cp", "tp"), None))
-    except ValueError:
-        # Mesh without a cp axis (hand-built 3-axis meshes): tp-only seq shard.
-        return jax.lax.with_sharding_constraint(x, P("dp", "tp", None))
-    except RuntimeError:
-        # No mesh in context (single-chip serving): skip the constraint.
-        return x
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x  # mesh lacks one of the axes (hand-built test meshes)
 
 
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> tuple[jax.Array, jax.Array]:
     """tokens [B,S] -> (logits [B,S,V] f32, aux_loss scalar)."""
+    # Activations feeding (and following) the manual-pp GPipe shard_map must
+    # stay off the cp axis (see _seq_shard); scoped via contextvar so nested
+    # traces of non-pipelined models are unaffected.
+    token = _no_cp_activations.set(cfg.pipeline_microbatches > 0)
+    try:
+        return _forward_inner(params, tokens, cfg)
+    finally:
+        _no_cp_activations.reset(token)
+
+
+def _forward_inner(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> tuple[jax.Array, jax.Array]:
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = embed_lookup(params["embed"], tokens, cfg.dtype)
@@ -342,11 +369,10 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> tuple[jax.Arra
     if cfg.pipeline_microbatches > 0:
         if cfg.context_parallel:
             raise NotImplementedError("pipeline_microbatches with context_parallel")
-        if cfg.n_experts:
-            # The GSPMD partitioner CHECK-fails on the MoE all-to-all inside
-            # the partial-auto pipeline body (xla spmd_partitioner_util.cc);
-            # keep MoE on the weight-gathered pp path until that is resolved.
-            raise NotImplementedError("pipeline_microbatches with n_experts (use the scan pp path)")
+        # MoE inside the pipeline body works since activations stay off the
+        # cp axis in the GPipe path (_no_cp_activations): the round-1 GSPMD
+        # CHECK-abort (spmd_partitioner_util.cc) was cp-sharded values
+        # crossing the manual-pp shard_map boundary, not the MoE all-to-all.
         from lws_tpu.models.pipeline import pipeline_forward
 
         x, aux = pipeline_forward(params["layers"], x, positions, cfg, _block)

@@ -25,10 +25,13 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-# XLA CPU aborts on the transpose of bf16 collectives (ppermute/psum —
-# reproduced minimally, including GSPMD-inserted tp all-reduces inside the
-# partial-auto body). CPU is the test platform only, so the whole pipeline
-# body runs f32 there; TPU keeps bf16 end to end.
+# XLA CPU aborts on the TRANSPOSE (backward pass) of bf16 collectives.
+# Re-verified minimally (2026-07-29): a bf16 tp-sharded matmul inside the
+# partial-auto body forward-computes fine, but jax.grad CHECK-aborts on the
+# GSPMD-inserted bf16 all-reduce's transpose even when the explicit
+# ppermute is cast to f32 — so casting only the explicit collectives is NOT
+# sufficient and the whole body runs f32 on CPU. CPU is the test platform
+# only; TPU keeps bf16 end to end.
 def _cpu_safe_dtype(dtype):
     if dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
         return jnp.float32

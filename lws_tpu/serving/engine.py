@@ -209,19 +209,22 @@ class Engine:
     DECODE_CHUNK = 32
 
     def _warm_decode(self, chunked: bool, single: bool) -> None:
-        """Compile the decode executables OUTSIDE generate()'s timed window
-        (on a throwaway cache) so decode_tokens_per_s measures steady state.
-        Each executable is warmed at most once per Engine."""
+        """AOT-compile the decode executables OUTSIDE generate()'s timed
+        window so decode_tokens_per_s measures steady state — no device
+        allocation or wasted decode steps. Each executable is warmed at most
+        once per Engine."""
         warmed = getattr(self, "_warmed", set())
         self._warmed = warmed
-        token = jnp.zeros((self.batch_size,), jnp.int32)
+        token_s = jax.ShapeDtypeStruct((self.batch_size,), jnp.int32)
+        cache_s = jax.eval_shape(self.new_cache)
+        key_s = jax.eval_shape(lambda: jax.random.key(0))
         if chunked and "chunk" not in warmed:
-            _, _, toks = self.decode_n(token, self.new_cache(), self.DECODE_CHUNK)
-            host_sync(toks)
+            self._decode_n.lower(
+                self.params, token_s, cache_s, self.DECODE_CHUNK, key_s
+            ).compile()
             warmed.add("chunk")
         if single and "single" not in warmed:
-            tok, _ = self.decode(token, self.new_cache())
-            host_sync(tok)
+            self._decode.lower(self.params, token_s, cache_s, key_s).compile()
             warmed.add("single")
 
     def generate(self, prompt: jax.Array, max_new_tokens: int) -> GenerationResult:
