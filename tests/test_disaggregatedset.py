@@ -273,3 +273,73 @@ def test_slices_change_is_not_a_rollout():
     assert set(after) == set(before) | {f"llmd-1-{rev}-prefill", f"llmd-1-{rev}-decode"}
     for name, uid in before.items():
         assert after[name].meta.uid == uid, f"{name} was recreated"
+
+
+def test_observed_rollout_steps_match_planner_predictions():
+    """Step-sequence tracking (≈ test/e2e/disaggregatedset/e2e_test.go:618):
+    watch every child-LWS scale during a live rolling update and assert the
+    observed (old, new) replica vectors are EXACTLY the planner's
+    ComputeAllSteps prediction, in order — the executor must never take a
+    step the pure-math planner didn't predict."""
+    from lws_tpu.controllers.disagg.executor import RollingUpdateExecutor
+    from lws_tpu.controllers.disagg.planner import ComputeAllSteps
+
+    cp = ControlPlane(auto_ready=True)
+    ds = cp.create(make_ds(roles=[role("prefill", replicas=3), role("decode", replicas=2)]))
+    cp.run_until_stable()
+    rev1 = dsutils.compute_revision(ds.spec.roles)
+
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:v2"
+    role_names = [r.name for r in fetched.spec.roles]
+    rev2 = dsutils.compute_revision(fetched.spec.roles)
+
+    observed = []
+
+    def snapshot(_event) -> None:
+        if _event.obj.kind != "LeaderWorkerSet":
+            return
+        old_vec, new_vec = [], []
+        for rn in role_names:
+            old = cp.store.try_get("LeaderWorkerSet", "default", f"llmd-0-{rev1}-{rn}")
+            new = cp.store.try_get("LeaderWorkerSet", "default", f"llmd-0-{rev2}-{rn}")
+            old_vec.append(old.spec.replicas if old is not None else 0)
+            new_vec.append(new.spec.replicas if new is not None else 0)
+        state = (tuple(old_vec), tuple(new_vec))
+        if not observed or observed[-1] != state:
+            observed.append(state)
+
+    cp.store.watch(snapshot)
+    cp.store.update(fetched)
+    cp.run_until_stable()
+
+    config = RollingUpdateExecutor._extract_config(fetched, role_names)
+    predicted = [
+        (tuple(s.past), tuple(s.new))
+        for s in ComputeAllSteps([3, 2], [3, 2], config)
+    ]
+    # The executor may pass through each predicted state over several
+    # reconciles (dedup'd above) but must visit exactly the predicted states
+    # in the predicted order. The 0-replica new-revision creation is the
+    # planner's initial state, so sequences align from the start.
+    predicted_set = set(predicted)
+    relevant = [s for s in observed if s in predicted_set]
+    assert relevant == predicted, f"observed={observed}\npredicted={predicted}"
+    # Scale steps span several store writes (one per role LWS), so watchers
+    # can also see half-applied vectors — but every one of those must lie
+    # componentwise BETWEEN two adjacent predicted steps; anything outside
+    # that envelope is a step the planner never sanctioned.
+    def between(obs, a, b):
+        return all(
+            min(a[k][i], b[k][i]) <= obs[k][i] <= max(a[k][i], b[k][i])
+            for k in (0, 1)
+            for i in range(len(obs[0]))
+        )
+
+    for obs in observed:
+        if obs in predicted_set:
+            continue
+        ok = any(between(obs, predicted[i], predicted[i + 1]) for i in range(len(predicted) - 1))
+        assert ok, f"executor state {obs} outside every predicted transition\npredicted={predicted}"

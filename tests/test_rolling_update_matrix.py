@@ -1,0 +1,482 @@
+"""Table-driven rolling-update matrix (≈ test/integration/controllers/
+leaderworkerset_test.go:631-2500): every maxSurge x maxUnavailable x
+partition x scale-up/down/to-zero x mid-update-replica-change combination
+the reference treats as the spec, as step sequences with intermediate
+partition / replica-count / condition checkpoints.
+
+The test plays kubelet (SURVEY §4.2): the control plane creates pods, the
+table flips their readiness group by group and asserts the controller's
+rolling-update parameters after each transition.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import pytest
+
+from lws_tpu.api.types import (
+    CONDITION_AVAILABLE,
+    CONDITION_PROGRESSING,
+    CONDITION_UPDATE_IN_PROGRESS,
+)
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import (
+    LWSBuilder,
+    condition_status,
+    make_all_groups_ready,
+    make_group_ready,
+    set_pod_not_ready,
+)
+
+NAME = "sample"
+
+
+# ---------------------------------------------------------------------------
+# Step DSL
+
+
+@dataclass
+class Step:
+    """One update step: run `do`, settle the control plane, assert `expect`."""
+
+    do: Callable[[ControlPlane], None]
+    expect: dict = field(default_factory=dict)
+    note: str = ""
+
+
+@dataclass
+class Case:
+    name: str
+    build: Callable[[], object]  # -> LeaderWorkerSet
+    steps: list[Step]
+
+
+# -- actions ----------------------------------------------------------------
+
+
+def ready_all(cp: ControlPlane) -> None:
+    make_all_groups_ready(cp, NAME, max_rounds=60)
+
+
+def ready_groups(*groups: int):
+    def act(cp: ControlPlane) -> None:
+        for g in groups:
+            make_group_ready(cp.store, NAME, g)
+            cp.run_until_stable()
+
+    return act
+
+
+def update_image(img: str):
+    def act(cp: ControlPlane) -> None:
+        lws = cp.store.get("LeaderWorkerSet", "default", NAME)
+        for c in lws.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = img
+        cp.store.update(lws)
+
+    return act
+
+
+def set_replicas(n: int):
+    def act(cp: ControlPlane) -> None:
+        lws = cp.store.get("LeaderWorkerSet", "default", NAME)
+        lws.spec.replicas = n
+        cp.store.update(lws)
+
+    return act
+
+
+def update_image_and_replicas(img: str, n: int):
+    def act(cp: ControlPlane) -> None:
+        lws = cp.store.get("LeaderWorkerSet", "default", NAME)
+        for c in lws.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = img
+        lws.spec.replicas = n
+        cp.store.update(lws)
+
+    return act
+
+
+def set_partition(n: int):
+    def act(cp: ControlPlane) -> None:
+        lws = cp.store.get("LeaderWorkerSet", "default", NAME)
+        lws.spec.rollout_strategy.rolling_update_configuration.partition = n
+        cp.store.update(lws)
+
+    return act
+
+
+def group_not_ready(group: int):
+    def act(cp: ControlPlane) -> None:
+        set_pod_not_ready(cp.store, "default", f"{NAME}-{group}")
+
+    return act
+
+
+def nothing(cp: ControlPlane) -> None:
+    pass
+
+
+def seq(*actions):
+    def act(cp: ControlPlane) -> None:
+        for a in actions:
+            a(cp)
+            cp.run_until_stable()
+
+    return act
+
+
+# -- assertions -------------------------------------------------------------
+
+
+def check(cp: ControlPlane, expect: dict, ctx: str) -> None:
+    lws = cp.store.get("LeaderWorkerSet", "default", NAME)
+    gs = cp.store.try_get("GroupSet", "default", NAME)
+    if any(k in expect for k in ("partition", "gs_replicas")):
+        assert gs is not None, f"{ctx}: GroupSet missing"
+    if "partition" in expect:
+        assert gs.spec.update_strategy.partition == expect["partition"], (
+            f"{ctx}: partition {gs.spec.update_strategy.partition} != {expect['partition']}"
+        )
+    if "gs_replicas" in expect:
+        assert gs.spec.replicas == expect["gs_replicas"], (
+            f"{ctx}: gs replicas {gs.spec.replicas} != {expect['gs_replicas']}"
+        )
+    if "ready" in expect:
+        assert lws.status.ready_replicas == expect["ready"], (
+            f"{ctx}: ready {lws.status.ready_replicas} != {expect['ready']}"
+        )
+    if "updated" in expect:
+        assert lws.status.updated_replicas == expect["updated"], (
+            f"{ctx}: updated {lws.status.updated_replicas} != {expect['updated']}"
+        )
+    if "available" in expect:
+        assert condition_status(lws, CONDITION_AVAILABLE) is expect["available"], (
+            f"{ctx}: available != {expect['available']}"
+        )
+    if "progressing" in expect:
+        assert condition_status(lws, CONDITION_PROGRESSING) is expect["progressing"], (
+            f"{ctx}: progressing != {expect['progressing']}"
+        )
+    if "updating" in expect:
+        got = condition_status(lws, CONDITION_UPDATE_IN_PROGRESS)
+        want = expect["updating"]
+        ok = (got is want) or (want is False and got is None)
+        assert ok, f"{ctx}: update-in-progress {got} != {want}"
+    if "images" in expect:
+        for g, img in expect["images"].items():
+            pod = cp.store.get("Pod", "default", f"{NAME}-{g}")
+            got = pod.spec.containers[0].image
+            assert got == img, f"{ctx}: group {g} image {got} != {img}"
+    if "revisions" in expect:
+        got = len(cp.store.list("ControllerRevision"))
+        assert got == expect["revisions"], f"{ctx}: revisions {got} != {expect['revisions']}"
+    if "pods" in expect:
+        leaders = [
+            p for p in cp.store.list("Pod")
+            if p.meta.name.startswith(f"{NAME}-") and p.meta.name.count("-") == 1
+        ]
+        assert len(leaders) == expect["pods"], (
+            f"{ctx}: leader pods {len(leaders)} != {expect['pods']}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# The matrix (case names track the reference entries; line refs are to
+# test/integration/controllers/leaderworkerset_test.go)
+
+
+CASES = [
+    # :631 leaderTemplate changed with default strategy (maxU=1): one group
+    # at a time from the top index.
+    Case(
+        "default_strategy_one_by_one",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").build(),
+        [
+            Step(ready_all, dict(partition=0, gs_replicas=4, ready=4, updated=4, available=True)),
+            # Group 3 is recreated on the new template immediately (the
+            # control plane integrates the statefulset-controller role, so
+            # `updated` counts the fresh unready pod the moment it exists).
+            Step(update_image("v2"), dict(partition=3, ready=3, updated=1, updating=True, progressing=True)),
+            Step(ready_groups(3), dict(partition=2, ready=3, updated=2)),
+            Step(ready_groups(2), dict(partition=1, ready=3, updated=3)),
+            Step(ready_groups(1), dict(partition=0, ready=3, updated=4)),
+            Step(
+                ready_groups(0),
+                dict(partition=0, ready=4, updated=4, available=True, updating=False, revisions=1,
+                     images={0: "v2", 1: "v2", 2: "v2", 3: "v2"}),
+            ),
+        ],
+    ),
+    # :729 workerTemplate changed with maxUnavailable=2: two at a time.
+    Case(
+        "max_unavailable_2_two_by_two",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=2).build(),
+        [
+            Step(ready_all, dict(partition=0, ready=4, updated=4, available=True)),
+            Step(update_image("v2"), dict(partition=2, ready=2, updated=2, updating=True)),
+            Step(ready_groups(3, 2), dict(partition=0, ready=2, updated=4)),
+            Step(
+                ready_groups(1, 0),
+                dict(partition=0, ready=4, updated=4, available=True, updating=False),
+            ),
+        ],
+    ),
+    # :807 maxUnavailable greater than replicas: everything at once.
+    Case(
+        "max_unavailable_exceeds_replicas",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=10).build(),
+        [
+            Step(ready_all, dict(partition=0, ready=4, updated=4)),
+            Step(update_image("v2"), dict(partition=0, ready=0, updated=4, updating=True)),
+            Step(
+                ready_groups(3, 2, 1, 0),
+                dict(partition=0, ready=4, updated=4, available=True, updating=False),
+            ),
+        ],
+    ),
+    # :856 both worker template and replicas changed in one update.
+    Case(
+        "template_and_replicas_together",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=2).build(),
+        [
+            Step(ready_all, dict(ready=4, updated=4)),
+            # New groups 4,5 come up on the new template immediately; old
+            # 0-3 roll two at a time.
+            Step(update_image_and_replicas("v2", 6), dict(gs_replicas=6, partition=4, ready=4,
+                                                          updated=2, updating=True,
+                                                          images={4: "v2", 5: "v2"})),
+            Step(ready_groups(5, 4, 3, 2), dict(partition=0, ready=4, updated=6)),
+            Step(
+                ready_groups(1, 0),
+                dict(partition=0, ready=6, updated=6, available=True, updating=False,
+                     revisions=1, images={0: "v2", 3: "v2", 5: "v2"}),
+            ),
+        ],
+    ),
+    # :916 replicas increase during rolling update.
+    Case(
+        "replicas_increase_mid_update",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").build(),
+        [
+            Step(ready_all, dict(ready=4, updated=4)),
+            Step(update_image("v2"), dict(partition=3, ready=3, updated=1, updating=True)),
+            Step(ready_groups(3), dict(partition=2, ready=3, updated=2)),
+            # Scale 4 -> 6 mid-update: new groups use the new template; the
+            # partition holds while the fresh groups come up.
+            Step(set_replicas(6), dict(gs_replicas=6, partition=2, updated=4, updating=True,
+                                       images={4: "v2", 5: "v2"})),
+            Step(ready_groups(5, 4), dict(partition=2, ready=5, updated=4)),
+            Step(
+                ready_groups(2, 1, 0),
+                dict(partition=0, ready=6, updated=6, available=True, updating=False),
+            ),
+        ],
+    ),
+    # :1008 replicas decrease during rolling update.
+    Case(
+        "replicas_decrease_mid_update",
+        lambda: LWSBuilder().replicas(6).size(2).image("v1").build(),
+        [
+            Step(ready_all, dict(ready=6, updated=6)),
+            Step(update_image("v2"), dict(partition=5, ready=5, updated=1, updating=True)),
+            Step(ready_groups(5), dict(partition=4, ready=5, updated=2)),
+            # Scale 6 -> 3 mid-update: groups 3-5 torn down; partition clamps
+            # into the surviving range.
+            Step(set_replicas(3), dict(gs_replicas=3, partition=2, ready=2, updated=1,
+                                       updating=True, pods=3)),
+            Step(
+                ready_groups(2, 1, 0),
+                dict(partition=0, ready=3, updated=3, available=True, updating=False, pods=3),
+            ),
+        ],
+    ),
+    # :1088 maxUnavailable=0 with maxSurge=1: zero-downtime one-by-one via a
+    # surge group; burst reclaimed at the end.
+    Case(
+        "maxU0_surge1_zero_downtime",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=0, max_surge=1).build(),
+        [
+            Step(ready_all, dict(partition=0, gs_replicas=4, ready=4, updated=4, available=True)),
+            # Surge group 4 appears (new template); nothing old is torn down.
+            Step(update_image("v2"), dict(gs_replicas=5, partition=4, ready=4, updated=1,
+                                          updating=True, images={4: "v2"})),
+            # Zero downtime: ready never drops below the 4 configured replicas.
+            Step(ready_groups(4), dict(partition=3, ready=4, updated=2)),
+            Step(ready_groups(3), dict(partition=2, ready=4, updated=3)),
+            Step(ready_groups(2), dict(partition=1, ready=4, updated=4)),
+            Step(ready_groups(1), dict(partition=0, ready=4, updated=5)),
+            Step(
+                ready_groups(0),
+                dict(partition=0, gs_replicas=4, ready=4, updated=4, available=True,
+                     updating=False, pods=4),
+            ),
+        ],
+    ),
+    # :1326 maxUnavailable=1 AND maxSurge=1 together.
+    Case(
+        "maxU1_surge1",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=1, max_surge=1).build(),
+        [
+            Step(ready_all, dict(gs_replicas=4, ready=4)),
+            # Surge to 5; budget allows updating 2 at once (1 unavail + 1 surge).
+            Step(update_image("v2"), dict(gs_replicas=5, partition=3, ready=3, updated=2,
+                                          updating=True)),
+            Step(ready_groups(4, 3), dict(partition=1, ready=3, updated=4)),
+            Step(ready_groups(2, 1), dict(partition=0, gs_replicas=4)),
+            Step(
+                ready_groups(0),
+                dict(gs_replicas=4, ready=4, updated=4, available=True, updating=False, pods=4),
+            ),
+        ],
+    ),
+    # :1404 replicas scaled up while maxSurge is set.
+    Case(
+        "scale_up_with_surge",
+        lambda: LWSBuilder().replicas(2).size(2).image("v1").rollout(max_unavailable=1, max_surge=1).build(),
+        [
+            Step(ready_all, dict(gs_replicas=2, ready=2)),
+            Step(update_image("v2"), dict(gs_replicas=3, partition=1, ready=1, updated=2,
+                                          updating=True)),
+            Step(set_replicas(4), dict(gs_replicas=5, partition=1, updated=4, updating=True)),
+            Step(
+                ready_groups(4, 3, 2, 1, 0),
+                dict(gs_replicas=4, ready=4, updated=4, available=True, updating=False, pods=4),
+            ),
+        ],
+    ),
+    # :1473 replicas scaled down while maxSurge is set.
+    Case(
+        "scale_down_with_surge",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=1, max_surge=1).build(),
+        [
+            Step(ready_all, dict(gs_replicas=4, ready=4)),
+            Step(update_image("v2"), dict(gs_replicas=5, partition=3, ready=3, updated=2,
+                                          updating=True)),
+            Step(set_replicas(2), dict(gs_replicas=3, partition=1, ready=1, updated=2,
+                                       updating=True, pods=3)),
+            Step(
+                ready_groups(2, 1, 0),
+                dict(gs_replicas=2, ready=2, updated=2, available=True, updating=False, pods=2),
+            ),
+        ],
+    ),
+    # :1539 maxSurge greater than replicas: surge is capped at replicas.
+    Case(
+        "surge_greater_than_replicas",
+        lambda: LWSBuilder().replicas(2).size(2).image("v1").rollout(max_unavailable=1, max_surge=4).build(),
+        [
+            Step(ready_all, dict(gs_replicas=2, ready=2)),
+            # Surge is capped: 2 replicas never burst beyond 3 groups here
+            # (ref caps surge so old+new stays within replicas+maxSurge and
+            # reclaims as the update progresses).
+            Step(update_image("v2"), dict(gs_replicas=3, partition=1, ready=1, updated=2,
+                                          updating=True)),
+            Step(
+                ready_groups(2, 1, 0),
+                dict(gs_replicas=2, ready=2, updated=2, available=True, updating=False, pods=2),
+            ),
+        ],
+    ),
+    # :1609 scale up AND down during one rolling update with maxSurge=2.
+    Case(
+        "scale_up_and_down_mid_update",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=1, max_surge=2).build(),
+        [
+            Step(ready_all, dict(gs_replicas=4, ready=4)),
+            Step(update_image("v2"), dict(gs_replicas=6, partition=3, ready=3, updated=3,
+                                          updating=True)),
+            Step(set_replicas(6), dict(gs_replicas=8, partition=3, updated=5, updating=True)),
+            Step(ready_groups(7, 6), dict(partition=3, ready=5, updated=5, updating=True)),
+            Step(set_replicas(2), dict(gs_replicas=3, partition=1, ready=1, updated=2,
+                                       updating=True, pods=3)),
+            Step(
+                ready_groups(2, 1, 0),
+                dict(gs_replicas=2, ready=2, updated=2, available=True, updating=False, pods=2),
+            ),
+        ],
+    ),
+    # :1766 multiple rolling updates: a second template change mid-rollout
+    # restarts the update against the newest revision.
+    Case(
+        "second_update_mid_rollout",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=1, max_surge=2).build(),
+        [
+            Step(ready_all, dict(gs_replicas=4, ready=4)),
+            Step(update_image("v2"), dict(gs_replicas=6, partition=3, updated=3, updating=True)),
+            Step(ready_groups(5, 4), dict(partition=1, updated=5, updating=True)),
+            # Second template change mid-rollout: updated resets against the
+            # NEWEST revision; the intermediate v2 revision is retained until
+            # the rollout completes.
+            Step(update_image("v3"), dict(partition=4, updated=0, updating=True, revisions=3)),
+            Step(
+                ready_all,
+                dict(gs_replicas=4, partition=0, ready=4, updated=4, available=True,
+                     updating=False, revisions=1,
+                     images={0: "v3", 1: "v3", 2: "v3", 3: "v3"}),
+            ),
+        ],
+    ),
+    # :2132 unhealthy pod below the partition mid-update: the rollout still
+    # completes (an already-unavailable group consumes no budget).
+    Case(
+        "unhealthy_below_partition",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=2).build(),
+        [
+            Step(ready_all, dict(ready=4, updated=4, available=True)),
+            Step(group_not_ready(1), dict(ready=3, available=False)),
+            # The already-unavailable group 1 consumes one unit of the
+            # maxU=2 budget, so only one group tears down at first.
+            Step(update_image("v2"), dict(partition=3, ready=2, updated=1, updating=True)),
+            Step(
+                seq(ready_groups(3, 2), ready_groups(1, 0)),
+                dict(partition=0, ready=4, updated=4, available=True, updating=False),
+            ),
+        ],
+    ),
+    # :2312 partition staged rollout: only indices >= partition update, both
+    # revisions retained while staged; lowering partition completes it.
+    Case(
+        "partition_staged_then_released",
+        lambda: LWSBuilder().replicas(4).size(2).image("v1").rollout(max_unavailable=1, partition=2).build(),
+        [
+            Step(ready_all, dict(ready=4, updated=4)),
+            Step(update_image("v2"), dict(partition=3, ready=3, updated=1, updating=True)),
+            Step(
+                ready_groups(3, 2),
+                dict(partition=2, ready=4, updated=2, available=True, updating=False,
+                     revisions=2, images={0: "v1", 1: "v1", 2: "v2", 3: "v2"}),
+            ),
+            Step(set_partition(0), dict(partition=1, ready=3, updated=3, updating=True)),
+            Step(
+                ready_groups(1, 0),
+                dict(partition=0, ready=4, updated=4, available=True, updating=False, revisions=1,
+                     images={0: "v2", 1: "v2"}),
+            ),
+        ],
+    ),
+    # :128/:147 scale to zero and back up (outside an update).
+    Case(
+        "scale_to_zero_and_back",
+        lambda: LWSBuilder().replicas(3).size(2).image("v1").build(),
+        [
+            Step(ready_all, dict(gs_replicas=3, ready=3)),
+            Step(set_replicas(0), dict(gs_replicas=0, ready=0, pods=0)),
+            Step(seq(set_replicas(3), ready_groups(0, 1, 2)),
+                 dict(gs_replicas=3, ready=3, available=True, pods=3)),
+        ],
+    ),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c.name for c in CASES])
+def test_rolling_update_matrix(case: Case) -> None:
+    cp = ControlPlane()
+    cp.create(case.build())
+    cp.run_until_stable()
+    for i, step in enumerate(case.steps):
+        step.do(cp)
+        cp.run_until_stable()
+        check(cp, step.expect, f"{case.name} step {i}")
