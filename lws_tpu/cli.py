@@ -18,10 +18,15 @@ import urllib.request
 
 
 _TLS_CONTEXT = None  # set by main() from --cacert/--insecure
+_TOKEN = None  # set by main() from --token/--token-file/$LWS_TPU_TOKEN
 
 
 def _url_context(url: str):
     return _TLS_CONTEXT if url.startswith("https://") else None
+
+
+def _auth_headers() -> dict:
+    return {"Authorization": f"Bearer {_TOKEN}"} if _TOKEN else {}
 
 
 def _server_base(server: str) -> str:
@@ -33,7 +38,7 @@ def _server_base(server: str) -> str:
 
 def _http(server: str, method: str, path: str, body: bytes | None = None):
     url = f"{_server_base(server)}{path}"
-    req = urllib.request.Request(url, data=body, method=method)
+    req = urllib.request.Request(url, data=body, method=method, headers=_auth_headers())
     try:
         with urllib.request.urlopen(req, context=_url_context(url)) as resp:
             return json.loads(resp.read().decode())
@@ -59,11 +64,35 @@ def cmd_serve(args) -> int:
     from lws_tpu.core.serialize import load_store, save_store
 
     cfg = load_configuration(args.config) if args.config else Configuration()
+    if args.state_file and args.state_dir:
+        raise SystemExit("error: --state-file and --state-dir are exclusive")
     cp = ControlPlane(
         scheduler_provider=cfg.gang_scheduling_management.scheduler_provider,
         enable_scheduler=cfg.enable_scheduler,
         auto_ready=(cfg.backend == "fake"),
     )
+    state_dir = None
+    if args.state_dir:
+        from lws_tpu.core.wal import StateDir, StateLockedError
+
+        state_dir = StateDir(args.state_dir, fsync=not args.no_fsync)
+        try:
+            state_dir.acquire(wait=args.standby)
+        except StateLockedError as e:
+            raise SystemExit(
+                f"error: {e}\nhint: add --standby to wait as a hot spare "
+                "(takes over the instant the active process dies)"
+            ) from None
+        try:
+            n = state_dir.attach(cp.store)
+        except (ValueError, KeyError, TypeError) as e:
+            raise SystemExit(
+                f"error: state dir {args.state_dir} is corrupt ({e}); "
+                "move it aside to start fresh"
+            ) from None
+        print(f"restored {n} objects from {args.state_dir} "
+              "(WAL journaling on: every acknowledged write is durable)")
+        cp.resync()
     if args.state_file and os.path.exists(args.state_file):
         try:
             n = load_store(cp.store, args.state_file)
@@ -116,7 +145,14 @@ def cmd_serve(args) -> int:
         tls = CertManager(args.tls_dir)
         paths = tls.ensure()
         print(f"serving TLS; clients trust {paths.ca_cert}")
-    server = ApiServer(cp, port=args.port, tls=tls)
+    auth = None
+    if args.token_file:
+        from lws_tpu.core.auth import TokenAuth
+
+        auth = TokenAuth.load(args.token_file)
+        print(f"API authentication on ({len(auth.entries)} token(s) from "
+              f"{args.token_file}; /healthz and /readyz stay open)")
+    server = ApiServer(cp, port=args.port, tls=tls, auth=auth)
     dirty = {"flag": True}  # always persist once after boot
     if args.state_file:
         # Register BEFORE the manager threads start: the first burst of
@@ -140,6 +176,8 @@ def cmd_serve(args) -> int:
         server.stop()
         if args.state_file:
             save_store(cp.store, args.state_file)
+        if state_dir is not None:
+            state_dir.close()  # final compaction + lock release → instant failover
     return 0
 
 
@@ -181,7 +219,7 @@ def cmd_delete(args) -> int:
 
 def cmd_logs(args) -> int:
     url = f"{_server_base(args.server)}/logs/{args.namespace}/{args.name}"
-    req = urllib.request.Request(url)
+    req = urllib.request.Request(url, headers=_auth_headers())
     try:
         with urllib.request.urlopen(req, context=_url_context(url)) as resp:
             sys.stdout.write(resp.read().decode(errors="replace"))
@@ -224,6 +262,130 @@ def cmd_drain(args) -> int:
     return 0
 
 
+def cmd_install(args) -> int:
+    """Render a one-command deployable bundle (≈ ref charts/lws + config/
+    kustomize install tree + config/rbac): component config, TLS material,
+    API tokens, durable state dir, a systemd unit, and optional Kubernetes
+    manifests for clusters that host the control plane as a pod."""
+    import os
+    import stat
+
+    from lws_tpu.core.auth import write_bootstrap_tokens
+    from lws_tpu.core.certs import CertManager
+
+    root = os.path.abspath(args.dir)
+    os.makedirs(root, exist_ok=True)
+    state_dir = os.path.join(root, "state")
+    os.makedirs(state_dir, exist_ok=True)
+
+    token_path = os.path.join(root, "tokens.csv")
+    if os.path.exists(token_path):
+        # Re-rendering the bundle must NOT rotate credentials already handed
+        # to clients; delete tokens.csv explicitly to rotate.
+        from lws_tpu.core.auth import TokenAuth
+
+        tokens = {e.role: e.token for e in TokenAuth.load(token_path).entries}
+        print(f"preserved existing tokens at {token_path}")
+    else:
+        tokens = write_bootstrap_tokens(token_path)
+    paths = CertManager(os.path.join(root, "tls")).ensure()
+
+    with open(os.path.join(root, "config.yaml"), "w") as f:
+        f.write(
+            "# lws-tpu component config (strict-decoded; see lws_tpu/config.py)\n"
+            f"api:\n  port: {args.port}\n"
+            f"backend: {args.backend}\n"
+            "enableScheduler: true\n"
+            "gangSchedulingManagement:\n  schedulerProvider: gang\n"
+        )
+
+    serve_cmd = (
+        f"{args.python} -m lws_tpu serve --config {root}/config.yaml "
+        f"--port {args.port} --state-dir {state_dir} "
+        f"--tls-dir {root}/tls --token-file {root}/tokens.csv"
+    )
+    start = os.path.join(root, "start.sh")
+    with open(start, "w") as f:
+        f.write(f"#!/bin/sh\n# active control plane (add --standby on a hot spare)\nexec {serve_cmd} \"$@\"\n")
+    os.chmod(start, os.stat(start).st_mode | stat.S_IEXEC)
+
+    with open(os.path.join(root, "lws-tpu.service"), "w") as f:
+        f.write(
+            "[Unit]\n"
+            "Description=lws-tpu control plane\n"
+            "After=network-online.target\n\n"
+            "[Service]\n"
+            f"ExecStart={serve_cmd}\n"
+            "Restart=always\nRestartSec=2\n\n"
+            "[Install]\nWantedBy=multi-user.target\n"
+        )
+
+    k8s = os.path.join(root, "kubernetes")
+    os.makedirs(k8s, exist_ok=True)
+    with open(os.path.join(k8s, "deployment.yaml"), "w") as f:
+        f.write(
+            "# Hosted mode: run the control plane as a cluster workload\n"
+            "# (tokens/TLS mounted from the Secret; state on a PVC so the WAL\n"
+            "#  survives rescheduling). kubectl apply -f kubernetes/\n"
+            "apiVersion: apps/v1\nkind: Deployment\nmetadata:\n"
+            "  name: lws-tpu-controller\n  namespace: lws-tpu-system\n"
+            "spec:\n  replicas: 2  # active + --standby hot spare over the shared PVC\n"
+            "  selector:\n    matchLabels: {app: lws-tpu}\n"
+            "  template:\n    metadata:\n      labels: {app: lws-tpu}\n"
+            "    spec:\n      containers:\n      - name: controller\n"
+            "        image: lws-tpu:latest\n"
+            f"        args: [serve, --config, /etc/lws-tpu/config.yaml, --port, '{args.port}',\n"
+            "               --state-dir, /var/lib/lws-tpu, --tls-dir, /etc/lws-tpu/tls,\n"
+            "               --token-file, /etc/lws-tpu/tokens.csv, --standby]\n"
+            f"        ports: [{{containerPort: {args.port}}}]\n"
+            "        readinessProbe: {httpGet: {path: /readyz, port: "
+            f"{args.port}, scheme: HTTPS}}\n"
+            "        volumeMounts:\n"
+            "        - {name: config, mountPath: /etc/lws-tpu}\n"
+            "        - {name: state, mountPath: /var/lib/lws-tpu}\n"
+            "      volumes:\n"
+            "      - {name: config, secret: {secretName: lws-tpu-config}}\n"
+            "      - {name: state, persistentVolumeClaim: {claimName: lws-tpu-state}}\n"
+            "---\n"
+            "apiVersion: v1\nkind: Service\nmetadata:\n"
+            "  name: lws-tpu\n  namespace: lws-tpu-system\n"
+            "spec:\n  selector: {app: lws-tpu}\n"
+            f"  ports: [{{port: {args.port}, targetPort: {args.port}}}]\n"
+        )
+    with open(os.path.join(k8s, "README.md"), "w") as f:
+        f.write(
+            "Create the config Secret + state PVC, then apply:\n\n"
+            "    kubectl create namespace lws-tpu-system\n"
+            "    kubectl -n lws-tpu-system create secret generic lws-tpu-config \\\n"
+            "        --from-file=config.yaml=../config.yaml "
+            "--from-file=tokens.csv=../tokens.csv\n"
+            "    kubectl -n lws-tpu-system apply -f .\n"
+        )
+
+    with open(os.path.join(root, "README.md"), "w") as f:
+        f.write(
+            "# lws-tpu install bundle\n\n"
+            "Start the control plane (TLS + token auth + durable WAL state):\n\n"
+            f"    {start}\n\n"
+            "Hot-spare HA on the same host/filesystem:\n\n"
+            f"    {start} --standby\n\n"
+            "Client usage:\n\n"
+            f"    export LWS_TPU_TOKEN=$(head -2 {root}/tokens.csv | tail -1 | cut -d, -f1)\n"
+            f"    {args.python} -m lws_tpu --cacert {paths.ca_cert} get lws "
+            f"--server https://127.0.0.1:{args.port}\n\n"
+            "Files: config.yaml (component config), tokens.csv (admin+view\n"
+            "Bearer tokens, 0600), tls/ (auto-rotated self-signed CA+cert),\n"
+            "state/ (snapshot + write-ahead log), lws-tpu.service (systemd),\n"
+            "kubernetes/ (hosted-mode manifests).\n"
+        )
+
+    print(f"bundle rendered at {root}")
+    print(f"  start:       {start}")
+    print(f"  admin token: {tokens['admin'][:8]}… (full value in tokens.csv)")
+    print(f"  ca cert:     {paths.ca_cert}")
+    return 0
+
+
 def cmd_plan_steps(args) -> int:
     """≈ hack/plan-steps/main.go: print the DS rollout step table."""
     from lws_tpu.controllers.disagg.planner import (
@@ -259,6 +421,12 @@ def main(argv=None) -> int:
                    help="CA bundle to trust for https:// servers")
     p.add_argument("--insecure", action="store_true",
                    help="skip TLS verification for https:// servers")
+    p.add_argument("--token", default=None,
+                   help="Bearer token for an auth-enabled server "
+                        "(or set $LWS_TPU_TOKEN)")
+    p.add_argument("--client-token-file", default=None,
+                   help="read the Bearer token from this file (first token "
+                        "of an install-rendered tokens.csv works)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("serve", help="run the control plane + API server")
@@ -267,9 +435,24 @@ def main(argv=None) -> int:
     sp.add_argument("--port", type=int, default=9443)
     sp.add_argument("--state-file", default=None,
                     help="persist the object store here; restored on restart")
+    sp.add_argument("--state-dir", default=None,
+                    help="durable state directory (snapshot + write-ahead log; "
+                         "every acknowledged write survives kill -9). Holds an "
+                         "exclusive flock: run a second serve with --standby "
+                         "for hot-spare HA")
+    sp.add_argument("--standby", action="store_true",
+                    help="with --state-dir: if another process holds the state "
+                         "lock, wait for it to die instead of exiting, then "
+                         "take over with zero lost acknowledged writes")
+    sp.add_argument("--no-fsync", action="store_true",
+                    help="with --state-dir: skip per-write fsync (faster, but "
+                         "an OS crash may lose the tail of the journal)")
     sp.add_argument("--tls-dir", default=None,
                     help="serve HTTPS with an auto-generated, auto-rotated "
                          "self-signed cert kept in this directory")
+    sp.add_argument("--token-file", default=None,
+                    help="require Bearer-token auth on the API: CSV lines of "
+                         "<token>,<name>,<role> (role: admin|view)")
     sp.set_defaults(fn=cmd_serve)
 
     ap = sub.add_parser("apply")
@@ -316,6 +499,14 @@ def main(argv=None) -> int:
     dr.add_argument("--server", default="127.0.0.1:9443")
     dr.set_defaults(fn=cmd_drain)
 
+    ip = sub.add_parser("install", help="render a deployable bundle: config, "
+                        "TLS, API tokens, state dir, systemd unit, k8s manifests")
+    ip.add_argument("dir")
+    ip.add_argument("--port", type=int, default=9443)
+    ip.add_argument("--backend", default="local", choices=("local", "fake"))
+    ip.add_argument("--python", default=sys.executable)
+    ip.set_defaults(fn=cmd_install)
+
     pp = sub.add_parser("plan-steps", help="print a DisaggregatedSet rollout step table")
     pp.add_argument("--initial", required=True)
     pp.add_argument("--target", required=True)
@@ -330,10 +521,24 @@ def main(argv=None) -> int:
     ep.set_defaults(fn=cmd_events)
 
     args = p.parse_args(argv)
+    global _TOKEN
     if args.cacert or args.insecure:
         from lws_tpu.core.certs import client_context
 
         _TLS_CONTEXT = client_context(args.cacert)
+    import os
+
+    if args.token:
+        _TOKEN = args.token
+    elif args.client_token_file:
+        with open(args.client_token_file) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    _TOKEN = line.split(",")[0]
+                    break
+    elif os.environ.get("LWS_TPU_TOKEN"):
+        _TOKEN = os.environ["LWS_TPU_TOKEN"]
     try:
         return args.fn(args)
     except BrokenPipeError:

@@ -84,8 +84,9 @@ class RemoteClient:
     client-go/clientset/versioned). All methods raise ApiError on non-2xx."""
 
     def __init__(self, base_url: str, ca_cert: Optional[str] = None,
-                 insecure: bool = False) -> None:
+                 insecure: bool = False, token: Optional[str] = None) -> None:
         self.base_url = base_url.rstrip("/")
+        self.token = token
         # https trust: explicit CA bundle > explicit insecure > system store.
         # (No flag must NEVER silently mean "no verification".)
         self._context = None
@@ -101,11 +102,14 @@ class RemoteClient:
 
         from lws_tpu.version import user_agent
 
+        headers = {"User-Agent": user_agent()}  # ref useragent.go:36
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
             self.base_url + path,
             data=body,
             method=method,
-            headers={"User-Agent": user_agent()},  # ref useragent.go:36
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, context=self._context) as resp:

@@ -112,6 +112,10 @@ class Store:
         # list by owner labels constantly (pods of an LWS, role members of a
         # DS); without this every such list is a full scan of the kind.
         self._label_index: dict[tuple[str, str, str], set[Key]] = {}
+        # Controller-owner index: owner uid -> dependent keys. owned_by() and
+        # delete-cascade were full-store scans; at fleet scale (512+ pods)
+        # those scans — each cloning every object — dominated convergence.
+        self._owner_index: dict[str, set[Key]] = {}
         # Per-kind mutation counter: lets read-heavy consumers (scheduler)
         # cache derived views and invalidate them precisely.
         self._kind_version: dict[str, int] = {}
@@ -133,6 +137,12 @@ class Store:
         # kind -> list of hooks, run inside create/update before storing.
         self._mutators: dict[str, list[Callable[[TypedObject, Optional[TypedObject]], None]]] = {}
         self._validators: dict[str, list[Callable[[TypedObject, Optional[TypedObject]], None]]] = {}
+        # Write-ahead journal hook (core.wal.StateDir). Called under _lock
+        # with ("create"|"update"|"delete", committed object) BEFORE the
+        # mutation becomes visible: if the journal append raises (disk full,
+        # I/O error), the write fails un-acknowledged and memory is unchanged
+        # — durability of every acknowledged write is the WAL contract.
+        self._journal: Optional[Callable[[str, TypedObject], None]] = None
 
     # ---- admission registration -------------------------------------------
     def register_mutator(self, kind: str, fn) -> None:
@@ -142,13 +152,32 @@ class Store:
         self._validators.setdefault(kind, []).append(fn)
 
     def _restore_object(self, obj: TypedObject) -> None:
-        """Snapshot restore: place an already-admitted object verbatim
-        (no admission, no events), maintaining all indexes."""
+        """Snapshot/WAL restore: place an already-admitted object verbatim
+        (no admission, no events), maintaining all indexes. WAL replay of an
+        'update' record re-restores over an existing key — the previous
+        version's label/owner index entries must not survive it (a stale
+        owner entry would feed the delete cascade after failover)."""
         key = obj.key()
+        prev = self._objects.get(key)
+        if prev is not None:
+            self._unindex_labels(key, prev)
+            self._unindex_owners(key, prev)
         self._objects[key] = obj
         self._by_kind.setdefault(key[0], {})[key] = obj
         self._index_labels(key, obj)
+        self._index_owners(key, obj)
         self._bump_kind(key[0])  # invalidate kind_version-keyed caches
+
+    def _forget_object(self, key: Key) -> None:
+        """WAL-replay counterpart of _restore_object: remove an object
+        verbatim (no admission, no cascade, no events) — the journal already
+        carries one record per cascaded deletion."""
+        obj = self._objects.pop(key, None)
+        if obj is not None:
+            self._by_kind.get(key[0], {}).pop(key, None)
+            self._unindex_labels(key, obj)
+            self._unindex_owners(key, obj)
+            self._bump_kind(key[0])
 
     def kind_version(self, kind: str) -> int:
         """Monotonic counter bumped on every create/update/delete of `kind`
@@ -170,6 +199,20 @@ class Store:
                 bucket.discard(key)
                 if not bucket:
                     del self._label_index[(key[0], lk, lv)]
+
+    def _index_owners(self, key: Key, obj: TypedObject) -> None:
+        for ref in obj.meta.owner_references:
+            if ref.controller:
+                self._owner_index.setdefault(ref.uid, set()).add(key)
+
+    def _unindex_owners(self, key: Key, obj: TypedObject) -> None:
+        for ref in obj.meta.owner_references:
+            if ref.controller:
+                bucket = self._owner_index.get(ref.uid)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._owner_index[ref.uid]
 
     def watch(self, fn: Callable[[WatchEvent], None]) -> Callable[[], None]:
         """Subscribe to all mutations; returns an unsubscribe handle."""
@@ -250,9 +293,12 @@ class Store:
                 obj.meta.resource_version = next(self._rv)
                 obj.meta.generation = 1
                 obj.meta.creation_timestamp = time.time()
+                if self._journal is not None:
+                    self._journal("create", obj)
                 self._objects[key] = obj
                 self._by_kind.setdefault(key[0], {})[key] = obj
                 self._index_labels(key, obj)
+                self._index_owners(key, obj)
                 self._bump_kind(key[0])
                 stored = _clone(obj)
                 self._pending_events.append(WatchEvent("ADDED", _clone(stored)))
@@ -308,10 +354,14 @@ class Store:
                 if self._spec_changed(current, obj):
                     obj.meta.generation += 1
             obj.meta.resource_version = next(self._rv)
+            if self._journal is not None:
+                self._journal("update", obj)
             self._unindex_labels(key, current)
+            self._unindex_owners(key, current)
             self._objects[key] = obj
             self._by_kind.setdefault(key[0], {})[key] = obj
             self._index_labels(key, obj)
+            self._index_owners(key, obj)
             self._bump_kind(key[0])
             stored = _clone(obj)
             self._pending_events.append(WatchEvent("MODIFIED", _clone(stored)))
@@ -332,19 +382,22 @@ class Store:
             self._drain_events()  # see create(): drain even on rejection
 
     def _delete_locked(self, key: Key, events: list[WatchEvent]) -> None:
-        obj = self._objects.pop(key, None)
-        self._by_kind.get(key[0], {}).pop(key, None)
-        if obj is not None:
-            self._unindex_labels(key, obj)
-            self._bump_kind(key[0])
+        obj = self._objects.get(key)
         if obj is None:
             return
-        # Cascade: anything whose controller owner is this object.
+        if self._journal is not None:
+            self._journal("delete", obj)
+        self._objects.pop(key)
+        self._by_kind.get(key[0], {}).pop(key, None)
+        self._unindex_labels(key, obj)
+        self._unindex_owners(key, obj)
+        self._bump_kind(key[0])
+        # Cascade: anything whose controller owner is this object (same
+        # namespace, as before — cross-namespace ownership is not a thing).
         dependents = [
             k
-            for k, dep in self._objects.items()
+            for k in sorted(self._owner_index.get(obj.meta.uid, ()))
             if k[1] == key[1]
-            and any(ref.uid == obj.meta.uid and ref.controller for ref in dep.meta.owner_references)
         ]
         for dep_key in dependents:
             self._delete_locked(dep_key, events)
@@ -396,11 +449,14 @@ class Store:
 
     # ---- convenience -------------------------------------------------------
     def owned_by(self, kind: str, namespace: str, owner_uid: str) -> list[TypedObject]:
-        return [
-            o
-            for o in self.list(kind, namespace)
-            if any(r.uid == owner_uid and r.controller for r in o.meta.owner_references)
-        ]
+        with self._lock:
+            out = [
+                _clone(self._objects[k])
+                for k in self._owner_index.get(owner_uid, ())
+                if k[0] == kind and k[1] == namespace and k in self._objects
+            ]
+        out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
+        return out
 
 
 def owner_ref(obj: TypedObject) -> "OwnerReference":

@@ -197,16 +197,22 @@ class ControlPlane:
         )
 
         if enable_scheduler:
-            def unbound_pods(obj) -> list[Key]:
-                return [p.key() for p in store.list("Pod") if not p.spec.node_name]
-
+            # The scheduler subscribes its own store watch for its incremental
+            # pod indexes (binding state, gang membership, pending set).
             self.scheduler = Scheduler(self.store, self.recorder)
+
+            def pending_work(obj) -> list[Key]:
+                # Node added/uncordoned or PodGroup created: requeue one
+                # representative per waiting gang + waiting solo pods
+                # (was: every unbound pod — O(pods) keys per event).
+                return self.scheduler.pending_representatives()
+
             self.manager.register(
                 self.scheduler,
                 {
                     "Pod": lambda o: [o.key()],
-                    "Node": unbound_pods,
-                    "PodGroup": unbound_pods,
+                    "Node": pending_work,
+                    "PodGroup": pending_work,
                 },
             )
             from lws_tpu.controllers.node_monitor import NodeMonitor
@@ -240,6 +246,8 @@ class ControlPlane:
         """Cold-start cache resync: enqueue every stored object to every
         watching controller — required when standing up a fresh control plane
         over pre-existing state (level-triggered restart semantics)."""
+        if getattr(self, "scheduler", None) is not None:
+            self.scheduler.rebuild_from_store()
         self.manager.resync()
 
     def add_nodes(self, nodes: list[Node]) -> None:

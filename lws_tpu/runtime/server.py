@@ -95,15 +95,20 @@ class ApiServer:
         host: str = "127.0.0.1",
         tls=None,
         watch_buffer: int = 4096,
+        auth=None,
     ) -> None:
         """`tls`: an optional lws_tpu.core.certs.CertManager; when given the
         server speaks HTTPS with its (auto-generated, auto-rotated) cert.
         `watch_buffer`: events retained for /watch replay; clients that fall
-        further behind are told to relist (k8s "410 Gone" semantics)."""
+        further behind are told to relist (k8s "410 Gone" semantics).
+        `auth`: an optional lws_tpu.core.auth.TokenAuth; when given every
+        endpoint except /healthz//readyz requires a Bearer token (ref gates
+        metrics behind authn/authz filters, cmd/main.go:336-348)."""
         import collections
 
         self.control_plane = control_plane
         self.tls = tls
+        self.auth = auth
         cp = control_plane
 
         # Watch plumbing (≈ the apiserver's watch cache): every store event
@@ -143,7 +148,25 @@ class ApiServer:
             def _json(self, code: int, obj):
                 self._send(code, json.dumps(obj, indent=1, default=str))
 
+            def _authorized(self) -> bool:
+                if auth is None:
+                    return True
+                from lws_tpu.core.auth import OPEN_PATHS
+
+                if self.path.split("?", 1)[0] in OPEN_PATHS:
+                    return True
+                entry = auth.authenticate(self.headers.get("Authorization"))
+                if entry is None:
+                    self._json(401, {"error": "unauthorized: missing or invalid bearer token"})
+                    return False
+                if not auth.authorize(entry, self.command):
+                    self._json(403, {"error": f"forbidden: role {entry.role!r} may not {self.command}"})
+                    return False
+                return True
+
             def do_GET(self):
+                if not self._authorized():
+                    return
                 path = self.path.split("?", 1)[0]
                 parts = [p for p in path.split("/") if p]
                 if self.path in ("/healthz", "/readyz"):
@@ -227,6 +250,8 @@ class ApiServer:
                     self._json(404, {"error": "unknown path"})
 
             def do_DELETE(self):
+                if not self._authorized():
+                    return
                 path = self.path.split("?", 1)[0]
                 parts = [p for p in path.split("/") if p]
                 if len(parts) == 4 and parts[0] == "apis":
@@ -240,6 +265,8 @@ class ApiServer:
                     self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
+                if not self._authorized():
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length).decode()
                 path = self.path.split("?", 1)[0]

@@ -13,11 +13,12 @@ Native replacement for kube-scheduler + Volcano gang admission. Honors:
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from lws_tpu.api import contract
 from lws_tpu.api.node import Node
-from lws_tpu.api.pod import Pod
+from lws_tpu.api.pod import Pod, PodPhase
 from lws_tpu.core.events import EventRecorder
 from lws_tpu.core.manager import Result
 from lws_tpu.core.store import Key, Store
@@ -29,12 +30,111 @@ class Scheduler:
     def __init__(self, store: Store, recorder: EventRecorder) -> None:
         self.store = store
         self.recorder = recorder
+        # Incremental pod indexes, maintained from the store watch (which
+        # carries event types, so deletions purge exactly — events are
+        # delivered in commit order):
+        #   _pending:  key -> gang name (None = solo); consumed by
+        #              pending_representatives() so a capacity event requeues
+        #              ONE key per waiting gang instead of every unbound pod
+        #              (the O(pods) fan-out that collapsed at fleet scale).
+        #   _bound:    key -> Pod for node-bound pods; replaces the full
+        #              store list that re-ran after every single bind.
+        #   _by_gang:  (ns, gang) -> {key: Pod} membership.
+        # A scheduler stood up over PRE-EXISTING state (restart/restore) must
+        # have rebuild_from_store() called — ControlPlane.resync() does.
+        self._pending: dict[Key, Optional[str]] = {}
+        self._bound: dict[Key, Pod] = {}
+        self._by_gang: dict[tuple[str, str], dict[Key, Pod]] = {}
+        self._gang_of: dict[Key, str] = {}  # reverse map for O(1) moves/purges
+        self._pending_lock = threading.Lock()
+        store.watch(self._observe)
+
+    # ---- incremental pod indexes (fleet-scale event fan-out) ---------------
+    def _observe(self, event) -> None:
+        if not isinstance(event.obj, Pod):
+            return
+        if event.type == "DELETED":
+            self._forget_pending(event.obj.key())
+        else:
+            self.note_pod(event.obj)
+
+    def rebuild_from_store(self) -> None:
+        """Seed the indexes from current store state (cold start over a
+        restored store — the watch never saw those objects)."""
+        with self._pending_lock:
+            self._pending.clear()
+            self._bound.clear()
+            self._by_gang.clear()
+            self._gang_of.clear()
+        for pod in self.store.list("Pod"):
+            self.note_pod(pod)
+
+    def note_pod(self, pod) -> None:
+        """Track binding state + gang membership for one observed pod."""
+        if not isinstance(pod, Pod):
+            return
+        key = pod.key()
+        gang = pod.meta.annotations.get(contract.POD_GROUP_ANNOTATION_KEY)
+        with self._pending_lock:
+            prev_gang = self._gang_of.get(key)
+            if prev_gang is not None and prev_gang != gang:
+                # Annotation changed/removed: leave the old gang's bucket so
+                # its joint assignment never binds an ex-member.
+                self._drop_from_gang_locked(key, prev_gang)
+            if gang:
+                self._by_gang.setdefault((key[1], gang), {})[key] = pod
+                self._gang_of[key] = gang
+            if pod.spec.node_name:
+                self._pending.pop(key, None)
+                self._bound[key] = pod
+            else:
+                self._bound.pop(key, None)
+                if pod.status.phase == PodPhase.PENDING:
+                    self._pending[key] = gang
+                else:
+                    self._pending.pop(key, None)
+
+    def pending_representatives(self) -> list[Key]:
+        """One key per waiting gang + every waiting solo pod: what a capacity
+        event (Node added/uncordoned, PodGroup created) needs to requeue."""
+        with self._pending_lock:
+            reps: dict[tuple[str, str], Key] = {}
+            solos: list[Key] = []
+            for key, gang in self._pending.items():
+                if gang is None:
+                    solos.append(key)
+                else:
+                    prev = reps.get((key[1], gang))
+                    if prev is None or key < prev:
+                        reps[(key[1], gang)] = key
+            return solos + sorted(reps.values())
+
+    def _drop_from_gang_locked(self, key: Key, gang: str) -> None:
+        members = self._by_gang.get((key[1], gang))
+        if members is not None:
+            members.pop(key, None)
+            if not members:
+                del self._by_gang[(key[1], gang)]
+        self._gang_of.pop(key, None)
+
+    def _forget_pending(self, *keys: Key) -> None:
+        """Drop deleted pods from every index."""
+        with self._pending_lock:
+            for key in keys:
+                self._pending.pop(key, None)
+                self._bound.pop(key, None)
+                gang = self._gang_of.get(key)
+                if gang is not None:
+                    self._drop_from_gang_locked(key, gang)
 
     # ---- reconcile ---------------------------------------------------------
     def reconcile(self, key: Key) -> Result | None:
         pod = self.store.try_get("Pod", key[1], key[2])
-        if pod is None or not isinstance(pod, Pod) or pod.spec.node_name:
+        if pod is None or not isinstance(pod, Pod):
+            self._forget_pending(key)  # belt-and-braces; _observe purges live
             return None
+        if pod.spec.node_name:
+            return None  # already bound (note_pod keeps the indexes current)
 
         gang_name = pod.meta.annotations.get(contract.POD_GROUP_ANNOTATION_KEY)
         if gang_name:
@@ -54,11 +154,7 @@ class Scheduler:
         group = self.store.try_get("PodGroup", namespace, gang_name)
         if group is None:
             return  # wait for the PodGroup; its creation event retriggers us
-        members = [
-            p
-            for p in self.store.list("Pod", namespace)
-            if p.meta.annotations.get(contract.POD_GROUP_ANNOTATION_KEY) == gang_name
-        ]
+        members = self._gang_members(namespace, gang_name)
         pending = [p for p in members if not p.spec.node_name]
         min_member = group.spec.min_member
         if not pending:
@@ -131,8 +227,18 @@ class Scheduler:
             if topology_key and domain == "":
                 continue
             domains.setdefault(domain, []).append(n)
+        used_by_node: dict[str, int] = {}
+        for p in bound:
+            if p.spec.node_name:
+                used_by_node[p.spec.node_name] = (
+                    used_by_node.get(p.spec.node_name, 0) + p.spec.effective_tpu_chips()
+                )
         for _, domain_nodes in sorted(domains.items()):
-            free = sum(self._free_chips(n, bound, {}) for n in domain_nodes)
+            free = sum(
+                n.spec.capacity.get(contract.TPU_RESOURCE_NAME, 0)
+                - used_by_node.get(n.meta.name, 0)
+                for n in domain_nodes
+            )
             if free >= need:
                 return {n.meta.name for n in domain_nodes}
         return None
@@ -155,16 +261,14 @@ class Scheduler:
         self._node_cache = (version, nodes)
         return nodes
 
-    def _bound_pods(self, namespace: str) -> list[Pod]:
-        return [p for p in self.store.list("Pod", namespace) if p.spec.node_name]
+    def _gang_members(self, namespace: str, gang_name: str) -> list[Pod]:
+        with self._pending_lock:
+            members = self._by_gang.get((namespace, gang_name), {})
+            return sorted(members.values(), key=lambda p: p.meta.name)
 
-    def _free_chips(self, node: Node, bound: list[Pod], extra: dict[str, Pod]) -> int:
-        used = sum(
-            p.spec.effective_tpu_chips()
-            for p in list(bound) + list(extra.values())
-            if p.spec.node_name == node.meta.name
-        )
-        return node.spec.capacity.get(contract.TPU_RESOURCE_NAME, 0) - used
+    def _bound_pods(self, namespace: str) -> list[Pod]:
+        with self._pending_lock:
+            return [p for k, p in self._bound.items() if k[1] == namespace]
 
     def _feasible_node(
         self,
@@ -182,60 +286,92 @@ class Scheduler:
             n = node_by_name.get(p.spec.node_name)
             return None if n is None else n.meta.labels.get(topology_key)
 
-        candidates = []
-        for node in nodes:
-            if any(node.meta.labels.get(k) != v for k, v in pod.spec.node_selector.items()):
-                continue
-            chips = pod.spec.effective_tpu_chips()
-            if chips > 0 and self._free_chips(node, bound, extra_assigned) < chips:
-                continue
-            if not self._affinity_ok(pod, node, all_pods, domain_of):
-                continue
-            candidates.append(node)
-        if not candidates:
-            return None
-        # Deterministic bin-packing: prefer slices already hosting peers of the
-        # same group key, then stable order.
-        group_key = pod.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY)
-
-        def score(node: Node) -> tuple:
-            slice_id = node.meta.labels.get(contract.NODE_TPU_SLICE_LABEL, "")
-            peers = sum(
-                1
-                for p in all_pods
-                if group_key
-                and p.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY) == group_key
-                and domain_of(p, contract.NODE_TPU_SLICE_LABEL) == slice_id
-            )
-            return (-peers, slice_id, node.meta.name)
-
-        return sorted(candidates, key=score)[0]
-
-    def _affinity_ok(self, pod: Pod, node: Node, all_pods: list[Pod], domain_of) -> bool:
-        aff = pod.spec.affinity
-        if aff is None:
-            return True
-        for term in aff.required_affinity:
-            node_domain = node.meta.labels.get(term.topology_key)
-            if node_domain is None:
-                return False
-            matching = [p for p in all_pods if term.selector_matches(p.meta.labels)]
-            if not matching:
-                # Self-affinity bootstrap: first pod of the group may open a
-                # new domain (kube-scheduler's special case).
-                if term.selector_matches(pod.meta.labels):
-                    continue
-                return False
-            if not any(domain_of(p, term.topology_key) == node_domain for p in matching):
-                return False
-        for term in aff.required_anti_affinity:
-            node_domain = node.meta.labels.get(term.topology_key)
-            if node_domain is None:
-                continue
+        # Everything per-pod is hoisted OUT of the per-node loop: chip usage
+        # per node, the domain sets each affinity term matches, and the
+        # same-group peer count per slice. The loop body is then O(1) per
+        # node instead of O(bound pods).
+        chips_needed = pod.spec.effective_tpu_chips()
+        used_by_node: dict[str, int] = {}
+        if chips_needed > 0:
             for p in all_pods:
-                if term.selector_matches(p.meta.labels) and domain_of(p, term.topology_key) == node_domain:
-                    return False
-        return True
+                if p.spec.node_name:
+                    used_by_node[p.spec.node_name] = (
+                        used_by_node.get(p.spec.node_name, 0)
+                        + p.spec.effective_tpu_chips()
+                    )
+
+        aff = pod.spec.affinity
+        # (topology_key, domains): node must carry the key AND, when domains
+        # is non-None, sit in one of them. domains=None = self-affinity
+        # bootstrap (first pod of the group may open any labeled domain —
+        # kube-scheduler's special case; an UNlabeled node stays ineligible,
+        # else peers would inherit an unschedulable None-domain).
+        aff_domains: list[tuple[str, Optional[set]]] = []
+        anti_domains: list[tuple[str, set]] = []
+        if aff is not None:
+            for term in aff.required_affinity:
+                matching = [p for p in all_pods if term.selector_matches(p.meta.labels)]
+                if not matching:
+                    if term.selector_matches(pod.meta.labels):
+                        aff_domains.append((term.topology_key, None))
+                        continue
+                    return None  # nothing can satisfy this term
+                aff_domains.append(
+                    (term.topology_key,
+                     {domain_of(p, term.topology_key) for p in matching})
+                )
+            for term in aff.required_anti_affinity:
+                domains = {
+                    domain_of(p, term.topology_key)
+                    for p in all_pods
+                    if term.selector_matches(p.meta.labels)
+                }
+                domains.discard(None)
+                if domains:
+                    anti_domains.append((term.topology_key, domains))
+
+        group_key = pod.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY)
+        peers_by_slice: dict[str, int] = {}
+        if group_key:
+            for p in all_pods:
+                if p.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY) == group_key:
+                    slice_id = domain_of(p, contract.NODE_TPU_SLICE_LABEL)
+                    if slice_id is not None:
+                        peers_by_slice[slice_id] = peers_by_slice.get(slice_id, 0) + 1
+
+        best = None
+        best_score = None
+        for node in nodes:
+            labels = node.meta.labels
+            if any(labels.get(k) != v for k, v in pod.spec.node_selector.items()):
+                continue
+            if chips_needed > 0:
+                free = node.spec.capacity.get(contract.TPU_RESOURCE_NAME, 0) - used_by_node.get(
+                    node.meta.name, 0
+                )
+                if free < chips_needed:
+                    continue
+            ok = True
+            for topology_key, domains in aff_domains:
+                node_domain = labels.get(topology_key)
+                if node_domain is None or (domains is not None and node_domain not in domains):
+                    ok = False
+                    break
+            if ok:
+                for topology_key, domains in anti_domains:
+                    node_domain = labels.get(topology_key)
+                    if node_domain is not None and node_domain in domains:
+                        ok = False
+                        break
+            if not ok:
+                continue
+            # Deterministic bin-packing: prefer slices already hosting peers
+            # of the same group key, then stable order.
+            slice_id = labels.get(contract.NODE_TPU_SLICE_LABEL, "")
+            score = (-peers_by_slice.get(slice_id, 0), slice_id, node.meta.name)
+            if best_score is None or score < best_score:
+                best, best_score = node, score
+        return best
 
     # ---- binding -----------------------------------------------------------
     def _bind(self, pod: Pod, node: Optional[Node] = None, node_name: str = "") -> None:

@@ -1,0 +1,288 @@
+"""Durable store: write-ahead log, torn-tail recovery, compaction, and
+flock-arbitrated multi-process HA (VERDICT #5; ref anchor: etcd-backed
+apiserver durability + cmd/main.go:186 leader election)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lws_tpu.api.pod import Pod
+from lws_tpu.core.serialize import snapshot_store
+from lws_tpu.core.store import Store, new_meta
+from lws_tpu.core.wal import (
+    CorruptWalError,
+    StateDir,
+    StateLockedError,
+    replay_wal,
+)
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder
+from tests.test_rolling_update import image_of, settle_and_make_ready, update_image
+
+
+def crash(sd: StateDir) -> None:
+    """Simulate kill -9: no final snapshot, no clean close — just the
+    kernel-side effects (flock released; fsync'd WAL bytes on disk)."""
+    if sd._store is not None:
+        sd._store._journal = None
+        sd._store = None
+    os.close(sd._lock_fd)
+    sd._lock_fd = None
+
+
+def fresh_attached(tmp_path, **kw):
+    store = Store()
+    sd = StateDir(str(tmp_path), **kw)
+    sd.acquire()
+    n = sd.attach(store)
+    return store, sd, n
+
+
+def test_acknowledged_writes_survive_crash(tmp_path):
+    store, sd, _ = fresh_attached(tmp_path)
+    for i in range(10):
+        store.create(Pod(meta=new_meta(f"p{i}")))
+    p3 = store.get("Pod", "default", "p3")
+    p3.status.message = "updated"
+    store.update_status(p3)
+    store.delete("Pod", "default", "p7")
+    expected = snapshot_store(store)
+    crash(sd)
+
+    store2, sd2, n = fresh_attached(tmp_path)
+    assert n == 9
+    assert snapshot_store(store2) == expected
+    # rv counter resumed past everything: new writes version above old ones.
+    old_rv = store2.get("Pod", "default", "p3").meta.resource_version
+    created = store2.create(Pod(meta=new_meta("p-new")))
+    assert created.meta.resource_version > old_rv
+    sd2.close()
+
+
+def test_delete_cascade_is_journaled_per_object(tmp_path):
+    """Owner-cascade deletes must replay correctly: one WAL record per
+    cascaded object (replay applies records verbatim, no re-cascade)."""
+    from lws_tpu.core.store import owner_ref
+
+    store, sd, _ = fresh_attached(tmp_path)
+    parent = store.create(Pod(meta=new_meta("leader")))
+    child_meta = new_meta("worker")
+    child_meta.owner_references = [owner_ref(parent)]
+    store.create(Pod(meta=child_meta))
+    store.delete("Pod", "default", "leader")
+    crash(sd)
+
+    records = replay_wal(os.path.join(str(tmp_path), "wal.jsonl"))
+    deletes = [r for r in records if r["op"] == "delete"]
+    assert {d["name"] for d in deletes} == {"leader", "worker"}
+    store2, sd2, _ = fresh_attached(tmp_path)
+    assert store2.list("Pod") == []
+    sd2.close()
+
+
+def test_torn_wal_tail_is_discarded(tmp_path):
+    store, sd, _ = fresh_attached(tmp_path)
+    store.create(Pod(meta=new_meta("whole")))
+    crash(sd)
+    with open(tmp_path / "wal.jsonl", "a") as f:
+        f.write('{"op": "create", "kind": "Pod", "obj": {"meta": {"na')  # torn
+
+    store2, sd2, _ = fresh_attached(tmp_path)
+    assert [p.meta.name for p in store2.list("Pod")] == ["whole"]
+    sd2.close()
+
+
+def test_corrupt_mid_wal_refuses_partial_replay(tmp_path):
+    store, sd, _ = fresh_attached(tmp_path)
+    store.create(Pod(meta=new_meta("a")))
+    store.create(Pod(meta=new_meta("b")))
+    crash(sd)
+    lines = (tmp_path / "wal.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+    lines[0] = lines[0][:20]  # corrupt a NON-final record
+    (tmp_path / "wal.jsonl").write_text("\n".join(lines) + "\n")
+
+    sd2 = StateDir(str(tmp_path))
+    sd2.acquire()
+    with pytest.raises(CorruptWalError):
+        sd2.attach(Store())
+    sd2.close(final_snapshot=False)
+
+
+def test_compaction_resets_wal_and_preserves_state(tmp_path):
+    store, sd, _ = fresh_attached(tmp_path, compact_records=5)
+    for i in range(23):
+        store.create(Pod(meta=new_meta(f"p{i}")))
+    # Thresholded compaction ran; the journal stays bounded.
+    assert sd._wal_records <= 5
+    expected = snapshot_store(store)
+    crash(sd)
+    store2, sd2, _ = fresh_attached(tmp_path)
+    assert snapshot_store(store2) == expected
+    sd2.close()
+
+
+def test_pending_write_survives_threshold_compaction(tmp_path):
+    """The write whose journal append crosses the threshold is not yet in the
+    store maps when the snapshot is cut; its record must land in the fresh
+    WAL or it would vanish."""
+    store, sd, _ = fresh_attached(tmp_path, compact_records=3)
+    for i in range(3):  # third append triggers compaction mid-write
+        store.create(Pod(meta=new_meta(f"p{i}")))
+    crash(sd)
+    store2, sd2, _ = fresh_attached(tmp_path)
+    assert len(store2.list("Pod")) == 3
+    sd2.close()
+
+
+def test_flock_arbitration(tmp_path):
+    _, sd, _ = fresh_attached(tmp_path)
+    other = StateDir(str(tmp_path))
+    assert other.locked_by_other()
+    with pytest.raises(StateLockedError):
+        other.acquire()
+    crash(sd)
+    assert not other.locked_by_other()
+    other.acquire()
+    other.close(final_snapshot=False)
+
+
+def test_failover_resumes_rolling_update(tmp_path):
+    """Active control plane dies (kill -9 equivalent) mid-rolling-update;
+    the successor restores from snapshot+WAL and completes the update —
+    the reference gets the same from etcd (SURVEY §5 checkpoint/resume)."""
+    cp = ControlPlane()
+    sd = StateDir(str(tmp_path))
+    sd.acquire()
+    sd.attach(cp.store)
+    cp.create(LWSBuilder().replicas(3).size(2).image("img:v1").build())
+    settle_and_make_ready(cp)
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()  # mid-rollout
+    crash(sd)
+
+    cp2 = ControlPlane()
+    sd2 = StateDir(str(tmp_path))
+    sd2.acquire()
+    sd2.attach(cp2.store)
+    cp2.resync()
+    settle_and_make_ready(cp2)
+    for i in range(3):
+        assert image_of(cp2, f"sample-{i}") == "img:v2"
+    assert cp2.store.get("LeaderWorkerSet", "default", "sample").status.updated_replicas == 3
+    sd2.close()
+
+
+# ---------------------------------------------------------------------------
+# Real-process HA: kill -9 the active serve; standby takes over.
+# ---------------------------------------------------------------------------
+
+LWS_YAML = """\
+apiVersion: leaderworkerset.x-k8s.io/v1
+kind: LeaderWorkerSet
+metadata:
+  name: ha-demo
+spec:
+  replicas: 2
+  leaderWorkerTemplate:
+    size: 2
+"""
+
+
+def _start_serve(state_dir, extra=()):
+    return subprocess.Popen(
+        [sys.executable, "-m", "lws_tpu", "serve", "--port", "0",
+         "--state-dir", str(state_dir), *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+def _wait_for_port(proc, deadline=60):
+    """Parse 'serving on http://127.0.0.1:PORT' from serve stdout."""
+    end = time.time() + deadline
+    port = None
+    while time.time() < end:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise AssertionError(f"serve exited rc={proc.returncode}")
+            time.sleep(0.05)
+            continue
+        if "serving on" in line:
+            port = int(line.rsplit(":", 1)[1].split()[0].strip("/"))
+            return port
+    raise AssertionError("serve did not report its port in time")
+
+
+def _http(port, method, path, body=None):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method=method
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+@pytest.mark.slow
+def test_kill9_failover_between_real_processes(tmp_path):
+    state = tmp_path / "state"
+    active = _start_serve(state)
+    standby = None
+    try:
+        port_a = _wait_for_port(active)
+        applied = _http(port_a, "POST", "/apply", LWS_YAML.encode())
+        assert applied["applied"] == ["LeaderWorkerSet/ha-demo"]
+
+        # Hot spare: blocks on the flock until the active dies.
+        standby = _start_serve(state, extra=("--standby",))
+        time.sleep(1.0)  # standby reaches the flock wait
+        assert standby.poll() is None
+
+        os.kill(active.pid, signal.SIGKILL)  # no goodbye, no final snapshot
+        port_b = _wait_for_port(standby, deadline=90)
+
+        objs = _http(port_b, "GET", "/apis/lws")
+        assert [o["metadata"]["name"] for o in objs] == ["ha-demo"]
+        # The acknowledged write survived AND the control plane is live:
+        # reconcilers on the successor materialized the group pods.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            pods = _http(port_b, "GET", "/apis/pods")
+            if len(pods) >= 2:
+                break
+            time.sleep(0.5)
+        assert len(pods) >= 2
+    finally:
+        for proc in (active, standby):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+def test_replayed_update_refreshes_owner_index(tmp_path):
+    """An update that drops a controller ownerReference, replayed from the
+    WAL, must not leave a stale owner-index entry — or deleting the former
+    owner after failover would cascade-delete the deliberately orphaned
+    object."""
+    from lws_tpu.core.store import owner_ref
+
+    store, sd, _ = fresh_attached(tmp_path)
+    parent = store.create(Pod(meta=new_meta("boss")))
+    child_meta = new_meta("kid")
+    child_meta.owner_references = [owner_ref(parent)]
+    child = store.create(Pod(meta=child_meta))
+    child.meta.owner_references = []  # deliberate orphaning
+    store.update(child)
+    crash(sd)
+
+    store2, sd2, _ = fresh_attached(tmp_path)
+    store2.delete("Pod", "default", "boss")
+    assert store2.try_get("Pod", "default", "kid") is not None
+    sd2.close()
