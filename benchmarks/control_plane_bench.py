@@ -37,6 +37,15 @@ except ImportError:
         [sys.executable, os.path.join(_ROOT, "native", "build.py")],
         check=False, capture_output=True,
     )
+    try:
+        from lws_tpu.core import _fastclone  # noqa: F401
+    except ImportError:
+        print(
+            "WARNING: native _fastclone unavailable (build failed?); numbers "
+            "below run the pure-Python clone path, ~10x slower than the "
+            "documented baseline",
+            file=sys.stderr,
+        )
 
 from lws_tpu.runtime import ControlPlane
 from lws_tpu.sched import make_slice_nodes

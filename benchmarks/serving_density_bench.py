@@ -1,0 +1,121 @@
+"""Serving density: paged KV pool vs dense per-slot reservation, on chip.
+
+VERDICT #4's acceptance: decode tok/s at 2x the dense-feasible batch without
+HBM overflow, against the vLLM-TPU reference shape (2048-token context,
+1024-token prompts — docs/examples/vllm/TPU/lws.yaml:22-34).
+
+The arithmetic this demonstrates (0.9B model, v5e 16GB):
+  dense cache bytes = slots * max_len * kv_row     -> 128 slots = 17.2 GB: OOM
+  paged pool bytes  = slots * footprint * kv_row   -> 128 slots = 10.8 GB: fits
+where footprint = prompt + decode budget (1280) < max_len (2048).
+
+Run: python benchmarks/serving_density_bench.py  (real chip; CPU = tiny smoke)
+Prints one JSON line per engine config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu.models.llama import LlamaConfig, init_params
+from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+
+def measure(engine, prompt_len, warm_chunk=4, timed_chunk=32) -> dict:
+    """Steady-state decode tok/s via two-point differencing of chunked
+    on-device stepping (per-dispatch host sync differences away)."""
+    rng = np.random.RandomState(0)
+    t_admit0 = time.perf_counter()
+    for _ in range(engine.slots):
+        rid = engine.submit(
+            rng.randint(1, 1000, size=prompt_len).astype(np.int32),
+            max_new_tokens=timed_chunk * 4 + warm_chunk * 4 + 8,
+        )
+        assert rid is not None, "admission failed — pool sized wrong"
+    admit_s = time.perf_counter() - t_admit0
+
+    engine.step_n(warm_chunk)   # compile short
+    engine.step_n(timed_chunk)  # compile long
+
+    def timed(n):
+        t0 = time.perf_counter()
+        engine.step_n(n)
+        return time.perf_counter() - t0
+
+    t_short = timed(warm_chunk)
+    t_long = timed(timed_chunk)
+    step_s = (t_long - t_short) / (timed_chunk - warm_chunk)
+    return {
+        "slots": engine.slots,
+        "decode_tok_s": round(engine.slots / step_s, 1),
+        "admit_s": round(admit_s, 1),
+    }
+
+
+def main() -> None:
+    on_chip = jax.default_backend() != "cpu"
+    if on_chip:
+        cfg = LlamaConfig(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq_len=2048, dtype=jnp.bfloat16,
+            param_dtype=jnp.bfloat16, remat=False, unroll_cached_layers=True,
+        )
+        max_len, prompt_len, bs = 2048, 1024, 64
+        dense_slots = 64   # dense reservation: 64 x 2048 rows = 8.6 GB (fits)
+        paged_slots = 128  # dense would need 17.2 GB (OOM on 16 GB v5e)
+        budget = 1280      # prompt 1024 + decode headroom
+    else:
+        cfg = LlamaConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False,
+        )
+        max_len, prompt_len, bs = 128, 32, 8
+        dense_slots, paged_slots, budget = 2, 4, 64
+
+    kv_row = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    jax.block_until_ready(params)
+
+    for slots, blocks_per_slot, label in (
+        (dense_slots, max_len // bs, "dense-equivalent pool (max_len reserved/slot)"),
+        (paged_slots, budget // bs, "paged pool (footprint-sized blocks/slot)"),
+    ):
+        num_blocks = slots * blocks_per_slot + 1
+        pool_gb = num_blocks * bs * kv_row / 1e9
+        dense_gb = slots * max_len * kv_row / 1e9
+        engine = PagedBatchEngine(
+            cfg, params, slots=slots, max_len=max_len, block_size=bs,
+            num_blocks=num_blocks,
+        )
+        r = measure(engine, prompt_len)
+        print(json.dumps({
+            "metric": f"continuous-batching decode, {label}",
+            "value": r["decode_tok_s"],
+            "unit": "tokens/s/chip",
+            "slots": slots,
+            "pool_gb": round(pool_gb, 2),
+            "dense_equivalent_gb": round(dense_gb, 2),
+            "admit_s": r["admit_s"],
+        }))
+        del engine
+    print(json.dumps({
+        "note": "paged row serves 2x the slots of the dense-feasible config "
+                "in LESS physical KV memory than dense would need "
+                "(dense at 2x slots would exceed HBM)"
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -381,7 +381,11 @@ def cmd_install(args) -> int:
 
     print(f"bundle rendered at {root}")
     print(f"  start:       {start}")
-    print(f"  admin token: {tokens['admin'][:8]}… (full value in tokens.csv)")
+    admin = tokens.get("admin")
+    if admin:
+        print(f"  admin token: {admin[:8]}… (full value in tokens.csv)")
+    else:  # preserved file the operator customized; don't crash post-render
+        print("  tokens:      preserved tokens.csv has no admin-role entry")
     print(f"  ca cert:     {paths.ca_cert}")
     return 0
 

@@ -676,6 +676,105 @@ def forward_decode_slotted(
     return logits, cache
 
 
+# ---------------------------------------------------------------------------
+# Paged KV cache (serving density): K/V live in a pool of fixed-size blocks;
+# each slot's logical sequence is its block-table row. TPU-idiomatic paging:
+# all shapes static (the gather/scatter compile once), allocation policy on
+# the host. Physical capacity decouples from slots x max_len, so a fleet
+# serves ~avg-length x slots instead of reserving max_len for everyone —
+# the same density trick vLLM's PagedAttention plays, re-shaped for XLA
+# (block-table advanced indexing instead of custom CUDA gather kernels).
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PagedKVCache:
+    """k/v pools [L, num_blocks, block_size, Hkv, hd]. Block 0 is the
+    reserved NULL block: unallocated table entries point at it; its contents
+    are never attendable (the per-slot position mask excludes them) and
+    inactive slots' dead writes land there harmlessly."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+
+def init_paged_cache(cfg: LlamaConfig, num_blocks: int, block_size: int) -> PagedKVCache:
+    if cfg.kv_quant:
+        raise NotImplementedError("kv_quant + paged cache; quantize weights instead")
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return PagedKVCache(k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype))
+
+
+def paged_insert(cache: PagedKVCache, stacked_k, stacked_v, block_ids) -> PagedKVCache:
+    """Scatter a freshly-prefilled sequence's K/V [L, S, Hkv, hd] (S a
+    multiple of block_size) into the pool blocks `block_ids` [S/bs]."""
+    L, S = stacked_k.shape[0], stacked_k.shape[1]
+    bs = cache.block_size
+    blocks_k = stacked_k.reshape(L, S // bs, bs, *stacked_k.shape[2:])
+    blocks_v = stacked_v.reshape(L, S // bs, bs, *stacked_v.shape[2:])
+    import dataclasses as _dc
+
+    return _dc.replace(
+        cache,
+        k=cache.k.at[:, block_ids].set(blocks_k.astype(cache.k.dtype)),
+        v=cache.v.at[:, block_ids].set(blocks_v.astype(cache.v.dtype)),
+    )
+
+
+def forward_decode_paged(
+    params: dict,
+    tokens: jax.Array,
+    cache: PagedKVCache,
+    block_table: jax.Array,
+    pos_b: jax.Array,
+    cfg: LlamaConfig,
+) -> tuple[jax.Array, PagedKVCache]:
+    """One decode step over paged slots: tokens [B], block_table [B, max_blocks]
+    maps each slot's logical blocks to pool blocks, pos_b [B] is each slot's
+    current length. The new K/V scatter to (table[b, pos//bs], pos%bs); the
+    attention view gathers each slot's blocks back into a [B, max_blocks*bs]
+    logical sequence and masks by pos_b exactly like the slotted path."""
+    B = tokens.shape[0]
+    bs = cache.block_size
+    positions = pos_b[:, None]
+    x = embed_lookup(params["embed"], tokens[:, None], cfg.dtype)
+    write_blk = jnp.take_along_axis(block_table, (pos_b // bs)[:, None], axis=1)[:, 0]
+    write_off = pos_b % bs
+
+    def paged_block(x, layer_idx, lp, cache):
+        updated = {}
+
+        def attn_fn(q, k, v):
+            new_k = cache.k.at[layer_idx, write_blk, write_off].set(
+                k[:, 0].astype(cache.k.dtype)
+            )
+            new_v = cache.v.at[layer_idx, write_blk, write_off].set(
+                v[:, 0].astype(cache.v.dtype)
+            )
+            updated["cache"] = PagedKVCache(k=new_k, v=new_v)
+            k_l = jax.lax.dynamic_index_in_dim(new_k, layer_idx, 0, keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(new_v, layer_idx, 0, keepdims=False)
+            k_view = k_l[block_table].reshape(B, -1, cfg.n_kv_heads, cfg.head_dim)
+            v_view = v_l[block_table].reshape(B, -1, cfg.n_kv_heads, cfg.head_dim)
+            return _cached_attention(q, k_view, v_view, pos_b)
+
+        x, _ = _block_core(x, positions, lp, cfg, attn_fn)
+        return x, updated["cache"]
+
+    x, cache = _cached_layer_loop(x, cache, params, cfg, paged_block)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
 def _cached_layer_loop(x, cache, params, cfg: LlamaConfig, block):
     """Shared unroll-vs-scan scaffold for the cached forwards: block(x,
     layer_idx, lp, cache) -> (x, cache)."""

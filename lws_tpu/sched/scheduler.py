@@ -74,7 +74,10 @@ class Scheduler:
         if not isinstance(pod, Pod):
             return
         key = pod.key()
-        gang = pod.meta.annotations.get(contract.POD_GROUP_ANNOTATION_KEY)
+        # `or None`: an empty-string annotation means solo everywhere else
+        # (reconcile's truthiness check) — storing "" would fold all such
+        # pods into one pseudo-gang with a single requeue representative.
+        gang = pod.meta.annotations.get(contract.POD_GROUP_ANNOTATION_KEY) or None
         with self._pending_lock:
             prev_gang = self._gang_of.get(key)
             if prev_gang is not None and prev_gang != gang:

@@ -1,0 +1,261 @@
+"""Paged continuous batching: BatchEngine's slot model with K/V in a shared
+block pool instead of a dense [slots, max_len] reservation.
+
+Why: dense continuous batching reserves max_len KV rows per slot, so HBM
+capacity caps slots at hbm / (max_len * kv_row_bytes) even when typical
+sequences are much shorter. Paging sizes physical memory to the EXPECTED
+live footprint: each request holds exactly ceil(footprint/block_size) blocks
+for its lifetime and returns them on completion, so the same pool serves
+~max_len/avg_len x more slots (VERDICT #4 "decode tok/s at 2x batch without
+HBM overflow"). All device shapes stay static — the block table is data, not
+shape — so XLA compiles one executable regardless of allocation state.
+
+Allocation policy (host side, exclusive):
+  * block 0 is the NULL block — never allocated; freed/unallocated table
+    entries point at it, so inactive slots' dead writes and padding reads
+    land there (position-masked, never attendable).
+  * submit() takes ceil(max(bucket, plen+max_new)/bs) blocks up front and
+    returns None when the pool (or slot set) is exhausted — callers retry
+    after a drain, exactly like a full BatchEngine.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lws_tpu.models.llama import (
+    LlamaConfig,
+    forward_decode_paged,
+    forward_prefill,
+    init_cache,
+    init_paged_cache,
+    paged_insert,
+)
+
+
+@dataclass
+class PagedRequest:
+    request_id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    tokens: list[int] = field(default_factory=list)
+    slot: int = -1
+    blocks: list[int] = field(default_factory=list)
+
+    @property
+    def done(self) -> bool:
+        return len(self.tokens) >= self.max_new_tokens
+
+
+class PagedBatchEngine:
+    """Slot-based continuously-batched greedy engine over a paged KV pool."""
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: dict,
+        slots: int = 8,
+        max_len: int = 512,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+    ):
+        if cfg.kv_quant:
+            raise NotImplementedError("kv_quant is not supported by PagedBatchEngine yet")
+        if max_len % block_size:
+            raise ValueError("max_len must be a multiple of block_size")
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len
+        self.block_size = block_size
+        self.max_blocks = max_len // block_size
+        # Default pool = dense equivalent (+null); callers shrink it for
+        # density (that is the whole point).
+        self.num_blocks = num_blocks if num_blocks is not None else slots * self.max_blocks + 1
+        self._ids = itertools.count()
+        self._free_slots = list(range(slots))
+        self._free_blocks = list(range(1, self.num_blocks))  # 0 = null
+        self._active: dict[int, PagedRequest] = {}
+        self._completed: dict[int, PagedRequest] = {}
+
+        self.cache = init_paged_cache(cfg, self.num_blocks, block_size)
+        self.table = np.zeros((slots, self.max_blocks), np.int32)  # host truth
+        self.pos_b = jnp.zeros((slots,), jnp.int32)
+        self.tokens = jnp.zeros((slots,), jnp.int32)
+
+        cfg_static = cfg
+
+        @jax.jit
+        def _prefill_one(params, prompt, last_pos):
+            cache = init_cache(cfg_static, 1, prompt.shape[1])
+            logits, cache = forward_prefill(
+                params, prompt, cache, cfg_static, last_pos=last_pos
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _insert(cache, slot_k, slot_v, block_ids, pos_b, tokens, slot, plen, first_token):
+            cache = paged_insert(cache, slot_k, slot_v, block_ids)
+            return cache, pos_b.at[slot].set(plen), tokens.at[slot].set(first_token)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _step(params, cache, table, tokens, pos_b, active):
+            logits, cache = forward_decode_paged(
+                params, tokens, cache, table, pos_b, cfg_static
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            tokens = jnp.where(active, nxt, tokens)
+            pos_b = jnp.where(active, pos_b + 1, pos_b)
+            return cache, tokens, pos_b
+
+        @partial(jax.jit, donate_argnums=(1,), static_argnums=(6,))
+        def _step_n(params, cache, table, tokens, pos_b, active, n):
+            # n chained steps in ONE dispatch (lax.scan): admission state is
+            # frozen for the chunk, so callers bound n by the soonest
+            # completion. Kills the per-step host round trip that dominates
+            # relay-backed links (same trick as Engine.decode_n).
+            def body(carry, _):
+                cache, tokens, pos_b = carry
+                logits, cache = forward_decode_paged(
+                    params, tokens, cache, table, pos_b, cfg_static
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tokens = jnp.where(active, nxt, tokens)
+                pos_b = jnp.where(active, pos_b + 1, pos_b)
+                return (cache, tokens, pos_b), tokens
+
+            (cache, tokens, pos_b), toks = jax.lax.scan(
+                body, (cache, tokens, pos_b), None, length=n
+            )
+            return cache, tokens, pos_b, toks  # toks [n, slots]
+
+        self._prefill_one = _prefill_one
+        self._insert = _insert
+        self._step_fn = _step
+        self._step_n_fn = _step_n
+
+    # ------------------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free_blocks)
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> Optional[int]:
+        """Admit a request; returns request id, or None when out of slots OR
+        out of pool blocks (the density backpressure signal)."""
+        if not self._free_slots:
+            return None
+        plen = len(prompt)
+        if plen + max_new_tokens > self.max_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        # Same power-of-two length bucketing as BatchEngine, floored at one
+        # block so the prefill scatter is block-aligned.
+        bucket = self.block_size
+        while bucket < plen:
+            bucket *= 2
+        bucket = min(bucket, self.max_len)
+        footprint = max(bucket, plen + max_new_tokens)
+        n_blocks = -(-footprint // self.block_size)
+        if n_blocks > len(self._free_blocks):
+            return None
+        slot = self._free_slots.pop(0)
+        blocks = [self._free_blocks.pop(0) for _ in range(n_blocks)]
+        req = PagedRequest(
+            next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot, blocks=blocks
+        )
+        self.table[slot] = 0
+        self.table[slot, :n_blocks] = blocks
+
+        padded = np.zeros((bucket,), np.int32)
+        padded[:plen] = prompt
+        first, slot_cache = self._prefill_one(
+            self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
+        )
+        prefill_ids = jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
+        self.cache, self.pos_b, self.tokens = self._insert(
+            self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
+            self.pos_b, self.tokens, slot, plen, first[0],
+        )
+        req.tokens.append(int(first[0]))
+        if req.done:
+            self._completed[req.request_id] = req
+            self._release(req)
+        else:
+            self._active[slot] = req
+        return req.request_id
+
+    def _release(self, req: PagedRequest) -> None:
+        self.table[req.slot] = 0  # dead writes + stale reads -> null block
+        self._free_blocks.extend(req.blocks)
+        req.blocks = []
+        self._free_slots.append(req.slot)
+
+    def step(self) -> None:
+        if not self._active:
+            return
+        active = jnp.asarray(
+            [s in self._active and not self._active[s].done for s in range(self.slots)]
+        )
+        self.cache, self.tokens, self.pos_b = self._step_fn(
+            self.params, self.cache, jnp.asarray(self.table), self.tokens,
+            self.pos_b, active,
+        )
+        host_tokens = np.asarray(self.tokens)
+        for slot, req in list(self._active.items()):
+            req.tokens.append(int(host_tokens[slot]))
+            if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
+                self._completed[req.request_id] = req
+                del self._active[slot]
+                self._release(req)
+
+    def step_n(self, n: int) -> None:
+        """n decode steps in one device dispatch. Safe only up to the soonest
+        completion/overflow among active slots (admission state is frozen for
+        the chunk); run_until_drained computes that bound."""
+        if not self._active or n <= 0:
+            return
+        active = jnp.asarray(
+            [s in self._active and not self._active[s].done for s in range(self.slots)]
+        )
+        self.cache, self.tokens, self.pos_b, toks = self._step_n_fn(
+            self.params, self.cache, jnp.asarray(self.table), self.tokens,
+            self.pos_b, active, n,
+        )
+        host_toks = np.asarray(toks)  # [n, slots]
+        for slot, req in list(self._active.items()):
+            req.tokens.extend(int(t) for t in host_toks[:, slot])
+            if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
+                self._completed[req.request_id] = req
+                del self._active[slot]
+                self._release(req)
+
+    def run_until_drained(self, max_steps: int = 10000) -> None:
+        """Drain via chunked on-device stepping: each dispatch runs exactly
+        up to the soonest completion, so no slot oversteps its budget."""
+        for _ in range(max_steps):
+            if not self._active:
+                return
+            bound = min(
+                min(r.max_new_tokens - len(r.tokens) for r in self._active.values()),
+                min(self.max_len - len(r.prompt) - len(r.tokens)
+                    for r in self._active.values()),
+            )
+            # Floor to a power of two (capped) so the scan compiles for a
+            # bounded set of lengths {1,2,4,...,32}, not every remainder.
+            n = max(1, min(bound, 32))
+            self.step_n(1 << (n.bit_length() - 1))
+        raise RuntimeError("engine did not drain")
+
+    def result(self, request_id: int) -> Optional[list[int]]:
+        req = self._completed.get(request_id)
+        return list(req.tokens) if req is not None else None
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
