@@ -1,7 +1,12 @@
-.PHONY: test native bench clean
+.PHONY: test lint check native bench clean
 
 test:
 	python -m pytest tests/ -q
+
+lint:  ## self-contained linter (ref parity: golangci-lint in Makefile:152-198)
+	python tools/lint.py
+
+check: lint test  ## what CI would run
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py
@@ -11,6 +16,9 @@ bench:
 
 bench-control-plane:
 	python benchmarks/control_plane_bench.py
+
+bench-density:
+	python benchmarks/serving_density_bench.py
 
 clean:
 	rm -f lws_tpu/core/_fastclone*.so

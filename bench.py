@@ -144,12 +144,14 @@ def main() -> None:
     from lws_tpu.serving import Engine
 
     on_accelerator = jax.default_backend() != "cpu"
-    # Serving-density switch: int8 KV + int8 weights measured against an
-    # honest roofline of the ACTUAL bytes streamed (int8 values + f32
-    # scales). Off until the pallas decode kernel makes int8 a win on chip —
-    # plain XLA materializes dequantized copies and loses the bandwidth it
-    # saves (measured: 2633 tok/s @ B=32 int8 vs 2681 @ B=16 bf16).
-    int8_mode = os.environ.get("BENCH_INT8", "0") == "1"
+    # Serving-density switches (BENCH_INT8): "w" = int8 weights via XLA's
+    # dequantize-into-dot (the default path; LWS_TPU_INT8_KERNEL=1 opts into
+    # the pallas kernel, which measured SLOWER in-model: 2129 tok/s vs
+    # bf16's 2679); "1" = weights + int8 KV cache too (the KV dequant
+    # materialization made that lose to bf16: 2633 @ B=32 vs 2681 @ B=16).
+    int8_env = os.environ.get("BENCH_INT8", "0")
+    int8_weights = int8_env in ("1", "w")
+    int8_mode = int8_env == "1"  # weights AND kv
     if on_accelerator:
         cfg = LlamaConfig(
             vocab_size=32000,
@@ -181,7 +183,7 @@ def main() -> None:
 
     params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
     jax.block_until_ready(params)
-    if int8_mode:
+    if int8_weights:
         params = jax.jit(quantize_params)(params)  # int8 weights, per-channel scales
         jax.block_until_ready(params)
 
@@ -241,7 +243,7 @@ def main() -> None:
           f"decode={tok_per_s:.0f} tok/s (roofline {roofline_tok_s:.0f})", file=sys.stderr)
 
     record = {
-        "metric": f"llama-{n_params/1e9:.1f}B-{'int8w-int8kv' if int8_mode else 'bf16'} greedy decode throughput, single chip ({gen})",
+        "metric": f"llama-{n_params/1e9:.1f}B-{'int8w-int8kv' if int8_mode else ('int8w' if int8_weights else 'bf16')} greedy decode throughput, single chip ({gen})",
         "value": round(tok_per_s, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_per_s / roofline_tok_s, 4),

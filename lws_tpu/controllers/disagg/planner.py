@@ -19,7 +19,7 @@ observed replica counts each call. Invariants:
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 RoleReplicaState = list[int]

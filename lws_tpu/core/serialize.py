@@ -13,7 +13,6 @@ import dataclasses
 import enum
 import json
 import os
-import typing
 from typing import Any, Optional, Union, get_args, get_origin, get_type_hints
 
 from lws_tpu.api.meta import to_plain

@@ -23,8 +23,7 @@ this model is the TPU-native counterpart of its vLLM Llama examples
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from functools import partial
+from dataclasses import dataclass
 from typing import Any, Optional
 
 import jax

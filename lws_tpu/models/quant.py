@@ -92,10 +92,28 @@ def quantized_bytes(params: dict) -> int:
 
 
 def matmul(x: jax.Array, w, dtype=None) -> jax.Array:
-    """x @ w for plain or quantized w. The int8->compute-dtype convert fuses
-    into the dot's operand read; scale applies per output channel after."""
+    """x @ w for plain or quantized w. The XLA path dequantizes into the
+    dot's operand read. LWS_TPU_INT8_KERNEL=1 opts decode-shaped matmuls
+    into the pallas kernel (ops/int8_matmul.py) instead — kept opt-in
+    because measured in-model on v5e it LOST to the XLA path (2129 tok/s vs
+    bf16's 2679; isolated microbenches show XLA's int8 dot already streams
+    int8 fine at 17.8us vs bf16's 80.9us for 16x2048@2048x5632)."""
+    import os
+
     dtype = dtype or x.dtype
     if isinstance(w, QuantizedArray):
+        if (
+            w.q.ndim == 2
+            and jax.default_backend() in ("tpu", "axon")
+            and os.environ.get("LWS_TPU_INT8_KERNEL", "0") == "1"
+        ):
+            from lws_tpu.ops.int8_matmul import int8_matmul, supported
+
+            m = 1
+            for s in x.shape[:-1]:
+                m *= s
+            if supported(m, w.q.shape[0], w.q.shape[1]):
+                return int8_matmul(x.astype(dtype), w.q, w.scale)
         return (x @ w.q.astype(dtype)) * w.scale.astype(dtype)
     return x @ w.astype(dtype)
 

@@ -93,7 +93,6 @@ def flash_attention(
     """q [B,S,H,D], k/v [B,Skv,Hkv,D] -> [B,S,H,D]. Pads S/Skv to block
     multiples internally (padded keys are masked out)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     B, S, H, D = q.shape
     Skv, Hkv = k.shape[1], k.shape[2]

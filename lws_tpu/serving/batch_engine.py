@@ -21,7 +21,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from lws_tpu.models.llama import (
-    KVCache,
     LlamaConfig,
     forward_decode_slotted,
     forward_prefill,

@@ -197,29 +197,26 @@ class PagedBatchEngine:
         self._free_slots.append(req.slot)
 
     def step(self) -> None:
-        if not self._active:
-            return
-        active = jnp.asarray(
-            [s in self._active and not self._active[s].done for s in range(self.slots)]
+        """One decode step across every active slot."""
+        self.step_n(1)
+
+    def _completion_bound(self) -> int:
+        """Steps until the soonest completion/length-overflow among active
+        slots — the longest chunk that cannot overrun any budget."""
+        return min(
+            min(r.max_new_tokens - len(r.tokens) for r in self._active.values()),
+            min(self.max_len - len(r.prompt) - len(r.tokens)
+                for r in self._active.values()),
         )
-        self.cache, self.tokens, self.pos_b = self._step_fn(
-            self.params, self.cache, jnp.asarray(self.table), self.tokens,
-            self.pos_b, active,
-        )
-        host_tokens = np.asarray(self.tokens)
-        for slot, req in list(self._active.items()):
-            req.tokens.append(int(host_tokens[slot]))
-            if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
-                self._completed[req.request_id] = req
-                del self._active[slot]
-                self._release(req)
 
     def step_n(self, n: int) -> None:
-        """n decode steps in one device dispatch. Safe only up to the soonest
-        completion/overflow among active slots (admission state is frozen for
-        the chunk); run_until_drained computes that bound."""
+        """Up to n decode steps in one device dispatch. Clamped to the
+        soonest completion among active slots (admission state is frozen for
+        the chunk, and a slot stepping past its block footprint would write
+        into the shared null block while its mask starts attending it)."""
         if not self._active or n <= 0:
             return
+        n = min(n, max(1, self._completion_bound()))
         active = jnp.asarray(
             [s in self._active and not self._active[s].done for s in range(self.slots)]
         )

@@ -3,13 +3,12 @@ volume claim templates, orbax checkpoint save/restore into mesh shardings."""
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from lws_tpu.api.autoscaler import METRIC_ANNOTATION_PREFIX, Autoscaler, AutoscalerSpec
 from lws_tpu.api.pod import VolumeClaimTemplate
 from lws_tpu.core.store import new_meta
 from lws_tpu.runtime import ControlPlane
-from lws_tpu.testing import LWSBuilder, lws_pods, make_all_groups_ready
+from lws_tpu.testing import LWSBuilder, lws_pods
 
 
 def set_metric(cp, pod_name, metric, value):

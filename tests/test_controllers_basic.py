@@ -2,7 +2,6 @@
 (≈ test/integration/controllers/leaderworkerset_test.go create/scale cases).
 """
 
-import pytest
 
 from lws_tpu.api import contract
 from lws_tpu.api.types import (

@@ -4,7 +4,7 @@ update with coordinated drain, revision-aware services, role add/remove
 
 import pytest
 
-from lws_tpu.api import contract, disagg
+from lws_tpu.api import disagg
 from lws_tpu.api.disagg import (
     DisaggregatedRoleSpec,
     DisaggregatedSet,

@@ -4,7 +4,7 @@ from lws_tpu.api import contract
 from lws_tpu.core import DnsView
 from lws_tpu.core.metrics import MetricsRegistry
 from lws_tpu.runtime import ControlPlane
-from lws_tpu.testing import LWSBuilder, set_pod_ready
+from lws_tpu.testing import LWSBuilder
 
 
 def test_dns_resolves_group_members_before_ready():

@@ -20,7 +20,7 @@ from lws_tpu.api.pod import Container, EnvVar, PodSpec, PodTemplateSpec
 from lws_tpu.api.types import LeaderWorkerSetSpec, LeaderWorkerTemplate
 from lws_tpu.core.store import new_meta
 from lws_tpu.runtime import ControlPlane
-from tests.test_e2e_local import REPO_ROOT, make_backend
+from tests.test_e2e_local import make_backend
 
 DECODE_STEPS = 6
 

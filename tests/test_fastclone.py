@@ -15,7 +15,6 @@ native = pytest.importorskip("lws_tpu.core._fastclone")
 
 def sample_objects():
     from lws_tpu.api.lease import Lease
-    from lws_tpu.api.node import Node, NodeSpec
     from lws_tpu.sched import make_slice_nodes
 
     lws = LWSBuilder().replicas(2).size(4).tpu_chips(4).exclusive_topology().build()

@@ -2,7 +2,6 @@
 reference outsources to Kubernetes): ordinals, parallel creation, partition
 rolling updates within the unavailability budget, PVC provisioning."""
 
-from lws_tpu.api import contract
 from lws_tpu.api.groupset import GroupSet, GroupSetSpec, GroupSetUpdateStrategy, groupset_ready
 from lws_tpu.api.pod import Container, PodSpec, PodTemplateSpec, TemplateMeta, VolumeClaimTemplate
 from lws_tpu.controllers.groupset_controller import GroupSetReconciler

@@ -1,0 +1,39 @@
+"""Fused int8 matmul kernel: exactness vs the XLA dequantize path
+(interpret mode — the real-chip win is measured by bench.py BENCH_INT8=1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lws_tpu.models.quant import quantize_array
+from lws_tpu.ops.int8_matmul import int8_matmul, supported
+
+
+@pytest.mark.parametrize("m,d,f", [(8, 512, 256), (16, 1024, 512), (3, 512, 256)])
+def test_matches_xla_dequant_path(m, d, f):
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (m, d), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (d, f), jnp.float32)
+    qa = quantize_array(w)
+    want = (x @ qa.q.astype(jnp.float32)) * qa.scale.astype(jnp.float32)
+    got = int8_matmul(x, qa.q, qa.scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-4)
+
+
+def test_leading_dims_roundtrip():
+    x = jax.random.normal(jax.random.key(2), (2, 4, 512), jnp.float32)
+    w = jax.random.normal(jax.random.key(3), (512, 256), jnp.float32)
+    qa = quantize_array(w)
+    got = int8_matmul(x, qa.q, qa.scale, interpret=True)
+    want = (x @ qa.q.astype(jnp.float32)) * qa.scale.astype(jnp.float32)
+    assert got.shape == (2, 4, 256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-4)
+
+
+def test_supported_gating():
+    assert supported(16, 2048, 5632)      # decode MLP
+    assert supported(16, 2048, 32000)     # lm_head (F = 125 * 256)
+    assert not supported(16, 2048, 1000)  # ragged F
+    assert not supported(16, 100, 256)    # ragged D
+    assert not supported(4096, 2048, 5632)  # prefill-sized M: XLA wins there

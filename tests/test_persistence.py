@@ -3,11 +3,10 @@ a FRESH control plane process-equivalent resumes a rollout mid-flight."""
 
 import json
 
-from lws_tpu.api import contract
 from lws_tpu.core.serialize import load_store, restore_store, save_store, snapshot_store
 from lws_tpu.core.store import Store
 from lws_tpu.runtime import ControlPlane
-from lws_tpu.testing import LWSBuilder, lws_pods, set_pod_ready
+from lws_tpu.testing import LWSBuilder
 from tests.test_disaggregatedset import make_ds
 from tests.test_rolling_update import image_of, settle_and_make_ready, update_image
 

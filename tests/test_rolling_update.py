@@ -2,7 +2,6 @@
 tables): group-by-group updates from the highest index, maxSurge bursting and
 reclaim, partition staging, conditions, revision truncation."""
 
-from lws_tpu.api import contract
 from lws_tpu.api.types import (
     CONDITION_AVAILABLE,
     CONDITION_UPDATE_IN_PROGRESS,
@@ -11,9 +10,7 @@ from lws_tpu.runtime import ControlPlane
 from lws_tpu.testing import (
     LWSBuilder,
     condition_status,
-    lws_pods,
     make_all_groups_ready,
-    set_pod_ready,
 )
 
 

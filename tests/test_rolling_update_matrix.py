@@ -12,7 +12,7 @@ rolling-update parameters after each transition.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable
 
 import pytest
 
