@@ -491,16 +491,28 @@ def _block_with_cache(x, positions, pos, layer_idx, lp, cache: KVCache, cfg: Lla
             import dataclasses as _dc
 
             updated["cache"] = _dc.replace(cache, k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
-            cache_k_l = _dequantize_kv(
-                jax.lax.dynamic_index_in_dim(new_k, layer_idx, 0, keepdims=False),
-                jax.lax.dynamic_index_in_dim(new_ks, layer_idx, 0, keepdims=False),
-                cfg.dtype,
-            )
-            cache_v_l = _dequantize_kv(
-                jax.lax.dynamic_index_in_dim(new_v, layer_idx, 0, keepdims=False),
-                jax.lax.dynamic_index_in_dim(new_vs, layer_idx, 0, keepdims=False),
-                cfg.dtype,
-            )
+            kq_l = jax.lax.dynamic_index_in_dim(new_k, layer_idx, 0, keepdims=False)
+            ks_l = jax.lax.dynamic_index_in_dim(new_ks, layer_idx, 0, keepdims=False)
+            vq_l = jax.lax.dynamic_index_in_dim(new_v, layer_idx, 0, keepdims=False)
+            vs_l = jax.lax.dynamic_index_in_dim(new_vs, layer_idx, 0, keepdims=False)
+            import os
+
+            if (
+                q.shape[1] == 1
+                and jax.default_backend() in ("tpu", "axon")
+                and os.environ.get("LWS_TPU_INT8_ATTN", "1") != "0"
+            ):
+                # Decode: fused kernel reads the cache AS int8 — the XLA
+                # fallback below materializes a dequantized copy every step,
+                # which is why int8 KV used to lose to bf16. Interpret-mode
+                # exact; LWS_TPU_INT8_ATTN=0 falls back without a code edit
+                # if real-chip lowering misbehaves (relay was down when this
+                # landed, so the chip run is pending).
+                from lws_tpu.ops.int8_attention import int8_decode_attention
+
+                return int8_decode_attention(q, kq_l, ks_l, vq_l, vs_l, pos)
+            cache_k_l = _dequantize_kv(kq_l, ks_l, cfg.dtype)
+            cache_v_l = _dequantize_kv(vq_l, vs_l, cfg.dtype)
             return _cached_attention(q, cache_k_l, cache_v_l, pos)
         new_k = jax.lax.dynamic_update_slice(
             cache.k, k.astype(cache.k.dtype)[None], (layer_idx, 0, pos, 0, 0)

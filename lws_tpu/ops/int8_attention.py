@@ -1,0 +1,78 @@
+"""Decode attention over an int8 KV cache (pallas).
+
+The XLA int8-KV path dequantizes the ENTIRE cache view into a bf16 copy
+every step (models/llama.py _block_with_cache kv_quant branch) — reading
+int8 and then writing+rereading bf16 spends ~3x the bandwidth the
+quantization saved, which is why int8 KV measured slower than bf16
+(2633 tok/s @ B=32 vs 2681 @ B=16). This kernel DMAs the int8 tiles
+straight out of the cache's native [B, T, Hkv, hd] layout (strided block
+specs — no transposed or dequantized copies ever hit HBM), dequantizes
+in-register per (token, kv-head) scale, and fuses the whole decode
+attention for one (batch, kv-head) pair. Grouped-query: the G = H/Hkv query
+heads sharing a kv head are processed together, so each K/V tile is loaded
+once and reused G times.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(q_ref, kq_ref, ks_ref, vq_ref, vs_ref, bias_ref, o_ref, *, scale: float):
+    q = q_ref[0, 0].astype(jnp.float32)                        # [G, hd]
+    k = kq_ref[0, :, 0, :].astype(jnp.float32) * ks_ref[0, :, 0][:, None]  # [T, hd]
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                                  # [G, T]
+    scores = scores + bias_ref[0]                              # [T] broadcasts
+    probs = jax.nn.softmax(scores, axis=-1)
+    v = vq_ref[0, :, 0, :].astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+    o_ref[0, 0] = jnp.dot(probs, v, preferred_element_type=jnp.float32).astype(
+        o_ref.dtype
+    )
+
+
+def int8_decode_attention(
+    q: jax.Array,        # [B, 1, H, hd] (compute dtype)
+    kq: jax.Array,       # [B, T, Hkv, hd] int8 (cache-native layout)
+    k_scale: jax.Array,  # [B, T, Hkv] f32
+    vq: jax.Array,
+    v_scale: jax.Array,
+    pos,                 # scalar or [B]: the CURRENT write position (attendable)
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, 1, H, hd] in q.dtype. Key positions > pos are masked
+    (same contract as models.llama._cached_attention with S=1)."""
+    from jax.experimental import pallas as pl
+
+    B, S, H, hd = q.shape
+    assert S == 1, "decode kernel: single query position"
+    T, Hkv = kq.shape[1], kq.shape[2]
+    G = H // Hkv
+    key_pos = jnp.arange(T)
+    bias = jnp.where(
+        key_pos[None, :] <= jnp.reshape(pos, (-1, 1)), 0.0, -1e30
+    ).astype(jnp.float32)
+    bias = jnp.broadcast_to(bias, (B, T))
+
+    qg = q[:, 0].reshape(B, Hkv, G, hd)  # tiny; fine to materialize
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=hd**-0.5),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
+        grid=(B, Hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, T, 1, hd), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, T, 1), lambda b, h: (b, 0, h)),
+            pl.BlockSpec((1, T, 1, hd), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, T, 1), lambda b, h: (b, 0, h)),
+            pl.BlockSpec((1, T), lambda b, h: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h: (b, h, 0, 0)),
+        interpret=interpret,
+    )(qg, kq, k_scale, vq, v_scale, bias)
+    return out.reshape(B, 1, H, hd)

@@ -105,16 +105,6 @@ class PagedBatchEngine:
             cache = paged_insert(cache, slot_k, slot_v, block_ids)
             return cache, pos_b.at[slot].set(plen), tokens.at[slot].set(first_token)
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def _step(params, cache, table, tokens, pos_b, active):
-            logits, cache = forward_decode_paged(
-                params, tokens, cache, table, pos_b, cfg_static
-            )
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            tokens = jnp.where(active, nxt, tokens)
-            pos_b = jnp.where(active, pos_b + 1, pos_b)
-            return cache, tokens, pos_b
-
         @partial(jax.jit, donate_argnums=(1,), static_argnums=(6,))
         def _step_n(params, cache, table, tokens, pos_b, active, n):
             # n chained steps in ONE dispatch (lax.scan): admission state is
@@ -138,7 +128,6 @@ class PagedBatchEngine:
 
         self._prefill_one = _prefill_one
         self._insert = _insert
-        self._step_fn = _step
         self._step_n_fn = _step_n
 
     # ------------------------------------------------------------------
@@ -216,7 +205,8 @@ class PagedBatchEngine:
         into the shared null block while its mask starts attending it)."""
         if not self._active or n <= 0:
             return
-        n = min(n, max(1, self._completion_bound()))
+        n = min(n, max(1, self._completion_bound()), 32)
+        n = 1 << (n.bit_length() - 1)  # floor pow2: bounded compile set
         active = jnp.asarray(
             [s in self._active and not self._active[s].done for s in range(self.slots)]
         )
@@ -238,15 +228,7 @@ class PagedBatchEngine:
         for _ in range(max_steps):
             if not self._active:
                 return
-            bound = min(
-                min(r.max_new_tokens - len(r.tokens) for r in self._active.values()),
-                min(self.max_len - len(r.prompt) - len(r.tokens)
-                    for r in self._active.values()),
-            )
-            # Floor to a power of two (capped) so the scan compiles for a
-            # bounded set of lengths {1,2,4,...,32}, not every remainder.
-            n = max(1, min(bound, 32))
-            self.step_n(1 << (n.bit_length() - 1))
+            self.step_n(32)  # step_n clamps to the completion bound itself
         raise RuntimeError("engine did not drain")
 
     def result(self, request_id: int) -> Optional[list[int]]:
