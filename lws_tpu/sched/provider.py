@@ -83,10 +83,64 @@ class GangSchedulerProvider:
         )
 
 
+# Annotation namespaces an external gang scheduler owns; inherited verbatim
+# from the LWS onto PodGroups and pods (ref volcano_provider.go:49-101
+# inherits queue + volcano.sh/* annotations; DS e2e checks Kueue labels).
+EXTERNAL_INHERIT_PREFIXES = ("volcano.sh/", "kueue.x-k8s.io/", "scheduling.x-k8s.io/")
+EXTERNAL_QUEUE_ANNOTATION = "volcano.sh/queue-name"
+
+
+class ExternalSchedulerProvider(GangSchedulerProvider):
+    """Compat path for clusters that already run an external gang scheduler
+    (Volcano/Kueue-style): PodGroups carry the inherited queue + external
+    annotations, pods are stamped with the external scheduler's name, and
+    the NATIVE scheduler leaves them strictly alone — binding happens via
+    the API (spec.node_name update through a client), exactly how an
+    external scheduler integrates with an apiserver."""
+
+    def __init__(self, store: Store, scheduler_name: str = "external") -> None:
+        super().__init__(store)
+        self.scheduler_name = scheduler_name
+
+    def create_pod_group_if_not_exists(self, lws: LeaderWorkerSet, leader_pod: Pod) -> None:
+        self.queue = lws.meta.annotations.get(EXTERNAL_QUEUE_ANNOTATION, "")
+        super().create_pod_group_if_not_exists(lws, leader_pod)
+        # Inherit the external scheduler's annotation namespaces.
+        group_index = leader_pod.meta.labels.get(contract.GROUP_INDEX_LABEL_KEY, "0")
+        name = get_pod_group_name(lws.meta.name, group_index, get_revision_key(leader_pod))
+        inherited = {
+            k: v
+            for k, v in lws.meta.annotations.items()
+            if k.startswith(EXTERNAL_INHERIT_PREFIXES)
+        }
+        if not inherited:
+            return
+        pg = self.store.try_get("PodGroup", lws.meta.namespace, name)
+        if pg is not None and not all(
+            pg.meta.annotations.get(k) == v for k, v in inherited.items()
+        ):
+            pg.meta.annotations.update(inherited)
+            from lws_tpu.core.store import ConflictError
+
+            try:
+                self.store.update(pg)
+            except ConflictError:
+                pass  # level-triggered: the next leader-pod reconcile retries
+
+    def inject_pod_group_metadata(self, pod: Pod) -> None:
+        super().inject_pod_group_metadata(pod)
+        pod.spec.scheduler_name = self.scheduler_name
+
+
 def make_scheduler_provider(name: Optional[str], store: Store) -> Optional[SchedulerProvider]:
-    """≈ schedulerprovider factory (interface.go:57-64)."""
+    """≈ schedulerprovider factory (interface.go:57-64). `external[:NAME]`
+    selects the external-compat provider (pods bound by a foreign scheduler
+    through the API)."""
     if name in (None, ""):
         return None
     if name == "gang":
         return GangSchedulerProvider(store)
+    if name == "external" or (name and name.startswith("external:")):
+        _, _, sched = name.partition(":")
+        return ExternalSchedulerProvider(store, scheduler_name=sched or "external")
     raise ValueError(f"unknown scheduler provider {name!r}")
