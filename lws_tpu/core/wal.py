@@ -158,7 +158,13 @@ class StateDir:
         self._lock_fd = fd
 
     def locked_by_other(self) -> bool:
-        """Probe (without taking) the writer lock."""
+        """Probe the writer lock. Returns False when THIS process holds it
+        (Linux flock denies a second fd of the same file even within the
+        holding process, which would misreport self as 'other'). When free,
+        the probe momentarily acquires and releases the lock — a brief
+        write-side action inherent to flock probing."""
+        if self._lock_fd is not None:
+            return False
         fd = os.open(self.lock_path, os.O_RDWR | os.O_CREAT, 0o600)
         try:
             fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)

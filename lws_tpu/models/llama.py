@@ -500,14 +500,13 @@ def _block_with_cache(x, positions, pos, layer_idx, lp, cache: KVCache, cfg: Lla
             if (
                 q.shape[1] == 1
                 and jax.default_backend() in ("tpu", "axon")
-                and os.environ.get("LWS_TPU_INT8_ATTN", "1") != "0"
+                and os.environ.get("LWS_TPU_INT8_ATTN", "0") == "1"
             ):
                 # Decode: fused kernel reads the cache AS int8 — the XLA
                 # fallback below materializes a dequantized copy every step,
                 # which is why int8 KV used to lose to bf16. Interpret-mode
-                # exact; LWS_TPU_INT8_ATTN=0 falls back without a code edit
-                # if real-chip lowering misbehaves (relay was down when this
-                # landed, so the chip run is pending).
+                # exact. OPT-IN (LWS_TPU_INT8_ATTN=1) until validated on a
+                # real chip, matching the LWS_TPU_INT8_KERNEL precedent.
                 from lws_tpu.ops.int8_attention import int8_decode_attention
 
                 return int8_decode_attention(q, kq_l, ks_l, vq_l, vs_l, pos)

@@ -39,6 +39,12 @@ class GangSchedulerProvider:
         self.store = store
         self.queue = queue
 
+    def _queue_for(self, lws: LeaderWorkerSet) -> str:
+        """Queue for this LWS's PodGroups; read per call so providers that
+        derive it from LWS annotations stay safe under concurrent reconciles
+        of different LWS (no shared-state write between them)."""
+        return self.queue
+
     def create_pod_group_if_not_exists(self, lws: LeaderWorkerSet, leader_pod: Pod) -> None:
         group_index = leader_pod.meta.labels.get(contract.GROUP_INDEX_LABEL_KEY, "0")
         name = get_pod_group_name(lws.meta.name, group_index, get_revision_key(leader_pod))
@@ -70,7 +76,11 @@ class GangSchedulerProvider:
                     labels={contract.SET_NAME_LABEL_KEY: lws.meta.name},
                     owners=[leader_pod],
                 ),
-                spec=PodGroupSpec(min_member=min_member, min_resources=min_resources, queue=self.queue),
+                spec=PodGroupSpec(
+                    min_member=min_member,
+                    min_resources=min_resources,
+                    queue=self._queue_for(lws),
+                ),
             )
         )
 
@@ -102,8 +112,10 @@ class ExternalSchedulerProvider(GangSchedulerProvider):
         super().__init__(store)
         self.scheduler_name = scheduler_name
 
+    def _queue_for(self, lws: LeaderWorkerSet) -> str:
+        return lws.meta.annotations.get(EXTERNAL_QUEUE_ANNOTATION, "")
+
     def create_pod_group_if_not_exists(self, lws: LeaderWorkerSet, leader_pod: Pod) -> None:
-        self.queue = lws.meta.annotations.get(EXTERNAL_QUEUE_ANNOTATION, "")
         super().create_pod_group_if_not_exists(lws, leader_pod)
         # Inherit the external scheduler's annotation namespaces.
         group_index = leader_pod.meta.labels.get(contract.GROUP_INDEX_LABEL_KEY, "0")

@@ -138,6 +138,11 @@ class Scheduler:
             return None
         if pod.spec.node_name:
             return None  # already bound (note_pod keeps the indexes current)
+        if pod.spec.scheduler_name and pod.spec.scheduler_name != self.name:
+            # Stamped for an external scheduler (ExternalSchedulerProvider):
+            # binding happens via the API; the native scheduler leaves the
+            # pod strictly alone even when both are enabled.
+            return None
 
         gang_name = pod.meta.annotations.get(contract.POD_GROUP_ANNOTATION_KEY)
         if gang_name:

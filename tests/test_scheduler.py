@@ -9,7 +9,8 @@ from lws_tpu.testing import LWSBuilder, lws_pods
 
 def make_cp_with_slices(n_slices=2, topology="2x4", **kw):
     cp = ControlPlane(
-        enable_scheduler=True, auto_ready=True, require_binding=True,
+        enable_scheduler=True, require_binding=True,
+        auto_ready=kw.pop("auto_ready", True),
         scheduler_provider=kw.pop("scheduler_provider", None),
     )
     for s in range(n_slices):
@@ -259,3 +260,42 @@ def test_gang_annotation_change_moves_membership():
     assert ("Pod", "default", "sample-0-1") in sched._by_gang.get(
         ("default", "other-gang"), {}
     )
+
+
+def test_external_provider_pods_stay_unbound_by_native_scheduler():
+    """ADVICE r2: with enableScheduler:true AND schedulerProvider external,
+    pods stamped with a foreign spec.scheduler_name must be left strictly
+    alone by the native scheduler — binding is the external scheduler's job
+    (done via the API)."""
+    cp = make_cp_with_slices(
+        n_slices=2, scheduler_provider="external", auto_ready=False
+    )
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+    pods = lws_pods(cp.store, "sample")
+    assert pods, "leader pod should exist"
+    assert all(p.spec.scheduler_name == "external" for p in pods)
+    assert all(not p.spec.node_name for p in pods), (
+        "native scheduler must not bind externally-owned pods"
+    )
+
+
+def test_external_provider_queue_is_per_lws():
+    """ADVICE r2: the external provider must read volcano.sh/queue-name per
+    call (no shared self.queue mutation) so two LWS with different queues
+    can never stamp each other's queue onto a PodGroup."""
+    cp = make_cp_with_slices(n_slices=2, scheduler_provider="external", auto_ready=False)
+    cp.create(
+        LWSBuilder(name="lws-a").replicas(1).size(2).tpu_chips(4)
+        .annotation("volcano.sh/queue-name", "queue-a").build()
+    )
+    cp.create(
+        LWSBuilder(name="lws-b").replicas(1).size(2).tpu_chips(4)
+        .annotation("volcano.sh/queue-name", "queue-b").build()
+    )
+    cp.run_until_stable()
+    queues = {
+        pg.meta.labels[contract.SET_NAME_LABEL_KEY]: pg.spec.queue
+        for pg in cp.store.list("PodGroup")
+    }
+    assert queues == {"lws-a": "queue-a", "lws-b": "queue-b"}, queues
