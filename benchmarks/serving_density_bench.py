@@ -10,7 +10,11 @@ The arithmetic this demonstrates (0.9B model, v5e 16GB):
 where footprint = prompt + decode budget (1280) < max_len (2048).
 
 Run: python benchmarks/serving_density_bench.py  (real chip; CPU = tiny smoke)
-Prints one JSON line per engine config.
+Prints one JSON line per engine config AND writes the whole result set to
+DENSITY_<round>.json at the repo root (round from LWS_TPU_ROUND, default r03)
+so the numbers are a driver-capturable artifact, not STATUS.md prose
+(VERDICT r2 weak #7). Includes a plain-Engine run as the throughput floor the
+paged config must beat (VERDICT r3 #1 acceptance).
 """
 
 from __future__ import annotations
@@ -63,7 +67,44 @@ def measure(engine, prompt_len, warm_chunk=4, timed_chunk=32) -> dict:
     }
 
 
+def measure_plain_engine(cfg, params, batch, prompt_len, max_len) -> dict:
+    """The dense single-dispatch Engine at its headline batch — the
+    throughput floor a paged config must beat to claim a win."""
+    from lws_tpu.serving import Engine
+    from lws_tpu.serving.engine import host_sync
+
+    engine = Engine(cfg, params, batch_size=batch, max_len=max_len)
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    engine.generate(prompt, max_new_tokens=8)  # compile+warm
+
+    def timed(n):
+        token, cache = engine.prefill(prompt)
+        host_sync(token)
+        t0 = time.perf_counter()
+        token, cache, _ = engine.decode_n(token, cache, n)
+        host_sync(token)
+        return time.perf_counter() - t0
+
+    short, long = 16, 64
+    timed(short), timed(long)  # compile both lengths
+    step_s = (timed(long) - timed(short)) / (long - short)
+    return {"slots": batch, "decode_tok_s": round(batch / step_s, 1)}
+
+
 def main() -> None:
+    # Relay outages hang backend init forever; probe like bench.py does
+    # (_ROOT is on sys.path, so this is repo-root bench.py).
+    import bench
+    round_tag = os.environ.get("LWS_TPU_ROUND", "r03")
+    artifact_path = os.path.join(_ROOT, f"DENSITY_{round_tag}.json")
+    if not bench._probe_backend_with_retry(total_budget_s=600.0):
+        rec = {"degraded": True, "note": "TPU relay unreachable; no fresh density numbers"}
+        print(json.dumps(rec))
+        with open(artifact_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        return
     on_chip = jax.default_backend() != "cpu"
     if on_chip:
         cfg = LlamaConfig(
@@ -82,11 +123,23 @@ def main() -> None:
             param_dtype=jnp.float32, remat=False,
         )
         max_len, prompt_len, bs = 128, 32, 8
-        dense_slots, paged_slots, budget = 2, 4, 64
+        dense_slots, paged_slots, budget = 2, 4, 96
 
     kv_row = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize
     params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
     jax.block_until_ready(params)
+
+    rows = []
+    plain = measure_plain_engine(
+        cfg, params, batch=16 if on_chip else 2, prompt_len=prompt_len, max_len=max_len
+    )
+    rows.append({
+        "metric": "plain Engine decode (throughput floor for the paged configs)",
+        "value": plain["decode_tok_s"],
+        "unit": "tokens/s/chip",
+        "slots": plain["slots"],
+    })
+    print(json.dumps(rows[-1]))
 
     for slots, blocks_per_slot, label in (
         (dense_slots, max_len // bs, "dense-equivalent pool (max_len reserved/slot)"),
@@ -95,12 +148,33 @@ def main() -> None:
         num_blocks = slots * blocks_per_slot + 1
         pool_gb = num_blocks * bs * kv_row / 1e9
         dense_gb = slots * max_len * kv_row / 1e9
-        engine = PagedBatchEngine(
-            cfg, params, slots=slots, max_len=max_len, block_size=bs,
-            num_blocks=num_blocks,
-        )
-        r = measure(engine, prompt_len)
-        print(json.dumps({
+
+        def run_config():
+            engine = PagedBatchEngine(
+                cfg, params, slots=slots, max_len=max_len, block_size=bs,
+                num_blocks=num_blocks,
+            )
+            try:
+                return measure(engine, prompt_len, *(() if on_chip else (2, 8)))
+            finally:
+                del engine
+
+        # The kernel path mirrors the gate in models/llama.py; if its first
+        # real-chip contact fails, retry on the XLA fallback so the round
+        # still gets a density artifact (honestly attributed).
+        paged_env = os.environ.get("LWS_TPU_PAGED_ATTN", "1")
+        kernel_on = paged_env != "0" and (on_chip or paged_env == "interpret")
+        try:
+            r = run_config()
+        except Exception as e:
+            if not kernel_on:
+                raise
+            print(f"[density] kernel path failed ({e!r}); retrying with "
+                  "LWS_TPU_PAGED_ATTN=0", file=sys.stderr)
+            os.environ["LWS_TPU_PAGED_ATTN"] = "0"
+            kernel_on = False
+            r = run_config()
+        rows.append({
             "metric": f"continuous-batching decode, {label}",
             "value": r["decode_tok_s"],
             "unit": "tokens/s/chip",
@@ -108,13 +182,20 @@ def main() -> None:
             "pool_gb": round(pool_gb, 2),
             "dense_equivalent_gb": round(dense_gb, 2),
             "admit_s": r["admit_s"],
-        }))
-        del engine
-    print(json.dumps({
+            "paged_attn_kernel": kernel_on,
+        })
+        print(json.dumps(rows[-1]))
+    artifact = {
+        "rows": rows,
         "note": "paged row serves 2x the slots of the dense-feasible config "
                 "in LESS physical KV memory than dense would need "
-                "(dense at 2x slots would exceed HBM)"
-    }))
+                "(dense at 2x slots would exceed HBM)",
+        "on_chip": on_chip,
+        "acceptance": "paged(128) >= 2x dense-pool aggregate AND >= plain Engine",
+    }
+    with open(artifact_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({"artifact": artifact_path}))
 
 
 if __name__ == "__main__":

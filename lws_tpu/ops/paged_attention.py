@@ -1,0 +1,176 @@
+"""Paged decode attention over a block-pool KV cache (pallas).
+
+The XLA paged path materializes every slot's FULL logical cache view per
+layer per step (`k_l[block_table]` gather in models/llama.py
+forward_decode_paged) — random-access gather traffic that made 128 paged
+slots run at ~40% of the dense Engine's throughput. This kernel reads each
+slot's KV blocks IN PLACE from the pool:
+
+  * the block table and per-slot positions are scalar-prefetched, and the
+    K/V index maps resolve (layer, pool_block) per grid step — the DMA
+    fetches exactly the addressed [block_size, Hkv, hd] tile, nothing else;
+  * grid = (B, max_blocks) with the block index innermost; chunks past a
+    slot's live length map to its LAST live block, so the pipeline's
+    revisiting logic elides their copies — HBM traffic is the LIVE tokens,
+    not slots x max_len;
+  * flash-style online softmax (running max / sum / accumulator in VMEM
+    scratch) across a slot's chunks; grouped-query heads share each K/V
+    tile load.
+
+Same contract as models.llama._cached_attention with S=1: key positions
+<= pos are attendable (pos = the slot's current write position).
+vLLM's PagedAttention is the competitor shape
+(/root/reference/docs/examples/vllm/TPU/lws.yaml:22-34); this is the
+TPU-native re-design, not a translation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _kernel(
+    table_ref,  # [B, max_blocks] int32 (SMEM, scalar-prefetch)
+    pos_ref,    # [B] int32
+    layer_ref,  # [1] int32
+    q_ref,      # [1, Hkv, G, hd]
+    k_ref,      # [1, 1, bs, Hkv, hd]
+    v_ref,      # [1, 1, bs, Hkv, hd]
+    *rest,      # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
+    scale: float,
+    block_size: int,
+    quant: bool,
+):
+    from jax.experimental import pallas as pl
+
+    if quant:  # int8 pool: per-(token, head) scales ride along
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    pos = pos_ref[b]
+    n_live = pos // block_size + 1  # blocks holding attendable tokens
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j < n_live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Hkv, G, hd]
+        k = k_ref[0, 0].astype(jnp.float32)               # [bs, Hkv, hd]
+        if quant:  # dequantize in-register; int8 is what crossed HBM
+            k = k * ks_ref[0, 0][..., None]
+        kt = k.transpose(1, 2, 0)                         # [Hkv, hd, bs]
+        s = jax.lax.dot_general(
+            q, kt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )                                                 # [Hkv, G, bs]
+        token_idx = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2
+        )
+        s = jnp.where(token_idx <= pos, s, _NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))  # [Hkv, G]
+        alpha = jnp.exp(m_prev - m_new)                   # j==0: exp(-1e30-m)=0
+        p = jnp.exp(s - m_new[..., None])                 # [Hkv, G, bs]
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1)
+        v = v_ref[0, 0].astype(jnp.float32)               # [bs, Hkv, hd]
+        if quant:
+            v = v * vs_ref[0, 0][..., None]
+        vt = v.transpose(1, 0, 2)                         # [Hkv, bs, hd]
+        pv = jax.lax.dot_general(
+            p, vt, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )                                                 # [Hkv, G, hd]
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+        m_ref[...] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[...][..., None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,            # [B, 1, H, hd] (compute dtype)
+    k_pool: jax.Array,       # [L, num_blocks, bs, Hkv, hd] (cache pool, whole)
+    v_pool: jax.Array,       # same
+    block_table: jax.Array,  # [B, max_blocks] int32 (slot -> pool blocks)
+    pos_b: jax.Array,        # [B] int32: each slot's current write position
+    layer_idx,               # int (unrolled loop) or int32 scalar
+    k_scale: jax.Array | None = None,  # [L, num_blocks, bs, Hkv] f32 (int8 pool)
+    v_scale: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, 1, H, hd] in q.dtype. The pool is passed WHOLE (no
+    per-layer slice — a slice operand would make XLA materialize a layer
+    copy, re-creating the traffic this kernel exists to kill); the layer is
+    resolved inside the index maps. With k_scale/v_scale the pool is int8
+    and dequantization happens in-register per tile — int8 is what crosses
+    HBM, composing paged density with KV quantization."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, hd = q.shape
+    assert S == 1, "decode kernel: single query position"
+    _, _, bs, Hkv, _ = k_pool.shape
+    max_blocks = block_table.shape[1]
+    G = H // Hkv
+    quant = k_scale is not None
+
+    qg = q[:, 0].reshape(B, Hkv, G, hd)  # tiny; fine to materialize
+    layer_arr = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    table = block_table.astype(jnp.int32)
+    pos_arr = pos_b.astype(jnp.int32).reshape(B)
+
+    def kv_index(b, j, table_ref, pos_ref, layer_ref):
+        # Dead chunks (j >= live blocks) revisit the last live block: the
+        # pipeline elides the repeated copy, so they cost no HBM traffic.
+        n_live = pos_ref[b] // bs + 1
+        jj = jnp.minimum(j, n_live - 1)
+        return (layer_ref[0], table_ref[b, jj], 0, 0, 0)
+
+    def scale_index(b, j, table_ref, pos_ref, layer_ref):
+        n_live = pos_ref[b] // bs + 1
+        jj = jnp.minimum(j, n_live - 1)
+        return (layer_ref[0], table_ref[b, jj], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, Hkv, G, hd), lambda b, j, *_: (b, 0, 0, 0)),
+        pl.BlockSpec((1, 1, bs, Hkv, hd), kv_index),
+        pl.BlockSpec((1, 1, bs, Hkv, hd), kv_index),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs, Hkv), scale_index),
+            pl.BlockSpec((1, 1, bs, Hkv), scale_index),
+        ]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, max_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Hkv, G, hd), lambda b, j, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, G), jnp.float32),
+            pltpu.VMEM((Hkv, G, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=hd**-0.5, block_size=bs, quant=quant),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(table, pos_arr, layer_arr, *operands)
+    return out.reshape(B, 1, H, hd)

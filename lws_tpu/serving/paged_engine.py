@@ -66,8 +66,6 @@ class PagedBatchEngine:
         block_size: int = 16,
         num_blocks: Optional[int] = None,
     ):
-        if cfg.kv_quant:
-            raise NotImplementedError("kv_quant is not supported by PagedBatchEngine yet")
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
         self.cfg = cfg
@@ -101,8 +99,9 @@ class PagedBatchEngine:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
         @partial(jax.jit, donate_argnums=(0,))
-        def _insert(cache, slot_k, slot_v, block_ids, pos_b, tokens, slot, plen, first_token):
-            cache = paged_insert(cache, slot_k, slot_v, block_ids)
+        def _insert(cache, slot_k, slot_v, block_ids, pos_b, tokens, slot, plen,
+                    first_token, slot_ks=None, slot_vs=None):
+            cache = paged_insert(cache, slot_k, slot_v, block_ids, slot_ks, slot_vs)
             return cache, pos_b.at[slot].set(plen), tokens.at[slot].set(first_token)
 
         @partial(jax.jit, donate_argnums=(1,), static_argnums=(6,))
@@ -167,9 +166,14 @@ class PagedBatchEngine:
             self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
         )
         prefill_ids = jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
+        scales = (
+            (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
+            if self.cfg.kv_quant
+            else ()
+        )
         self.cache, self.pos_b, self.tokens = self._insert(
             self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
-            self.pos_b, self.tokens, slot, plen, first[0],
+            self.pos_b, self.tokens, slot, plen, first[0], *scales,
         )
         req.tokens.append(int(first[0]))
         if req.done:

@@ -1,0 +1,227 @@
+"""Paged decode-attention pallas kernel: exactness vs the XLA gather
+reference (interpret mode on CPU), across GQA/MHA, scrambled block tables,
+block-boundary positions, and multi-layer pools (VERDICT r3 #1 — the kernel
+that replaces the dense-view gather at models/llama.py forward_decode_paged)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu.models.llama import _cached_attention
+from lws_tpu.ops.paged_attention import paged_decode_attention
+
+
+def reference(q, k_pool, v_pool, table, pos_b, layer):
+    """The gather path the kernel replaces: materialize each slot's logical
+    view, then dense cached attention."""
+    B = q.shape[0]
+    Hkv, hd = k_pool.shape[3], k_pool.shape[4]
+    k_l, v_l = k_pool[layer], v_pool[layer]
+    k_view = k_l[table].reshape(B, -1, Hkv, hd)
+    v_view = v_l[table].reshape(B, -1, Hkv, hd)
+    return _cached_attention(q, k_view, v_view, pos_b)
+
+
+def make_case(rng, B, H, Hkv, hd, L, num_blocks, bs, max_blocks):
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((L, num_blocks, bs, Hkv, hd)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((L, num_blocks, bs, Hkv, hd)), jnp.float32)
+    # Scrambled non-contiguous allocation; unallocated tail entries -> null 0.
+    table = np.zeros((B, max_blocks), np.int32)
+    pool_free = list(range(1, num_blocks))
+    rng.shuffle(pool_free)
+    pos = np.empty((B,), np.int32)
+    for b in range(B):
+        pos[b] = rng.integers(0, max_blocks * bs)
+        n_live = pos[b] // bs + 1
+        table[b, :n_live] = [pool_free.pop() for _ in range(n_live)]
+    return q, k_pool, v_pool, jnp.asarray(table), jnp.asarray(pos)
+
+
+@pytest.mark.parametrize("H,Hkv", [(4, 2), (4, 4), (8, 2)])
+def test_kernel_matches_gather_reference(H, Hkv):
+    rng = np.random.default_rng(0)
+    B, hd, L, bs, max_blocks = 5, 128, 3, 8, 6
+    num_blocks = B * max_blocks + 1
+    q, k_pool, v_pool, table, pos = make_case(
+        rng, B, H, Hkv, hd, L, num_blocks, bs, max_blocks
+    )
+    for layer in range(L):
+        got = paged_decode_attention(
+            q, k_pool, v_pool, table, pos, layer, interpret=True
+        )
+        want = reference(q, k_pool, v_pool, table, pos, layer)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_block_boundary_positions():
+    """pos exactly at block edges: last block holds exactly 1 token / is
+    exactly full — the masking and live-block count must agree with the
+    reference at both edges."""
+    rng = np.random.default_rng(1)
+    B, H, Hkv, hd, L, bs, max_blocks = 4, 4, 2, 128, 1, 8, 4
+    q, k_pool, v_pool, table, _ = make_case(
+        rng, B, H, Hkv, hd, L, B * max_blocks + 1, bs, max_blocks
+    )
+    table = jnp.asarray(
+        np.arange(1, B * max_blocks + 1, dtype=np.int32).reshape(B, max_blocks)
+    )
+    for pos_val in [0, bs - 1, bs, 2 * bs - 1, max_blocks * bs - 1]:
+        pos = jnp.full((B,), pos_val, jnp.int32)
+        got = paged_decode_attention(q, k_pool, v_pool, table, pos, 0, interpret=True)
+        want = reference(q, k_pool, v_pool, table, pos, 0)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_mixed_lengths_ignore_null_and_stale_blocks():
+    """Slots at very different lengths; dead table entries point at the null
+    block AND at blocks owned by other slots (release/reuse) — neither may
+    leak into another slot's attention."""
+    rng = np.random.default_rng(2)
+    B, H, Hkv, hd, L, bs, max_blocks = 3, 8, 2, 128, 2, 8, 4
+    num_blocks = 8
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((L, num_blocks, bs, Hkv, hd)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((L, num_blocks, bs, Hkv, hd)), jnp.float32)
+    table = jnp.asarray(
+        np.array(
+            [
+                [1, 2, 3, 4],   # long slot
+                [5, 0, 0, 0],   # short; tail = null
+                [6, 7, 1, 2],   # stale tail pointing at slot 0's blocks
+            ],
+            np.int32,
+        )
+    )
+    pos = jnp.asarray([max_blocks * bs - 1, 3, 2 * bs - 1], jnp.int32)
+    for layer in range(L):
+        got = paged_decode_attention(q, k_pool, v_pool, table, pos, layer, interpret=True)
+        want = reference(q, k_pool, v_pool, table, pos, layer)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_engine_with_kernel_matches_dense(monkeypatch):
+    """End-to-end: PagedBatchEngine with the kernel FORCED on (interpret
+    mode on CPU) must be token-identical to the dense engine."""
+    from lws_tpu.serving.batch_engine import BatchEngine
+    from lws_tpu.serving.paged_engine import PagedBatchEngine
+    from lws_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+
+    monkeypatch.setenv("LWS_TPU_PAGED_ATTN", "interpret")
+
+    dense = BatchEngine(cfg, params, slots=4, max_len=64)
+    paged = PagedBatchEngine(cfg, params, slots=4, max_len=64, block_size=8)
+    r = np.random.RandomState(3)
+    ps = [r.randint(1, 255, size=r.randint(4, 40)).astype(np.int32) for _ in range(4)]
+    ids_d = [dense.submit(p, max_new_tokens=12) for p in ps]
+    ids_p = [paged.submit(p, max_new_tokens=12) for p in ps]
+    dense.run_until_drained()
+    paged.run_until_drained()
+    for d, p in zip(ids_d, ids_p):
+        assert dense.result(d) == paged.result(p)
+
+
+# ---------------------------------------------------------------------------
+# Paged x int8-KV composition (VERDICT r3 #4: the two density features must
+# compose — half-width KV rows over a footprint-sized pool).
+
+
+def quant_pools(rng, L, num_blocks, bs, Hkv, hd):
+    from lws_tpu.models.llama import _quantize_kv
+
+    k = jnp.asarray(rng.standard_normal((L, num_blocks, bs, Hkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((L, num_blocks, bs, Hkv, hd)), jnp.float32)
+    kq, ks = _quantize_kv(k)
+    vq, vs = _quantize_kv(v)
+    return kq, ks, vq, vs
+
+
+def test_quantized_kernel_matches_dequant_reference():
+    from lws_tpu.models.llama import _dequantize_kv
+
+    rng = np.random.default_rng(4)
+    B, H, Hkv, hd, L, bs, max_blocks = 4, 8, 2, 128, 2, 8, 4
+    num_blocks = B * max_blocks + 1
+    kq, ks, vq, vs = quant_pools(rng, L, num_blocks, bs, Hkv, hd)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.float32)
+    table = jnp.asarray(
+        np.arange(1, B * max_blocks + 1, dtype=np.int32).reshape(B, max_blocks)
+    )
+    pos = jnp.asarray(rng.integers(0, max_blocks * bs, size=B), jnp.int32)
+    for layer in range(L):
+        got = paged_decode_attention(
+            q, kq, vq, table, pos, layer, k_scale=ks, v_scale=vs, interpret=True
+        )
+        k_deq = _dequantize_kv(kq, ks, jnp.float32)
+        v_deq = _dequantize_kv(vq, vs, jnp.float32)
+        want = reference(q, k_deq, v_deq, table, pos, layer)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_int8_engine_kernel_matches_xla_fallback(monkeypatch):
+    """PagedBatchEngine with kv_quant: the pallas path and the XLA
+    gather+dequant fallback must produce identical greedy tokens from the
+    same quantized pool (the kernel changes traffic, not math)."""
+    from lws_tpu.serving.paged_engine import PagedBatchEngine
+    from lws_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False, kv_quant=True,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    r = np.random.RandomState(5)
+    ps = [r.randint(1, 255, size=r.randint(4, 40)).astype(np.int32) for _ in range(3)]
+
+    def run(mode):
+        monkeypatch.setenv("LWS_TPU_PAGED_ATTN", mode)
+        eng = PagedBatchEngine(cfg, params, slots=3, max_len=64, block_size=8)
+        ids = [eng.submit(p, max_new_tokens=10) for p in ps]
+        eng.run_until_drained()
+        return [eng.result(i) for i in ids]
+
+    assert run("interpret") == run("0")
+
+
+def test_paged_int8_close_to_paged_fp32():
+    """Accuracy smoke: int8-KV logits track the fp32 cache within
+    quantization noise on the first decode steps (not a token-exactness
+    claim — int8 IS lossy; this guards against sign/scale bugs)."""
+    from lws_tpu.models.llama import (
+        LlamaConfig, init_params, init_paged_cache, forward_decode_paged,
+    )
+    import dataclasses
+
+    cfg32 = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    cfg8 = dataclasses.replace(cfg32, kv_quant=True)
+    params = jax.jit(lambda: init_params(cfg32, jax.random.key(0)))()
+    B, bs, max_blocks = 2, 8, 4
+    table = jnp.asarray(
+        np.arange(1, B * max_blocks + 1, dtype=np.int32).reshape(B, max_blocks)
+    )
+    tokens = jnp.asarray([5, 9], jnp.int32)
+    pos = jnp.asarray([0, 3], jnp.int32)
+    c32 = init_paged_cache(cfg32, B * max_blocks + 1, bs)
+    c8 = init_paged_cache(cfg8, B * max_blocks + 1, bs)
+    logits32 = logits8 = None
+    for step in range(4):
+        logits32, c32 = forward_decode_paged(params, tokens, c32, table, pos, cfg32)
+        logits8, c8 = forward_decode_paged(params, tokens, c8, table, pos, cfg8)
+        tokens = jnp.argmax(logits32, axis=-1).astype(jnp.int32)
+        pos = pos + 1
+    err = jnp.max(jnp.abs(logits32 - logits8)) / jnp.max(jnp.abs(logits32))
+    assert err < 0.08, float(err)
