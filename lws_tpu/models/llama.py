@@ -151,6 +151,18 @@ def param_shardings(cfg: LlamaConfig) -> dict:
     }
 
 
+def cache_shardings(cfg: LlamaConfig):
+    """PartitionSpec tree matching init_cache: KV heads shard over tp (each
+    tp shard attends with its own heads; the o-projection all-reduce is the
+    only cross-shard exchange, inserted by GSPMD from wo's sharding), batch
+    over dp. Requires n_kv_heads % tp == 0 — checked by the Engine."""
+    kv = P(None, "dp", None, "tp", None)
+    if cfg.kv_quant:
+        return KVCache(k=kv, v=kv, pos=P(), k_scale=P(None, "dp", None, "tp"),
+                       v_scale=P(None, "dp", None, "tp"))
+    return KVCache(k=kv, v=kv, pos=P())
+
+
 # ---------------------------------------------------------------------------
 # Blocks
 

@@ -83,6 +83,46 @@ def run_tp_forward() -> int:
     return 0
 
 
+def run_serve_tp() -> int:
+    """BASELINE #3 serving shape: the group forms ONE tensor-parallel mesh
+    across its processes and serves through the TP-sharded Engine — params
+    and KV cache sharded over 'tp' spanning process boundaries, decode under
+    GSPMD. Every process must sample IDENTICAL tokens (the lm-head
+    all-reduce replicates the logits), which is what makes multi-host
+    serving coherent: any process can answer."""
+    from lws_tpu.parallel import initialize_from_env
+
+    info = initialize_from_env()
+
+    import jax
+    import jax.numpy as jnp
+
+    from lws_tpu.models import LlamaConfig, init_params
+    from lws_tpu.parallel import mesh_from_bootstrap
+    from lws_tpu.serving import Engine
+
+    mesh = mesh_from_bootstrap(info)
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+    with jax.set_mesh(mesh):
+        params = jax.jit(lambda: init_params(cfg, jax.random.key(7)))()
+        engine = Engine(cfg, params, batch_size=2, max_len=64, mesh=mesh)
+        prompt = (jnp.arange(32, dtype=jnp.int32) % 64).reshape(2, 16)
+        token, cache = engine.prefill(prompt)
+        token, cache, toks = engine.decode_n(token, cache, 8)
+        tokens = [int(t) for t in jax.device_get(toks).ravel()]
+
+    line = (
+        f"process={info.process_id}/{info.num_processes} "
+        f"tp={mesh.devices.size} tokens={tokens}"
+    )
+    _write_result(line)
+    print(f"[worker] {line}")
+    return 0
+
+
 def _write_result(line: str) -> None:
     """Atomic write: readers poll for the file and must never see it empty."""
     out = os.environ.get("LWS_TPU_RESULT_FILE")
@@ -100,6 +140,8 @@ def main() -> int:
         return run_psum()
     if cmd == "tp_forward":
         return run_tp_forward()
+    if cmd == "serve_tp":
+        return run_serve_tp()
     if cmd == "sleep":
         import time
 

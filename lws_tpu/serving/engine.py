@@ -20,10 +20,23 @@ import numpy as np
 from lws_tpu.models.llama import (
     KVCache,
     LlamaConfig,
+    cache_shardings,
     forward_prefill,
     forward_with_cache,
     init_cache,
+    param_shardings,
 )
+
+
+def shard_params_for_serving(params: dict, cfg: LlamaConfig, mesh) -> dict:
+    """Place params onto a serving mesh per the model's TP sharding rules
+    (weights split over 'tp'; the layer-stack dim rides 'pp', size 1 on a
+    pure-TP serving mesh). On a multi-host mesh every process calls this
+    with the same host params and jax builds the global sharded arrays."""
+    from jax.sharding import NamedSharding
+
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), param_shardings(cfg))
+    return jax.device_put(params, shardings)
 
 
 @dataclass(frozen=True)
@@ -80,8 +93,37 @@ class Engine:
         max_len: int = 2048,
         sampling: SamplingParams = SamplingParams(),
         seed: int = 0,
+        mesh=None,
     ):
+        """With `mesh` (axes incl. 'tp'/'dp'), the engine serves TENSOR-
+        PARALLEL under GSPMD: params are placed per param_shardings (pass
+        them pre-sharded or host-replicated — shard_params_for_serving is
+        applied when they aren't already on the mesh), the KV cache is
+        sharded over ('dp' batch, 'tp' kv-heads), and prefill/decode jits
+        pin those shardings so XLA inserts the tp collectives (the o-proj /
+        lm-head all-reduces) and the cache never reshards between steps.
+        This is the single-model-too-big-for-one-chip path (BASELINE #3,
+        70B-class serving; ref vLLM-TPU TP=16 shape,
+        /root/reference/docs/examples/vllm/TPU/lws.yaml:22-34)."""
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+            if cfg.n_kv_heads % max(tp, 1):
+                raise ValueError(
+                    f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}"
+                )
+            from jax.sharding import NamedSharding
+
+            # Unconditional: device_put to the target shardings is an
+            # identity when params already match, and merely being ON the
+            # mesh (e.g. compiler-chosen replication) is not TP-sharded.
+            params = shard_params_for_serving(params, cfg, mesh)
+            self._cache_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), cache_shardings(cfg)
+            )
+        else:
+            self._cache_shardings = None
         self.params = params
         self.batch_size = batch_size
         self.max_len = max_len
@@ -91,7 +133,19 @@ class Engine:
         cfg_static = cfg
         sampling_static = sampling
 
-        @jax.jit
+        if mesh is not None:
+            # Pin the phase outputs: tokens replicated, cache on its mesh
+            # shardings — the cache must never reshard between steps.
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            _rep = NamedSharding(mesh, _P())
+            _sh2 = {"out_shardings": (_rep, self._cache_shardings)}
+            _sh3 = {"out_shardings": (_rep, self._cache_shardings, _rep)}
+        else:
+            _sh2 = {}
+            _sh3 = {}
+
+        @partial(jax.jit, **_sh2)
         def _prefill(params, tokens, cache, key):
             # Engine.prefill always starts on an empty cache, so the
             # flash-attention prefill path applies (causal over the prompt
@@ -99,12 +153,12 @@ class Engine:
             logits, cache = forward_prefill(params, tokens, cache, cfg_static)
             return sample_logits(logits, key, sampling_static), cache
 
-        @partial(jax.jit, donate_argnums=(2,))
+        @partial(jax.jit, donate_argnums=(2,), **_sh2)
         def _decode(params, tokens, cache, key):
             logits, cache = forward_with_cache(params, tokens[:, None], cache, cfg_static)
             return sample_logits(logits, key, sampling_static), cache
 
-        @partial(jax.jit, donate_argnums=(2,), static_argnums=(3,))
+        @partial(jax.jit, donate_argnums=(2,), static_argnums=(3,), **_sh3)
         def _decode_n(params, tokens, cache, n, key):
             # Whole decode loop on-device: one dispatch for n steps (no
             # per-step host round trips — critical on relay-backed links).
@@ -119,7 +173,7 @@ class Engine:
             )
             return token, cache, toks.swapaxes(0, 1)  # [B, n]
 
-        @partial(jax.jit, donate_argnums=(2,))
+        @partial(jax.jit, donate_argnums=(2,), **_sh2)  # (hidden rep, cache pinned)
         def _prefill_chunk(params, tokens, cache):
             # Chunked prefill step: compiled ONCE for the chunk shape and
             # reused across chunks and requests.
@@ -127,7 +181,7 @@ class Engine:
 
             return forward_prefill_chunk(params, tokens, cache, cfg_static)
 
-        @partial(jax.jit, donate_argnums=(1,), static_argnums=(3,))
+        @partial(jax.jit, donate_argnums=(1,), static_argnums=(3,), **_sh2)
         def _finish_chunked(params, cache, hidden, last_off, key):
             import dataclasses as _dc
 
@@ -141,6 +195,12 @@ class Engine:
         self._prefill = _prefill
         self._decode = _decode
         self._decode_n = _decode_n
+        # Jitted ONCE here: a per-call jit(lambda) would re-trace and
+        # re-compile the cache init on every request.
+        self._new_cache = jax.jit(
+            lambda: init_cache(cfg_static, batch_size, max_len),
+            **({"out_shardings": self._cache_shardings} if mesh is not None else {}),
+        )
 
     @property
     def sampling(self) -> SamplingParams:
@@ -153,7 +213,7 @@ class Engine:
         return sub
 
     def new_cache(self) -> KVCache:
-        return init_cache(self.cfg, self.batch_size, self.max_len)
+        return self._new_cache()
 
     def prefill(self, tokens: jax.Array) -> tuple[jax.Array, KVCache]:
         """tokens [B, S] -> (first generated token [B], cache)."""

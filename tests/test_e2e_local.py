@@ -172,3 +172,47 @@ def test_real_process_failure_recreates_group(tmp_path):
             assert proc is not None and proc.poll() is None
     finally:
         backend.shutdown()
+
+
+def test_real_process_group_serves_tp_sharded_engine(tmp_path):
+    """VERDICT r3 #3: the orchestrated group (2 procs x 2 virtual devices =
+    tp=4) serves through the TP-SHARDED Engine — params + KV cache sharded
+    across process boundaries, decode_n under GSPMD — and both processes
+    sample IDENTICAL tokens (multi-host serving coherence: any process can
+    answer)."""
+    template = PodTemplateSpec(
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="worker",
+                    command=[sys.executable, "-m", "lws_tpu.runtime.worker", "serve_tp"],
+                    env=[EnvVar("LWS_TPU_RESULT_FILE", str(tmp_path / "$(POD_NAME).txt"))],
+                )
+            ]
+        )
+    )
+    lws = LeaderWorkerSet(
+        meta=new_meta("servetp"),
+        spec=LeaderWorkerSetSpec(
+            replicas=1,
+            leader_worker_template=LeaderWorkerTemplate(worker_template=template, size=2),
+        ),
+    )
+    cp = ControlPlane()
+    backend = make_backend(
+        cp, tmp_path, extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    )
+    cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+    try:
+        cp.create(lws)
+        cp.run_until_stable()
+        wait_for_files(cp, backend, tmp_path, {"servetp-0.txt", "servetp-0-1.txt"})
+        lines = sorted((tmp_path / n).read_text().strip() for n in ("servetp-0.txt", "servetp-0-1.txt"))
+        assert "tp=4" in lines[0], lines
+        import ast
+
+        token_strs = {l.split("tokens=")[1] for l in lines}
+        assert len(token_strs) == 1, f"processes sampled different tokens: {lines}"
+        assert len(ast.literal_eval(token_strs.pop())) == 16  # 2 slots x 8 steps
+    finally:
+        backend.shutdown()
