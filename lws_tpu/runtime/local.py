@@ -165,6 +165,7 @@ class LocalBackend:
                 value = _localize(value)
             env[e.name] = value
         env["POD_NAME"] = pod.meta.name
+        env["POD_NAMESPACE"] = pod.meta.namespace  # downward-API parity
         env.update(self.env_overrides)
         stdout = None
         if self.log_dir is not None:

@@ -1,15 +1,20 @@
 """Disaggregated serving workers: the llm-d shape (BASELINE config #5) as
 runnable processes under a DisaggregatedSet.
 
-  python -m lws_tpu.serving.disagg_worker prefill --handoff DIR
-  python -m lws_tpu.serving.disagg_worker decode  --handoff DIR
+  python -m lws_tpu.serving.disagg_worker prefill --transport tcp
+  python -m lws_tpu.serving.disagg_worker decode  --transport tcp
 
-The prefill role consumes prompt files (`<id>.prompt.npy`), runs
-`Engine.prefill`, and writes the KV cache + first token as a handoff bundle
-(`<id>.kv.npz`). The decode role consumes bundles, runs `Engine.decode_n`,
-and writes `<id>.tokens.npy`. The handoff directory stands in for the
-cross-slice DCN transfer; the endpoints real deployments would dial are the
-DS's per-(slice, revision, role) `-prv` services.
+TCP transport (the real data plane, VERDICT r3 #5): the prefill worker
+serves prompts-in / KV-bundles-out on its LWS_TPU_KV_PORT; the decode
+worker DISCOVERS prefill's endpoint from the DS's revision-aware `-prv`
+service record via the API server (LWS_TPU_API), pulls bundles over the
+socket, decodes, and serves results on its own port. KV bytes move over
+TCP only — zero shared-filesystem coupling (ref the reference's
+service_manager.go:126-163 endpoint publication).
+
+Directory transport (--transport dir, the round-2 stand-in): prompt files
+(`<id>.prompt.npy`) -> bundle files (`<id>.kv.npz`) -> `<id>.tokens.npy`
+in a shared --handoff dir; kept for single-host dev without an API server.
 
 Both roles build the SAME model from a shared seed (in production: the same
 checkpoint), so prefill's cache is exactly what decode expects — verified by
@@ -66,18 +71,14 @@ def run_prefill(handoff: str, once: bool) -> int:
             path = _claim(os.path.join(handoff, fname), me)
             if path is None:
                 continue  # a replica beat us to it
+            from lws_tpu.serving.kv_transport import cache_to_bundle
+
             prompt = np.load(path)
             token, cache = engine.prefill(prompt.reshape(1, -1))
             out = os.path.join(handoff, f"{req_id}.kv.npz")
-            tmp = out + ".tmp.npz"  # keep the .npz suffix so np.savez doesn't append one
-            extra = {}
-            if cache.k_scale is not None:  # kv_quant caches carry scales
-                extra = {"k_scale": np.asarray(cache.k_scale), "v_scale": np.asarray(cache.v_scale)}
-            np.savez(
-                tmp,
-                k=np.asarray(cache.k), v=np.asarray(cache.v),
-                pos=np.asarray(cache.pos), token=np.asarray(token), **extra,
-            )
+            tmp = out + ".tmp.npz"
+            with open(tmp, "wb") as f:
+                f.write(cache_to_bundle(cache, token))
             os.replace(tmp, out)
             os.remove(path)
             print(f"[prefill] handed off {req_id} (pos={int(cache.pos)})", flush=True)
@@ -86,11 +87,17 @@ def run_prefill(handoff: str, once: bool) -> int:
         time.sleep(0.2)
 
 
+def _decode_bundle(engine, payload: bytes, steps: int) -> np.ndarray:
+    """Bundle bytes -> [B, steps+1] tokens (first token + decode_n)."""
+    from lws_tpu.serving.kv_transport import bundle_to_cache
+
+    cache, token = bundle_to_cache(payload)
+    first = np.asarray(token)
+    _, _, tokens = engine.decode_n(token, cache, steps)
+    return np.concatenate([first[:, None], np.asarray(tokens)], axis=1)
+
+
 def run_decode(handoff: str, steps: int, once: bool) -> int:
-    import jax.numpy as jnp
-
-    from lws_tpu.models.llama import KVCache
-
     engine = build_engine(batch=1, max_len=32)
     print(f"[decode {os.environ.get('POD_NAME', '?')}] ready, watching {handoff}")
     me = os.environ.get("POD_NAME", str(os.getpid()))
@@ -101,16 +108,8 @@ def run_decode(handoff: str, steps: int, once: bool) -> int:
             path = _claim(os.path.join(handoff, fname), me)
             if path is None:
                 continue
-            bundle = np.load(path)
-            cache = KVCache(
-                k=jnp.asarray(bundle["k"]), v=jnp.asarray(bundle["v"]),
-                pos=jnp.asarray(bundle["pos"]),
-                k_scale=jnp.asarray(bundle["k_scale"]) if "k_scale" in bundle else None,
-                v_scale=jnp.asarray(bundle["v_scale"]) if "v_scale" in bundle else None,
-            )
-            token = jnp.asarray(bundle["token"])
-            _, _, tokens = engine.decode_n(token, cache, steps)
-            full = np.concatenate([np.asarray(bundle["token"])[:, None], np.asarray(tokens)], axis=1)
+            with open(path, "rb") as f:
+                full = _decode_bundle(engine, f.read(), steps)
             out = os.path.join(handoff, f"{req_id}.tokens.npy")
             np.save(out + ".tmp.npy", full)
             os.replace(out + ".tmp.npy", out)
@@ -121,13 +120,99 @@ def run_decode(handoff: str, steps: int, once: bool) -> int:
         time.sleep(0.2)
 
 
+def _own_pod(client, namespace: str, pod_name: str) -> dict:
+    return client.get("Pod", namespace, pod_name)
+
+
+def run_prefill_tcp(once: bool) -> int:
+    """Serve prompts-in / KV-bundles-out on LWS_TPU_KV_PORT. With `once`,
+    exit after the first bundle has been pulled AND acked by a peer."""
+    from lws_tpu.serving import kv_transport as kt
+
+    engine = build_engine(batch=1, max_len=32)
+    server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
+    print(f"[prefill {os.environ.get('POD_NAME', '?')}] serving KV on :{server.port}",
+          flush=True)
+    while True:
+        if once and server.bundles_delivered >= 1:
+            return 0
+        item = server.next_prompt(timeout=0.5)
+        if item is None:
+            continue
+        meta, payload = item
+        req_id = meta["id"]
+        prompt = kt.bytes_to_arrays(payload)["prompt"]
+        token, cache = engine.prefill(prompt.reshape(1, -1))
+        server.offer_bundle({"id": req_id}, kt.cache_to_bundle(cache, token))
+        print(f"[prefill] handed off {req_id} (pos={int(cache.pos)})", flush=True)
+
+
+def run_decode_tcp(steps: int, once: bool) -> int:
+    """Discover prefill's endpoint from the DS -prv service record (via the
+    API server), pull KV bundles over TCP, decode, serve results. With
+    `once`, exit after the first result has been delivered to a peer."""
+    import time as _time
+
+    from lws_tpu.api import disagg
+    from lws_tpu.client import RemoteClient
+    from lws_tpu.serving import kv_transport as kt
+
+    engine = build_engine(batch=1, max_len=32)
+    server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
+    me = os.environ.get("POD_NAME", str(os.getpid()))
+    namespace = os.environ.get("POD_NAMESPACE", "default")
+    client = RemoteClient(os.environ["LWS_TPU_API"])
+    own = _own_pod(client, namespace, me)
+    labels = own["metadata"]["labels"]
+    ds_name = labels[disagg.DS_NAME_LABEL_KEY]
+    # Pin the pairing to OUR revision and slice: during a rollout both
+    # revisions' -prv services coexist, and pairing across them would decode
+    # against different weights (silently wrong tokens).
+    revision = labels.get(disagg.DS_REVISION_LABEL_KEY)
+    slice_idx = labels.get(disagg.DS_SLICE_LABEL_KEY)
+    print(f"[decode {me}] serving results on :{server.port}; discovering "
+          f"prefill of DS {ds_name!r} rev={revision} slice={slice_idx}", flush=True)
+
+    endpoint = None
+    while True:
+        if once and server.results_served >= 1:
+            return 0
+        if endpoint is None:
+            # The -prv service exists only once the revision is ready on ALL
+            # roles — poll the record, not a filesystem.
+            endpoint = kt.discover_role_endpoint(
+                client, namespace, ds_name, "prefill",
+                revision=revision, slice_idx=slice_idx,
+            )
+            if endpoint is None:
+                _time.sleep(0.5)
+                continue
+            print(f"[decode] prefill endpoint via -prv service: {endpoint}", flush=True)
+        try:
+            pulled = kt.pull_bundle(endpoint, timeout=1.0)
+        except OSError:
+            endpoint = None  # peer rolled/moved: rediscover through the service
+            continue
+        if pulled is None:
+            continue
+        meta, payload = pulled
+        full = _decode_bundle(engine, payload, steps)
+        server.post_result(meta["id"], {"id": meta["id"]}, kt.arrays_to_bytes(tokens=full))
+        print(f"[decode] finished {meta['id']}: {full[0][:8]}...", flush=True)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("role", choices=["prefill", "decode"])
+    parser.add_argument("--transport", choices=["dir", "tcp"], default="dir")
     parser.add_argument("--handoff", default=os.environ.get("LWS_TPU_HANDOFF_DIR", "/tmp/lws-handoff"))
     parser.add_argument("--steps", type=int, default=6)
     parser.add_argument("--once", action="store_true")
     args = parser.parse_args()
+    if args.transport == "tcp":
+        if args.role == "prefill":
+            return run_prefill_tcp(args.once)
+        return run_decode_tcp(args.steps, args.once)
     os.makedirs(args.handoff, exist_ok=True)
     if args.role == "prefill":
         return run_prefill(args.handoff, args.once)

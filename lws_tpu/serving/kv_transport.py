@@ -1,0 +1,291 @@
+"""KV-cache handoff transport: length-prefixed frames over TCP.
+
+The disaggregated data plane (llm-d shape, BASELINE #5): the prefill role
+serves its finished KV bundles on a TCP port; the decode role DISCOVERS that
+endpoint from the DS's revision-aware `-prv` service record in the API
+server (ref service_manager.go:126-163 — the service selector names the
+pods; the pod's address + declared KV port form the endpoint, exactly how a
+k8s Service routes to containerPort) and pulls bundles over the socket.
+No shared filesystem anywhere (VERDICT r3 #5).
+
+Frame = !II (header_len, payload_len) + JSON header + raw payload bytes.
+One request per connection: dial, send one op frame, read one reply frame,
+close — the bundles are MB-scale, so connection setup is noise, and
+stateless requests keep replica failover trivial (any endpoint of the
+service can answer).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import queue
+import socket
+import struct
+import threading
+from typing import Optional
+
+_FRAME = struct.Struct("!II")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def send_msg(sock: socket.socket, meta: dict, payload: bytes = b"") -> None:
+    header = json.dumps(meta).encode()
+    sock.sendall(_FRAME.pack(len(header), len(payload)) + header + payload)
+
+
+def recv_msg(sock: socket.socket) -> tuple[Optional[dict], bytes]:
+    raw = _recv_exact(sock, _FRAME.size)
+    if raw is None:
+        return None, b""
+    hlen, plen = _FRAME.unpack(raw)
+    header = _recv_exact(sock, hlen)
+    if header is None:
+        return None, b""
+    payload = _recv_exact(sock, plen) if plen else b""
+    return json.loads(header), payload or b""
+
+
+def arrays_to_bytes(**arrays) -> bytes:
+    """npz-serialize a dict of arrays (the KV bundle wire format)."""
+    import numpy as np
+
+    bio = io.BytesIO()
+    np.savez(bio, **{k: np.asarray(v) for k, v in arrays.items()})
+    return bio.getvalue()
+
+
+def bytes_to_arrays(data: bytes) -> dict:
+    import numpy as np
+
+    return dict(np.load(io.BytesIO(data)))
+
+
+def cache_to_bundle(cache, token) -> bytes:
+    """KVCache + first token -> wire bundle. The ONE place the bundle schema
+    lives (both transports and both roles go through here)."""
+    arrays = {"k": cache.k, "v": cache.v, "pos": cache.pos, "token": token}
+    if cache.k_scale is not None:  # kv_quant caches carry scales
+        arrays.update(k_scale=cache.k_scale, v_scale=cache.v_scale)
+    return arrays_to_bytes(**arrays)
+
+
+def bundle_to_cache(data: bytes):
+    """Wire bundle -> (KVCache, first token [B])."""
+    import jax.numpy as jnp
+
+    from lws_tpu.models.llama import KVCache
+
+    bundle = bytes_to_arrays(data)
+    cache = KVCache(
+        k=jnp.asarray(bundle["k"]), v=jnp.asarray(bundle["v"]),
+        pos=jnp.asarray(bundle["pos"]),
+        k_scale=jnp.asarray(bundle["k_scale"]) if "k_scale" in bundle else None,
+        v_scale=jnp.asarray(bundle["v_scale"]) if "v_scale" in bundle else None,
+    )
+    return cache, jnp.asarray(bundle["token"])
+
+
+class KVServer:
+    """Per-worker handoff server. The owning worker enqueues/dequeues
+    locally; remote peers drive the queues through one-shot TCP ops:
+
+      submit_prompt  (router/client -> prefill)   meta {id}, payload bytes
+      pull_prompt    (unused remotely; prefill drains its own queue)
+      pull_bundle    (decode -> prefill)          reply meta {id}|{none};
+                     the puller ACKS on the same connection — unacked
+                     bundles are re-queued (at-least-once; decode is
+                     idempotent per id, so replays are harmless)
+      pull_result    (router/client -> decode)    meta {id}; the entry is
+                     evicted on delivery (no unbounded growth)
+    """
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0") -> None:
+        self._prompts: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
+        self._bundles: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
+        self._results: dict[str, tuple[dict, bytes]] = {}
+        self._results_lock = threading.Lock()
+        self.bundles_delivered = 0  # acked pulls (drives prefill --once)
+        self.results_served = 0     # delivered results (drives decode --once)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._closed = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    # ---- worker-side (in-process) ----------------------------------------
+    def next_prompt(self, timeout: float = 0.2) -> Optional[tuple[dict, bytes]]:
+        try:
+            return self._prompts.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def offer_bundle(self, meta: dict, payload: bytes) -> None:
+        self._bundles.put((meta, payload))
+
+    def post_result(self, req_id: str, meta: dict, payload: bytes) -> None:
+        with self._results_lock:
+            self._results[req_id] = (meta, payload)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ---- network side -----------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,), daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        with conn:
+            meta, payload = recv_msg(conn)
+            if meta is None:
+                return
+            op = meta.get("op")
+            if op == "submit_prompt":
+                self._prompts.put((meta, payload))
+                send_msg(conn, {"ok": True})
+            elif op == "pull_bundle":
+                try:
+                    bmeta, bpayload = self._bundles.get(timeout=meta.get("timeout", 1.0))
+                except queue.Empty:
+                    send_msg(conn, {"none": True})
+                    return
+                # At-least-once: the bundle is only discarded once the puller
+                # acks on this connection; any failure re-queues it (a lost
+                # MB-scale KV bundle would hang its request forever).
+                try:
+                    send_msg(conn, bmeta, bpayload)
+                    conn.settimeout(10.0)
+                    ack, _ = recv_msg(conn)
+                    if not (ack or {}).get("ack"):
+                        raise OSError("no ack")
+                    self.bundles_delivered += 1
+                except OSError:
+                    self._bundles.put((bmeta, bpayload))
+            elif op == "pull_result":
+                with self._results_lock:
+                    entry = self._results.get(meta.get("id", ""))
+                if entry is None:
+                    send_msg(conn, {"none": True})
+                    return
+                try:
+                    send_msg(conn, entry[0], entry[1])
+                except OSError:
+                    return  # keep the entry for a retry
+                with self._results_lock:
+                    self._results.pop(meta.get("id", ""), None)
+                self.results_served += 1
+            else:
+                send_msg(conn, {"error": f"unknown op {op!r}"})
+
+
+def _one_shot(endpoint: tuple[str, int], meta: dict, payload: bytes = b"",
+              timeout: float = 10.0) -> tuple[Optional[dict], bytes]:
+    with socket.create_connection(endpoint, timeout=timeout) as sock:
+        send_msg(sock, meta, payload)
+        return recv_msg(sock)
+
+
+def submit_prompt(endpoint, req_id: str, prompt_bytes: bytes) -> None:
+    meta, _ = _one_shot(endpoint, {"op": "submit_prompt", "id": req_id}, prompt_bytes)
+    if not (meta or {}).get("ok"):
+        raise RuntimeError(f"submit_prompt failed: {meta}")
+
+
+def pull_bundle(endpoint, timeout: float = 1.0):
+    """Returns (meta, payload), or None when the peer has nothing pending.
+    Acks receipt so the server can discard; a truncated reply raises (the
+    server re-queues unacked bundles, the caller rediscovers/retries)."""
+    with socket.create_connection(endpoint, timeout=timeout + 9.0) as sock:
+        send_msg(sock, {"op": "pull_bundle", "timeout": timeout})
+        meta, payload = recv_msg(sock)
+        if meta is None:
+            raise OSError("truncated pull_bundle reply")
+        if meta.get("none"):
+            return None
+        send_msg(sock, {"ack": True})
+        return meta, payload
+
+
+def pull_result(endpoint, req_id: str):
+    meta, payload = _one_shot(endpoint, {"op": "pull_result", "id": req_id})
+    if meta is None or meta.get("none"):
+        return None
+    return meta, payload
+
+
+# ---------------------------------------------------------------------------
+# Endpoint discovery from the DS `-prv` service record (API-server backed).
+
+
+def discover_role_endpoint(
+    client, namespace: str, ds_name: str, role: str,
+    port_env: str = "LWS_TPU_KV_PORT",
+    revision: Optional[str] = None,
+    slice_idx: Optional[str] = None,
+) -> Optional[tuple[str, int]]:
+    """Resolve role's KV endpoint THROUGH the revision-aware service record:
+    find the `-prv` Service labeled (ds, role), match its selector against
+    Pods (k8s Endpoints semantics: selector + readiness), and read the
+    pod's published address + its declared KV port (containerPort analog:
+    the `port_env` env var in the pod spec). `client` is a RemoteClient —
+    the worker talks to the API server exactly like any external consumer.
+
+    Pass `revision`/`slice_idx` (a worker passes ITS OWN labels) to pin the
+    pairing: during a rolling update old still-ready revisions keep their
+    -prv services alongside the target's, and multi-slice DSes publish one
+    service per slice — an unpinned pick could pair a new-revision decode
+    with an old-revision prefill (different weights: silent garbage) or
+    cross slices (the pairing is slice-scoped by design)."""
+    from lws_tpu.api import disagg
+
+    def svc_label(s, key):
+        return s.get("metadata", {}).get("labels", {}).get(key)
+
+    services = [
+        s for s in client.list("Service")
+        if s.get("metadata", {}).get("namespace") == namespace
+        and svc_label(s, disagg.DS_NAME_LABEL_KEY) == ds_name
+        and svc_label(s, disagg.DS_ROLE_LABEL_KEY) == role
+        and s.get("metadata", {}).get("name", "").endswith("-prv")
+        and (revision is None or svc_label(s, disagg.DS_REVISION_LABEL_KEY) == revision)
+        and (slice_idx is None or svc_label(s, disagg.DS_SLICE_LABEL_KEY) == str(slice_idx))
+    ]
+    for svc in services:
+        selector = svc.get("spec", {}).get("selector", {})
+        for pod in client.list("Pod"):
+            meta = pod.get("metadata", {})
+            if meta.get("namespace") != namespace:
+                continue
+            labels = meta.get("labels", {})
+            if any(labels.get(k) != v for k, v in selector.items()):
+                continue
+            status = pod.get("status", {})
+            if not status.get("ready"):
+                continue
+            host = status.get("address") or "127.0.0.1"
+            for container in pod.get("spec", {}).get("containers", []):
+                for env in container.get("env", []):
+                    if env.get("name") == port_env and env.get("value"):
+                        return host, int(env["value"])
+    return None

@@ -1,9 +1,14 @@
 """e2e: REAL disaggregated serving — a DisaggregatedSet launches prefill and
-decode as separate OS processes; a prompt flows prompt -> prefill (KV cache
-handoff bundle) -> decode -> tokens, and the result is byte-identical to a
-single-engine oracle (BASELINE config #5, the llm-d shape)."""
+decode as separate OS processes; a prompt flows client -> prefill (KV cache
+bundle) -> decode -> client with KV BYTES OVER TCP ONLY, the decode worker
+discovering prefill's endpoint from the DS's revision-aware `-prv` service
+record through the API server (VERDICT r3 #5; ref
+service_manager.go:126-163). Result byte-identical to a single-engine
+oracle (BASELINE config #5, the llm-d shape). Zero shared-filesystem
+coupling: the only cross-process channels are the HTTP API and the KV
+sockets."""
 
-import os
+import socket
 import sys
 import time
 
@@ -18,14 +23,23 @@ from lws_tpu.api.disagg import (
 )
 from lws_tpu.api.pod import Container, EnvVar, PodSpec, PodTemplateSpec
 from lws_tpu.api.types import LeaderWorkerSetSpec, LeaderWorkerTemplate
+from lws_tpu.client import RemoteClient
 from lws_tpu.core.store import new_meta
 from lws_tpu.runtime import ControlPlane
+from lws_tpu.runtime.server import ApiServer
+from lws_tpu.serving import kv_transport as kt
 from tests.test_e2e_local import make_backend
 
 DECODE_STEPS = 6
 
 
-def role_spec(role: str, handoff: str):
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def role_spec(role: str, kv_port: int, api_url: str):
     return DisaggregatedRoleSpec(
         name=role,
         replicas=1,
@@ -40,9 +54,15 @@ def role_spec(role: str, handoff: str):
                                     name=role,
                                     command=[
                                         sys.executable, "-m", "lws_tpu.serving.disagg_worker",
-                                        role, "--handoff", handoff, "--steps", str(DECODE_STEPS),
+                                        role, "--transport", "tcp", "--steps", str(DECODE_STEPS),
                                     ],
-                                    env=[EnvVar("JAX_PLATFORMS", "cpu")],
+                                    env=[
+                                        EnvVar("JAX_PLATFORMS", "cpu"),
+                                        # containerPort analog: the declared KV
+                                        # endpoint port the service routes to.
+                                        EnvVar("LWS_TPU_KV_PORT", str(kv_port)),
+                                        EnvVar("LWS_TPU_API", api_url),
+                                    ],
                                 )
                             ]
                         )
@@ -53,19 +73,25 @@ def role_spec(role: str, handoff: str):
     )
 
 
-def test_disaggregated_prefill_decode_roundtrip(tmp_path):
-    handoff = str(tmp_path / "handoff")
-    os.makedirs(handoff)
+def test_disaggregated_prefill_decode_over_tcp(tmp_path):
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    api_url = f"http://127.0.0.1:{api.port}"
+    prefill_port, decode_port = free_port(), free_port()
 
     ds = DisaggregatedSet(
         meta=new_meta("llmd"),
         spec=DisaggregatedSetSpec(
-            roles=[role_spec("prefill", handoff), role_spec("decode", handoff)]
+            roles=[
+                role_spec("prefill", prefill_port, api_url),
+                role_spec("decode", decode_port, api_url),
+            ]
         ),
     )
-    cp = ControlPlane()
     backend = make_backend(cp, tmp_path)
     cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+    client = RemoteClient(api_url)
 
     try:
         cp.create(ds)
@@ -73,31 +99,85 @@ def test_disaggregated_prefill_decode_roundtrip(tmp_path):
         pods = sorted(p.meta.name for p in cp.store.list("Pod"))
         assert len(pods) == 2, pods  # one prefill, one decode leader
 
-        # Submit a request into the prefill role's queue.
-        prompt = np.array([5, 9, 2, 11, 7], dtype=np.int32)
-        np.save(str(tmp_path / "req1.prompt.npy"), prompt)
-        os.replace(str(tmp_path / "req1.prompt.npy"), os.path.join(handoff, "req1.prompt.npy"))
-
+        # The client discovers BOTH endpoints exactly like the decode worker
+        # does: through the -prv service records, via the HTTP API.
         deadline = time.time() + 150
-        result_path = os.path.join(handoff, "req1.tokens.npy")
-        while time.time() < deadline:
+        endpoints = {}
+        while time.time() < deadline and len(endpoints) < 2:
             backend.poll_all()
             cp.run_until_stable()
-            if os.path.exists(result_path):
+            for role in ("prefill", "decode"):
+                if role not in endpoints:
+                    ep = kt.discover_role_endpoint(client, "default", "llmd", role)
+                    if ep is not None:
+                        endpoints[role] = ep
+            time.sleep(0.3)
+        assert len(endpoints) == 2, f"-prv endpoints never published: {endpoints}"
+
+        # The pod goes Ready when its process is alive, which can precede the
+        # worker binding its KV port (engine compile) — dial with retries,
+        # exactly like a production client behind a service would.
+        prompt = np.array([5, 9, 2, 11, 7], dtype=np.int32)
+        while time.time() < deadline:
+            try:
+                kt.submit_prompt(
+                    endpoints["prefill"], "req1", kt.arrays_to_bytes(prompt=prompt)
+                )
+                break
+            except OSError:
+                time.sleep(0.5)
+        else:
+            pytest.fail("prefill endpoint never accepted the prompt")
+
+        result = None
+        while time.time() < deadline:
+            backend.poll_all()
+            try:
+                got = kt.pull_result(endpoints["decode"], "req1")
+            except OSError:
+                got = None
+            if got is not None:
+                result = kt.bytes_to_arrays(got[1])["tokens"]
                 break
             time.sleep(0.5)
-        else:
-            pytest.fail(f"no decode result; handoff dir: {os.listdir(handoff)}")
-
-        generated = np.load(result_path)
+        assert result is not None, "no decode result over TCP"
 
         # Oracle: the same model end-to-end in one engine.
         from lws_tpu.serving.disagg_worker import build_engine
 
         engine = build_engine(batch=1, max_len=32)
-        result = engine.generate(
+        oracle = engine.generate(
             np.asarray(prompt).reshape(1, -1), max_new_tokens=DECODE_STEPS + 1
         )
-        np.testing.assert_array_equal(generated[0], np.asarray(result.tokens)[0])
+        np.testing.assert_array_equal(result[0], np.asarray(oracle.tokens)[0])
     finally:
         backend.shutdown()
+        api.stop()
+
+
+def test_dir_transport_still_works(tmp_path):
+    """The round-2 directory transport stays available for single-host dev
+    (no API server); exercised end-to-end in one process pair."""
+    import os
+    import subprocess
+
+    handoff = str(tmp_path / "handoff")
+    os.makedirs(handoff)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    np.save(str(tmp_path / "r.prompt.npy"), np.array([3, 1, 4], np.int32))
+    os.replace(str(tmp_path / "r.prompt.npy"), os.path.join(handoff, "r.prompt.npy"))
+    pre = subprocess.run(
+        [sys.executable, "-m", "lws_tpu.serving.disagg_worker", "prefill",
+         "--handoff", handoff, "--once"],
+        env=env, timeout=120,
+    )
+    assert pre.returncode == 0
+    dec = subprocess.run(
+        [sys.executable, "-m", "lws_tpu.serving.disagg_worker", "decode",
+         "--handoff", handoff, "--steps", "4", "--once"],
+        env=env, timeout=120,
+    )
+    assert dec.returncode == 0
+    out = np.load(os.path.join(handoff, "r.tokens.npy"))
+    assert out.shape == (1, 5)

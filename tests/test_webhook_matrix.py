@@ -1,0 +1,508 @@
+"""Admission matrix: field-by-field assertions for every pod-mutation path
+and the LWS validation table, tracking the reference's integration suites
+case-by-case (VERDICT r3 #6):
+
+  P<n>  ≈ /root/reference/test/integration/webhooks/pod_test.go:<line>
+  L<n>  ≈ /root/reference/test/integration/webhooks/leaderworkerset_test.go:<line>
+
+Pod cases drive the REAL admission path — pods created through the store by
+the controllers, mutated by the registered webhook — and assert the exact
+labels, env values (names AND ordering), affinities, and annotations the
+contract promises. The reference's suite is 938 LoC of such cases; this is
+the same table re-expressed against the TPU-native contract."""
+
+import pytest
+
+from lws_tpu.api import contract
+from lws_tpu.api.meta import ObjectMeta
+from lws_tpu.api.pod import Container, Pod, PodSpec
+from lws_tpu.api.types import StartupPolicy, SubdomainPolicy, SubGroupPolicyType
+from lws_tpu.core.store import AdmissionError
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder, lws_pods, make_worker_template
+from lws_tpu.webhooks.pod_webhook import PodWebhook, gen_group_unique_key
+
+TPU_PORT = str(contract.TPU_PROCESS_DEFAULT_PORT)
+
+
+def run_cp(lws, **cp_kwargs):
+    cp = ControlPlane(auto_ready=True, **cp_kwargs)
+    cp.create(lws)
+    cp.run_until_stable()
+    return cp
+
+
+def pod(cp, name, lws_name="sample"):
+    for p in lws_pods(cp.store, lws_name):
+        if p.meta.name == name:
+            return p
+    raise AssertionError(f"pod {name} not found: {[p.meta.name for p in lws_pods(cp.store, lws_name)]}")
+
+
+def env_of(p, container=0):
+    return {e.name: e.value for e in p.spec.containers[container].env}
+
+
+def hostnames(p, container=0):
+    return env_of(p, container)[contract.TPU_WORKER_HOSTNAMES].split(",")
+
+
+# ---------------------------------------------------------------------------
+# Index labels (P:68, P:91, P:119)
+
+
+def test_p68_non_lws_pod_untouched():
+    """A pod without the LWS name label passes through unmutated."""
+    p = Pod(meta=ObjectMeta(name="loner-3", namespace="default"),
+            spec=PodSpec(containers=[Container(name="c")]))
+    PodWebhook().default(p, None)
+    assert p.meta.labels == {} and p.spec.containers[0].env == []
+    assert p.spec.affinity is None
+
+
+def test_p119_p91_index_labels_populated():
+    cp = run_cp(LWSBuilder().replicas(2).size(3).build())
+    leader = pod(cp, "sample-1")
+    assert leader.meta.labels[contract.GROUP_INDEX_LABEL_KEY] == "1"
+    assert leader.meta.labels[contract.WORKER_INDEX_LABEL_KEY] == "0"
+    worker = pod(cp, "sample-1-2")
+    assert worker.meta.labels[contract.WORKER_INDEX_LABEL_KEY] == "2"
+    assert worker.meta.labels[contract.GROUP_INDEX_LABEL_KEY] == "1"
+    # Group key: sha1(namespace/leaderName), identical across the group.
+    key = gen_group_unique_key("default", "sample-1")
+    assert leader.meta.labels[contract.GROUP_UNIQUE_HASH_LABEL_KEY] == key
+
+
+# ---------------------------------------------------------------------------
+# Subgroup labels (P:152, P:192, P:229)
+
+
+def test_p152_leader_subgroup_labels():
+    cp = run_cp(LWSBuilder().size(4).replicas(1).tpu_chips(4)
+                .leader_template(tpu_chips=4).subgroup(2).build())
+    leader = pod(cp, "sample-0")
+    assert leader.meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "0"
+    assert leader.meta.labels[contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY] == (
+        gen_group_unique_key("sample-0", "0")
+    )
+
+
+def test_p192_worker_subgroup_labels_leader_has_tpus():
+    """size=4, sgs=2, leader holds TPUs: size%sgs==0 -> worker w's subgroup
+    is w//sgs (P:192's table)."""
+    cp = run_cp(LWSBuilder().size(4).replicas(1).tpu_chips(4)
+                .leader_template(tpu_chips=4).subgroup(2).build())
+    assert pod(cp, "sample-0-1").meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "0"
+    assert pod(cp, "sample-0-2").meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "1"
+    assert pod(cp, "sample-0-3").meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "1"
+    assert pod(cp, "sample-0-2").meta.labels[contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY] == (
+        gen_group_unique_key("sample-0", "1")
+    )
+
+
+def test_p229_worker_subgroup_labels_leader_without_tpus():
+    """size=5, sgs=2, leader WITHOUT TPUs: (size-1)%sgs==0 -> the leader is
+    the folded extra pod, workers shift down: subgroup=(w-1)//sgs."""
+    cp = run_cp(LWSBuilder().size(5).replicas(1).tpu_chips(4)
+                .leader_template(tpu_chips=0).subgroup(2).build())
+    assert pod(cp, "sample-0-1").meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "0"
+    assert pod(cp, "sample-0-2").meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "0"
+    assert pod(cp, "sample-0-3").meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "1"
+    assert pod(cp, "sample-0-4").meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "1"
+
+
+# ---------------------------------------------------------------------------
+# TPU env negative cases (P:265, P:282, P:306)
+
+
+def test_p265_tpu_pod_outside_lws_gets_no_tpu_env():
+    p = Pod(meta=ObjectMeta(name="solo-0", namespace="default"),
+            spec=PodSpec(containers=[
+                Container(name="c", resources={contract.TPU_RESOURCE_NAME: 4})
+            ]))
+    PodWebhook().default(p, None)
+    assert contract.TPU_WORKER_HOSTNAMES not in env_of(p)
+
+
+def test_p282_p306_no_tpu_request_no_tpu_env():
+    cp = run_cp(LWSBuilder().replicas(1).size(2).build())  # no chips anywhere
+    for name in ("sample-0", "sample-0-1"):
+        env = env_of(pod(cp, name))
+        assert contract.TPU_WORKER_HOSTNAMES not in env
+        assert contract.TPU_WORKER_ID not in env
+        # ...but the generic LWS vars are still there.
+        assert env[contract.LWS_GROUP_SIZE] == "2"
+
+
+# ---------------------------------------------------------------------------
+# TPU env values, whole group (P:330, P:423, P:482, P:539, P:568)
+
+
+def test_p330_size5_leader_tpu_env_values():
+    cp = run_cp(LWSBuilder().replicas(1).size(5).tpu_chips(4)
+                .leader_template(tpu_chips=4).build())
+    leader = pod(cp, "sample-0")
+    env = env_of(leader)
+    assert hostnames(leader) == [
+        "sample-0.sample", "sample-0-1.sample", "sample-0-2.sample",
+        "sample-0-3.sample", "sample-0-4.sample",
+    ]
+    assert env[contract.TPU_WORKER_ID] == "0"
+    assert env[contract.TPU_NAME] == "sample-0"
+    assert env[contract.TPU_PROCESS_PORT] == TPU_PORT
+    assert env[contract.TPU_PROCESS_ADDRESSES] == ",".join(
+        f"{h}:{TPU_PORT}" for h in hostnames(leader)
+    )
+
+
+def test_p423_worker_tpu_env_leader_too():
+    cp = run_cp(LWSBuilder().replicas(1).size(3).tpu_chips(4)
+                .leader_template(tpu_chips=4).build())
+    w2 = pod(cp, "sample-0-2")
+    env = env_of(w2)
+    assert env[contract.TPU_WORKER_ID] == "2"  # leader holds id 0
+    assert hostnames(w2)[0] == "sample-0.sample"
+    assert len(hostnames(w2)) == 3
+    assert w2.meta.annotations[contract.LEADER_REQUESTS_TPUS_ANNOTATION_KEY] == "true"
+
+
+def test_p482_worker_tpu_env_leader_doesnt():
+    """Leader without TPUs is not a TPU worker: ids shift down by one and the
+    leader's hostname leaves the list (tpu.go:201-299 shift rule)."""
+    cp = run_cp(LWSBuilder().replicas(1).size(3).tpu_chips(4)
+                .leader_template(tpu_chips=0).build())
+    w2 = pod(cp, "sample-0-2")
+    env = env_of(w2)
+    assert env[contract.TPU_WORKER_ID] == "1"  # shifted: worker 1 had id 0
+    assert hostnames(w2) == ["sample-0-1.sample", "sample-0-2.sample"]
+    assert contract.LEADER_REQUESTS_TPUS_ANNOTATION_KEY not in w2.meta.annotations
+
+
+def test_p539_size2_worker_env():
+    cp = run_cp(LWSBuilder().replicas(1).size(2).tpu_chips(4)
+                .leader_template(tpu_chips=4).build())
+    env = env_of(pod(cp, "sample-0-1"))
+    assert env[contract.TPU_WORKER_ID] == "1"
+    assert env[contract.TPU_WORKER_HOSTNAMES] == "sample-0.sample,sample-0-1.sample"
+
+
+def test_p568_size1_leader_env():
+    cp = run_cp(LWSBuilder().replicas(1).size(1).tpu_chips(4).build())
+    leader = pod(cp, "sample-0")
+    env = env_of(leader)
+    assert env[contract.TPU_WORKER_ID] == "0"
+    assert env[contract.TPU_WORKER_HOSTNAMES] == "sample-0.sample"
+
+
+# ---------------------------------------------------------------------------
+# TPU env values, subgroups (P:395, P:452, P:510)
+
+
+def test_p395_leader_subgroup_tpu_env():
+    """size=10 sgs=5 leader with TPUs: subgroup 0's window includes the
+    leader and shifts right-edge left by one."""
+    cp = run_cp(LWSBuilder().replicas(1).size(10).tpu_chips(4)
+                .leader_template(tpu_chips=4).subgroup(5).build())
+    leader = pod(cp, "sample-0")
+    env = env_of(leader)
+    assert env[contract.TPU_WORKER_ID] == "0"
+    assert hostnames(leader) == [
+        "sample-0.sample", "sample-0-1.sample", "sample-0-2.sample",
+        "sample-0-3.sample", "sample-0-4.sample",
+    ]
+
+
+def test_p452_worker_subgroup_tpu_env_leader_too():
+    cp = run_cp(LWSBuilder().replicas(1).size(10).tpu_chips(4)
+                .leader_template(tpu_chips=4).subgroup(5).build())
+    # Worker 7 -> subgroup 1 (10%5==0, w//sgs) with window [5..9] unshifted?
+    # Leader requests TPUs and sub_index>0: window shifts left by one: [4..9-1].
+    w7 = pod(cp, "sample-0-7")
+    env = env_of(w7)
+    assert w7.meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "1"
+    assert env[contract.TPU_WORKER_ID] == str(7 % 5)
+    assert hostnames(w7) == [
+        "sample-0-5.sample", "sample-0-6.sample", "sample-0-7.sample",
+        "sample-0-8.sample", "sample-0-9.sample",
+    ]
+
+
+def test_p510_worker_subgroup_tpu_env_leader_doesnt():
+    """size=5 sgs=2 leader without TPUs: worker ids are (w-1)%sgs and the
+    windows are the plain [sgs*i+1, sgs*(i+1)] spans."""
+    cp = run_cp(LWSBuilder().replicas(1).size(5).tpu_chips(4)
+                .leader_template(tpu_chips=0).subgroup(2).build())
+    w3 = pod(cp, "sample-0-3")
+    env = env_of(w3)
+    assert w3.meta.labels[contract.SUBGROUP_INDEX_LABEL_KEY] == "1"
+    assert env[contract.TPU_WORKER_ID] == "0"  # (3-1)%2
+    assert hostnames(w3) == ["sample-0-3.sample", "sample-0-4.sample"]
+
+
+# ---------------------------------------------------------------------------
+# Multi-container interleave (P:595)
+
+
+def test_p595_multi_container_some_requesting_tpus():
+    """Two TPU containers interleave worker ids (pod j container i ->
+    j*n + i) and get per-container ports; non-TPU containers untouched."""
+    tmpl = make_worker_template(tpu_chips=4)
+    tmpl.spec.containers.append(Container(name="tpu2", resources={contract.TPU_RESOURCE_NAME: 4}))
+    tmpl.spec.containers.append(Container(name="sidecar"))
+    lws = LWSBuilder().replicas(1).size(2).build()
+    lws.spec.leader_worker_template.worker_template = tmpl
+    lws.spec.leader_worker_template.leader_template = None
+    cp = run_cp(lws)
+    w1 = pod(cp, "sample-0-1")
+    env0, env1 = env_of(w1, 0), env_of(w1, 1)
+    assert env0[contract.TPU_WORKER_ID] == "2"  # pod 1, container 0: 1*2+0
+    assert env1[contract.TPU_WORKER_ID] == "3"  # pod 1, container 1: 1*2+1
+    assert env0[contract.TPU_PROCESS_PORT] == TPU_PORT
+    assert env1[contract.TPU_PROCESS_PORT] == str(contract.TPU_PROCESS_DEFAULT_PORT + 1)
+    # Each host appears once per TPU container (interleaved hostname list).
+    assert len(hostnames(w1)) == 4
+    sidecar_env = env_of(w1, 2)
+    assert contract.TPU_WORKER_HOSTNAMES not in sidecar_env
+    assert sidecar_env[contract.LWS_GROUP_SIZE] == "2"  # generic vars: all containers
+
+
+# ---------------------------------------------------------------------------
+# Subdomain (P:357)
+
+
+def test_p357_unique_per_replica_subdomain():
+    cp = run_cp(LWSBuilder().replicas(2).size(2).tpu_chips(4)
+                .subdomain_policy(SubdomainPolicy.UNIQUE_PER_REPLICA).build())
+    leader = pod(cp, "sample-1")
+    assert leader.spec.subdomain == "sample-1"
+    # TPU hostnames ride the per-replica subdomain.
+    assert hostnames(leader)[0].endswith(".sample-1")
+
+
+# ---------------------------------------------------------------------------
+# Exclusive placement affinities (P:622, P:645, P:671, P:698, P:720, P:745)
+
+
+def exclusive_terms(p, label_key):
+    aff = p.spec.affinity
+    if aff is None:
+        return [], []
+    req = [t for t in aff.required_affinity
+           if any(r.key == label_key for r in t.match_expressions)]
+    anti = [t for t in aff.required_anti_affinity
+            if any(r.key == label_key for r in t.match_expressions)]
+    return req, anti
+
+
+def test_p622_leader_exclusive_affinity():
+    cp = run_cp(LWSBuilder().replicas(1).size(2).tpu_chips(4)
+                .exclusive_topology("topo.k8s/rack").build())
+    leader = pod(cp, "sample-0")
+    req, anti = exclusive_terms(leader, contract.GROUP_UNIQUE_HASH_LABEL_KEY)
+    assert len(req) == 1 and len(anti) == 1
+    assert req[0].topology_key == "topo.k8s/rack"
+    key = leader.meta.labels[contract.GROUP_UNIQUE_HASH_LABEL_KEY]
+    assert req[0].match_expressions[0].values == [key]
+    ops = [r.operator.value for r in anti[0].match_expressions]
+    assert ops == ["Exists", "NotIn"]
+
+
+def test_p645_leader_group_plus_subgroup_affinity():
+    lws = (LWSBuilder().replicas(1).size(4).tpu_chips(4)
+           .leader_template(tpu_chips=4).subgroup(2)
+           .exclusive_topology("topo/slice")
+           .annotation(contract.SUBGROUP_EXCLUSIVE_KEY_ANNOTATION_KEY, "topo/subslice")
+           .build())
+    cp = run_cp(lws)
+    leader = pod(cp, "sample-0")
+    g_req, g_anti = exclusive_terms(leader, contract.GROUP_UNIQUE_HASH_LABEL_KEY)
+    s_req, s_anti = exclusive_terms(leader, contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY)
+    assert len(g_req) == len(g_anti) == 1  # group topology
+    assert len(s_req) == len(s_anti) == 1  # AND subgroup topology
+    assert g_req[0].topology_key == "topo/slice"
+    assert s_req[0].topology_key == "topo/subslice"
+
+
+def test_p671_worker_subgroup_only_affinity():
+    """Group-exclusive placement gates worker creation on the leader being
+    SCHEDULED (follow-the-leader nodeSelector), so this case runs against a
+    real scheduled cluster."""
+    from lws_tpu.sched import make_slice_nodes
+
+    cp = ControlPlane(auto_ready=True, enable_scheduler=True, require_binding=True)
+    for s in range(2):
+        nodes = make_slice_nodes(f"slice-{s}", topology="4x4")
+        for i, node in enumerate(nodes):  # sub-slice domains: host pairs
+            node.meta.labels["topo/subslice"] = f"slice-{s}-sub{i // 2}"
+        cp.add_nodes(nodes)
+    lws = (LWSBuilder().replicas(1).size(4).tpu_chips(4)
+           .leader_template(tpu_chips=4).subgroup(2)
+           .exclusive_topology()  # default slice topology key (schedulable)
+           .annotation(contract.SUBGROUP_EXCLUSIVE_KEY_ANNOTATION_KEY, "topo/subslice")
+           .build())
+    cp.create(lws)
+    cp.run_until_stable()
+    worker = pod(cp, "sample-0-2")
+    g_req, _ = exclusive_terms(worker, contract.GROUP_UNIQUE_HASH_LABEL_KEY)
+    s_req, s_anti = exclusive_terms(worker, contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY)
+    assert g_req == []  # workers follow the leader via nodeSelector, not affinity
+    assert len(s_req) == 1 and len(s_anti) == 1
+
+
+def test_p698_no_exclusive_no_affinity():
+    cp = run_cp(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    assert pod(cp, "sample-0").spec.affinity is None
+
+
+def test_p720_no_reapply_of_exclusive_terms():
+    cp = run_cp(LWSBuilder().replicas(1).size(2).tpu_chips(4)
+                .exclusive_topology("topo/rack").build())
+    leader = pod(cp, "sample-0")
+    before = len(leader.spec.affinity.required_affinity)
+    PodWebhook().default(leader, None)  # second admission pass (retry path)
+    assert len(leader.spec.affinity.required_affinity) == before
+
+
+def test_p745_user_affinity_terms_preserved():
+    from lws_tpu.api.pod import AffinityTerm, LabelSelectorRequirement, AffinityOperator, PodAffinity
+
+    tmpl = make_worker_template(tpu_chips=4)
+    tmpl.spec.affinity = PodAffinity(required_affinity=[
+        AffinityTerm(topology_key="user/zone", match_expressions=[
+            LabelSelectorRequirement("user-key", AffinityOperator.IN, ["v"])
+        ])
+    ])
+    lws = LWSBuilder().replicas(1).size(2).exclusive_topology("topo/rack").build()
+    lws.spec.leader_worker_template.worker_template = tmpl
+    cp = run_cp(lws)
+    leader = pod(cp, "sample-0")
+    keys = [t.topology_key for t in leader.spec.affinity.required_affinity]
+    assert "user/zone" in keys and "topo/rack" in keys
+
+
+# ---------------------------------------------------------------------------
+# Env ordering (P:801) + gang metadata (P:913)
+
+
+def test_p801_leader_address_is_first_env_var():
+    cp = run_cp(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    for name in ("sample-0", "sample-0-1"):
+        p = pod(cp, name)
+        for c in p.spec.containers:
+            assert c.env[0].name == contract.LWS_LEADER_ADDRESS
+            assert c.env[0].value == "sample-0.sample.default"
+
+
+def test_p913_gang_pod_group_annotation():
+    cp = ControlPlane(auto_ready=True, scheduler_provider="gang")
+    cp.create(LWSBuilder().replicas(1).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+    for p in lws_pods(cp.store, "sample"):
+        gang = p.meta.annotations[contract.POD_GROUP_ANNOTATION_KEY]
+        assert gang.startswith("sample-0-")  # <lws>-<groupIdx>-<revision>
+
+
+# ---------------------------------------------------------------------------
+# LeaderReady gate interplay: workers only exist after the leader is ready
+# (P: startup-policy rows; pod_controller.go:143-146)
+
+
+def test_leader_ready_gates_worker_mutation():
+    cp = ControlPlane(auto_ready=False)
+    cp.create(LWSBuilder().replicas(1).size(3)
+              .startup_policy(StartupPolicy.LEADER_READY).build())
+    cp.run_until_stable()
+    names = {p.meta.name for p in lws_pods(cp.store, "sample")}
+    assert names == {"sample-0"}  # leader only until ready
+    from lws_tpu.testing import set_pod_ready
+
+    set_pod_ready(cp.store, "default", "sample-0")
+    cp.run_until_stable()
+    names = {p.meta.name for p in lws_pods(cp.store, "sample")}
+    assert names == {"sample-0", "sample-0-1", "sample-0-2"}
+
+
+# ---------------------------------------------------------------------------
+# LWS validation table (L:219-:562)
+
+
+def reject(lws, match):
+    cp = ControlPlane()
+    with pytest.raises(AdmissionError, match=match):
+        cp.create(lws)
+
+
+def test_l219_invalid_dns1035_name_rejected():
+    for bad in ("Capital", "has_underscore", "-leading-dash", "trailing-", "0digit"):
+        reject(LWSBuilder(name=bad).build(), "DNS-1035")
+    reject(LWSBuilder(name="x" * 64).build(), "DNS-1035")
+
+
+def test_l231_l237_invalid_size_replicas():
+    bad = LWSBuilder().build()
+    bad.spec.leader_worker_template.size = 0
+    reject(bad, "size")
+    bad2 = LWSBuilder().build()
+    bad2.spec.replicas = -1
+    reject(bad2, "replicas")
+
+
+def test_l276_replicas_times_size_overflow():
+    bad = LWSBuilder().build()
+    bad.spec.replicas = 2**20
+    bad.spec.leader_worker_template.size = 2**12
+    reject(bad, "MaxInt32")
+
+
+def test_l249_l255_l261_subgroup_divisibility():
+    reject(LWSBuilder().size(5).subgroup(3).build(), "divisible")
+    reject(LWSBuilder().size(2).subgroup(3).build(), "greater than size")
+    reject(
+        LWSBuilder().size(5).subgroup(3, SubGroupPolicyType.LEADER_EXCLUDED).build(),
+        "LeaderExcluded",
+    )
+    # size-1 divisible works for LeaderExcluded (size 7, sgs 3).
+    cp = ControlPlane()
+    cp.create(LWSBuilder().size(7).subgroup(3, SubGroupPolicyType.LEADER_EXCLUDED).build())
+
+
+def test_l303_l322_subgroup_immutability():
+    cp = ControlPlane()
+    lws = cp.create(LWSBuilder().size(4).subgroup(2).build())
+    lws.spec.leader_worker_template.sub_group_policy.sub_group_size = 4
+    with pytest.raises(AdmissionError, match="immutable"):
+        cp.store.update(lws)
+    # Adding one after the fact is equally rejected.
+    cp2 = ControlPlane()
+    lws2 = cp2.create(LWSBuilder().size(4).build())
+    from lws_tpu.api.types import SubGroupPolicy
+
+    lws2.spec.leader_worker_template.sub_group_policy = SubGroupPolicy(sub_group_size=2)
+    with pytest.raises(AdmissionError, match="immutable"):
+        cp2.store.update(lws2)
+
+
+def test_l410_l485_budget_combinations():
+    reject(LWSBuilder().rollout(max_unavailable="150%").build(), "maxUnavailable")
+    reject(LWSBuilder().rollout(max_surge="101%").build(), "maxSurge")
+    reject(LWSBuilder().rollout(max_unavailable=-1).build(), "maxUnavailable")
+    reject(LWSBuilder().rollout(max_surge=-2).build(), "maxSurge")
+    reject(LWSBuilder().rollout(max_unavailable=0, max_surge=0).build(), "both")
+    # mU=0 + mS>0 is a valid surge-only rollout (L:466).
+    ControlPlane().create(LWSBuilder().rollout(max_unavailable=0, max_surge=1).build())
+    # mU > replicas allowed (L:410); percentages allowed.
+    ControlPlane().create(LWSBuilder().replicas(2).rollout(max_unavailable=5).build())
+    ControlPlane().create(LWSBuilder().rollout(max_unavailable="50%", max_surge="25%").build())
+
+
+def test_l494_l562_partition_rules():
+    reject(LWSBuilder().rollout(partition=-1).build(), "partition")
+    # partition >= replicas is allowed at create and update (L:502, L:544).
+    cp = ControlPlane()
+    lws = cp.create(LWSBuilder().replicas(2).rollout(partition=5).build())
+    lws.spec.rollout_strategy.rolling_update_configuration.partition = 2
+    cp.store.update(lws)
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.rollout_strategy.rolling_update_configuration.partition = -3
+    with pytest.raises(AdmissionError, match="partition"):
+        cp.store.update(lws)
