@@ -262,16 +262,100 @@ def cmd_drain(args) -> int:
     return 0
 
 
+# Parameterized install values (≈ ref charts/lws/values.yaml): every knob
+# the rendered bundle honors, with its default. Strict: unknown keys are
+# rejected, values are coerced to the default's type.
+INSTALL_VALUES = {
+    "port": 9443,
+    "backend": "local",            # pod backend: local | fake
+    "enableScheduler": True,
+    "schedulerProvider": "gang",   # "" | gang | external[:name]
+    "namespace": "lws-tpu-system",  # k8s hosted-mode namespace
+    "replicaCount": 2,             # hosted-mode replicas (active + standby)
+    "image": "lws-tpu:latest",     # hosted-mode controller image
+    "serviceType": "ClusterIP",
+    "enablePrometheus": False,     # scrape annotations on the hosted pod
+    "nameOverride": "",            # k8s object name prefix override
+}
+
+
+def resolve_install_values(values_file, sets, port=None, backend=None) -> dict:
+    """defaults <- --values file <- --set k=v (helm precedence); --port and
+    --backend remain as aliases for their values keys."""
+    values = dict(INSTALL_VALUES)
+
+    def apply(key, raw):
+        if key not in values:
+            raise ValueError(
+                f"unknown install value {key!r} (known: {', '.join(sorted(values))})"
+            )
+        default = INSTALL_VALUES[key]
+        if isinstance(default, bool):
+            if isinstance(raw, bool):
+                values[key] = raw
+            elif str(raw).lower() in ("true", "1", "yes"):
+                values[key] = True
+            elif str(raw).lower() in ("false", "0", "no"):
+                values[key] = False
+            else:
+                raise ValueError(f"{key} must be a boolean, got {raw!r}")
+        elif isinstance(default, int):
+            try:
+                values[key] = int(raw)
+            except (TypeError, ValueError):
+                raise ValueError(f"{key} must be an integer, got {raw!r}") from None
+        else:
+            values[key] = str(raw)
+
+    if values_file:
+        import yaml
+
+        with open(values_file) as f:
+            try:
+                data = yaml.safe_load(f) or {}
+            except yaml.YAMLError as e:
+                raise ValueError(f"{values_file}: invalid YAML ({e})") from None
+        if not isinstance(data, dict):
+            raise ValueError(f"{values_file} must contain a mapping")
+        for k, v in data.items():
+            apply(k, v)
+    for item in sets or ():
+        if "=" not in item:
+            raise ValueError(f"--set expects key=value, got {item!r}")
+        k, v = item.split("=", 1)
+        apply(k.strip(), v.strip())
+    if port is not None:
+        values["port"] = port
+    if backend is not None:
+        values["backend"] = backend
+    if values["backend"] not in ("local", "fake"):
+        raise ValueError(f"backend must be 'local' or 'fake', got {values['backend']!r}")
+    if values["serviceType"] not in ("ClusterIP", "NodePort", "LoadBalancer"):
+        raise ValueError(f"invalid serviceType {values['serviceType']!r}")
+    return values
+
+
 def cmd_install(args) -> int:
     """Render a one-command deployable bundle (≈ ref charts/lws + config/
     kustomize install tree + config/rbac): component config, TLS material,
     API tokens, durable state dir, a systemd unit, and optional Kubernetes
-    manifests for clusters that host the control plane as a pod."""
+    manifests for clusters that host the control plane as a pod. Values-
+    parameterized like the reference helm chart: --values file.yaml and
+    repeatable --set key=value override INSTALL_VALUES."""
     import os
     import stat
 
     from lws_tpu.core.auth import write_bootstrap_tokens
     from lws_tpu.core.certs import CertManager
+
+    try:
+        values = resolve_install_values(args.values, args.set, args.port, args.backend)
+    except (ValueError, OSError) as e:
+        print(f"install: {e}", file=sys.stderr)
+        return 1
+    port = values["port"]
+    namespace = values["namespace"]
+    app_name = values["nameOverride"] or "lws-tpu"
 
     root = os.path.abspath(args.dir)
     os.makedirs(root, exist_ok=True)
@@ -290,18 +374,23 @@ def cmd_install(args) -> int:
         tokens = write_bootstrap_tokens(token_path)
     paths = CertManager(os.path.join(root, "tls")).ensure()
 
+    gang_section = (
+        f"gangSchedulingManagement:\n  schedulerProvider: {values['schedulerProvider']}\n"
+        if values["schedulerProvider"]
+        else ""
+    )
     with open(os.path.join(root, "config.yaml"), "w") as f:
         f.write(
             "# lws-tpu component config (strict-decoded; see lws_tpu/config.py)\n"
-            f"api:\n  port: {args.port}\n"
-            f"backend: {args.backend}\n"
-            "enableScheduler: true\n"
-            "gangSchedulingManagement:\n  schedulerProvider: gang\n"
+            f"api:\n  port: {port}\n"
+            f"backend: {values['backend']}\n"
+            f"enableScheduler: {'true' if values['enableScheduler'] else 'false'}\n"
+            + gang_section
         )
 
     serve_cmd = (
         f"{args.python} -m lws_tpu serve --config {root}/config.yaml "
-        f"--port {args.port} --state-dir {state_dir} "
+        f"--port {port} --state-dir {state_dir} "
         f"--tls-dir {root}/tls --token-file {root}/tokens.csv"
     )
     start = os.path.join(root, "start.sh")
@@ -322,45 +411,61 @@ def cmd_install(args) -> int:
 
     k8s = os.path.join(root, "kubernetes")
     os.makedirs(k8s, exist_ok=True)
+    prom_annotations = (
+        "      annotations:\n"
+        "        prometheus.io/scrape: 'true'\n"
+        f"        prometheus.io/port: '{port}'\n"
+        if values["enablePrometheus"]
+        else ""
+    )
     with open(os.path.join(k8s, "deployment.yaml"), "w") as f:
         f.write(
             "# Hosted mode: run the control plane as a cluster workload\n"
             "# (tokens/TLS mounted from the Secret; state on a PVC so the WAL\n"
             "#  survives rescheduling). kubectl apply -f kubernetes/\n"
             "apiVersion: apps/v1\nkind: Deployment\nmetadata:\n"
-            "  name: lws-tpu-controller\n  namespace: lws-tpu-system\n"
-            "spec:\n  replicas: 2  # active + --standby hot spare over the shared PVC\n"
-            "  selector:\n    matchLabels: {app: lws-tpu}\n"
-            "  template:\n    metadata:\n      labels: {app: lws-tpu}\n"
+            f"  name: {app_name}-controller\n  namespace: {namespace}\n"
+            f"spec:\n  replicas: {values['replicaCount']}"
+            "  # active + --standby hot spares over the shared PVC\n"
+            f"  selector:\n    matchLabels: {{app: {app_name}}}\n"
+            f"  template:\n    metadata:\n      labels: {{app: {app_name}}}\n"
+            + prom_annotations +
             "    spec:\n      containers:\n      - name: controller\n"
-            "        image: lws-tpu:latest\n"
-            f"        args: [serve, --config, /etc/lws-tpu/config.yaml, --port, '{args.port}',\n"
+            f"        image: {values['image']}\n"
+            f"        args: [serve, --config, /etc/lws-tpu/config.yaml, --port, '{port}',\n"
             "               --state-dir, /var/lib/lws-tpu, --tls-dir, /etc/lws-tpu/tls,\n"
             "               --token-file, /etc/lws-tpu/tokens.csv, --standby]\n"
-            f"        ports: [{{containerPort: {args.port}}}]\n"
+            f"        ports: [{{containerPort: {port}}}]\n"
             "        readinessProbe: {httpGet: {path: /readyz, port: "
-            f"{args.port}, scheme: HTTPS}}\n"
+            f"{port}, scheme: HTTPS}}\n"
             "        volumeMounts:\n"
             "        - {name: config, mountPath: /etc/lws-tpu}\n"
             "        - {name: state, mountPath: /var/lib/lws-tpu}\n"
             "      volumes:\n"
-            "      - {name: config, secret: {secretName: lws-tpu-config}}\n"
-            "      - {name: state, persistentVolumeClaim: {claimName: lws-tpu-state}}\n"
+            f"      - {{name: config, secret: {{secretName: {app_name}-config}}}}\n"
+            f"      - {{name: state, persistentVolumeClaim: {{claimName: {app_name}-state}}}}\n"
             "---\n"
             "apiVersion: v1\nkind: Service\nmetadata:\n"
-            "  name: lws-tpu\n  namespace: lws-tpu-system\n"
-            "spec:\n  selector: {app: lws-tpu}\n"
-            f"  ports: [{{port: {args.port}, targetPort: {args.port}}}]\n"
+            f"  name: {app_name}\n  namespace: {namespace}\n"
+            f"spec:\n  type: {values['serviceType']}\n"
+            f"  selector: {{app: {app_name}}}\n"
+            f"  ports: [{{port: {port}, targetPort: {port}}}]\n"
         )
     with open(os.path.join(k8s, "README.md"), "w") as f:
         f.write(
             "Create the config Secret + state PVC, then apply:\n\n"
-            "    kubectl create namespace lws-tpu-system\n"
-            "    kubectl -n lws-tpu-system create secret generic lws-tpu-config \\\n"
+            f"    kubectl create namespace {namespace}\n"
+            f"    kubectl -n {namespace} create secret generic {app_name}-config \\\n"
             "        --from-file=config.yaml=../config.yaml "
             "--from-file=tokens.csv=../tokens.csv\n"
-            "    kubectl -n lws-tpu-system apply -f .\n"
+            f"    kubectl -n {namespace} apply -f .\n"
         )
+    # The resolved values, recorded for reproducible re-renders (helm's
+    # `helm get values` analog).
+    import yaml as _yaml
+
+    with open(os.path.join(root, "values.yaml"), "w") as f:
+        _yaml.safe_dump(values, f, default_flow_style=False)
 
     with open(os.path.join(root, "README.md"), "w") as f:
         f.write(
@@ -372,7 +477,7 @@ def cmd_install(args) -> int:
             "Client usage:\n\n"
             f"    export LWS_TPU_TOKEN=$(head -2 {root}/tokens.csv | tail -1 | cut -d, -f1)\n"
             f"    {args.python} -m lws_tpu --cacert {paths.ca_cert} get lws "
-            f"--server https://127.0.0.1:{args.port}\n\n"
+            f"--server https://127.0.0.1:{port}\n\n"
             "Files: config.yaml (component config), tokens.csv (admin+view\n"
             "Bearer tokens, 0600), tls/ (auto-rotated self-signed CA+cert),\n"
             "state/ (snapshot + write-ahead log), lws-tpu.service (systemd),\n"
@@ -506,9 +611,16 @@ def main(argv=None) -> int:
     ip = sub.add_parser("install", help="render a deployable bundle: config, "
                         "TLS, API tokens, state dir, systemd unit, k8s manifests")
     ip.add_argument("dir")
-    ip.add_argument("--port", type=int, default=9443)
-    ip.add_argument("--backend", default="local", choices=("local", "fake"))
+    ip.add_argument("--port", type=int, default=None,
+                    help="alias for --set port=N")
+    ip.add_argument("--backend", default=None, choices=("local", "fake"),
+                    help="alias for --set backend=NAME")
     ip.add_argument("--python", default=sys.executable)
+    ip.add_argument("--set", action="append", metavar="KEY=VALUE",
+                    help="override an install value (repeatable; "
+                         "see lws_tpu.cli.INSTALL_VALUES for the schema)")
+    ip.add_argument("--values", default=None, metavar="FILE",
+                    help="YAML file of install values (helm values.yaml analog)")
     ip.set_defaults(fn=cmd_install)
 
     pp = sub.add_parser("plan-steps", help="print a DisaggregatedSet rollout step table")

@@ -1,0 +1,162 @@
+"""Preemption-storm soak (VERDICT r3 #9): TPU preemption is the norm the
+KEP-820 budget exists for (ref keps/820-distributed-preflight-check).
+At 128-slice scale, kill random slices mid-rollout and assert:
+
+  * the fleet re-converges — every group fully ready on surviving capacity,
+  * the KEP-820 restart budget is enforced (over-budget LWS goes terminally
+    Failed instead of restart-looping),
+  * no orphaned groups: every live pod belongs to a live group whose leader
+    exists, and no group is split across slices under exclusive placement.
+
+Marked slow: the storm case drives 128 slices x 4-pod groups through
+repeated preemption waves."""
+
+import random
+
+import pytest
+
+from lws_tpu.api import contract
+from lws_tpu.api.pod import PodPhase
+from lws_tpu.api.types import CONDITION_FAILED
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.sched import make_slice_nodes
+from lws_tpu.testing import LWSBuilder, condition_status, lws_pods
+
+
+def preempt_slice(cp: ControlPlane, slice_name: str) -> None:
+    """A slice going away = its nodes NotReady + its pods failed (what a
+    real TPU preemption does to a v5p slice)."""
+    for node in cp.store.list("Node"):
+        if node.meta.labels.get(contract.NODE_TPU_SLICE_LABEL) != slice_name:
+            continue
+        fresh = cp.store.get("Node", node.meta.namespace, node.meta.name)
+        fresh.status.ready = False
+        cp.store.update_status(fresh)
+
+
+def restore_slice(cp: ControlPlane, slice_name: str) -> None:
+    for node in cp.store.list("Node"):
+        if node.meta.labels.get(contract.NODE_TPU_SLICE_LABEL) != slice_name:
+            continue
+        fresh = cp.store.get("Node", node.meta.namespace, node.meta.name)
+        fresh.status.ready = True
+        cp.store.update_status(fresh)
+
+
+def assert_no_orphans(cp: ControlPlane, lws_name: str) -> None:
+    """Every pod belongs to a group whose leader exists; exclusive groups
+    are never split across slices."""
+    pods = lws_pods(cp.store, lws_name)
+    by_group: dict[str, list] = {}
+    for p in pods:
+        by_group.setdefault(p.meta.labels[contract.GROUP_INDEX_LABEL_KEY], []).append(p)
+    for group, members in by_group.items():
+        leaders = [p for p in members
+                   if p.meta.labels[contract.WORKER_INDEX_LABEL_KEY] == "0"]
+        assert leaders, f"group {group} has {len(members)} pods but no leader"
+        slices = set()
+        for p in members:
+            if not p.spec.node_name:
+                continue
+            node = cp.store.get("Node", "_cluster", p.spec.node_name)
+            slices.add(node.meta.labels[contract.NODE_TPU_SLICE_LABEL])
+        assert len(slices) <= 1, f"group {group} split across slices {slices}"
+
+
+@pytest.mark.slow
+def test_preemption_storm_at_128_slices():
+    n_slices, replicas, size = 128, 64, 4
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, require_binding=True,
+                      scheduler_provider="gang")
+    for s in range(n_slices):
+        cp.add_nodes(make_slice_nodes(f"slice-{s}", topology=f"{size}x4"))
+    cp.create(
+        LWSBuilder().replicas(replicas).size(size).tpu_chips(4)
+        .exclusive_topology().build()
+    )
+    cp.run_until_stable(max_iterations=2_000_000)
+    pods = lws_pods(cp.store, "sample")
+    assert len(pods) == replicas * size and all(p.status.ready for p in pods)
+
+    rng = random.Random(7)
+    # Three preemption waves, each mid-rollout: kill 8 random slices while a
+    # template update is in flight, then restore them.
+    for wave in range(3):
+        lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+        for c in lws.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = f"v{wave + 2}"
+        cp.store.update(lws)
+        cp.run_until_stable(max_iterations=2_000_000)
+
+        victims = rng.sample(range(n_slices), 8)
+        for v in victims:
+            preempt_slice(cp, f"slice-{v}")
+        cp.run_until_stable(max_iterations=2_000_000)
+        assert_no_orphans(cp, "sample")
+        for v in victims:
+            restore_slice(cp, f"slice-{v}")
+        cp.run_until_stable(max_iterations=2_000_000)
+
+    # Convergence: full fleet ready on the final template.
+    pods = lws_pods(cp.store, "sample")
+    assert len(pods) == replicas * size
+    assert all(p.status.ready for p in pods), (
+        f"{sum(not p.status.ready for p in pods)} pods not ready after storm"
+    )
+    leaders = [p for p in pods if p.meta.labels[contract.WORKER_INDEX_LABEL_KEY] == "0"]
+    assert all(p.spec.containers[0].image == "v4" for p in leaders)
+    assert_no_orphans(cp, "sample")
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.ready_replicas == replicas
+    assert condition_status(lws, CONDITION_FAILED) is not True
+
+
+@pytest.mark.slow
+def test_preemption_budget_enforced_under_storm():
+    """KEP-820: an LWS with maxGroupRestarts=2 that keeps losing its slice
+    goes terminally Failed instead of thrashing forever; a sibling with
+    budget headroom keeps recovering."""
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, require_binding=True)
+    for s in range(4):
+        cp.add_nodes(make_slice_nodes(f"slice-{s}", topology="2x4"))
+    cp.create(
+        LWSBuilder(name="budgeted").replicas(1).size(2).tpu_chips(4)
+        .exclusive_topology()
+        .annotation(contract.MAX_GROUP_RESTARTS_ANNOTATION_KEY, "2")
+        .build()
+    )
+    cp.create(
+        LWSBuilder(name="unbounded").replicas(1).size(2).tpu_chips(4)
+        .exclusive_topology().build()
+    )
+    cp.run_until_stable(max_iterations=1_000_000)
+
+    def slice_of(lws_name):
+        for p in lws_pods(cp.store, lws_name):
+            if p.spec.node_name:
+                node = cp.store.get("Node", "_cluster", p.spec.node_name)
+                return node.meta.labels[contract.NODE_TPU_SLICE_LABEL]
+        return None
+
+    for _ in range(4):  # storm: preempt whatever slice hosts each LWS
+        for name in ("budgeted", "unbounded"):
+            s = slice_of(name)
+            if s is None:
+                continue
+            preempt_slice(cp, s)
+            cp.run_until_stable(max_iterations=1_000_000)
+            restore_slice(cp, s)
+            cp.run_until_stable(max_iterations=1_000_000)
+
+    budgeted = cp.store.get("LeaderWorkerSet", "default", "budgeted")
+    assert condition_status(budgeted, CONDITION_FAILED) is True, (
+        budgeted.status.conditions
+    )
+    # Budget exhausted -> the group stays DOWN (no restart-loop thrash).
+    down = [p for p in lws_pods(cp.store, "budgeted") if p.status.phase == PodPhase.FAILED]
+    live = [p for p in lws_pods(cp.store, "budgeted") if p.status.ready]
+    assert not live or down, "budgeted LWS kept thrashing after Failed"
+
+    unbounded = cp.store.get("LeaderWorkerSet", "default", "unbounded")
+    assert condition_status(unbounded, CONDITION_FAILED) is not True
+    assert unbounded.status.ready_replicas == 1, unbounded.status
