@@ -103,7 +103,23 @@ def main() -> None:
 
     turnup, cp = bench_turnup(args.replicas, args.size)
     print(json.dumps(turnup))
-    print(json.dumps(bench_rollout(cp, args.replicas, args.size)))
+    rollout = bench_rollout(cp, args.replicas, args.size)
+    print(json.dumps(rollout))
+
+    # In-repo artifact so fleet numbers are captured, not STATUS.md prose
+    # (VERDICT r2 weak #7). Round tag from LWS_TPU_ROUND, default r03.
+    try:
+        from lws_tpu.core import _fastclone  # noqa: F401
+
+        native = True
+    except ImportError:
+        native = False
+    artifact_path = os.path.join(
+        _ROOT, f"CONTROL_{os.environ.get('LWS_TPU_ROUND', 'r03')}.json"
+    )
+    with open(artifact_path, "w") as f:
+        json.dump({"rows": [turnup, rollout], "native_clone": native}, f, indent=1)
+    print(json.dumps({"artifact": artifact_path}))
 
 
 if __name__ == "__main__":
