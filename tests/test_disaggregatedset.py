@@ -343,3 +343,91 @@ def test_observed_rollout_steps_match_planner_predictions():
             continue
         ok = any(between(obs, predicted[i], predicted[i + 1]) for i in range(len(predicted) - 1))
         assert ok, f"executor state {obs} outside every predicted transition\npredicted={predicted}"
+
+
+def test_abc_mid_rollout_drains_newest_first():
+    """Mid-rollout A->B->C (ref e2e_test.go:978 'drain B before A'): B is a
+    bad intermediate that never goes ready; pushing C mid-rollout must drain
+    B (newest old revision) to zero while stable A still holds capacity, and
+    only then drain A."""
+    from lws_tpu.testing import make_all_groups_ready
+
+    cp = ControlPlane(auto_ready=False)
+    ds = cp.create(make_ds(roles=[role("prefill"), role("decode")]))
+    cp.run_until_stable()
+    rev_a = dsutils.compute_revision(ds.spec.roles)
+    for name in child_lws(cp):
+        make_all_groups_ready(cp, name, max_rounds=30)
+    cp.run_until_stable()
+
+    def total_replicas(rev):
+        return sum(
+            l.spec.replicas for l in child_lws(cp).values()
+            if l.meta.labels[disagg.DS_REVISION_LABEL_KEY] == rev
+        )
+
+    # B: bad deploy — its pods never become ready.
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:broken"
+    cp.store.update(fetched)
+    rev_b = dsutils.compute_revision(fetched.spec.roles)
+    cp.run_until_stable()
+    assert total_replicas(rev_b) >= 0 and total_replicas(rev_a) > 0
+
+    # C: the fix, pushed mid-rollout.
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:fixed"
+    cp.store.update(fetched)
+    rev_c = dsutils.compute_revision(fetched.spec.roles)
+
+    b_zero_seen_while_a_alive = False
+    for _ in range(60):
+        cp.run_until_stable()
+        for name, lws in child_lws(cp).items():
+            if lws.meta.labels[disagg.DS_REVISION_LABEL_KEY] == rev_c:
+                make_all_groups_ready(cp, name, max_rounds=30)
+        cp.run_until_stable()
+        a, b, c = total_replicas(rev_a), total_replicas(rev_b), total_replicas(rev_c)
+        if b == 0 and a > 0:
+            b_zero_seen_while_a_alive = True  # newest-first: B dies before A
+        if a == 0 and b == 0 and c == 4:
+            break
+    assert b_zero_seen_while_a_alive, "B (newest old) must drain before A"
+    children = child_lws(cp)
+    assert {l.meta.labels[disagg.DS_REVISION_LABEL_KEY] for l in children.values()} == {rev_c}
+    assert all(l.status.ready_replicas == l.spec.replicas for l in children.values())
+
+
+@pytest.mark.parametrize("surge,expected_first_jump", [("25%", 1), ("100%", 4)])
+def test_per_role_percentage_grid(surge, expected_first_jump):
+    """Percentage budgets at more grid points (ref executor.go:235-260 +
+    VERDICT r2 missing #4): 25% of 4 -> steps of 1; 100% of 4 -> one jump."""
+    from lws_tpu.api.types import RollingUpdateConfiguration, RolloutStrategy
+
+    cp = ControlPlane(auto_ready=True)
+    roles = [role("prefill", replicas=4), role("decode", replicas=4)]
+    for r in roles:
+        r.template.spec.rollout_strategy = RolloutStrategy(
+            rolling_update_configuration=RollingUpdateConfiguration(max_surge=surge)
+        )
+    ds = cp.create(make_ds(roles=roles))
+    cp.run_until_stable()
+
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:v2"
+    cp.store.update(fetched)
+    rev2 = dsutils.compute_revision(fetched.spec.roles)
+    cp.run_until_stable()
+
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-0-{rev2}-prefill", f"llmd-0-{rev2}-decode"}
+    assert all(l.status.ready_replicas == 4 for l in children.values())
+    ups = [e.message for e in cp.recorder.events
+           if e.reason == "ScalingUp" and "prefill" in e.message]
+    assert any(f"from 0 to {expected_first_jump}" in m for m in ups), ups
