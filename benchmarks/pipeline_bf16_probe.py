@@ -1,0 +1,91 @@
+"""bf16 GPipe pipeline body on real TPU hardware (VERDICT r3 weak #7).
+
+The pipeline body runs f32 on the CPU test platform only (XLA:CPU aborts on
+the transpose of bf16 collectives — models/pipeline.py:28-38), so the bf16
+path had executed nowhere until hardware appeared. This probe runs the GPipe
+schedule in bf16 on the chip: forward vs the non-pipelined bf16 scan path
+(tolerance sized for bf16 accumulation) and one optax train step through the
+reverse schedule.
+
+Single-chip honesty: with one real TPU the pp axis is size 1, so the
+shard_map body, scan schedule, ppermute, and psum all execute in bf16 on TPU
+but cross-stage transfer is a self-permute. Multi-stage bf16 remains pending
+multi-chip hardware; the artifact records pp explicitly.
+
+Prints one JSON line; exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import jax
+
+import bench
+
+bench.force_cpu_if_dev()  # axon plugin overrides JAX_PLATFORMS; see helper
+
+import jax.numpy as jnp
+
+
+def main() -> None:
+    import dataclasses
+
+    from lws_tpu.models.llama import LlamaConfig, forward, init_params
+    from lws_tpu.models.train import init_train_state, make_optimizer, make_train_step
+    from lws_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        print(json.dumps({"skipped": "cpu backend — probe is for real TPU bf16"}))
+        return
+
+    n = len(jax.devices())
+    pp = 2 if n >= 2 else 1
+    cfg = LlamaConfig(
+        vocab_size=512, d_model=128, n_layers=4, n_heads=4, n_kv_heads=2,
+        d_ff=256, max_seq_len=64, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+        remat=False,
+    )
+    cfg_pipe = dataclasses.replace(cfg, pipeline_microbatches=2)
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    tokens = jax.random.randint(jax.random.key(2), (4, 16), 0, cfg.vocab_size).astype(jnp.int32)
+
+    mesh = build_mesh(MeshSpec(dp=1, pp=pp, tp=1), devices=jax.devices()[: pp])
+    dense_logits, _ = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    with jax.set_mesh(mesh):
+        piped_logits, _ = jax.jit(lambda p, t: forward(p, t, cfg_pipe))(params, tokens)
+    max_err = float(jnp.abs(
+        dense_logits.astype(jnp.float32) - piped_logits.astype(jnp.float32)
+    ).max())
+
+    # Train step: gradients through the bf16 reverse schedule.
+    opt = make_optimizer(lr=1e-2)
+    state = init_train_state(cfg_pipe, mesh, opt)
+    step = make_train_step(cfg_pipe, mesh, opt)
+    batch = {"tokens": jax.random.randint(jax.random.key(3), (4, 17), 0, cfg.vocab_size).astype(jnp.int32)}
+    p2, o2, l0, _ = step(state.params, state.opt_state, batch)
+    _, _, l1, _ = step(p2, o2, batch)
+    losses_finite = bool(jnp.isfinite(l0)) and bool(jnp.isfinite(l1))
+
+    ok = max_err < 0.25 and losses_finite  # bf16 logits tolerance
+    rec = {
+        "ok": ok,
+        "backend": backend,
+        "pp": pp,
+        "bf16_fwd_max_err_vs_scan": round(max_err, 4),
+        "train_losses": [round(float(l0), 4), round(float(l1), 4)],
+        "note": "pp=1 single-chip: bf16 body/schedule executed on TPU; multi-stage pending hardware" if pp == 1 else "multi-stage bf16 on chip",
+    }
+    print(json.dumps(rec))
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

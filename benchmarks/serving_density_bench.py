@@ -11,7 +11,7 @@ where footprint = prompt + decode budget (1280) < max_len (2048).
 
 Run: python benchmarks/serving_density_bench.py  (real chip; CPU = tiny smoke)
 Prints one JSON line per engine config AND writes the whole result set to
-DENSITY_<round>.json at the repo root (round from LWS_TPU_ROUND, default r03)
+DENSITY_<round>.json at the repo root (round tag from bench.ROUND_TAG)
 so the numbers are a driver-capturable artifact, not STATUS.md prose
 (VERDICT r2 weak #7). Includes a plain-Engine run as the throughput floor the
 paged config must beat (VERDICT r3 #1 acceptance).
@@ -30,6 +30,11 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
 import jax
+
+import bench
+
+bench.force_cpu_if_dev()  # axon plugin overrides JAX_PLATFORMS; see helper
+
 import jax.numpy as jnp
 
 from lws_tpu.models.llama import LlamaConfig, init_params
@@ -94,11 +99,9 @@ def measure_plain_engine(cfg, params, batch, prompt_len, max_len) -> dict:
 
 
 def main() -> None:
-    # Relay outages hang backend init forever; probe like bench.py does
-    # (_ROOT is on sys.path, so this is repo-root bench.py).
-    import bench
-    round_tag = os.environ.get("LWS_TPU_ROUND", "r03")
-    artifact_path = os.path.join(_ROOT, f"DENSITY_{round_tag}.json")
+    # Relay outages hang backend init forever; probe like bench.py does.
+    # Round tag comes from bench.ROUND_TAG — one bump site per round.
+    artifact_path = os.path.join(_ROOT, f"DENSITY_{bench.ROUND_TAG}.json")
     if not bench._probe_backend_with_retry(total_budget_s=600.0):
         rec = {"degraded": True, "note": "TPU relay unreachable; no fresh density numbers"}
         print(json.dumps(rec))

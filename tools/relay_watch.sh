@@ -1,0 +1,31 @@
+#!/bin/bash
+# Watch the TPU relay; the moment backend init succeeds, run the full bench
+# orchestrator (headline -> density -> int8w -> kernel validation -> bf16
+# pipeline probe) so one relay window of any length captures a prefix of the
+# artifact list (VERDICT r3 next #1). Exits after one full successful run.
+# Usage: nohup bash tools/relay_watch.sh >> relay_watch.log 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  echo "[watch] $(date -u +%FT%TZ) probing relay..."
+  if timeout 300 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[watch] $(date -u +%FT%TZ) RELAY UP — running bench orchestrator"
+    # Outer timeout must exceed the sum of bench.py's internal stage budgets
+    # (probe 1500 + density 1500 + int8w 900 + kernel 600 + pipeline 600 +
+    # headline measure time) or a slow-but-succeeding run gets killed.
+    LWS_TPU_ROUND=r04 timeout 9000 python bench.py > .bench_watch_out.json 2> .bench_watch_err.log
+    rc=$?
+    echo "[watch] bench rc=$rc; stdout:"; cat .bench_watch_out.json
+    # Complete = rc 0, fresh (not degraded), and no stage-level "error"
+    # records — a partial capture must leave the watcher alive to retry.
+    if [ $rc -eq 0 ] && grep -q '"value"' .bench_watch_out.json \
+        && ! grep -q '"degraded"' .bench_watch_out.json \
+        && ! grep -q '"error"' .bench_watch_out.json; then
+      echo "[watch] $(date -u +%FT%TZ) capture complete"
+      exit 0
+    fi
+    echo "[watch] bench did not complete cleanly; will retry next window"
+  else
+    echo "[watch] $(date -u +%FT%TZ) relay still down"
+  fi
+  sleep 300
+done
