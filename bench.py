@@ -388,7 +388,12 @@ def _run_stage_subprocess(argv: list[str], timeout_s: int, extra_env: dict | Non
         return {
             "rc": p.returncode,
             "stdout_tail": tail[-4:],
-            **({} if p.returncode == 0 else {"stderr_tail": (p.stderr or "")[-400:]}),
+            # Non-zero rc always carries an explicit "error" key — the relay
+            # watcher's completeness check greps for '"error":'.
+            **({} if p.returncode == 0 else {
+                "error": f"stage rc={p.returncode}",
+                "stderr_tail": (p.stderr or "")[-400:],
+            }),
         }
     except subprocess.TimeoutExpired:
         return {"rc": -1, "error": f"stage timed out after {timeout_s}s"}

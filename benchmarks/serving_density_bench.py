@@ -158,25 +158,14 @@ def main() -> None:
                 num_blocks=num_blocks,
             )
             try:
-                return measure(engine, prompt_len, *(() if on_chip else (2, 8)))
+                # The engine itself probes the kernel on first decode and
+                # falls back to the XLA gather path on compile failure;
+                # engine.stats records which path actually served.
+                return measure(engine, prompt_len, *(() if on_chip else (2, 8))), dict(engine.stats)
             finally:
                 del engine
 
-        # The kernel path mirrors the gate in models/llama.py; if its first
-        # real-chip contact fails, retry on the XLA fallback so the round
-        # still gets a density artifact (honestly attributed).
-        paged_env = os.environ.get("LWS_TPU_PAGED_ATTN", "1")
-        kernel_on = paged_env != "0" and (on_chip or paged_env == "interpret")
-        try:
-            r = run_config()
-        except Exception as e:
-            if not kernel_on:
-                raise
-            print(f"[density] kernel path failed ({e!r}); retrying with "
-                  "LWS_TPU_PAGED_ATTN=0", file=sys.stderr)
-            os.environ["LWS_TPU_PAGED_ATTN"] = "0"
-            kernel_on = False
-            r = run_config()
+        r, stats = run_config()
         rows.append({
             "metric": f"continuous-batching decode, {label}",
             "value": r["decode_tok_s"],
@@ -185,7 +174,8 @@ def main() -> None:
             "pool_gb": round(pool_gb, 2),
             "dense_equivalent_gb": round(dense_gb, 2),
             "admit_s": r["admit_s"],
-            "paged_attn_kernel": kernel_on,
+            "attention_path": stats["attention_path"],
+            **({"kernel_error": stats["kernel_error"]} if "kernel_error" in stats else {}),
         })
         print(json.dumps(rows[-1]))
     artifact = {

@@ -45,8 +45,6 @@ class BatchEngine:
     """Slot-based continuously-batched greedy engine."""
 
     def __init__(self, cfg: LlamaConfig, params: dict, slots: int = 8, max_len: int = 512):
-        if cfg.kv_quant:
-            raise NotImplementedError("kv_quant is not supported by BatchEngine yet")
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -79,6 +77,12 @@ class BatchEngine:
                 k=cache.k.at[:, slot].set(slot_cache.k[:, 0]),
                 v=cache.v.at[:, slot].set(slot_cache.v[:, 0]),
             )
+            if cache.k_scale is not None:  # int8 KV: scales ride with values
+                cache = _dc.replace(
+                    cache,
+                    k_scale=cache.k_scale.at[:, slot].set(slot_cache.k_scale[:, 0]),
+                    v_scale=cache.v_scale.at[:, slot].set(slot_cache.v_scale[:, 0]),
+                )
             return cache, pos_b.at[slot].set(plen), tokens.at[slot].set(first_token)
 
         @partial(jax.jit, donate_argnums=(1,))

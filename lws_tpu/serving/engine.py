@@ -272,12 +272,26 @@ class Engine:
         """AOT-compile the decode executables OUTSIDE generate()'s timed
         window so decode_tokens_per_s measures steady state — no device
         allocation or wasted decode steps. Each executable is warmed at most
-        once per Engine."""
+        once per Engine. Under a mesh the avals must carry the REAL
+        shardings: sharding-less structs lower a different executable than
+        the runtime call (wasting the warm compile) whose donation can't
+        alias — the 'donated buffers were not usable' warning."""
         warmed = getattr(self, "_warmed", set())
         self._warmed = warmed
         token_s = jax.ShapeDtypeStruct((self.batch_size,), jnp.int32)
         cache_s = jax.eval_shape(self.new_cache)
         key_s = jax.eval_shape(lambda: jax.random.key(0))
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            rep = NamedSharding(self.mesh, _P())
+
+            def with_sharding(struct, sh):
+                return jax.ShapeDtypeStruct(struct.shape, struct.dtype, sharding=sh)
+
+            token_s = with_sharding(token_s, rep)
+            cache_s = jax.tree.map(with_sharding, cache_s, self._cache_shardings)
+            key_s = with_sharding(key_s, rep)
         if chunked and "chunk" not in warmed:
             self._decode_n.lower(
                 self.params, token_s, cache_s, self.DECODE_CHUNK, key_s

@@ -72,25 +72,64 @@ def bytes_to_arrays(data: bytes) -> dict:
 
 def cache_to_bundle(cache, token) -> bytes:
     """KVCache + first token -> wire bundle. The ONE place the bundle schema
-    lives (both transports and both roles go through here)."""
-    arrays = {"k": cache.k, "v": cache.v, "pos": cache.pos, "token": token}
+    lives (both roles go through here).
+
+    Bundle bytes are ∝ PROMPT LENGTH, not the prefill engine's allocation:
+    the sequence dim is truncated to `pos` (only rows [0, pos) hold prompt
+    KV; everything past is zeros the decode mask never attends). A 1k-token
+    prompt in a 2k-slot allocation ships half the bytes; production prompts
+    in 70B-scale caches ship orders less than the reservation (VERDICT r3
+    next #3). For a tp-sharded cache np.asarray performs an explicit host
+    gather — the recorded len() of the result is the true wire cost; the
+    decode side re-shards onto ITS mesh (see disagg_worker)."""
+    import numpy as np
+
+    p = int(np.asarray(cache.pos))
+    arrays = {
+        "k": np.asarray(cache.k)[:, :, :p],
+        "v": np.asarray(cache.v)[:, :, :p],
+        "pos": cache.pos,
+        "token": token,
+    }
     if cache.k_scale is not None:  # kv_quant caches carry scales
-        arrays.update(k_scale=cache.k_scale, v_scale=cache.v_scale)
+        arrays.update(
+            k_scale=np.asarray(cache.k_scale)[:, :, :p],
+            v_scale=np.asarray(cache.v_scale)[:, :, :p],
+        )
     return arrays_to_bytes(**arrays)
 
 
-def bundle_to_cache(data: bytes):
-    """Wire bundle -> (KVCache, first token [B])."""
+def bundle_to_cache(data: bytes, max_len: Optional[int] = None):
+    """Wire bundle -> (KVCache, first token [B]).
+
+    `max_len` is the DECODE side's sequence budget: the pos-truncated prefix
+    from the wire is pasted into a zeroed [*, max_len, *] allocation with
+    room to append (decode's budget is its own, not prefill's). Omitted,
+    the cache is exactly the wire length — full for decode purposes."""
+    import numpy as np
+
     import jax.numpy as jnp
 
     from lws_tpu.models.llama import KVCache
 
     bundle = bytes_to_arrays(data)
+
+    def fit(a):
+        if max_len is None or a.shape[2] == max_len:
+            return a
+        if a.shape[2] > max_len:
+            raise ValueError(
+                f"bundle holds {a.shape[2]} KV rows but decode max_len={max_len}"
+            )
+        pad = [(0, 0)] * a.ndim
+        pad[2] = (0, max_len - a.shape[2])
+        return np.pad(a, pad)
+
     cache = KVCache(
-        k=jnp.asarray(bundle["k"]), v=jnp.asarray(bundle["v"]),
+        k=jnp.asarray(fit(bundle["k"])), v=jnp.asarray(fit(bundle["v"])),
         pos=jnp.asarray(bundle["pos"]),
-        k_scale=jnp.asarray(bundle["k_scale"]) if "k_scale" in bundle else None,
-        v_scale=jnp.asarray(bundle["v_scale"]) if "v_scale" in bundle else None,
+        k_scale=jnp.asarray(fit(bundle["k_scale"])) if "k_scale" in bundle else None,
+        v_scale=jnp.asarray(fit(bundle["v_scale"])) if "v_scale" in bundle else None,
     )
     return cache, jnp.asarray(bundle["token"])
 
@@ -107,9 +146,20 @@ class KVServer:
                      idempotent per id, so replays are harmless)
       pull_result    (router/client -> decode)    meta {id}; the entry is
                      evicted on delivery (no unbounded growth)
+
+    Trust model: the server binds the pod network (0.0.0.0) exactly like a
+    containerPort behind a k8s Service — network reachability IS the k8s
+    intra-cluster trust boundary. For anything stronger set LWS_TPU_KV_TOKEN
+    in both roles' env (or pass `token=`): every op must then carry the
+    matching "token" in its frame meta or is rejected unauthorized. The
+    client helpers read the same env var.
     """
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0") -> None:
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 token: Optional[str] = None) -> None:
+        import os
+
+        self._token = token if token is not None else os.environ.get("LWS_TPU_KV_TOKEN")
         self._prompts: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
         self._bundles: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
         self._results: dict[str, tuple[dict, bytes]] = {}
@@ -160,6 +210,9 @@ class KVServer:
             meta, payload = recv_msg(conn)
             if meta is None:
                 return
+            if self._token and meta.get("token") != self._token:
+                send_msg(conn, {"error": "unauthorized"})
+                return
             op = meta.get("op")
             if op == "submit_prompt":
                 self._prompts.put((meta, payload))
@@ -170,12 +223,16 @@ class KVServer:
                 except queue.Empty:
                     send_msg(conn, {"none": True})
                     return
-                # At-least-once: the bundle is only discarded once the puller
-                # acks on this connection; any failure re-queues it (a lost
-                # MB-scale KV bundle would hang its request forever).
+                # At-least-once END TO END: the bundle is only discarded once
+                # the puller acks on this connection, and the puller acks only
+                # after it has PROCESSED the bundle (result posted) — a decode
+                # crash mid-processing drops the connection, the bundle
+                # re-queues, and another pull redelivers (decode is idempotent
+                # per id, so replays are harmless). The ack window covers
+                # decode + first-call compile.
                 try:
                     send_msg(conn, bmeta, bpayload)
-                    conn.settimeout(10.0)
+                    conn.settimeout(float(meta.get("ack_timeout", 120.0)))
                     ack, _ = recv_msg(conn)
                     if not (ack or {}).get("ack"):
                         raise OSError("no ack")
@@ -183,26 +240,38 @@ class KVServer:
                 except OSError:
                     self._bundles.put((bmeta, bpayload))
             elif op == "pull_result":
+                # Pop under the lock BEFORE sending: two concurrent pulls for
+                # the same id must not both deliver (results_served drives
+                # --once exit); re-insert on send failure so a retry works.
                 with self._results_lock:
-                    entry = self._results.get(meta.get("id", ""))
+                    entry = self._results.pop(meta.get("id", ""), None)
                 if entry is None:
                     send_msg(conn, {"none": True})
                     return
                 try:
                     send_msg(conn, entry[0], entry[1])
                 except OSError:
-                    return  # keep the entry for a retry
-                with self._results_lock:
-                    self._results.pop(meta.get("id", ""), None)
+                    with self._results_lock:
+                        self._results.setdefault(meta.get("id", ""), entry)
+                    return
                 self.results_served += 1
             else:
                 send_msg(conn, {"error": f"unknown op {op!r}"})
 
 
+def _auth(meta: dict) -> dict:
+    import os
+
+    token = os.environ.get("LWS_TPU_KV_TOKEN")
+    if token:
+        meta = dict(meta, token=token)
+    return meta
+
+
 def _one_shot(endpoint: tuple[str, int], meta: dict, payload: bytes = b"",
               timeout: float = 10.0) -> tuple[Optional[dict], bytes]:
     with socket.create_connection(endpoint, timeout=timeout) as sock:
-        send_msg(sock, meta, payload)
+        send_msg(sock, _auth(meta), payload)
         return recv_msg(sock)
 
 
@@ -212,25 +281,49 @@ def submit_prompt(endpoint, req_id: str, prompt_bytes: bytes) -> None:
         raise RuntimeError(f"submit_prompt failed: {meta}")
 
 
-def pull_bundle(endpoint, timeout: float = 1.0):
-    """Returns (meta, payload), or None when the peer has nothing pending.
-    Acks receipt so the server can discard; a truncated reply raises (the
-    server re-queues unacked bundles, the caller rediscovers/retries)."""
+def pull_bundle(endpoint, timeout: float = 1.0, process=None,
+                ack_timeout: float = 120.0):
+    """Returns (meta, payload) — or `process(meta, payload)`'s result when a
+    callback is given — or None when the peer has nothing pending.
+
+    Without `process`, receipt is acked immediately (wire-level
+    at-least-once only: a crash after the ack loses the request — the
+    router's retry covers that). WITH `process`, the ack is sent only after
+    the callback returns: the server re-queues the bundle if the puller
+    dies mid-processing, making delivery at-least-once END TO END (decode
+    must be idempotent per id — replays happen). `ack_timeout` is forwarded
+    to the server as its ack-wait window — size it for the callback's worst
+    case (decode + first-call jit compile), or the server re-queues and
+    redelivers while the puller is still working."""
     with socket.create_connection(endpoint, timeout=timeout + 9.0) as sock:
-        send_msg(sock, {"op": "pull_bundle", "timeout": timeout})
+        send_msg(sock, _auth({
+            "op": "pull_bundle", "timeout": timeout, "ack_timeout": ack_timeout,
+        }))
         meta, payload = recv_msg(sock)
         if meta is None:
             raise OSError("truncated pull_bundle reply")
+        if meta.get("error"):
+            raise RuntimeError(f"pull_bundle rejected: {meta}")
         if meta.get("none"):
             return None
+        if process is None:
+            send_msg(sock, {"ack": True})
+            return meta, payload
+        result = process(meta, payload)  # raise => no ack => server re-queues
         send_msg(sock, {"ack": True})
-        return meta, payload
+        return result
 
 
 def pull_result(endpoint, req_id: str):
+    """None = not ready yet. Raises on protocol-level rejection (e.g. auth)
+    instead of handing the error reply back as if it were a result. A
+    delivered result whose meta carries "failed" is the DECODE's verdict on
+    a poison request — returned to the caller, who must check it."""
     meta, payload = _one_shot(endpoint, {"op": "pull_result", "id": req_id})
     if meta is None or meta.get("none"):
         return None
+    if meta.get("error"):
+        raise RuntimeError(f"pull_result rejected: {meta}")
     return meta, payload
 
 

@@ -32,10 +32,12 @@ import numpy as np
 
 from lws_tpu.models.llama import (
     LlamaConfig,
+    cache_shardings,
     forward_decode_paged,
     forward_prefill,
     init_cache,
     init_paged_cache,
+    paged_cache_shardings,
     paged_insert,
 )
 
@@ -65,10 +67,49 @@ class PagedBatchEngine:
         max_len: int = 512,
         block_size: int = 16,
         num_blocks: Optional[int] = None,
+        mesh=None,
     ):
+        """With `mesh` (axes incl. 'tp'), the engine serves TENSOR-PARALLEL
+        paged continuous batching under GSPMD: params per param_shardings,
+        K/V pools (+ scale pools) sharded over 'tp' on the kv-heads dim,
+        block tables / positions / tokens replicated (host-side allocation
+        state is identical on every shard). This is the conjunction the
+        70B-class llm-d shape needs — TP x paged x continuous batching in
+        ONE engine (ref vLLM-TPU TP=16 shape,
+        /root/reference/docs/examples/vllm/TPU/lws.yaml:22-34). dp inside
+        one pool is deliberately unused: blocks are randomly indexed, so dp
+        stays the replica-level axis (see paged_cache_shardings)."""
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
         self.cfg = cfg
+        self.mesh = mesh
+        self._tp = 1
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            self._tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+            if cfg.n_kv_heads % max(self._tp, 1):
+                raise ValueError(
+                    f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={self._tp}"
+                )
+            from lws_tpu.serving.engine import shard_params_for_serving
+
+            params = shard_params_for_serving(params, cfg, mesh)
+            self._pool_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), paged_cache_shardings(cfg)
+            )
+            self._rep = NamedSharding(mesh, _P())
+            # Single-request prefill cache: B=1 can't shard over dp.
+            self._prefill_cache_shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s), cache_shardings(cfg, dp=False)
+            )
+            _sh_prefill = {"out_shardings": (self._rep, self._prefill_cache_shardings)}
+            _sh_insert = {"out_shardings": (self._pool_shardings, self._rep, self._rep)}
+            _sh_step = {"out_shardings": (self._pool_shardings, self._rep, self._rep, self._rep)}
+        else:
+            self._pool_shardings = None
+            self._rep = None
+            _sh_prefill = _sh_insert = _sh_step = {}
         self.params = params
         self.slots = slots
         self.max_len = max_len
@@ -83,14 +124,20 @@ class PagedBatchEngine:
         self._active: dict[int, PagedRequest] = {}
         self._completed: dict[int, PagedRequest] = {}
 
-        self.cache = init_paged_cache(cfg, self.num_blocks, block_size)
+        cfg_static = cfg
+        self._cfg_static = cfg
+        self._sh_step = _sh_step
+
+        with self._mesh_ctx():
+            self.cache = jax.jit(
+                lambda: init_paged_cache(cfg_static, self.num_blocks, block_size),
+                **({"out_shardings": self._pool_shardings} if mesh is not None else {}),
+            )()
         self.table = np.zeros((slots, self.max_blocks), np.int32)  # host truth
         self.pos_b = jnp.zeros((slots,), jnp.int32)
         self.tokens = jnp.zeros((slots,), jnp.int32)
 
-        cfg_static = cfg
-
-        @jax.jit
+        @partial(jax.jit, **_sh_prefill)
         def _prefill_one(params, prompt, last_pos):
             cache = init_cache(cfg_static, 1, prompt.shape[1])
             logits, cache = forward_prefill(
@@ -98,13 +145,40 @@ class PagedBatchEngine:
             )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=(0,), **_sh_insert)
         def _insert(cache, slot_k, slot_v, block_ids, pos_b, tokens, slot, plen,
                     first_token, slot_ks=None, slot_vs=None):
             cache = paged_insert(cache, slot_k, slot_v, block_ids, slot_ks, slot_vs)
             return cache, pos_b.at[slot].set(plen), tokens.at[slot].set(first_token)
 
-        @partial(jax.jit, donate_argnums=(1,), static_argnums=(6,))
+        self._prefill_one = _prefill_one
+        self._insert = _insert
+        # Attention path: the kernel's first real-chip contact happens inside
+        # a serving engine, so a compile failure must fall back, not crash
+        # (VERDICT r3 next #4). stats records which path actually serves.
+        from lws_tpu.models.llama import paged_kernel_default
+
+        kernel_intent = paged_kernel_default()
+        self.stats = {"attention_path": "kernel" if kernel_intent else "xla_fallback"}
+        # The kernel's first step is the compile probe: run it WITHOUT cache
+        # donation (a post-compile runtime failure would have consumed the
+        # donated pool, leaving nothing for the fallback retry); switch to
+        # the donating executable once the kernel has proven itself.
+        self._kernel_probed = not kernel_intent
+        self._step_n_fn = self._make_step_n(
+            use_kernel=kernel_intent, donate=self._kernel_probed
+        )
+
+    def _make_step_n(self, use_kernel: bool, donate: bool = True):
+        cfg_static = self._cfg_static
+        tp_static = self._tp
+
+        @partial(
+            jax.jit,
+            static_argnums=(6,),
+            **({"donate_argnums": (1,)} if donate else {}),
+            **self._sh_step,
+        )
         def _step_n(params, cache, table, tokens, pos_b, active, n):
             # n chained steps in ONE dispatch (lax.scan): admission state is
             # frozen for the chunk, so callers bound n by the soonest
@@ -113,7 +187,8 @@ class PagedBatchEngine:
             def body(carry, _):
                 cache, tokens, pos_b = carry
                 logits, cache = forward_decode_paged(
-                    params, tokens, cache, table, pos_b, cfg_static
+                    params, tokens, cache, table, pos_b, cfg_static,
+                    tp_shard=tp_static, use_kernel=use_kernel,
                 )
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 tokens = jnp.where(active, nxt, tokens)
@@ -125,9 +200,15 @@ class PagedBatchEngine:
             )
             return cache, tokens, pos_b, toks  # toks [n, slots]
 
-        self._prefill_one = _prefill_one
-        self._insert = _insert
-        self._step_n_fn = _step_n
+        return _step_n
+
+    def _mesh_ctx(self):
+        """Ambient-mesh context for tracing/executing the jitted phases: the
+        shard_map inside the paged kernel path (and shardings resolution)
+        needs jax.set_mesh when the engine is mesh-sharded."""
+        import contextlib
+
+        return jax.set_mesh(self.mesh) if self.mesh is not None else contextlib.nullcontext()
 
     # ------------------------------------------------------------------
     @property
@@ -162,19 +243,20 @@ class PagedBatchEngine:
 
         padded = np.zeros((bucket,), np.int32)
         padded[:plen] = prompt
-        first, slot_cache = self._prefill_one(
-            self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
-        )
-        prefill_ids = jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
-        scales = (
-            (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
-            if self.cfg.kv_quant
-            else ()
-        )
-        self.cache, self.pos_b, self.tokens = self._insert(
-            self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
-            self.pos_b, self.tokens, slot, plen, first[0], *scales,
-        )
+        with self._mesh_ctx():
+            first, slot_cache = self._prefill_one(
+                self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
+            )
+            prefill_ids = jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
+            scales = (
+                (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
+                if self.cfg.kv_quant
+                else ()
+            )
+            self.cache, self.pos_b, self.tokens = self._insert(
+                self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
+                self.pos_b, self.tokens, slot, plen, first[0], *scales,
+            )
         req.tokens.append(int(first[0]))
         if req.done:
             self._completed[req.request_id] = req
@@ -214,10 +296,48 @@ class PagedBatchEngine:
         active = jnp.asarray(
             [s in self._active and not self._active[s].done for s in range(self.slots)]
         )
-        self.cache, self.tokens, self.pos_b, toks = self._step_n_fn(
-            self.params, self.cache, jnp.asarray(self.table), self.tokens,
-            self.pos_b, active, n,
-        )
+        table = jnp.asarray(self.table)
+        if self.mesh is not None:
+            # Pin the host-built inputs replicated: left uncommitted, GSPMD
+            # may shard them and the shard_map'd kernel expects them whole.
+            active = jax.device_put(active, self._rep)
+            table = jax.device_put(table, self._rep)
+        with self._mesh_ctx():
+            try:
+                self.cache, self.tokens, self.pos_b, toks = self._step_n_fn(
+                    self.params, self.cache, table, self.tokens,
+                    self.pos_b, active, n,
+                )
+            except Exception as e:  # noqa: BLE001 — kernel trace/compile/runtime failure
+                if self.stats["attention_path"] != "kernel" or self._kernel_probed:
+                    raise
+                # One-time probe semantics: the pallas kernel failed its
+                # first contact with this backend — log, rebuild the step on
+                # the XLA gather path (slower, never wrong), and keep
+                # serving. The probe step ran WITHOUT donation, so the cache
+                # survives even a post-compile runtime failure.
+                import sys
+
+                print(
+                    f"[paged-engine] pallas kernel failed on "
+                    f"{jax.default_backend()!r}: {e!r:.300}; falling back to "
+                    f"the XLA gather path",
+                    file=sys.stderr, flush=True,
+                )
+                self.stats["attention_path"] = "xla_fallback"
+                self.stats["kernel_error"] = repr(e)[:300]
+                self._kernel_probed = True
+                self._step_n_fn = self._make_step_n(use_kernel=False)
+                self.cache, self.tokens, self.pos_b, toks = self._step_n_fn(
+                    self.params, self.cache, table, self.tokens,
+                    self.pos_b, active, n,
+                )
+            else:
+                if not self._kernel_probed:
+                    # Kernel proved itself: swap in the donating executable
+                    # for every subsequent step (in-place pool updates).
+                    self._kernel_probed = True
+                    self._step_n_fn = self._make_step_n(use_kernel=True)
         host_toks = np.asarray(toks)  # [n, slots]
         for slot, req in list(self._active.items()):
             req.tokens.extend(int(t) for t in host_toks[:, slot])

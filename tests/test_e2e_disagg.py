@@ -39,7 +39,7 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def role_spec(role: str, kv_port: int, api_url: str):
+def role_spec(role: str, kv_port: int, api_url: str, extra_env: list | None = None):
     return DisaggregatedRoleSpec(
         name=role,
         replicas=1,
@@ -62,7 +62,7 @@ def role_spec(role: str, kv_port: int, api_url: str):
                                         # endpoint port the service routes to.
                                         EnvVar("LWS_TPU_KV_PORT", str(kv_port)),
                                         EnvVar("LWS_TPU_API", api_url),
-                                    ],
+                                    ] + list(extra_env or []),
                                 )
                             ]
                         )
@@ -73,7 +73,8 @@ def role_spec(role: str, kv_port: int, api_url: str):
     )
 
 
-def test_disaggregated_prefill_decode_over_tcp(tmp_path):
+def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
+                    backend_env: dict | None = None):
     cp = ControlPlane()
     api = ApiServer(cp, port=0)
     api.start()
@@ -84,12 +85,12 @@ def test_disaggregated_prefill_decode_over_tcp(tmp_path):
         meta=new_meta("llmd"),
         spec=DisaggregatedSetSpec(
             roles=[
-                role_spec("prefill", prefill_port, api_url),
-                role_spec("decode", decode_port, api_url),
+                role_spec("prefill", prefill_port, api_url, extra_env),
+                role_spec("decode", decode_port, api_url, extra_env),
             ]
         ),
     )
-    backend = make_backend(cp, tmp_path)
+    backend = make_backend(cp, tmp_path, extra_env=backend_env)
     cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
     client = RemoteClient(api_url)
 
@@ -155,29 +156,19 @@ def test_disaggregated_prefill_decode_over_tcp(tmp_path):
         api.stop()
 
 
-def test_dir_transport_still_works(tmp_path):
-    """The round-2 directory transport stays available for single-host dev
-    (no API server); exercised end-to-end in one process pair."""
-    import os
-    import subprocess
+def test_disaggregated_prefill_decode_over_tcp(tmp_path):
+    _run_disagg_e2e(tmp_path)
 
-    handoff = str(tmp_path / "handoff")
-    os.makedirs(handoff)
-    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    np.save(str(tmp_path / "r.prompt.npy"), np.array([3, 1, 4], np.int32))
-    os.replace(str(tmp_path / "r.prompt.npy"), os.path.join(handoff, "r.prompt.npy"))
-    pre = subprocess.run(
-        [sys.executable, "-m", "lws_tpu.serving.disagg_worker", "prefill",
-         "--handoff", handoff, "--once"],
-        env=env, timeout=120,
+
+def test_disaggregated_tp_sharded_over_tcp(tmp_path):
+    """tp=2 prefill -> TCP -> tp=2 decode (VERDICT r3 next #3): each worker
+    builds its engine on a 2-device tp mesh (params + cache over 'tp'), the
+    bundle is host-gathered + pos-truncated on the wire, re-sharded onto the
+    decode mesh — tokens identical to the single-device oracle."""
+    _run_disagg_e2e(
+        tmp_path,
+        extra_env=[EnvVar("LWS_TPU_TP", "2")],
+        # The harness's env_overrides win over pod-declared env (it forces
+        # JAX_PLATFORMS=cpu the same way), so the device count rides there.
+        backend_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
     )
-    assert pre.returncode == 0
-    dec = subprocess.run(
-        [sys.executable, "-m", "lws_tpu.serving.disagg_worker", "decode",
-         "--handoff", handoff, "--steps", "4", "--once"],
-        env=env, timeout=120,
-    )
-    assert dec.returncode == 0
-    out = np.load(os.path.join(handoff, "r.tokens.npy"))
-    assert out.shape == (1, 5)

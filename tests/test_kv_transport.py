@@ -1,0 +1,109 @@
+"""KV transport protocol semantics (no model, no jax): end-to-end
+at-least-once bundle delivery, token auth, pull_result eviction/race rules.
+Ref anchor: the -prv service endpoint publication this transport rides,
+/root/reference/pkg/controllers/disaggregatedset/service_manager.go:126-163."""
+
+import threading
+import time
+
+import pytest
+
+from lws_tpu.serving import kv_transport as kt
+
+
+def wait_for(predicate, timeout=5.0):
+    """The ack is one-way: the client returns before the server has counted
+    it, so counter asserts poll briefly."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+@pytest.fixture
+def server():
+    s = kt.KVServer(port=0, host="127.0.0.1")
+    yield s
+    s.close()
+
+
+def ep(server):
+    return ("127.0.0.1", server.port)
+
+
+def test_process_failure_requeues_bundle(server):
+    """Ack-after-process: a puller that dies mid-processing must NOT lose the
+    bundle — the server re-queues it and the next pull redelivers."""
+    server.offer_bundle({"id": "r1"}, b"payload")
+
+    with pytest.raises(RuntimeError, match="mid-process"):
+        kt.pull_bundle(ep(server), timeout=1.0,
+                       process=lambda m, p: (_ for _ in ()).throw(RuntimeError("mid-process")),
+                       ack_timeout=2.0)
+    assert server.bundles_delivered == 0
+
+    got = kt.pull_bundle(ep(server), timeout=2.0)  # redelivery
+    assert got is not None and got[0]["id"] == "r1" and got[1] == b"payload"
+    assert wait_for(lambda: server.bundles_delivered == 1)
+
+
+def test_process_success_acks_and_consumes(server):
+    server.offer_bundle({"id": "r2"}, b"xyz")
+    seen = {}
+
+    def process(meta, payload):
+        seen["meta"], seen["payload"] = meta, payload
+        return "done"
+
+    assert kt.pull_bundle(ep(server), timeout=1.0, process=process) == "done"
+    assert seen["payload"] == b"xyz"
+    assert wait_for(lambda: server.bundles_delivered == 1)
+    assert kt.pull_bundle(ep(server), timeout=0.2) is None  # consumed
+
+
+def test_token_auth_rejects_unauthenticated_ops(monkeypatch):
+    s = kt.KVServer(port=0, host="127.0.0.1", token="sekret")
+    try:
+        monkeypatch.delenv("LWS_TPU_KV_TOKEN", raising=False)
+        with pytest.raises(RuntimeError, match="submit_prompt failed"):
+            kt.submit_prompt(ep(s), "r", b"p")
+        with pytest.raises(RuntimeError, match="rejected"):
+            kt.pull_bundle(ep(s), timeout=0.2)
+        s.post_result("r", {"id": "r"}, b"out")
+        with pytest.raises(RuntimeError, match="rejected"):
+            kt.pull_result(ep(s), "r")
+        # With the token in the client env, everything flows.
+        monkeypatch.setenv("LWS_TPU_KV_TOKEN", "sekret")
+        kt.submit_prompt(ep(s), "r2", b"p2")
+        assert s.next_prompt(timeout=1.0)[0]["id"] == "r2"
+        assert kt.pull_result(ep(s), "r")[1] == b"out"
+    finally:
+        s.close()
+
+
+def test_pull_result_evicts_once(server):
+    server.post_result("a", {"id": "a"}, b"res")
+    assert kt.pull_result(ep(server), "a")[1] == b"res"
+    assert kt.pull_result(ep(server), "a") is None  # evicted on delivery
+    assert server.results_served == 1
+
+
+def test_pull_result_concurrent_single_delivery(server):
+    """The pop-under-lock rule: N concurrent pulls for one id deliver it
+    exactly once (results_served drives --once exit)."""
+    server.post_result("c", {"id": "c"}, b"res")
+    hits = []
+
+    def pull():
+        got = kt.pull_result(ep(server), "c")
+        if got is not None:
+            hits.append(got)
+
+    threads = [threading.Thread(target=pull) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(hits) == 1 and server.results_served == 1

@@ -194,3 +194,81 @@ def test_chunked_prefill_int8_cache():
     t_full, _ = engine.prefill(prompt)
     assert jnp.array_equal(t_chunk, t_full)
     assert c.k_scale is not None
+
+
+def test_bundle_bytes_scale_with_prompt_length():
+    """cache_to_bundle pos-truncates: wire bytes follow the PROMPT length,
+    not the prefill engine's max_len reservation (VERDICT r3 next #3)."""
+    from lws_tpu.serving.kv_transport import bundle_to_cache, cache_to_bundle
+
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, batch_size=1, max_len=64)
+
+    def bundle_for(plen):
+        prompt = jax.random.randint(jax.random.key(2), (1, plen), 0, cfg.vocab_size).astype(jnp.int32)
+        token, cache = engine.prefill(prompt)
+        return cache_to_bundle(cache, token)
+
+    b8, b32 = bundle_for(8), bundle_for(32)
+    # 4x the prompt ~> 4x the KV bytes (npz framing is small at these sizes).
+    assert 2.5 * len(b8) < len(b32) < 6 * len(b8), (len(b8), len(b32))
+    # And both are far below the full-allocation bundle (64 rows).
+    full_rows_estimate = len(b32) * 2  # 32 -> 64 rows
+    assert len(b8) < full_rows_estimate / 4
+
+    # Round trip into a DIFFERENT decode budget: prefix pasted, room to run.
+    cache, token = bundle_to_cache(b8, max_len=48)
+    assert cache.k.shape[2] == 48 and int(cache.pos) == 8
+    decode_engine = Engine(cfg, params, batch_size=1, max_len=48)
+    tok2, _ = decode_engine.decode(token, cache)
+    assert tok2.shape == (1,)
+
+
+def test_bundle_rejects_too_small_decode_budget():
+    import pytest
+
+    from lws_tpu.serving.kv_transport import bundle_to_cache, cache_to_bundle
+
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, batch_size=1, max_len=64)
+    prompt = jnp.ones((1, 16), jnp.int32)
+    token, cache = engine.prefill(prompt)
+    data = cache_to_bundle(cache, token)
+    with pytest.raises(ValueError, match="max_len"):
+        bundle_to_cache(data, max_len=8)
+
+
+def test_sharded_prefill_bundle_to_sharded_decode():
+    """tp=2 prefill cache -> pos-truncated host bundle -> re-sharded tp=2
+    decode cache: tokens identical to the single-device engine end to end
+    (the in-process version of the disagg tp handoff e2e)."""
+    from lws_tpu.parallel import MeshSpec, build_mesh
+    from lws_tpu.serving.kv_transport import bundle_to_cache, cache_to_bundle
+
+    cfg = LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    prompt = jax.random.randint(jax.random.key(3), (1, 9), 0, cfg.vocab_size).astype(jnp.int32)
+    steps = 6
+
+    # Oracle: one single-device engine does prefill + decode.
+    single = Engine(cfg, params, batch_size=1, max_len=32)
+    want = np.asarray(single.generate(prompt, max_new_tokens=steps + 1).tokens)
+
+    mesh_a = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=2), jax.devices()[:2])
+    mesh_b = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=2), jax.devices()[2:4])
+    prefill_eng = Engine(cfg, params, batch_size=1, max_len=32, mesh=mesh_a)
+    decode_eng = Engine(cfg, params, batch_size=1, max_len=32, mesh=mesh_b)
+
+    token, cache = prefill_eng.prefill(prompt)
+    assert cache.k.sharding.spec[3] == "tp"
+    data = cache_to_bundle(cache, token)  # host gather + pos truncate
+    cache2, token2 = bundle_to_cache(data, max_len=32)
+    cache2 = jax.device_put(cache2, decode_eng._cache_shardings)
+    token2, cache2, toks = decode_eng.decode_n(token2, cache2, steps)
+    got = np.concatenate([np.asarray(token)[:, None], np.asarray(toks)], axis=1)
+    np.testing.assert_array_equal(got, want)

@@ -104,3 +104,19 @@ def test_tp_engine_kv_quant(model):
     )
     _, cache = sharded.prefill(p)
     assert cache.k_scale.sharding.spec[3] == "tp"
+
+
+def test_tp_engine_warm_compile_donates(model):
+    """The warm-up AOT compile must carry the real shardings: a sharding-less
+    lowering builds a different executable whose cache donation can't alias
+    (doubled HBM traffic on the TP path) and warms nothing."""
+    import warnings
+
+    cfg, params = model
+    mesh = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=2), jax.devices()[:2])
+    eng = Engine(cfg, params, batch_size=2, max_len=64, mesh=mesh)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        eng.generate(prompt(cfg), max_new_tokens=16)
+    donated = [x for x in w if "donated" in str(x.message).lower()]
+    assert not donated, [str(x.message) for x in donated]

@@ -17,9 +17,11 @@ while true; do
     echo "[watch] bench rc=$rc; stdout:"; cat .bench_watch_out.json
     # Complete = rc 0, fresh (not degraded), and no stage-level "error"
     # records — a partial capture must leave the watcher alive to retry.
+    # Pattern is '"error":' exactly: a "kernel_error" attribution on an
+    # otherwise-complete capture (kernel fell back on chip) must NOT match.
     if [ $rc -eq 0 ] && grep -q '"value"' .bench_watch_out.json \
         && ! grep -q '"degraded"' .bench_watch_out.json \
-        && ! grep -q '"error"' .bench_watch_out.json; then
+        && ! grep -q '"error":' .bench_watch_out.json; then
       echo "[watch] $(date -u +%FT%TZ) capture complete"
       exit 0
     fi
