@@ -1,0 +1,33 @@
+"""The generated API reference must stay in lockstep with the code: the
+committed docs/reference/ pages are exactly what tools/gen_api_reference.py
+produces from the current dataclasses + contract (≈ the reference's genref
+CI check, /root/reference/hack/genref)."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_generated_api_reference_in_sync():
+    p = subprocess.run(
+        [sys.executable, os.path.join("tools", "gen_api_reference.py"), "--check"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0, f"stale docs/reference — regenerate:\n{p.stderr}"
+
+
+def test_reference_covers_the_contract():
+    """Every public contract constant appears in the generated page."""
+    from lws_tpu.api import contract
+
+    page = open(
+        os.path.join(ROOT, "docs", "reference",
+                     "labels-annotations-and-environment-variables.md")
+    ).read()
+    names = [n for n, v in vars(contract).items()
+             if not n.startswith("_") and isinstance(v, (str, int))]
+    assert len(names) > 30
+    missing = [n for n in names if f"`{n}`" not in page]
+    assert not missing, missing

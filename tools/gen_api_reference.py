@@ -1,0 +1,321 @@
+"""Generate docs/reference/ from the API dataclasses + contract constants.
+
+The analog of the reference's genref pipeline (/root/reference/hack/genref ->
+site/content/en/docs/reference): instead of parsing Go doc-comments, this
+walks the Python modules' dataclasses/enums/constants and lifts each field's
+preceding `#` source comments as its description — the comments in
+api/*.py ARE the field docs, so the generated reference stays in lockstep
+with the code by construction.
+
+Run:  python tools/gen_api_reference.py       (writes docs/reference/*.md)
+Check: python tools/gen_api_reference.py --check   (CI-style drift check)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import importlib
+import inspect
+import os
+import sys
+import typing
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+OUT_DIR = os.path.join(_ROOT, "docs", "reference")
+
+
+# --------------------------------------------------------------------------
+# Source-comment extraction: the description of a field/constant is the run
+# of '#' lines immediately above it (plus any trailing comment on its line).
+
+
+def _line_comments(source_lines: list[str]) -> dict[int, str]:
+    """lineno (1-based) of each assignment -> joined preceding comment."""
+    out = {}
+    pending: list[str] = []
+    for i, raw in enumerate(source_lines, start=1):
+        stripped = raw.strip()
+        if stripped.startswith("#"):
+            text = stripped.lstrip("#").strip()
+            if not text.startswith("----"):  # section rules aren't field docs
+                pending.append(text)
+            continue
+        if stripped:
+            if pending:
+                out[i] = " ".join(pending)
+            pending = []
+            if "#" in raw and not stripped.startswith(("'", '"')):
+                trailing = raw.split("#", 1)[1].strip()
+                if trailing and i not in out:
+                    out[i] = trailing
+        else:
+            pending = []
+    return out
+
+
+def _field_linenos(cls) -> dict[str, int]:
+    """field/member name -> source lineno of its assignment in the class."""
+    try:
+        src = inspect.getsource(cls)
+        tree = ast.parse(src)
+        base = inspect.getsourcelines(cls)[1] - 1
+    except (OSError, TypeError):
+        return {}
+    out = {}
+    cls_node = tree.body[0]
+    for node in getattr(cls_node, "body", []):
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            out[node.target.id] = base + node.lineno
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = base + node.lineno
+    return out
+
+
+def _comments_for(cls) -> dict[str, str]:
+    try:
+        module_lines = inspect.getsource(sys.modules[cls.__module__]).splitlines()
+    except OSError:
+        return {}
+    by_line = _line_comments(module_lines)
+    return {
+        name: by_line.get(lineno, "")
+        for name, lineno in _field_linenos(cls).items()
+    }
+
+
+def _type_str(t) -> str:
+    s = typing.get_type_hints  # noqa: F841 — resolved below, fall back to raw
+    if isinstance(t, str):
+        return t
+    if isinstance(t, type):
+        return t.__name__
+    return str(t).replace("typing.", "").replace("lws_tpu.api.", "").replace(
+        "lws_tpu.", ""
+    )
+
+
+def _default_str(f: dataclasses.Field) -> str:
+    if f.default is not dataclasses.MISSING:
+        v = f.default
+        if isinstance(v, enum.Enum):
+            return f"`{v.value}`"
+        return f"`{v!r}`"
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        try:
+            v = f.default_factory()  # type: ignore[misc]
+            if v in ({}, [], ()):  # noqa: PLR6201
+                return f"`{v!r}`"
+            return f"`{type(v).__name__}()`"
+        except Exception:  # noqa: BLE001
+            return "factory"
+    return "required"
+
+
+def _real_doc(cls) -> str | None:
+    """The class docstring, unless it's just the synthesized signature."""
+    doc = inspect.getdoc(cls)
+    if doc and not doc.startswith(cls.__name__ + "("):
+        return doc
+    return None
+
+
+def render_dataclass(cls) -> list[str]:
+    lines = [f"### `{cls.__name__}`", ""]
+    doc = _real_doc(cls)
+    if doc:
+        lines += [doc, ""]
+    comments = _comments_for(cls)
+    hints = typing.get_type_hints(cls)
+    lines += ["| field | type | default | description |",
+              "|---|---|---|---|"]
+    for f in dataclasses.fields(cls):
+        lines.append(
+            f"| `{f.name}` | `{_type_str(hints.get(f.name, f.type))}` "
+            f"| {_default_str(f)} | {comments.get(f.name, '')} |"
+        )
+    lines.append("")
+    return lines
+
+
+def render_enum(cls) -> list[str]:
+    lines = [f"### `{cls.__name__}`", ""]
+    doc = _real_doc(cls)
+    if doc:
+        lines += [doc, ""]
+    comments = _comments_for(cls)
+    lines += ["| value | description |", "|---|---|"]
+    for member in cls:
+        lines.append(f"| `{member.value}` | {comments.get(member.name, '')} |")
+    lines.append("")
+    return lines
+
+
+def render_module_types(module_name: str, title: str, note: str = "") -> str:
+    mod = importlib.import_module(module_name)
+    lines = [f"# {title}", ""]
+    if mod.__doc__:
+        lines += [inspect.cleandoc(mod.__doc__), ""]
+    if note:
+        lines += [note, ""]
+    classes = [
+        cls for _, cls in inspect.getmembers(mod, inspect.isclass)
+        if cls.__module__ == module_name
+    ]
+    # Definition order (getmembers sorts alphabetically) — references read
+    # top-down the way the source does.
+    classes.sort(key=lambda c: inspect.getsourcelines(c)[1])
+    for cls in classes:
+        if isinstance(cls, type) and issubclass(cls, enum.Enum):
+            lines += render_enum(cls)
+        elif dataclasses.is_dataclass(cls):
+            lines += render_dataclass(cls)
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# Constants (contract): sectioned tables from module-level assignments.
+
+
+def render_module_consts(module_name: str, title: str) -> str:
+    mod = importlib.import_module(module_name)
+    src_lines = inspect.getsource(mod).splitlines()
+    by_line = _line_comments(src_lines)
+    tree = ast.parse("\n".join(src_lines))
+
+    lines = [f"# {title}", ""]
+    if mod.__doc__:
+        lines += [inspect.cleandoc(mod.__doc__), ""]
+
+    section = None
+
+    def start_section(name: str):
+        nonlocal section
+        section = name
+        lines.extend([f"## {name}", "", "| constant | value | description |",
+                      "|---|---|---|"])
+
+    # Section markers are the `# ---- name ----` ruled comments.
+    sections_by_line = {}
+    for i, raw in enumerate(src_lines, start=1):
+        s = raw.strip()
+        if s.startswith("# ----"):
+            sections_by_line[i] = s.strip("# -").strip()
+
+    marker_lines = sorted(sections_by_line)
+
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name) or target.id.startswith("_"):
+            continue
+        value = getattr(mod, target.id, None)
+        if not isinstance(value, (str, int)):
+            continue
+        latest_marker = [m for m in marker_lines if m < node.lineno]
+        sec = sections_by_line[latest_marker[-1]] if latest_marker else "constants"
+        if sec != section:
+            start_section(sec)
+        desc = by_line.get(node.lineno, "")
+        lines.append(f"| `{target.id}` | `{value}` | {desc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+
+
+GENERATED_HEADER = (
+    "<!-- Generated by tools/gen_api_reference.py — DO NOT EDIT BY HAND.\n"
+    "     Regenerate: python tools/gen_api_reference.py -->\n\n"
+)
+
+PAGES = {
+    "leaderworkerset.v1.md": lambda: render_module_types(
+        "lws_tpu.api.types", "LeaderWorkerSet v1 API",
+        "Reference parity: `api/leaderworkerset/v1/leaderworkerset_types.go`.",
+    ),
+    "disaggregatedset.v1.md": lambda: render_module_types(
+        "lws_tpu.api.disagg", "DisaggregatedSet v1 API",
+        "Reference parity: `api/disaggregatedset/v1/disaggregatedset_types.go`.",
+    ) + "\n" + render_module_consts(
+        "lws_tpu.api.disagg", "DisaggregatedSet labels and bounds"
+    ),
+    "core.v1.md": lambda: "\n".join([
+        render_module_types("lws_tpu.api.meta", "Object metadata"),
+        render_module_types("lws_tpu.api.pod", "Pod / PodTemplate"),
+        render_module_types("lws_tpu.api.groupset", "GroupSet (native StatefulSet analog)"),
+        render_module_types("lws_tpu.api.node", "Node"),
+        render_module_types("lws_tpu.api.service", "Service"),
+        render_module_types("lws_tpu.api.pvc", "PersistentVolumeClaim templates"),
+        render_module_types("lws_tpu.api.autoscaler", "Autoscaler"),
+        render_module_types("lws_tpu.api.podgroup", "PodGroup (gang scheduling)"),
+        render_module_types("lws_tpu.api.lease", "Lease (leader election)"),
+        render_module_types("lws_tpu.api.revision", "ControllerRevision"),
+        render_module_types("lws_tpu.api.intstr", "IntOrPercent"),
+    ]),
+    "configuration.v1alpha1.md": lambda: render_module_types(
+        "lws_tpu.config", "Component configuration",
+        "Reference parity: `api/config/v1alpha1/configuration_types.go` + "
+        "`defaults.go` (strict decode: unknown fields are rejected).",
+    ),
+    "labels-annotations-and-environment-variables.md": lambda: render_module_consts(
+        "lws_tpu.api.contract", "Labels, annotations and environment variables"
+    ),
+}
+
+
+INDEX = """# API reference
+
+Generated from the source dataclasses and contract constants by
+`tools/gen_api_reference.py` (the analog of the reference's `hack/genref`
+pipeline). Regenerate after any API change; CI-style drift check:
+`python tools/gen_api_reference.py --check`.
+
+- [LeaderWorkerSet v1](leaderworkerset.v1.md) — the core group-of-pods API
+- [DisaggregatedSet v1](disaggregatedset.v1.md) — multi-role coordinated rollouts
+- [Core types](core.v1.md) — pods, groupsets, nodes, services, autoscaler, gang, leases
+- [Component configuration](configuration.v1alpha1.md) — the `--config` file schema
+- [Labels, annotations and environment variables](labels-annotations-and-environment-variables.md) — the wire contract controllers, webhooks and workloads share
+"""
+
+
+def generate() -> dict[str, str]:
+    out = {"_index.md": INDEX}
+    for name, fn in PAGES.items():
+        out[name] = GENERATED_HEADER + fn().rstrip() + "\n"
+    return out
+
+
+def main() -> int:
+    check = "--check" in sys.argv
+    pages = generate()
+    os.makedirs(OUT_DIR, exist_ok=True)
+    drift = []
+    for name, content in pages.items():
+        path = os.path.join(OUT_DIR, name)
+        if check:
+            try:
+                with open(path) as f:
+                    if f.read() != content:
+                        drift.append(name)
+            except OSError:
+                drift.append(name)
+        else:
+            with open(path, "w") as f:
+                f.write(content)
+            print(f"wrote {os.path.relpath(path, _ROOT)} ({len(content)} bytes)")
+    if check and drift:
+        print(f"DRIFT: {drift} — run python tools/gen_api_reference.py", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
