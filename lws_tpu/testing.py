@@ -218,3 +218,155 @@ def condition_status(lws: LeaderWorkerSet, ctype: str) -> Optional[bool]:
         if c.type == ctype:
             return c.status
     return None
+
+
+def assert_valid_group(store: Store, lws: LeaderWorkerSet, group: int) -> None:
+    """Validate EVERY field the controllers promise for one group — labels,
+    annotations, env contract, affinities, subdomain, revision links, worker
+    groupset wiring (≈ validators.go ExpectValidLeaderStatefulSet +
+    ExpectValidWorkerStatefulSets + pod-webhook postconditions rolled into
+    one call, /root/reference/test/testutils/validators.go:45-367). Checks
+    only pods that exist — callers assert counts separately (groups mid-
+    recreate legitimately have missing pods)."""
+    ns = lws.meta.namespace
+    size = lws.spec.leader_worker_template.size
+    tmpl = lws.spec.leader_worker_template
+    leader_name = f"{lws.meta.name}-{group}"
+    leader = store.try_get("Pod", ns, leader_name)
+    assert leader is not None, f"leader pod {leader_name} missing"
+
+    # ---- leader labels -----------------------------------------------------
+    labels = leader.meta.labels
+    assert labels[contract.SET_NAME_LABEL_KEY] == lws.meta.name
+    assert labels[contract.GROUP_INDEX_LABEL_KEY] == str(group)
+    assert labels[contract.WORKER_INDEX_LABEL_KEY] == "0"
+    group_key = labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY)
+    assert group_key, "leader missing group unique key"
+    revision = labels.get(contract.REVISION_LABEL_KEY)
+    assert revision, "leader missing template revision label"
+
+    # ---- revision link: the label resolves to a stored ControllerRevision --
+    revs = [
+        r for r in store.list("ControllerRevision", ns)
+        if r.meta.labels.get(contract.SET_NAME_LABEL_KEY) == lws.meta.name
+        and revision in r.meta.name
+    ]
+    assert revs, f"no ControllerRevision for hash {revision}"
+
+    # ---- leader annotations ------------------------------------------------
+    assert leader.meta.annotations[contract.SIZE_ANNOTATION_KEY] == str(size)
+    exclusive = lws.meta.annotations.get(contract.EXCLUSIVE_KEY_ANNOTATION_KEY)
+    if exclusive:
+        aff = leader.spec.affinity
+        assert aff is not None, "exclusive topology promised but no affinity"
+        assert any(
+            t.topology_key == exclusive
+            and t.selector_matches({contract.GROUP_UNIQUE_HASH_LABEL_KEY: group_key})
+            for t in aff.required_affinity
+        ), "missing same-topology affinity on the group key"
+        assert any(
+            t.topology_key == exclusive
+            and not t.selector_matches({contract.GROUP_UNIQUE_HASH_LABEL_KEY: group_key})
+            for t in aff.required_anti_affinity
+        ), "missing anti-affinity against other groups' keys"
+
+    # ---- subdomain / DNS identity -----------------------------------------
+    unique = (
+        lws.spec.network_config is not None
+        and lws.spec.network_config.subdomain_policy == SubdomainPolicy.UNIQUE_PER_REPLICA
+    )
+    want_subdomain = leader_name if unique else lws.meta.name
+    assert leader.spec.subdomain == want_subdomain, (
+        f"leader subdomain {leader.spec.subdomain!r} != {want_subdomain!r}"
+    )
+
+    # ---- env contract (every container, leader first) ----------------------
+    leader_addr = f"{leader_name}.{want_subdomain}.{ns}"
+
+    def check_env(pod, worker_index: int) -> None:
+        for container in pod.spec.containers + pod.spec.init_containers:
+            env = {e.name: e.value for e in container.env}
+            assert container.env and container.env[0].name == contract.LWS_LEADER_ADDRESS, (
+                f"{pod.meta.name}: LWS_LEADER_ADDRESS must be the FIRST env var"
+            )
+            assert env[contract.LWS_LEADER_ADDRESS] == leader_addr
+            assert env[contract.LWS_GROUP_SIZE] == str(size)
+            assert env[contract.LWS_WORKER_INDEX] == str(worker_index)
+            assert env[contract.JAX_COORDINATOR_ADDRESS] == (
+                f"{leader_addr}:{contract.JAX_COORDINATOR_PORT_DEFAULT}"
+            )
+            assert env[contract.JAX_PROCESS_ID] == str(worker_index)
+        # TPU bootstrap rides any container that requests chips.
+        for container in pod.spec.containers:
+            if int(container.resources.get(contract.TPU_RESOURCE_NAME, 0) or 0) > 0:
+                env = {e.name: e.value for e in container.env}
+                assert contract.TPU_WORKER_HOSTNAMES in env, (
+                    f"{pod.meta.name}: requests TPUs but no TPU_WORKER_HOSTNAMES"
+                )
+                assert contract.TPU_WORKER_ID in env
+                n_hosts = len(env[contract.TPU_WORKER_HOSTNAMES].split(","))
+                assert 0 <= int(env[contract.TPU_WORKER_ID]) < n_hosts
+
+    check_env(leader, 0)
+
+    # ---- workers -----------------------------------------------------------
+    for i in range(1, size):
+        wname = f"{leader_name}-{i}"
+        worker = store.try_get("Pod", ns, wname)
+        if worker is None:
+            continue  # group mid-materialization; counts asserted by callers
+        wl = worker.meta.labels
+        assert wl[contract.SET_NAME_LABEL_KEY] == lws.meta.name
+        assert wl[contract.GROUP_INDEX_LABEL_KEY] == str(group)
+        assert wl[contract.WORKER_INDEX_LABEL_KEY] == str(i)
+        assert wl[contract.GROUP_UNIQUE_HASH_LABEL_KEY] == group_key, (
+            "worker group key differs from leader's"
+        )
+        assert wl[contract.REVISION_LABEL_KEY] == revision, (
+            f"{wname}: revision {wl[contract.REVISION_LABEL_KEY]} != leader's {revision}"
+        )
+        assert worker.meta.annotations[contract.SIZE_ANNOTATION_KEY] == str(size)
+        assert worker.meta.annotations[contract.LEADER_POD_NAME_ANNOTATION_KEY] == leader_name
+        if tmpl.sub_group_policy is not None and tmpl.sub_group_policy.sub_group_size:
+            from lws_tpu.utils.tpu import get_subgroup_index
+
+            # get_subgroup_index owns the leader-fold rule ((size-1) % sgs
+            # == 0 folds the leader into subgroup 0 and shifts workers) for
+            # BOTH policies — recomputing it here diverged once already.
+            want_sub = get_subgroup_index(size, tmpl.sub_group_policy.sub_group_size, i)
+            assert wl[contract.SUBGROUP_INDEX_LABEL_KEY] == str(want_sub), (
+                f"{wname}: subgroup index {wl.get(contract.SUBGROUP_INDEX_LABEL_KEY)} != {want_sub}"
+            )
+        check_env(worker, i)
+
+    # ---- worker groupset wiring -------------------------------------------
+    if size > 1:
+        gs = store.try_get("GroupSet", ns, leader_name)
+        if gs is not None:
+            assert gs.spec.replicas == size - 1
+            assert gs.spec.start_ordinal == 1
+            assert gs.spec.template.metadata.labels[contract.REVISION_LABEL_KEY] == revision
+            assert gs.spec.template.metadata.annotations[contract.LEADER_POD_NAME_ANNOTATION_KEY] == leader_name
+            assert gs.spec.service_name == (leader_name if unique else lws.meta.name)
+
+    # ---- services: the rendezvous plane ------------------------------------
+    svc_name = leader_name if unique else lws.meta.name
+    svc = store.try_get("Service", ns, svc_name)
+    assert svc is not None, f"headless service {svc_name} missing"
+    assert svc.spec.headless and svc.spec.publish_not_ready_addresses, (
+        "rendezvous service must be headless and publish not-ready addresses"
+    )
+    assert svc.spec.selector.get(contract.SET_NAME_LABEL_KEY) == lws.meta.name
+    if unique:
+        assert svc.spec.selector.get(contract.GROUP_INDEX_LABEL_KEY) == str(group)
+
+
+def assert_valid_lws(store: Store, lws_name: str, namespace: str = "default") -> None:
+    """assert_valid_group over every group of the CURRENT stored LWS, plus
+    the leader groupset checks — one call validating the whole promised
+    surface (adopt in any test that reaches a stable state)."""
+    lws = store.get("LeaderWorkerSet", namespace, lws_name)
+    expect_valid_leader_groupset(store, lws)
+    for g in range(lws.spec.replicas):
+        if store.try_get("Pod", namespace, f"{lws_name}-{g}") is not None:
+            assert_valid_group(store, lws, g)

@@ -13,6 +13,7 @@ from lws_tpu.api.types import (
 from lws_tpu.runtime import ControlPlane
 from lws_tpu.testing import (
     LWSBuilder,
+    assert_valid_lws,
     condition_status,
     expect_valid_leader_groupset,
     expect_valid_worker_groupsets,
@@ -33,6 +34,7 @@ def test_create_materializes_groups():
 
     expect_valid_leader_groupset(cp.store, lws, replicas=2)
     expect_valid_worker_groupsets(cp.store, lws, count=2)
+    assert_valid_lws(cp.store, "sample")
     pods = lws_pods(cp.store, "sample")
     names = sorted(p.meta.name for p in pods)
     assert names == sorted(

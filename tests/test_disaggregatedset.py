@@ -431,3 +431,109 @@ def test_per_role_percentage_grid(surge, expected_first_jump):
     ups = [e.message for e in cp.recorder.events
            if e.reason == "ScalingUp" and "prefill" in e.message]
     assert any(f"from 0 to {expected_first_jump}" in m for m in ups), ups
+
+
+@pytest.mark.parametrize(
+    "surge,unavailable,replicas",
+    [
+        # The reference's own e2e shape: 50% surge + 25% unavailable of 4
+        # (e2e_test.go:243-259).
+        ("50%", "25%", 4),
+        ("25%", "25%", 8),
+        ("100%", "50%", 4),
+    ],
+)
+def test_per_role_percentage_grid_surge_and_unavailable(surge, unavailable, replicas):
+    """Percentage budgets on BOTH axes at three grid points (VERDICT r3 #8).
+    Surge resolves by ceil (never 0 for a nonzero percent), so every
+    intermediate child-LWS replica count stays admissible — the reference's
+    e2e sweep pairs the axes the same way for the same reason (a pure
+    percentage-maxUnavailable with surge 0 is rejected by both webhooks the
+    moment it floors to 0, leaderworkerset_webhook.go:171-174). Every
+    observed drain must be a step the pure-math planner predicted for the
+    RESOLVED budgets — the percentage parsing is the layer under test. Ref
+    executor.go:235-260, test/e2e/disaggregatedset/e2e_test.go:243-259."""
+    from lws_tpu.api.types import RollingUpdateConfiguration, RolloutStrategy
+    from lws_tpu.controllers.disagg.executor import RollingUpdateExecutor
+    from lws_tpu.controllers.disagg.planner import ComputeAllSteps
+
+    cp = ControlPlane(auto_ready=True)
+    roles = [role("prefill", replicas=replicas), role("decode", replicas=replicas)]
+    for r in roles:
+        r.template.spec.rollout_strategy = RolloutStrategy(
+            rolling_update_configuration=RollingUpdateConfiguration(
+                max_unavailable=unavailable, max_surge=surge
+            )
+        )
+    cp.create(make_ds(roles=roles))
+    cp.run_until_stable()
+
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:v2"
+    cp.store.update(fetched)
+    rev2 = dsutils.compute_revision(fetched.spec.roles)
+    cp.run_until_stable()
+
+    # Converged on the new revision at target.
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-0-{rev2}-prefill", f"llmd-0-{rev2}-decode"}
+    assert all(l.status.ready_replicas == replicas for l in children.values())
+
+    # The executor's drains followed the planner's predicted old-replica
+    # sequence for the budgets RESOLVED from the percentages.
+    role_names = [r.name for r in fetched.spec.roles]
+    config = RollingUpdateExecutor._extract_config(fetched, role_names)
+    init = [replicas] * len(role_names)
+    predicted_old = [s.past[0] for s in ComputeAllSteps(init, init, config)]
+    predicted_pairs = {
+        (predicted_old[i], predicted_old[i + 1])
+        for i in range(len(predicted_old) - 1)
+        if predicted_old[i] != predicted_old[i + 1]
+    }
+    downs = [e.message for e in cp.recorder.events
+             if e.reason == "ScalingDown" and "prefill" in e.message]
+    assert downs, "no drain events recorded"
+    import re
+
+    for m in downs:
+        frm, to = map(int, re.search(r"from (\d+) to (\d+)", m).groups())
+        assert (frm, to) in predicted_pairs, (m, sorted(predicted_pairs))
+
+
+def test_template_metadata_propagates_to_child_lws():
+    """Role template metadata (the Kueue-style queue labels a cluster admin
+    sets) must land on each child LWS — per role, and re-applied on every new
+    revision's children across a rolling update (ref
+    test/e2e/disaggregatedset/e2e_test.go:477-518 kueue.x-k8s.io/queue-name
+    propagation)."""
+    cp = ControlPlane(auto_ready=True)
+    roles = [role("prefill"), role("decode")]
+    roles[0].template.metadata.labels["kueue.x-k8s.io/queue-name"] = "prefill-queue"
+    roles[0].template.metadata.annotations["team"] = "serving"
+    roles[1].template.metadata.labels["kueue.x-k8s.io/queue-name"] = "decode-queue"
+    ds = cp.create(make_ds(roles=roles))
+    cp.run_until_stable()
+    rev1 = dsutils.compute_revision(ds.spec.roles)
+
+    children = child_lws(cp)
+    pre = children[f"llmd-0-{rev1}-prefill"]
+    dec = children[f"llmd-0-{rev1}-decode"]
+    assert pre.meta.labels["kueue.x-k8s.io/queue-name"] == "prefill-queue"
+    assert pre.meta.annotations["team"] == "serving"
+    assert dec.meta.labels["kueue.x-k8s.io/queue-name"] == "decode-queue"
+    assert "team" not in dec.meta.annotations
+
+    # Rolling update: the NEW revision's children carry the same metadata.
+    fetched = cp.store.get("DisaggregatedSet", "default", "llmd")
+    for r in fetched.spec.roles:
+        for c in r.template.spec.leader_worker_template.worker_template.spec.containers:
+            c.image = "img:v2"
+    cp.store.update(fetched)
+    rev2 = dsutils.compute_revision(fetched.spec.roles)
+    cp.run_until_stable()
+    children = child_lws(cp)
+    assert set(children) == {f"llmd-0-{rev2}-prefill", f"llmd-0-{rev2}-decode"}
+    assert children[f"llmd-0-{rev2}-prefill"].meta.labels["kueue.x-k8s.io/queue-name"] == "prefill-queue"
+    assert children[f"llmd-0-{rev2}-decode"].meta.labels["kueue.x-k8s.io/queue-name"] == "decode-queue"

@@ -22,6 +22,9 @@ def test_recreate_group_on_pod_restart():
 
     after = uids(cp, "sample")
     assert set(after) == set(before)
+    # The recreated group satisfies the full promised contract again.
+    from lws_tpu.testing import assert_valid_lws
+    assert_valid_lws(cp.store, "sample")
     # Whole group 0 recreated (new uids), group 1 untouched.
     for name in ("sample-0", "sample-0-1", "sample-0-2"):
         assert after[name] != before[name], name

@@ -9,6 +9,7 @@ from lws_tpu.api.types import (
 from lws_tpu.runtime import ControlPlane
 from lws_tpu.testing import (
     LWSBuilder,
+    assert_valid_lws,
     condition_status,
     make_all_groups_ready,
 )
@@ -52,6 +53,8 @@ def test_rolling_update_replaces_all_groups():
     assert condition_status(lws, CONDITION_UPDATE_IN_PROGRESS) is False
     for name in ("sample-0", "sample-1", "sample-2", "sample-3", "sample-0-1", "sample-3-1"):
         assert image_of(cp, name) == "img:v2", name
+    # Every promised field holds on the post-update groups.
+    assert_valid_lws(cp.store, "sample")
     # Old revision truncated once update is done.
     assert len(cp.store.list("ControllerRevision")) == 1
     gs = cp.store.get("GroupSet", "default", "sample")

@@ -679,6 +679,8 @@ def test_condition_events_emitted():
 
 @pytest.mark.parametrize("case", CASES, ids=[c.name for c in CASES])
 def test_rolling_update_matrix(case: Case) -> None:
+    from lws_tpu.testing import assert_valid_lws
+
     cp = ControlPlane()
     cp.create(case.build())
     cp.run_until_stable()
@@ -686,3 +688,8 @@ def test_rolling_update_matrix(case: Case) -> None:
         step.do(cp)
         cp.run_until_stable()
         check(cp, step.expect, f"{case.name} step {i}")
+    # Whatever state the case ends in, every EXISTING group must satisfy the
+    # full promised contract (labels/env/affinities/services/revision links)
+    # — the shared declarative validator raises every case's strength at once
+    # (≈ validators.go ExpectValidLeaderStatefulSet on each poll).
+    assert_valid_lws(cp.store, NAME)

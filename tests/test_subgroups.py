@@ -7,7 +7,7 @@ from lws_tpu.api.types import SubGroupPolicyType
 from lws_tpu.core.store import AdmissionError
 from lws_tpu.runtime import ControlPlane
 from lws_tpu.sched import make_slice_nodes
-from lws_tpu.testing import LWSBuilder, lws_pods
+from lws_tpu.testing import LWSBuilder, assert_valid_lws, lws_pods
 
 import pytest
 
@@ -25,6 +25,7 @@ def test_subgroup_labels_and_tpu_windows():
         .leader_template(tpu_chips=4).subgroup(4).build()
     )
     cp.run_until_stable()
+    assert_valid_lws(cp.store, "sample")
     pods = {p.meta.name: p for p in lws_pods(cp.store, "sample")}
     assert len(pods) == 8
 
@@ -67,6 +68,7 @@ def test_leader_excluded_subgroups():
         .subgroup(4, SubGroupPolicyType.LEADER_EXCLUDED).build()
     )
     cp.run_until_stable()
+    assert_valid_lws(cp.store, "sample")
     pods = {p.meta.name: p for p in lws_pods(cp.store, "sample")}
     leader = pods["sample-0"]
     assert contract.SUBGROUP_INDEX_LABEL_KEY not in leader.meta.labels
