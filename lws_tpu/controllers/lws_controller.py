@@ -54,7 +54,24 @@ class LWSReconciler:
         if lws is None or not isinstance(lws, LeaderWorkerSet):
             return None
 
-        leader_gs = self.store.try_get("GroupSet", lws.meta.namespace, lws.meta.name)
+        # One store snapshot per reconcile: leader pods + every owned
+        # groupset, shared by the rolling-update math and the status pass.
+        # Re-listing per phase was the rollout hot path at fleet scale — and
+        # a single snapshot is also more coherent than three taken at
+        # different points of the same reconcile. list_shared: these are
+        # READ-ONLY (every mutation below re-fetches via get()); the
+        # per-call deep clone of 2x replicas objects was the remaining
+        # rollout bottleneck (CONTROL_r04).
+        leader_pods = self.store.list_shared(
+            "Pod",
+            lws.meta.namespace,
+            labels={contract.SET_NAME_LABEL_KEY: lws.meta.name, contract.WORKER_INDEX_LABEL_KEY: "0"},
+        )
+        groupsets = self.store.list_shared(
+            "GroupSet", lws.meta.namespace, labels={contract.SET_NAME_LABEL_KEY: lws.meta.name}
+        )
+        gs_by_name = {g.meta.name: g for g in groupsets}
+        leader_gs = gs_by_name.get(lws.meta.name)
 
         # Revision management (ref :138-157, :722-766).
         revision = self._get_or_create_revision(leader_gs, lws)
@@ -68,7 +85,9 @@ class LWSReconciler:
             )
         revision_key = revisionutils.get_revision_key(revision)
 
-        partition, replicas = self._rolling_update_parameters(lws, leader_gs, revision_key, lws_updated)
+        partition, replicas = self._rolling_update_parameters(
+            lws, leader_gs, revision_key, lws_updated, leader_pods, gs_by_name
+        )
         self._apply_leader_groupset(lws, leader_gs, partition, replicas, revision_key)
         if leader_gs is None:
             self.recorder.event(lws, "Normal", "GroupsProgressing", f"Created leader groupset {lws.meta.name}")
@@ -77,7 +96,7 @@ class LWSReconciler:
 
         self._reconcile_headless_services(lws)
 
-        update_done = self._update_status(lws, revision_key)
+        update_done = self._update_status(lws, revision_key, leader_pods, gs_by_name)
         if update_done:
             revisionutils.truncate_revisions(self.store, lws, revision_key)
         return None
@@ -104,7 +123,8 @@ class LWSReconciler:
 
     # ---- rolling update parameters (ref :258-373) ---------------------
     def _rolling_update_parameters(
-        self, lws: LeaderWorkerSet, gs: Optional[GroupSet], revision_key: str, lws_updated: bool
+        self, lws: LeaderWorkerSet, gs: Optional[GroupSet], revision_key: str,
+        lws_updated: bool, leader_pods: list, gs_by_name: dict,
     ) -> tuple[int, int]:
         lws_replicas = lws.spec.replicas
         cfg = lws.spec.rollout_strategy.rolling_update_configuration
@@ -143,7 +163,9 @@ class LWSReconciler:
         if gs_replicas < lws_replicas:
             return clamp(partition, lws_replicas)
 
-        states = self._get_replica_states(lws, gs_replicas, revision_key)
+        states = self._get_replica_states(
+            lws, gs_replicas, revision_key, leader_pods, gs_by_name
+        )
         lws_unready = calculate_lws_unready_replicas(states, lws_replicas)
 
         original_replicas = int(gs.meta.annotations.get(contract.REPLICAS_ANNOTATION_KEY, lws_replicas))
@@ -158,20 +180,19 @@ class LWSReconciler:
         return clamp(partition, want_replicas(lws_unready))
 
     # ---- replica states (ref :576-641) --------------------------------
-    def _get_replica_states(self, lws: LeaderWorkerSet, gs_replicas: int, revision_key: str) -> list["ReplicaState"]:
-        leader_pods = self.store.list(
-            "Pod",
-            lws.meta.namespace,
-            labels={contract.SET_NAME_LABEL_KEY: lws.meta.name, contract.WORKER_INDEX_LABEL_KEY: "0"},
-        )
+    def _get_replica_states(
+        self, lws: LeaderWorkerSet, gs_replicas: int, revision_key: str,
+        leader_pods: list, gs_by_name: dict,
+    ) -> list["ReplicaState"]:
         sorted_pods = sort_by_index(
             lambda p: int(p.meta.labels[contract.GROUP_INDEX_LABEL_KEY]), leader_pods, gs_replicas
         )
-        groupsets = self.store.list(
-            "GroupSet", lws.meta.namespace, labels={contract.SET_NAME_LABEL_KEY: lws.meta.name}
-        )
+        worker_groupsets = [
+            g for g in gs_by_name.values()
+            if contract.GROUP_INDEX_LABEL_KEY in g.meta.labels
+        ]
         sorted_gs = sort_by_index(
-            lambda g: int(g.meta.labels[contract.GROUP_INDEX_LABEL_KEY]), groupsets, gs_replicas
+            lambda g: int(g.meta.labels[contract.GROUP_INDEX_LABEL_KEY]), worker_groupsets, gs_replicas
         )
         no_worker_gs = lws.spec.leader_worker_template.size == 1
 
@@ -305,8 +326,12 @@ class LWSReconciler:
                 )
 
     # ---- status & conditions (ref :414-567) -----------------------------
-    def _update_status(self, lws: LeaderWorkerSet, revision_key: str) -> bool:
+    def _update_status(
+        self, lws: LeaderWorkerSet, revision_key: str, leader_pods: list, gs_by_name: dict
+    ) -> bool:
         fresh = self.store.get("LeaderWorkerSet", lws.meta.namespace, lws.meta.name)
+        # The leader groupset is re-fetched (not taken from the snapshot):
+        # _apply_leader_groupset may have just created/resized it.
         gs = self.store.try_get("GroupSet", lws.meta.namespace, lws.meta.name)
         if gs is None:
             return False
@@ -324,17 +349,16 @@ class LWSReconciler:
             fresh.status.hpa_pod_selector = hpa_selector
             changed = True
 
-        cond_changed, update_done = self._update_conditions(fresh, revision_key)
+        cond_changed, update_done = self._update_conditions(
+            fresh, revision_key, leader_pods, gs_by_name
+        )
         if changed or cond_changed:
             self.store.update_status(fresh)
         return update_done
 
-    def _update_conditions(self, lws: LeaderWorkerSet, revision_key: str) -> tuple[bool, bool]:
-        leader_pods = self.store.list(
-            "Pod",
-            lws.meta.namespace,
-            labels={contract.SET_NAME_LABEL_KEY: lws.meta.name, contract.WORKER_INDEX_LABEL_KEY: "0"},
-        )
+    def _update_conditions(
+        self, lws: LeaderWorkerSet, revision_key: str, leader_pods: list, gs_by_name: dict
+    ) -> tuple[bool, bool]:
         no_worker_gs = lws.spec.leader_worker_template.size == 1
         cfg = lws.spec.rollout_strategy.rolling_update_configuration
         lws_partition = cfg.partition if cfg else 0
@@ -350,7 +374,7 @@ class LWSReconciler:
                 continue
             gs = None
             if not no_worker_gs:
-                gs = self.store.try_get("GroupSet", lws.meta.namespace, pod.meta.name)
+                gs = gs_by_name.get(pod.meta.name)
                 if gs is None:
                     continue
             if index < replicas and index >= lws_partition:

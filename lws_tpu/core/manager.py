@@ -150,6 +150,9 @@ class Manager:
             fn = reg.watches.get(event.obj.kind)
             if fn is None:
                 continue
+            types = getattr(fn, "_event_types", None)
+            if types is not None and event.type not in types:
+                continue
             for key in fn(event.obj):
                 reg.enqueue(key)
 
@@ -226,3 +229,12 @@ class Manager:
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+
+
+def deleted_only(fn: MapFn) -> MapFn:
+    """Mark a watch mapper to fire on DELETED events only. MapFns receive
+    the object, not the event, so repair-style mappers (requeue the owner to
+    recreate a deleted dependent) would otherwise also fire on every
+    creation/status write of the dependent — pure no-op reconcile churn."""
+    fn._event_types = ("DELETED",)  # type: ignore[attr-defined]
+    return fn

@@ -240,6 +240,32 @@ class Store:
         except NotFoundError:
             return None
 
+    def _iter_matching_locked(
+        self, kind: str, namespace: Optional[str], labels: Optional[dict[str, str]]
+    ):
+        """Yield (key, stored_obj) for every match. Caller holds the lock.
+        The ONE copy of the matching logic all three list variants share:
+        narrow by the smallest label bucket, then verify the rest."""
+        if labels:
+            buckets = [
+                self._label_index.get((kind, lk, lv), set())
+                for lk, lv in labels.items()
+            ]
+            objects = self._objects
+            for key in min(buckets, key=len):
+                obj = objects.get(key)
+                if obj is None:
+                    continue
+                if namespace is not None and key[1] != namespace:
+                    continue
+                if any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
+                    continue
+                yield key, obj
+        else:
+            for key, obj in self._by_kind.get(kind, {}).items():
+                if namespace is None or key[1] == namespace:
+                    yield key, obj
+
     def list(
         self,
         kind: str,
@@ -247,31 +273,45 @@ class Store:
         labels: Optional[dict[str, str]] = None,
     ) -> list[TypedObject]:
         with self._lock:
-            out = []
-            if labels:
-                # Narrow by the smallest label bucket, then verify the rest.
-                buckets = [
-                    self._label_index.get((kind, lk, lv), set())
-                    for lk, lv in labels.items()
-                ]
-                candidates = min(buckets, key=len)
-                objects = self._objects
-                for key in candidates:
-                    obj = objects.get(key)
-                    if obj is None:
-                        continue
-                    if namespace is not None and key[1] != namespace:
-                        continue
-                    if any(obj.meta.labels.get(lk) != lv for lk, lv in labels.items()):
-                        continue
-                    out.append(_clone(obj))
-            else:
-                for (_, ns, _), obj in self._by_kind.get(kind, {}).items():
-                    if namespace is not None and ns != namespace:
-                        continue
-                    out.append(_clone(obj))
+            out = [_clone(obj) for _, obj in self._iter_matching_locked(kind, namespace, labels)]
             out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
             return out
+
+    def list_shared(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+    ) -> list[TypedObject]:
+        """READ-ONLY list returning the stored objects THEMSELVES, no clone.
+
+        Informer-cache semantics (controller-runtime returns shared cache
+        pointers the same way): callers MUST NOT mutate the result — write
+        paths go through get()+update(). Safe to hold across writes because
+        every write REPLACES the stored entry with a fresh clone
+        (_update_locked), never mutates in place, so a returned reference
+        stays a stable snapshot. Exists for hot read-only reconcile paths:
+        list()'s per-call deep clone of every match was the fleet-rollout
+        bottleneck (CONTROL_r04)."""
+        with self._lock:
+            out = [obj for _, obj in self._iter_matching_locked(kind, namespace, labels)]
+            out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
+            return out
+
+    def list_keys(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+    ) -> list[Key]:
+        """Matching keys WITHOUT cloning the objects — for event mappers and
+        anything else that only fans out to keys. list() clones every match
+        at the isolation boundary, which is pure waste when the caller never
+        touches the objects (the fleet-rollout hot path, CONTROL_r04)."""
+        with self._lock:
+            return sorted(
+                key for key, _ in self._iter_matching_locked(kind, namespace, labels)
+            )
 
     # ---- writes ------------------------------------------------------------
     def _begin_write(self) -> None:

@@ -107,12 +107,11 @@ class ControlPlane:
             name = obj.meta.labels.get(contract.SET_NAME_LABEL_KEY)
             if not name:
                 return []
-            pods = store.list(
+            return store.list_keys(
                 "Pod",
                 obj.meta.namespace,
                 labels={contract.SET_NAME_LABEL_KEY: name, contract.WORKER_INDEX_LABEL_KEY: "0"},
             )
-            return [p.key() for p in pods]
 
         def groupset_owner_of_pod(obj) -> list[Key]:
             owner = obj.meta.controller_owner()
@@ -120,14 +119,34 @@ class ControlPlane:
                 return [("GroupSet", obj.meta.namespace, owner.name)]
             return []
 
+        _lws_fanout_gen: dict = {}
+
         def pods_of_lws(obj) -> list[Key]:
-            # LWS spec changes (e.g. size, template) flow through leader pods.
-            pods = store.list(
+            # LWS SPEC changes (size, template) flow through leader pods.
+            # Status-only updates keep meta.generation, and during a fleet
+            # rollout the LWS status churns once per group — fanning every
+            # one of those out to every leader pod was the dominant source
+            # of no-op pod reconciles (CONTROL_r04 rollout). Pods requeue
+            # themselves through their own direct watch; this mapper only
+            # needs to fire on generation edges. Deleted dependents are
+            # repaired by the owner_pod_of_deleted / leader_pod_of_groupset
+            # DELETED-only mappers below, not by this side channel.
+            # Memo keyed by uid: a deleted-and-recreated LWS restarts its
+            # generation counter and must not inherit the old memo. Bounded:
+            # DS rollouts churn uniquely-named child LWSes forever.
+            if len(_lws_fanout_gen) > 8192:
+                for stale in list(_lws_fanout_gen)[:4096]:
+                    del _lws_fanout_gen[stale]
+            memo_key = (obj.key(), obj.meta.uid)
+            gen = obj.meta.generation
+            if _lws_fanout_gen.get(memo_key) == gen:
+                return []
+            _lws_fanout_gen[memo_key] = gen
+            return store.list_keys(
                 "Pod",
                 obj.meta.namespace,
                 labels={contract.SET_NAME_LABEL_KEY: obj.meta.name, contract.WORKER_INDEX_LABEL_KEY: "0"},
             )
-            return [p.key() for p in pods]
 
         self.lws_controller = LWSReconciler(self.store, self.recorder)
         self.manager.register(
@@ -140,6 +159,30 @@ class ControlPlane:
             },
         )
 
+        from lws_tpu.core.manager import deleted_only
+
+        @deleted_only
+        def leader_pod_of_groupset(obj) -> list[Key]:
+            # Worker groupsets are named after their leader pod; deleting one
+            # must requeue that leader directly so the pod controller
+            # recreates it (previously this recovery rode the LWS
+            # status-churn side channel, which the generation gate above
+            # rightly cuts). DELETED-only: firing on every creation/status
+            # write would reintroduce the no-op churn the gate removed.
+            if contract.GROUP_INDEX_LABEL_KEY in obj.meta.labels:
+                return [("Pod", obj.meta.namespace, obj.meta.name)]
+            return []
+
+        @deleted_only
+        def owner_pod_of_deleted(obj) -> list[Key]:
+            # Per-replica Services and gang PodGroups are owned by their
+            # leader pod; deleting one requeues that pod so its reconcile
+            # recreates the dependent (same repair edge as above).
+            owner = obj.meta.controller_owner()
+            if owner is not None and owner.kind == "Pod":
+                return [("Pod", obj.meta.namespace, owner.name)]
+            return []
+
         self.pod_controller = PodReconciler(self.store, self.recorder, provider)
         self.manager.register(
             self.pod_controller,
@@ -148,6 +191,9 @@ class ControlPlane:
                 "ControllerRevision": leader_pods_of_lws,
                 "Node": lambda o: [],  # placeholder; exclusive placement keys off pod binding
                 "LeaderWorkerSet": pods_of_lws,
+                "GroupSet": leader_pod_of_groupset,
+                "Service": owner_pod_of_deleted,
+                "PodGroup": owner_pod_of_deleted,
             },
         )
 

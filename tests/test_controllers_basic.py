@@ -257,3 +257,39 @@ def test_requeue_after_is_honored():
     assert mgr.run_until_stable() == 1
     assert len(calls) == 3
     assert mgr.run_until_stable() == 0
+
+
+def test_deleted_per_replica_service_recreated():
+    """UniquePerReplica services are owned by their leader pod; deleting one
+    must requeue that pod (owner_pod_of_deleted DELETED edge) so the pod
+    controller recreates it — the LWS status-churn side channel that used to
+    repair this is generation-gated now."""
+    cp = make_cp(auto_ready=True)
+    cp.create(
+        LWSBuilder().replicas(2).size(2)
+        .subdomain_policy(SubdomainPolicy.UNIQUE_PER_REPLICA).build()
+    )
+    cp.run_until_stable()
+    assert cp.store.try_get("Service", "default", "sample-1") is not None
+    cp.store.delete("Service", "default", "sample-1")
+    cp.run_until_stable()
+    assert cp.store.try_get("Service", "default", "sample-1") is not None
+    assert_valid_lws(cp.store, "sample")
+
+
+def test_deleted_podgroup_recreated():
+    """Gang PodGroups are owned by their leader pod; same DELETED repair
+    edge as per-replica services."""
+    from lws_tpu.sched import make_slice_nodes
+
+    cp = make_cp(enable_scheduler=True, auto_ready=True, scheduler_provider="gang")
+    for i in range(2):
+        cp.add_nodes(make_slice_nodes(f"slice-{i}", topology="2x4"))
+    cp.create(LWSBuilder().replicas(2).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+    groups = cp.store.list("PodGroup")
+    assert len(groups) == 2
+    victim = groups[0]
+    cp.store.delete("PodGroup", victim.meta.namespace, victim.meta.name)
+    cp.run_until_stable()
+    assert cp.store.try_get("PodGroup", victim.meta.namespace, victim.meta.name) is not None
