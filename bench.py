@@ -367,6 +367,28 @@ def _validate_paged_kernel_on_chip() -> dict:
             if err > 0.06:
                 out["ok"] = False
                 return out
+
+    # The int8 fused attention kernel (LWS_TPU_INT8_ATTN opt-in path) has
+    # also never touched hardware — validate it in the same window.
+    from lws_tpu.models.llama import _cached_attention, _dequantize_kv
+    from lws_tpu.ops.int8_attention import int8_decode_attention
+
+    B, T, Hkv, Hq, hd = 4, 48, 2, 4, 64
+    q = jnp.asarray(rng.randn(B, 1, Hq, hd), jnp.bfloat16)
+    kq = jnp.asarray(rng.randint(-127, 128, (B, T, Hkv, hd)), jnp.int8)
+    vq = jnp.asarray(rng.randint(-127, 128, (B, T, Hkv, hd)), jnp.int8)
+    ks = jnp.asarray(rng.rand(B, T, Hkv) * 0.02, jnp.float32)
+    vs = jnp.asarray(rng.rand(B, T, Hkv) * 0.02, jnp.float32)
+    pos = jnp.asarray([3, 17, 31, 47], jnp.int32)
+    got = int8_decode_attention(q, kq, ks, vq, vs, pos)
+    want = _cached_attention(
+        q, _dequantize_kv(kq, ks, jnp.bfloat16), _dequantize_kv(vq, vs, jnp.bfloat16), pos
+    )
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))))
+    out["int8_attn_max_err"] = round(err, 5)
+    if err > 0.06:
+        out["ok"] = False
+        return out
     out["ok"] = True
     return out
 
