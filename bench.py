@@ -370,7 +370,7 @@ def _validate_paged_kernel_on_chip() -> dict:
 
     # The int8 fused attention kernel (LWS_TPU_INT8_ATTN opt-in path) has
     # also never touched hardware — validate it in the same window.
-    from lws_tpu.models.llama import _cached_attention, _dequantize_kv
+    # (_cached_attention/_dequantize_kv are already bound above.)
     from lws_tpu.ops.int8_attention import int8_decode_attention
 
     B, T, Hkv, Hq, hd = 4, 48, 2, 4, 64

@@ -1,0 +1,125 @@
+"""Reference-e2e-parity flows not covered by the rolling-update matrix
+(ref test/e2e/e2e_test.go): subdomain-policy change mid-life, per-replica
+service scale-up under maxSurge, gang PodGroup lifecycle across group
+restarts, and subgroup rollouts with surge."""
+
+from lws_tpu.api import contract
+from lws_tpu.api.types import NetworkConfig, SubdomainPolicy, SubGroupPolicyType
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.sched import make_slice_nodes
+from lws_tpu.testing import (
+    LWSBuilder,
+    assert_valid_lws,
+    lws_pods,
+    make_all_groups_ready,
+    restart_pod_container,
+)
+
+
+def test_subdomain_policy_change_rolls_new_dns_identity():
+    """Shared -> UniquePerReplica mid-life (ref e2e_test.go:305): the change
+    is a template revision, so groups roll; the new pods carry per-replica
+    subdomains, matching env (LWS_LEADER_ADDRESS/JAX coordinator), and
+    per-replica services exist. assert_valid_lws checks the whole contract
+    for whichever policy is in force."""
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(2).size(2).build())
+    cp.run_until_stable()
+    assert_valid_lws(cp.store, "sample")
+    before = {p.meta.name: p.spec.subdomain for p in lws_pods(cp.store, "sample")}
+    assert set(before.values()) == {"sample"}
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.network_config = NetworkConfig(
+        subdomain_policy=SubdomainPolicy.UNIQUE_PER_REPLICA
+    )
+    cp.store.update(lws)
+    make_all_groups_ready(cp, "sample", max_rounds=40)
+
+    assert_valid_lws(cp.store, "sample")
+    pods = {p.meta.name: p for p in lws_pods(cp.store, "sample")}
+    for g in range(2):
+        leader = pods[f"sample-{g}"]
+        assert leader.spec.subdomain == f"sample-{g}"
+        env = {e.name: e.value for e in leader.spec.containers[0].env}
+        assert env[contract.LWS_LEADER_ADDRESS] == f"sample-{g}.sample-{g}.default"
+        assert cp.store.try_get("Service", "default", f"sample-{g}") is not None
+
+
+def test_per_replica_services_scale_with_surge():
+    """UniquePerReplica + maxSurge (ref e2e_test.go:330): burst groups get
+    their own headless services while the surge lives."""
+    cp = ControlPlane()  # manual readiness: the burst must be observable
+    cp.create(
+        LWSBuilder().replicas(2).size(2).image("v1")
+        .subdomain_policy(SubdomainPolicy.UNIQUE_PER_REPLICA)
+        .rollout(max_unavailable=0, max_surge=2).build()
+    )
+    make_all_groups_ready(cp, "sample", max_rounds=40)
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.leader_worker_template.worker_template.spec.containers[0].image = "v2"
+    cp.store.update(lws)
+    cp.run_until_stable()
+    # Surge groups 2..3 exist mid-update with their per-replica services.
+    gs = cp.store.get("GroupSet", "default", "sample")
+    assert gs.spec.replicas == 4, "maxSurge=2 must burst to 4 groups"
+    for g in range(4):
+        assert cp.store.try_get("Service", "default", f"sample-{g}") is not None, g
+
+    make_all_groups_ready(cp, "sample", max_rounds=60)
+    assert_valid_lws(cp.store, "sample")
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 2
+    # Reclaimed: burst services' groups are gone with their pods.
+    assert cp.store.get("GroupSet", "default", "sample").spec.replicas == 2
+
+
+def test_podgroup_follows_group_restart():
+    """Gang PodGroup lifecycle across RecreateGroupOnPodRestart (ref
+    e2e_gang_scheduling_test.go / e2e_test.go:365): the PodGroup is owned by
+    the leader pod, so a group restart GCs it and the replacement leader's
+    reconcile recreates it."""
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, scheduler_provider="gang")
+    for i in range(2):
+        cp.add_nodes(make_slice_nodes(f"slice-{i}", topology="2x4"))
+    cp.create(LWSBuilder().replicas(2).size(2).tpu_chips(4).build())
+    cp.run_until_stable()
+    groups_before = {g.meta.name: g.meta.uid for g in cp.store.list("PodGroup")}
+    assert len(groups_before) == 2
+    leader_uid_before = cp.store.get("Pod", "default", "sample-0").meta.uid
+
+    restart_pod_container(cp.store, "default", "sample-0-1")
+    cp.run_until_stable()
+    make_all_groups_ready(cp, "sample", max_rounds=40)
+
+    assert cp.store.get("Pod", "default", "sample-0").meta.uid != leader_uid_before
+    groups_after = {g.meta.name: g.meta.uid for g in cp.store.list("PodGroup")}
+    assert set(groups_after) == set(groups_before)
+    changed = [n for n in groups_after if groups_after[n] != groups_before[n]]
+    assert len(changed) == 1, (groups_before, groups_after)
+
+
+def test_subgroup_rollout_with_surge_preserves_windows():
+    """Rolling update with subGroupSize + maxSurge (ref e2e_test.go:230):
+    every post-rollout pod keeps correct subgroup labels and TPU hostname
+    windows — assert_valid_lws recomputes them all."""
+    cp = ControlPlane(auto_ready=True)
+    cp.create(
+        LWSBuilder().replicas(2).size(4).tpu_chips(4).image("v1")
+        .subgroup(2, SubGroupPolicyType.LEADER_WORKER)
+        .rollout(max_unavailable=1, max_surge=1).build()
+    )
+    cp.run_until_stable()
+    assert_valid_lws(cp.store, "sample")
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.leader_worker_template.worker_template.spec.containers[0].image = "v2"
+    cp.store.update(lws)
+    make_all_groups_ready(cp, "sample", max_rounds=60)
+
+    assert_valid_lws(cp.store, "sample")
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == 2 and lws.status.ready_replicas == 2
+    for p in lws_pods(cp.store, "sample"):
+        assert p.spec.containers[0].image == "v2", p.meta.name
