@@ -467,6 +467,7 @@ def main() -> None:
       3. weights-only int8 experiment (the undecided lane -> recorded verdict)
       4. paged-attention kernel on-chip validation (first hardware contact)
       5. bf16 pipeline-body on-chip probe
+      6. training throughput (tokens/s + MFU -> TRAIN_<round>.json)
     Each stage writes its artifact / per-metric cache entry IMMEDIATELY, so a
     relay window of any length captures a prefix of the list instead of
     nothing. The headline JSON line is printed right after stage 1 AND
@@ -533,6 +534,16 @@ def main() -> None:
     headline["pipeline_bf16_on_chip"] = pipe
     if on_accelerator and pipe.get("rc") == 0:
         _save_last_good("pipeline_bf16_on_chip", pipe)
+
+    # --- Stage 6: training throughput (TRAIN_<round>.json) ----------------
+    # Training-side evidence has never been driver-captured (round 1's
+    # attempt died to the relay outage); lowest priority — runs last.
+    train = _run_stage_subprocess(
+        [sys.executable, os.path.join("benchmarks", "train_bench.py")],
+        timeout_s=900,
+    )
+    headline["train"] = train
+    print(f"[bench] train stage: {json.dumps(train)}", file=sys.stderr)
 
     print(json.dumps(headline), flush=True)
 

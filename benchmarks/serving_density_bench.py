@@ -41,6 +41,17 @@ from lws_tpu.models.llama import LlamaConfig, init_params
 from lws_tpu.serving.paged_engine import PagedBatchEngine
 
 
+def _write_artifact(path: str, data: dict) -> None:
+    """Atomic artifact write: the orchestrator's hard timeout can SIGKILL
+    this stage mid-write; a torn artifact must be impossible."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def measure(engine, prompt_len, warm_chunk=4, timed_chunk=32) -> dict:
     """Steady-state decode tok/s via two-point differencing of chunked
     on-device stepping (per-dispatch host sync differences away)."""
@@ -105,8 +116,7 @@ def main() -> None:
     if not bench._probe_backend_with_retry(total_budget_s=600.0):
         rec = {"degraded": True, "note": "TPU relay unreachable; no fresh density numbers"}
         print(json.dumps(rec))
-        with open(artifact_path, "w") as f:
-            json.dump(rec, f, indent=1)
+        _write_artifact(artifact_path, rec)
         return
     on_chip = jax.default_backend() != "cpu"
     if on_chip:
@@ -186,8 +196,7 @@ def main() -> None:
         "on_chip": on_chip,
         "acceptance": "paged(128) >= 2x dense-pool aggregate AND >= plain Engine",
     }
-    with open(artifact_path, "w") as f:
-        json.dump(artifact, f, indent=1)
+    _write_artifact(artifact_path, artifact)
     print(json.dumps({"artifact": artifact_path}))
 
 

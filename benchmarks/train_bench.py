@@ -1,23 +1,38 @@
 """Training throughput microbench (single chip): tokens/s and MFU for the
 flagship model's train step (adamw, remat, bf16 compute / f32 params).
 
-Not the driver-recorded benchmark (that is bench.py at the repo root); this is
-the training-side evidence: `python benchmarks/train_bench.py`.
+Stage 6 of the bench.py orchestrator (also runnable directly:
+`python benchmarks/train_bench.py`). Prints one JSON line and writes
+TRAIN_<round>.json at the repo root so training-side numbers are a
+driver-capturable artifact.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
 
 PEAK_BF16_FLOPS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "cpu": 1e12}
 
 
 def main() -> None:
+    import bench
+
+    bench.force_cpu_if_dev()  # axon plugin overrides JAX_PLATFORMS; see helper
+    if not bench._probe_backend_with_retry(total_budget_s=300.0):
+        # A mid-window relay drop would otherwise block in C until the
+        # orchestrator's hard timeout; emit a parseable degraded record.
+        print(json.dumps({"degraded": True, "note": "TPU relay unreachable; no train numbers"}))
+        return
+
     import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, ".")
     from bench import detect_generation
     from lws_tpu.models.llama import LlamaConfig
     from lws_tpu.models.train import init_train_state, make_optimizer, make_train_step
@@ -67,10 +82,26 @@ def main() -> None:
     flops_per_step = 6 * n_params * batch * seq
     gen = detect_generation()
     mfu = flops_per_step / step_s / PEAK_BF16_FLOPS.get(gen, PEAK_BF16_FLOPS["v5e"])
-    print(
-        f"train: {step_s*1e3:.1f} ms/step, {tokens_per_s:,.0f} tokens/s/chip, "
-        f"MFU {mfu:.1%} ({gen}, loss {float(loss):.3f})"
-    )
+    record = {
+        "metric": f"llama-{n_params/1e9:.1f}B train step (adamw, remat, bf16), single chip ({gen})",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s/chip",
+        "mfu": round(mfu, 4),
+        "ms_per_step": round(step_s * 1e3, 1),
+        "loss": round(float(loss), 3),
+        "on_chip": on_accel,
+    }
+    print(json.dumps(record))
+    if on_accel:
+        # Atomic write: the orchestrator's hard timeout can SIGKILL this
+        # stage mid-write; a torn artifact must be impossible.
+        path = os.path.join(_ROOT, f"TRAIN_{bench.ROUND_TAG}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
 
 if __name__ == "__main__":
