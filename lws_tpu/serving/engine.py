@@ -69,6 +69,44 @@ def sample_logits(logits: jax.Array, key, params: SamplingParams) -> jax.Array:
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_logits_per_slot(
+    logits: jax.Array,       # [B, V]
+    keys: jax.Array,         # [B] typed PRNG keys (one stream per slot)
+    temperature: jax.Array,  # [B] f32; <= 0 means greedy for that slot
+    top_k: jax.Array,        # [B] i32; 0 disables
+    top_p: jax.Array,        # [B] f32; 1.0 disables
+) -> jax.Array:
+    """Per-slot sampling for continuous batching: every slot carries ITS OWN
+    request's sampling params and PRNG stream (vLLM's per-request
+    SamplingParams shape), vectorized so one [B, V] pass serves mixed
+    greedy/sampled batches. Same semantics as sample_logits per slot:
+    temperature scaling, then top-k mask, then top-p on the masked
+    distribution, then categorical; temperature <= 0 short-circuits to
+    argmax for that slot."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # top-k: mask everything below each slot's kth value (k=0 / k>=V off).
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)
+    use_k = (top_k > 0) & (top_k < V)
+    scaled = jnp.where(use_k[:, None] & (scaled < kth), -jnp.inf, scaled)
+
+    # top-p on the post-top-k distribution (mirrors sample_logits' order).
+    sorted_masked = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_masked, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.clip(jnp.sum(cumulative < top_p[:, None], axis=-1), 0, V - 1)
+    cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx[:, None], axis=1)
+    use_p = top_p < 1.0
+    scaled = jnp.where(use_p[:, None] & (scaled < cutoff), -jnp.inf, scaled)
+
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
 def host_sync(x) -> None:
     """Force completion via a host transfer — `block_until_ready` is not a
     reliable fence on relay-backed remote TPU backends."""

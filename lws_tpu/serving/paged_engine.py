@@ -50,6 +50,12 @@ class PagedRequest:
     tokens: list[int] = field(default_factory=list)
     slot: int = -1
     blocks: list[int] = field(default_factory=list)
+    # Per-request sampling (vLLM SamplingParams shape): temperature <= 0 is
+    # greedy; seed pins the slot's PRNG stream for reproducible sampling.
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
 
     @property
     def done(self) -> bool:
@@ -57,7 +63,9 @@ class PagedRequest:
 
 
 class PagedBatchEngine:
-    """Slot-based continuously-batched greedy engine over a paged KV pool."""
+    """Slot-based continuously-batched engine over a paged KV pool, with
+    per-request sampling (greedy by default; temperature/top-k/top-p/seed
+    per submit — mixed batches sample each slot from its own stream)."""
 
     def __init__(
         self,
@@ -105,7 +113,9 @@ class PagedBatchEngine:
             )
             _sh_prefill = {"out_shardings": (self._rep, self._prefill_cache_shardings)}
             _sh_insert = {"out_shardings": (self._pool_shardings, self._rep, self._rep)}
-            _sh_step = {"out_shardings": (self._pool_shardings, self._rep, self._rep, self._rep)}
+            _sh_step = {"out_shardings": (
+                self._pool_shardings, self._rep, self._rep, self._rep, self._rep
+            )}
         else:
             self._pool_shardings = None
             self._rep = None
@@ -136,6 +146,11 @@ class PagedBatchEngine:
         self.table = np.zeros((slots, self.max_blocks), np.int32)  # host truth
         self.pos_b = jnp.zeros((slots,), jnp.int32)
         self.tokens = jnp.zeros((slots,), jnp.int32)
+        # Per-slot sampling state (host truth, tiny; shipped per dispatch).
+        self.temp = np.zeros((slots,), np.float32)
+        self.top_k = np.zeros((slots,), np.int32)
+        self.top_p = np.ones((slots,), np.float32)
+        self._keys = jax.random.split(jax.random.key(0), slots)
 
         @partial(jax.jit, **_sh_prefill)
         def _prefill_one(params, prompt, last_pos):
@@ -143,7 +158,17 @@ class PagedBatchEngine:
             logits, cache = forward_prefill(
                 params, prompt, cache, cfg_static, last_pos=last_pos
             )
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return logits, cache  # [1, V]: the caller samples per-request
+
+        @jax.jit
+        def _sample_first(logits, key, temp, top_k, top_p):
+            from lws_tpu.serving.engine import sample_logits_per_slot
+
+            return sample_logits_per_slot(
+                logits, key[None], temp[None], top_k[None], top_p[None]
+            )[0]
+
+        self._sample_first = _sample_first
 
         @partial(jax.jit, donate_argnums=(0,), **_sh_insert)
         def _insert(cache, slot_k, slot_v, block_ids, pos_b, tokens, slot, plen,
@@ -165,11 +190,22 @@ class PagedBatchEngine:
         # donated pool, leaving nothing for the fallback retry); switch to
         # the donating executable once the kernel has proven itself.
         self._kernel_probed = not kernel_intent
-        self._step_n_fn = self._make_step_n(
-            use_kernel=kernel_intent, donate=self._kernel_probed
-        )
+        self._use_kernel = kernel_intent
+        # Step executables, cached per (use_kernel, donate, sample): the
+        # all-greedy default must stay a single argmax — the full sampling
+        # pipeline (two [slots, V] sorts + softmax + cumsum + categorical)
+        # would tax every decode step of the benchmarked path for nothing.
+        self._step_cache: dict = {}
 
-    def _make_step_n(self, use_kernel: bool, donate: bool = True):
+    def _get_step_fn(self, sample: bool):
+        key = (self._use_kernel, self._kernel_probed, sample)
+        if key not in self._step_cache:
+            self._step_cache[key] = self._make_step_n(
+                use_kernel=self._use_kernel, donate=self._kernel_probed, sample=sample
+            )
+        return self._step_cache[key]
+
+    def _make_step_n(self, use_kernel: bool, donate: bool = True, sample: bool = False):
         cfg_static = self._cfg_static
         tp_static = self._tp
 
@@ -179,26 +215,35 @@ class PagedBatchEngine:
             **({"donate_argnums": (1,)} if donate else {}),
             **self._sh_step,
         )
-        def _step_n(params, cache, table, tokens, pos_b, active, n):
+        def _step_n(params, cache, table, tokens, pos_b, active, n, keys, temp, top_k, top_p):
             # n chained steps in ONE dispatch (lax.scan): admission state is
             # frozen for the chunk, so callers bound n by the soonest
             # completion. Kills the per-step host round trip that dominates
             # relay-backed links (same trick as Engine.decode_n).
+            from lws_tpu.serving.engine import sample_logits_per_slot
+
             def body(carry, _):
-                cache, tokens, pos_b = carry
+                cache, tokens, pos_b, keys = carry
                 logits, cache = forward_decode_paged(
                     params, tokens, cache, table, pos_b, cfg_static,
                     tp_shard=tp_static, use_kernel=use_kernel,
                 )
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if sample:
+                    # Each slot advances ITS OWN stream; inactive slots
+                    # advance too (harmless — a new occupant reseeds).
+                    split = jax.vmap(jax.random.split)(keys)  # [slots, 2]
+                    step_keys, keys = split[:, 0], split[:, 1]
+                    nxt = sample_logits_per_slot(logits, step_keys, temp, top_k, top_p)
+                else:  # all-greedy batch: plain argmax, keys pass through
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 tokens = jnp.where(active, nxt, tokens)
                 pos_b = jnp.where(active, pos_b + 1, pos_b)
-                return (cache, tokens, pos_b), tokens
+                return (cache, tokens, pos_b, keys), tokens
 
-            (cache, tokens, pos_b), toks = jax.lax.scan(
-                body, (cache, tokens, pos_b), None, length=n
+            (cache, tokens, pos_b, keys), toks = jax.lax.scan(
+                body, (cache, tokens, pos_b, keys), None, length=n
             )
-            return cache, tokens, pos_b, toks  # toks [n, slots]
+            return cache, tokens, pos_b, toks, keys  # toks [n, slots]
 
         return _step_n
 
@@ -215,9 +260,21 @@ class PagedBatchEngine:
     def free_blocks(self) -> int:
         return len(self._free_blocks)
 
-    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> Optional[int]:
+    def submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: Optional[int] = None,
+    ) -> Optional[int]:
         """Admit a request; returns request id, or None when out of slots OR
-        out of pool blocks (the density backpressure signal)."""
+        out of pool blocks (the density backpressure signal). Sampling is
+        per-request (vLLM SamplingParams shape): temperature <= 0 is greedy;
+        with temperature > 0, `seed` pins this request's PRNG stream
+        (auto-assigned otherwise) — sampled and greedy requests mix freely
+        in one batch without perturbing each other."""
         if not self._free_slots:
             return None
         plen = len(prompt)
@@ -236,17 +293,38 @@ class PagedBatchEngine:
         slot = self._free_slots.pop(0)
         blocks = [self._free_blocks.pop(0) for _ in range(n_blocks)]
         req = PagedRequest(
-            next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot, blocks=blocks
+            next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot,
+            blocks=blocks, temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=seed,
         )
         self.table[slot] = 0
         self.table[slot, :n_blocks] = blocks
+        self.temp[slot] = temperature
+        self.top_k[slot] = top_k
+        self.top_p[slot] = top_p
+        # Unseeded sampling must be nondeterministic (vLLM seed=None): draw
+        # from process entropy, not a counter — a counter would collide with
+        # small user seeds and make every dp replica replay identical
+        # "random" samples. User seeds stay a pure function of the seed.
+        if seed is None:
+            import os as _os
+
+            # 63 bits: jax.random.key seeds go through np.int64.
+            seed = int.from_bytes(_os.urandom(8), "little") >> 1
+        req_key = jax.random.key(seed)
 
         padded = np.zeros((bucket,), np.int32)
         padded[:plen] = prompt
         with self._mesh_ctx():
-            first, slot_cache = self._prefill_one(
+            logits, slot_cache = self._prefill_one(
                 self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
             )
+            first_key, slot_key = jax.random.split(req_key)
+            first = self._sample_first(
+                logits, first_key,
+                jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
+            )
+            self._keys = self._keys.at[slot].set(slot_key)
             prefill_ids = jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
             scales = (
                 (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
@@ -255,9 +333,9 @@ class PagedBatchEngine:
             )
             self.cache, self.pos_b, self.tokens = self._insert(
                 self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
-                self.pos_b, self.tokens, slot, plen, first[0], *scales,
+                self.pos_b, self.tokens, slot, plen, first, *scales,
             )
-        req.tokens.append(int(first[0]))
+        req.tokens.append(int(first))
         if req.done:
             self._completed[req.request_id] = req
             self._release(req)
@@ -297,16 +375,27 @@ class PagedBatchEngine:
             [s in self._active and not self._active[s].done for s in range(self.slots)]
         )
         table = jnp.asarray(self.table)
+        sampling = (
+            self._keys, jnp.asarray(self.temp), jnp.asarray(self.top_k),
+            jnp.asarray(self.top_p),
+        )
+        # All-greedy batches (the default and the benchmarked configuration)
+        # take the argmax-only executable.
+        any_sampled = bool(
+            any(self._active[s].temperature > 0.0 for s in self._active)
+        )
         if self.mesh is not None:
             # Pin the host-built inputs replicated: left uncommitted, GSPMD
             # may shard them and the shard_map'd kernel expects them whole.
             active = jax.device_put(active, self._rep)
             table = jax.device_put(table, self._rep)
+            sampling = tuple(jax.device_put(s, self._rep) for s in sampling)
         with self._mesh_ctx():
             try:
-                self.cache, self.tokens, self.pos_b, toks = self._step_n_fn(
+                step_fn = self._get_step_fn(any_sampled)
+                self.cache, self.tokens, self.pos_b, toks, self._keys = step_fn(
                     self.params, self.cache, table, self.tokens,
-                    self.pos_b, active, n,
+                    self.pos_b, active, n, *sampling,
                 )
             except Exception as e:  # noqa: BLE001 — kernel trace/compile/runtime failure
                 if self.stats["attention_path"] != "kernel" or self._kernel_probed:
@@ -327,17 +416,18 @@ class PagedBatchEngine:
                 self.stats["attention_path"] = "xla_fallback"
                 self.stats["kernel_error"] = repr(e)[:300]
                 self._kernel_probed = True
-                self._step_n_fn = self._make_step_n(use_kernel=False)
-                self.cache, self.tokens, self.pos_b, toks = self._step_n_fn(
-                    self.params, self.cache, table, self.tokens,
-                    self.pos_b, active, n,
+                self._use_kernel = False
+                self.cache, self.tokens, self.pos_b, toks, self._keys = (
+                    self._get_step_fn(any_sampled)(
+                        self.params, self.cache, table, self.tokens,
+                        self.pos_b, active, n, *sampling,
+                    )
                 )
             else:
                 if not self._kernel_probed:
-                    # Kernel proved itself: swap in the donating executable
-                    # for every subsequent step (in-place pool updates).
+                    # Kernel proved itself: subsequent steps use the
+                    # donating executables (in-place pool updates).
                     self._kernel_probed = True
-                    self._step_n_fn = self._make_step_n(use_kernel=True)
         host_toks = np.asarray(toks)  # [n, slots]
         for slot, req in list(self._active.items()):
             req.tokens.extend(int(t) for t in host_toks[:, slot])

@@ -1,0 +1,147 @@
+"""Per-request sampling under paged continuous batching (vLLM
+SamplingParams parity): greedy and sampled requests share a batch without
+perturbing each other; seeds make sampling reproducible; every slot draws
+from its own PRNG stream."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu.models import init_params
+from lws_tpu.models.llama import LlamaConfig
+from lws_tpu.serving.engine import SamplingParams, sample_logits, sample_logits_per_slot
+from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    return cfg, jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+
+
+def make_engine(cfg, params):
+    return PagedBatchEngine(cfg, params, slots=3, max_len=32, block_size=8)
+
+
+PROMPT_A = np.array([5, 9, 2], np.int32)
+PROMPT_B = np.array([7, 7, 1, 4], np.int32)
+
+
+def test_greedy_slot_unperturbed_by_sampled_neighbors(model):
+    """A greedy request decodes the SAME tokens whether its batch neighbors
+    sample or not — per-slot streams and params are fully isolated."""
+    cfg, params = model
+    ref = make_engine(cfg, params)
+    a0 = ref.submit(PROMPT_A, max_new_tokens=8)
+    ref.run_until_drained()
+
+    eng = make_engine(cfg, params)
+    a = eng.submit(PROMPT_A, max_new_tokens=8)
+    b = eng.submit(PROMPT_B, max_new_tokens=8, temperature=1.5, top_k=20, seed=7)
+    eng.run_until_drained()
+    assert eng.result(a) == ref.result(a0)
+    assert len(eng.result(b)) == 8
+
+
+def test_seeded_sampling_reproducible(model):
+    cfg, params = model
+
+    def run(seed):
+        eng = make_engine(cfg, params)
+        r = eng.submit(PROMPT_A, max_new_tokens=10, temperature=1.0, seed=seed)
+        eng.run_until_drained()
+        return eng.result(r)
+
+    assert run(42) == run(42)
+    runs = {tuple(run(s)) for s in (1, 2, 3, 4, 5)}
+    assert len(runs) > 1, "five seeds all produced identical samples"
+
+
+def test_top_k_one_is_greedy(model):
+    """temperature > 0 with top_k=1 must reduce to argmax exactly."""
+    cfg, params = model
+    ref = make_engine(cfg, params)
+    a0 = ref.submit(PROMPT_B, max_new_tokens=8)
+    ref.run_until_drained()
+
+    eng = make_engine(cfg, params)
+    a = eng.submit(PROMPT_B, max_new_tokens=8, temperature=2.0, top_k=1, seed=3)
+    eng.run_until_drained()
+    assert eng.result(a) == ref.result(a0)
+
+
+def test_sampling_survives_slot_reuse(model):
+    """A freed slot's sampling params must not leak into the next occupant:
+    a greedy request admitted into a slot previously used for sampling stays
+    greedy."""
+    cfg, params = model
+    eng = PagedBatchEngine(cfg, params, slots=1, max_len=32, block_size=8)
+    s = eng.submit(PROMPT_A, max_new_tokens=4, temperature=2.0, seed=9)
+    eng.run_until_drained()
+    assert len(eng.result(s)) == 4
+
+    g = eng.submit(PROMPT_B, max_new_tokens=8)  # same slot, greedy
+    eng.run_until_drained()
+    ref = make_engine(cfg, params)
+    g0 = ref.submit(PROMPT_B, max_new_tokens=8)
+    ref.run_until_drained()
+    assert eng.result(g) == ref.result(g0)
+
+
+def test_per_slot_sampler_matches_scalar_sampler():
+    """With uniform params and the same key, the vectorized per-slot sampler
+    must agree with Engine.sample_logits (same masking order, same
+    categorical draw)."""
+    key = jax.random.key(0)
+    logits = jax.random.normal(jax.random.key(1), (4, 64)) * 3.0
+    for temp, k, p in ((1.0, 0, 1.0), (0.7, 10, 1.0), (1.3, 0, 0.9), (1.0, 8, 0.8)):
+        want = sample_logits(logits, key, SamplingParams(temp, k, p))
+        got = sample_logits_per_slot(
+            logits,
+            jnp.broadcast_to(key, (4,)),
+            jnp.full((4,), temp, jnp.float32),
+            jnp.full((4,), k, jnp.int32),
+            jnp.full((4,), p, jnp.float32),
+        )
+        # sample_logits draws ONE key for the whole batch (categorical over
+        # [B, V]); the per-slot path draws per slot. Same key per slot ==
+        # same key stream per row only for row 0; compare distributions via
+        # the masked support instead: every drawn token must be inside the
+        # scalar sampler's admissible set.
+        V = logits.shape[-1]
+        scaled = logits / temp
+        masked = scaled
+        if 0 < k < V:
+            kth = jax.lax.top_k(masked, k)[0][:, -1][:, None]
+            masked = jnp.where(masked < kth, -jnp.inf, masked)
+        if p < 1.0:
+            sorted_desc = jnp.sort(masked, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cumulative = jnp.cumsum(probs, axis=-1)
+            cutoff_idx = jnp.clip(jnp.sum(cumulative < p, axis=-1), 0, V - 1)
+            cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx[:, None], axis=1)
+            masked = jnp.where(masked < cutoff, -jnp.inf, masked)
+        for row in range(4):
+            assert jnp.isfinite(masked[row, got[row]]), (temp, k, p, row)
+            assert jnp.isfinite(masked[row, want[row]]), (temp, k, p, row)
+
+
+def test_greedy_temperature_zero_ignores_keys():
+    logits = jax.random.normal(jax.random.key(2), (3, 32))
+    keys = jax.random.split(jax.random.key(3), 3)
+    out = sample_logits_per_slot(
+        logits, keys,
+        jnp.zeros((3,), jnp.float32), jnp.zeros((3,), jnp.int32), jnp.ones((3,), jnp.float32),
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.argmax(logits, axis=-1)))
