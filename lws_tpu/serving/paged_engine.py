@@ -50,6 +50,9 @@ class PagedRequest:
     tokens: list[int] = field(default_factory=list)
     slot: int = -1
     blocks: list[int] = field(default_factory=list)
+    # Prefix caching: blocks this request shares through the prefix map
+    # (released by refcount) vs privately owned (released to the free list).
+    shared_blocks: list[int] = field(default_factory=list)
     # Per-request sampling (vLLM SamplingParams shape): temperature <= 0 is
     # greedy; seed pins the slot's PRNG stream for reproducible sampling.
     temperature: float = 0.0
@@ -76,6 +79,7 @@ class PagedBatchEngine:
         block_size: int = 16,
         num_blocks: Optional[int] = None,
         mesh=None,
+        prefix_cache: bool = False,
     ):
         """With `mesh` (axes incl. 'tp'), the engine serves TENSOR-PARALLEL
         paged continuous batching under GSPMD: params per param_shardings,
@@ -133,6 +137,18 @@ class PagedBatchEngine:
         self._free_blocks = list(range(1, self.num_blocks))  # 0 = null
         self._active: dict[int, PagedRequest] = {}
         self._completed: dict[int, PagedRequest] = {}
+        # Automatic prefix caching (vLLM APC shape, opt-in): full prompt
+        # blocks are content-addressed by a position-binding hash chain;
+        # later prompts sharing a block-aligned prefix reuse the cached
+        # blocks and prefill only their suffix. Shareable blocks carry
+        # refcounts; at refcount 0 they park in an LRU (contents intact,
+        # still mapped) and are evicted only when allocation needs them.
+        self.prefix_cache = prefix_cache
+        self._prefix_map: dict[bytes, int] = {}      # digest -> pool block
+        self._block_digest: dict[int, bytes] = {}    # reverse map
+        self._block_refs: dict[int, int] = {}        # shareable-block refs
+        self._lru: "dict[int, None]" = {}            # refcount-0, evictable
+        self.stats_prefix = {"hit_tokens": 0, "hit_blocks": 0, "evictions": 0}
 
         cfg_static = cfg
         self._cfg_static = cfg
@@ -175,6 +191,57 @@ class PagedBatchEngine:
                     first_token, slot_ks=None, slot_vs=None):
             cache = paged_insert(cache, slot_k, slot_v, block_ids, slot_ks, slot_vs)
             return cache, pos_b.at[slot].set(plen), tokens.at[slot].set(first_token)
+
+        quant = cfg.kv_quant
+        _sh_insert_prefix = (
+            {"out_shardings": (self._pool_shardings, self._rep, self._rep)}
+            if mesh is not None else {}
+        )
+
+        @partial(jax.jit, donate_argnums=(1,), **_sh_insert_prefix)
+        def _insert_with_prefix(params, cache, suffix, block_ids, hit_len,
+                                last_off, pos_b, slot, plen):
+            """Prefix-cache admission: gather the slot's table blocks into a
+            dense view (hit blocks carry the cached prefix K/V; new blocks
+            carry garbage the suffix pass overwrites), run the SUFFIX only
+            through forward_with_cache at pos=hit_len, scatter the view
+            back. Returns (cache, pos_b', last-token logits [1, V]). The
+            hit-block scatter rewrites identical bytes — harmless, and it
+            keeps one code path for quantized and plain pools."""
+            import dataclasses as _dc
+
+            from lws_tpu.models.llama import KVCache, forward_with_cache
+
+            L = cache.k.shape[0]
+            bs_ = cache.block_size
+            bucket = block_ids.shape[0] * bs_
+            s_suf = suffix.shape[1]
+
+            def view(pool):  # [L, nb, bs, ...] -> [L, 1, bucket(+pad), ...]
+                v = pool[:, block_ids].reshape(L, 1, bucket, *pool.shape[3:])
+                pad = jnp.zeros((L, 1, s_suf, *pool.shape[3:]), pool.dtype)
+                return jnp.concatenate([v, pad], axis=2)
+
+            dense = KVCache(
+                k=view(cache.k), v=view(cache.v),
+                pos=hit_len.astype(jnp.int32),
+                k_scale=view(cache.k_scale) if quant else None,
+                v_scale=view(cache.v_scale) if quant else None,
+            )
+            logits, dense = forward_with_cache(
+                params, suffix, dense, cfg_static, last_offset=last_off
+            )
+            scales = (
+                (dense.k_scale[:, 0, :bucket], dense.v_scale[:, 0, :bucket])
+                if quant else ()
+            )
+            cache = paged_insert(
+                cache, dense.k[:, 0, :bucket], dense.v[:, 0, :bucket],
+                block_ids, *scales,
+            )
+            return cache, pos_b.at[slot].set(plen), logits
+
+        self._insert_with_prefix = _insert_with_prefix
 
         self._prefill_one = _prefill_one
         self._insert = _insert
@@ -258,7 +325,90 @@ class PagedBatchEngine:
     # ------------------------------------------------------------------
     @property
     def free_blocks(self) -> int:
-        return len(self._free_blocks)
+        # LRU-parked blocks are allocatable (evict-on-demand) — they count
+        # toward the backpressure signal.
+        return len(self._free_blocks) + len(self._lru)
+
+    # ---- prefix caching ------------------------------------------------
+    def _block_digests(self, prompt: np.ndarray, n: int) -> list[bytes]:
+        """Position-binding hash chain over the first n full blocks: block
+        i's digest commits to ALL tokens in [0, (i+1)*bs) — equal digests
+        mean equal tokens at equal positions, which is exactly when K/V
+        match (RoPE binds position)."""
+        import hashlib
+
+        bs = self.block_size
+        d = b"\x00" * 16
+        out = []
+        for i in range(n):
+            chunk = np.ascontiguousarray(prompt[i * bs:(i + 1) * bs], dtype=np.int32)
+            d = hashlib.blake2b(d + chunk.tobytes(), digest_size=16).digest()
+            out.append(d)
+        return out
+
+    def _alloc_blocks(self, n: int) -> Optional[list[int]]:
+        """Allocate n pool blocks, evicting LRU-parked prefix blocks on
+        demand (unmapping their digests). Returns None (with full rollback)
+        when the pool genuinely cannot supply n."""
+        out: list[int] = []
+        while len(out) < n:
+            if self._free_blocks:
+                out.append(self._free_blocks.pop(0))
+                continue
+            if self._lru:
+                blk = next(iter(self._lru))
+                del self._lru[blk]
+                digest = self._block_digest.pop(blk, None)
+                # Guarded: only unmap the digest if it still points at THIS
+                # block (a re-registration after a partial eviction may have
+                # remapped it to a newer block that must stay discoverable).
+                if digest is not None and self._prefix_map.get(digest) == blk:
+                    self._prefix_map.pop(digest, None)
+                self._block_refs.pop(blk, None)
+                self.stats_prefix["evictions"] += 1
+                out.append(blk)
+                continue
+            self._free_blocks = out + self._free_blocks
+            return None
+        return out
+
+    def _assign_sampling(self, slot: int, temperature, top_k, top_p, seed):
+        """Write the slot's sampling params and derive its request key.
+        Shared by both admission paths — drift here would diverge cached vs
+        uncached sampling behavior."""
+        self.temp[slot] = temperature
+        self.top_k[slot] = top_k
+        self.top_p[slot] = top_p
+        # Unseeded sampling must be nondeterministic (vLLM seed=None): draw
+        # from process entropy, not a counter — a counter would collide with
+        # small user seeds and make every dp replica replay identical
+        # "random" samples. User seeds stay a pure function of the seed.
+        if seed is None:
+            import os as _os
+
+            # 63 bits: jax.random.key seeds go through np.int64.
+            seed = int.from_bytes(_os.urandom(8), "little") >> 1
+        return jax.random.key(seed)
+
+    def _sample_first_token(self, logits, req_key, slot, temperature, top_k, top_p):
+        """Sample the post-prefill token from this request's stream and park
+        the stream key on the slot. Caller holds the mesh context."""
+        first_key, slot_key = jax.random.split(req_key)
+        first = self._sample_first(
+            logits, first_key,
+            jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
+        )
+        self._keys = self._keys.at[slot].set(slot_key)
+        return first
+
+    def _finish_admission(self, req: PagedRequest, first) -> int:
+        req.tokens.append(int(first))
+        if req.done:
+            self._completed[req.request_id] = req
+            self._release(req)
+        else:
+            self._active[req.slot] = req
+        return req.request_id
 
     def submit(
         self,
@@ -274,7 +424,10 @@ class PagedBatchEngine:
         per-request (vLLM SamplingParams shape): temperature <= 0 is greedy;
         with temperature > 0, `seed` pins this request's PRNG stream
         (auto-assigned otherwise) — sampled and greedy requests mix freely
-        in one batch without perturbing each other."""
+        in one batch without perturbing each other. With prefix_cache=True,
+        block-aligned prompt prefixes already resident in the pool are
+        REUSED: only the suffix is prefilled (vLLM automatic-prefix-caching
+        shape; exactness-tested against the uncached engine)."""
         if not self._free_slots:
             return None
         plen = len(prompt)
@@ -288,6 +441,11 @@ class PagedBatchEngine:
         bucket = min(bucket, self.max_len)
         footprint = max(bucket, plen + max_new_tokens)
         n_blocks = -(-footprint // self.block_size)
+        if self.prefix_cache:
+            return self._submit_prefix(
+                prompt, max_new_tokens, temperature, top_k, top_p, seed,
+                plen, bucket, n_blocks,
+            )
         if n_blocks > len(self._free_blocks):
             return None
         slot = self._free_slots.pop(0)
@@ -299,19 +457,7 @@ class PagedBatchEngine:
         )
         self.table[slot] = 0
         self.table[slot, :n_blocks] = blocks
-        self.temp[slot] = temperature
-        self.top_k[slot] = top_k
-        self.top_p[slot] = top_p
-        # Unseeded sampling must be nondeterministic (vLLM seed=None): draw
-        # from process entropy, not a counter — a counter would collide with
-        # small user seeds and make every dp replica replay identical
-        # "random" samples. User seeds stay a pure function of the seed.
-        if seed is None:
-            import os as _os
-
-            # 63 bits: jax.random.key seeds go through np.int64.
-            seed = int.from_bytes(_os.urandom(8), "little") >> 1
-        req_key = jax.random.key(seed)
+        req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
 
         padded = np.zeros((bucket,), np.int32)
         padded[:plen] = prompt
@@ -319,12 +465,9 @@ class PagedBatchEngine:
             logits, slot_cache = self._prefill_one(
                 self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
             )
-            first_key, slot_key = jax.random.split(req_key)
-            first = self._sample_first(
-                logits, first_key,
-                jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
+            first = self._sample_first_token(
+                logits, req_key, slot, temperature, top_k, top_p
             )
-            self._keys = self._keys.at[slot].set(slot_key)
             prefill_ids = jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
             scales = (
                 (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
@@ -335,18 +478,110 @@ class PagedBatchEngine:
                 self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
                 self.pos_b, self.tokens, slot, plen, first, *scales,
             )
-        req.tokens.append(int(first))
-        if req.done:
-            self._completed[req.request_id] = req
-            self._release(req)
-        else:
-            self._active[slot] = req
-        return req.request_id
+        return self._finish_admission(req, first)
+
+    def _submit_prefix(
+        self, prompt, max_new_tokens, temperature, top_k, top_p, seed,
+        plen, bucket, n_blocks,
+    ) -> Optional[int]:
+        prompt = np.asarray(prompt)
+        bs = self.block_size
+        # Never cache the FULL prompt: at least one token must be computed
+        # so the first-token logits exist (vLLM caps hits the same way).
+        shareable_n = (plen - 1) // bs
+        digests = self._block_digests(prompt, shareable_n)
+        hits: list[int] = []
+        for d in digests:
+            blk = self._prefix_map.get(d)
+            if blk is None:
+                break
+            hits.append(blk)
+        hit_len = len(hits) * bs
+        new_needed = n_blocks - len(hits)
+        # Pin the hit blocks BEFORE allocating (eviction must not take
+        # them); on allocation failure the pins roll back — a pre-check
+        # would double-count LRU-parked hit blocks as allocatable.
+        for blk in hits:
+            if self._block_refs.get(blk, 0) == 0:
+                self._lru.pop(blk, None)
+            self._block_refs[blk] = self._block_refs.get(blk, 0) + 1
+        new_blocks = self._alloc_blocks(new_needed)
+        if new_blocks is None:
+            for blk in hits:  # backpressure: unpin and park again
+                self._block_refs[blk] -= 1
+                if self._block_refs[blk] <= 0:
+                    self._block_refs[blk] = 0
+                    self._lru[blk] = None
+            return None
+        slot = self._free_slots.pop(0)
+        blocks = hits + new_blocks
+        req = PagedRequest(
+            next(self._ids), prompt, max_new_tokens, slot=slot, blocks=blocks,
+            shared_blocks=list(hits), temperature=temperature, top_k=top_k,
+            top_p=top_p, seed=seed,
+        )
+        self.table[slot] = 0
+        self.table[slot, :n_blocks] = blocks
+        req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
+
+        # Suffix: its own power-of-two bucket (bounded compile set); true
+        # rows land in [hit_len, plen) of the dense view, padding spills
+        # past `bucket` into the scratch tail the scatter drops.
+        s_true = plen - hit_len
+        s_suf = 8
+        while s_suf < s_true:
+            s_suf *= 2
+        suffix = np.zeros((s_suf,), np.int32)
+        suffix[:s_true] = prompt[hit_len:]
+        block_ids = np.asarray(blocks[: bucket // bs], np.int32)
+        args = (
+            jnp.asarray(suffix)[None, :], jnp.asarray(block_ids),
+            jnp.asarray(hit_len, jnp.int32), jnp.asarray(s_true - 1, jnp.int32),
+        )
+        with self._mesh_ctx():
+            if self.mesh is not None:
+                args = tuple(jax.device_put(a, self._rep) for a in args)
+            self.cache, self.pos_b, logits = self._insert_with_prefix(
+                self.params, self.cache, *args, self.pos_b, slot, plen,
+            )
+            first = self._sample_first_token(
+                logits, req_key, slot, temperature, top_k, top_p
+            )
+            self.tokens = self.tokens.at[slot].set(first)
+
+        # Register the newly computed shareable blocks for future prompts
+        # (this request holds a ref on each until it completes). A digest
+        # that is somehow already mapped (partial eviction of a chain's
+        # head, then recompute) keeps its existing mapping — our copy stays
+        # private so eviction bookkeeping never splits one digest across
+        # two blocks.
+        for i in range(len(hits), shareable_n):
+            d, blk = digests[i], blocks[i]
+            if d in self._prefix_map:
+                continue
+            self._prefix_map[d] = blk
+            self._block_digest[blk] = d
+            self._block_refs[blk] = self._block_refs.get(blk, 0) + 1
+            req.shared_blocks.append(blk)
+        self.stats_prefix["hit_tokens"] += hit_len
+        self.stats_prefix["hit_blocks"] += len(hits)
+        return self._finish_admission(req, first)
 
     def _release(self, req: PagedRequest) -> None:
         self.table[req.slot] = 0  # dead writes + stale reads -> null block
-        self._free_blocks.extend(req.blocks)
+        shared = set(req.shared_blocks)
+        for blk in req.blocks:
+            if blk in shared:
+                # Shared prefix block: drop our ref; at zero it PARKS in the
+                # LRU (contents + digest mapping intact) for future hits.
+                self._block_refs[blk] -= 1
+                if self._block_refs[blk] <= 0:
+                    self._block_refs[blk] = 0
+                    self._lru[blk] = None
+            else:
+                self._free_blocks.append(blk)
         req.blocks = []
+        req.shared_blocks = []
         self._free_slots.append(req.slot)
 
     def step(self) -> None:
