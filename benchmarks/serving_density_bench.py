@@ -52,15 +52,24 @@ def _write_artifact(path: str, data: dict) -> None:
     os.replace(tmp, path)
 
 
-def measure(engine, prompt_len, warm_chunk=4, timed_chunk=32) -> dict:
+def measure(engine, prompt_len, warm_chunk=4, timed_chunk=32,
+            shared_prefix=False) -> dict:
     """Steady-state decode tok/s via two-point differencing of chunked
-    on-device stepping (per-dispatch host sync differences away)."""
+    on-device stepping (per-dispatch host sync differences away).
+    shared_prefix: every slot's prompt shares all but the last token (the
+    system-prompt/RAG pattern) — with the engine's prefix cache on, slots
+    after the first prefill only their suffix, and admit_s shows it."""
     rng = np.random.RandomState(0)
+    base = rng.randint(1, 1000, size=prompt_len).astype(np.int32)
     t_admit0 = time.perf_counter()
-    for _ in range(engine.slots):
+    for i in range(engine.slots):
+        if shared_prefix:
+            prompt = base.copy()
+            prompt[-1] = 1 + (i % 999)  # distinct tail token per request
+        else:
+            prompt = rng.randint(1, 1000, size=prompt_len).astype(np.int32)
         rid = engine.submit(
-            rng.randint(1, 1000, size=prompt_len).astype(np.int32),
-            max_new_tokens=timed_chunk * 4 + warm_chunk * 4 + 8,
+            prompt, max_new_tokens=timed_chunk * 4 + warm_chunk * 4 + 8,
         )
         assert rid is not None, "admission failed — pool sized wrong"
     admit_s = time.perf_counter() - t_admit0
@@ -154,9 +163,13 @@ def main() -> None:
     })
     print(json.dumps(rows[-1]))
 
-    for slots, blocks_per_slot, label in (
-        (dense_slots, max_len // bs, "dense-equivalent pool (max_len reserved/slot)"),
-        (paged_slots, budget // bs, "paged pool (footprint-sized blocks/slot)"),
+    for slots, blocks_per_slot, label, prefix in (
+        (dense_slots, max_len // bs, "dense-equivalent pool (max_len reserved/slot)", False),
+        (paged_slots, budget // bs, "paged pool (footprint-sized blocks/slot)", False),
+        # Prefix caching on the same paged config, slots sharing all but
+        # the last prompt token (system-prompt/RAG pattern): admit_s shows
+        # the suffix-only prefill; decode tok/s should match the paged row.
+        (paged_slots, budget // bs, "paged pool + prefix cache (shared prompt prefix)", True),
     ):
         num_blocks = slots * blocks_per_slot + 1
         pool_gb = num_blocks * bs * kv_row / 1e9
@@ -165,17 +178,22 @@ def main() -> None:
         def run_config():
             engine = PagedBatchEngine(
                 cfg, params, slots=slots, max_len=max_len, block_size=bs,
-                num_blocks=num_blocks,
+                num_blocks=num_blocks, prefix_cache=prefix,
             )
             try:
                 # The engine itself probes the kernel on first decode and
                 # falls back to the XLA gather path on compile failure;
                 # engine.stats records which path actually served.
-                return measure(engine, prompt_len, *(() if on_chip else (2, 8))), dict(engine.stats)
+                return (
+                    measure(engine, prompt_len,
+                            *(() if on_chip else (2, 8)), shared_prefix=prefix),
+                    dict(engine.stats),
+                    dict(engine.stats_prefix),
+                )
             finally:
                 del engine
 
-        r, stats = run_config()
+        r, stats, prefix_stats = run_config()
         rows.append({
             "metric": f"continuous-batching decode, {label}",
             "value": r["decode_tok_s"],
@@ -185,6 +203,7 @@ def main() -> None:
             "dense_equivalent_gb": round(dense_gb, 2),
             "admit_s": r["admit_s"],
             "attention_path": stats["attention_path"],
+            **({"prefix_hit_tokens": prefix_stats["hit_tokens"]} if prefix else {}),
             **({"kernel_error": stats["kernel_error"]} if "kernel_error" in stats else {}),
         })
         print(json.dumps(rows[-1]))

@@ -208,8 +208,6 @@ class PagedBatchEngine:
             back. Returns (cache, pos_b', last-token logits [1, V]). The
             hit-block scatter rewrites identical bytes — harmless, and it
             keeps one code path for quantized and plain pools."""
-            import dataclasses as _dc
-
             from lws_tpu.models.llama import KVCache, forward_with_cache
 
             L = cache.k.shape[0]
@@ -348,8 +346,11 @@ class PagedBatchEngine:
 
     def _alloc_blocks(self, n: int) -> Optional[list[int]]:
         """Allocate n pool blocks, evicting LRU-parked prefix blocks on
-        demand (unmapping their digests). Returns None (with full rollback)
-        when the pool genuinely cannot supply n."""
+        demand (unmapping their digests). Returns None when the pool cannot
+        supply n — checked UP FRONT so a refused oversized request cannot
+        flush parked prefixes it would never have used."""
+        if n > len(self._free_blocks) + len(self._lru):
+            return None
         out: list[int] = []
         while len(out) < n:
             if self._free_blocks:
@@ -524,30 +525,54 @@ class PagedBatchEngine:
         self.table[slot, :n_blocks] = blocks
         req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
 
-        # Suffix: its own power-of-two bucket (bounded compile set); true
-        # rows land in [hit_len, plen) of the dense view, padding spills
-        # past `bucket` into the scratch tail the scatter drops.
-        s_true = plen - hit_len
-        s_suf = 8
-        while s_suf < s_true:
-            s_suf *= 2
-        suffix = np.zeros((s_suf,), np.int32)
-        suffix[:s_true] = prompt[hit_len:]
-        block_ids = np.asarray(blocks[: bucket // bs], np.int32)
-        args = (
-            jnp.asarray(suffix)[None, :], jnp.asarray(block_ids),
-            jnp.asarray(hit_len, jnp.int32), jnp.asarray(s_true - 1, jnp.int32),
-        )
-        with self._mesh_ctx():
-            if self.mesh is not None:
-                args = tuple(jax.device_put(a, self._rep) for a in args)
-            self.cache, self.pos_b, logits = self._insert_with_prefix(
-                self.params, self.cache, *args, self.pos_b, slot, plen,
+        if not hits:
+            # Cache miss: the plain prefill path is cheaper (no garbage
+            # gather/concat round trip) and compiles per bucket, not per
+            # (bucket, suffix) pair. Registration below still publishes the
+            # computed blocks for future prompts.
+            padded = np.zeros((bucket,), np.int32)
+            padded[:plen] = prompt
+            with self._mesh_ctx():
+                logits, slot_cache = self._prefill_one(
+                    self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
+                )
+                first = self._sample_first_token(
+                    logits, req_key, slot, temperature, top_k, top_p
+                )
+                prefill_ids = jnp.asarray(blocks[: bucket // bs], jnp.int32)
+                scales = (
+                    (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
+                    if self.cfg.kv_quant else ()
+                )
+                self.cache, self.pos_b, self.tokens = self._insert(
+                    self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
+                    self.pos_b, self.tokens, slot, plen, first, *scales,
+                )
+        else:
+            # Suffix: its own power-of-two bucket (bounded compile set); true
+            # rows land in [hit_len, plen) of the dense view, padding spills
+            # past `bucket` into the scratch tail the scatter drops.
+            s_true = plen - hit_len
+            s_suf = 8
+            while s_suf < s_true:
+                s_suf *= 2
+            suffix = np.zeros((s_suf,), np.int32)
+            suffix[:s_true] = prompt[hit_len:]
+            block_ids = np.asarray(blocks[: bucket // bs], np.int32)
+            args = (
+                jnp.asarray(suffix)[None, :], jnp.asarray(block_ids),
+                jnp.asarray(hit_len, jnp.int32), jnp.asarray(s_true - 1, jnp.int32),
             )
-            first = self._sample_first_token(
-                logits, req_key, slot, temperature, top_k, top_p
-            )
-            self.tokens = self.tokens.at[slot].set(first)
+            with self._mesh_ctx():
+                if self.mesh is not None:
+                    args = tuple(jax.device_put(a, self._rep) for a in args)
+                self.cache, self.pos_b, logits = self._insert_with_prefix(
+                    self.params, self.cache, *args, self.pos_b, slot, plen,
+                )
+                first = self._sample_first_token(
+                    logits, req_key, slot, temperature, top_k, top_p
+                )
+                self.tokens = self.tokens.at[slot].set(first)
 
         # Register the newly computed shareable blocks for future prompts
         # (this request holds a ref on each until it completes). A digest
