@@ -561,13 +561,16 @@ def _block_with_cache(x, positions, pos, layer_idx, lp, cache: KVCache, cfg: Lla
 def forward_with_cache(
     params: dict, tokens: jax.Array, cache: KVCache, cfg: LlamaConfig,
     last_offset: Optional[jax.Array] = None,
+    all_logits: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Append `tokens` [B,S] at cache.pos; returns (logits for the LAST token
     [B,V] f32, updated cache). Used for both prefill (S>1) and decode (S=1).
     `last_offset` selects which appended position's logits to return (for
     length-bucketed suffixes whose true end precedes the padding; default
     S-1). The padded tail's K/V land past the true length — masked out of
-    attention by pos and overwritten by later appends."""
+    attention by pos and overwritten by later appends. all_logits=True
+    returns [B, S, V] — every appended position's logits, the speculative-
+    decoding verification shape (one pass scores a whole draft run)."""
     B, S = tokens.shape
     pos = cache.pos
     positions = pos + jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -581,12 +584,15 @@ def forward_with_cache(
         lambda x, layer_idx, lp, cache: _block_with_cache(x, positions, pos, layer_idx, lp, cache, cfg),
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    import dataclasses as _dc
+
+    if all_logits:
+        logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [B, S, V]
+        return logits, _dc.replace(cache, pos=pos + S)
     last = x[:, -1] if last_offset is None else jnp.take_along_axis(
         x, jnp.broadcast_to(jnp.reshape(last_offset, (-1, 1, 1)), (B, 1, x.shape[-1])), axis=1
     )[:, 0]
     logits = _mm(last, params["lm_head"]).astype(jnp.float32)
-    import dataclasses as _dc
-
     return logits, _dc.replace(cache, pos=pos + S)
 
 

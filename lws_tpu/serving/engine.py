@@ -12,6 +12,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -120,6 +121,8 @@ class GenerationResult:
     decode_s: float
     decode_steps: int
     decode_tokens_per_s: float
+    # generate_speculative only: {"dispatches", "drafted", "accepted"}.
+    spec_stats: Optional[dict] = None
 
 
 class Engine:
@@ -338,6 +341,144 @@ class Engine:
         if single and "single" not in warmed:
             self._decode.lower(self.params, token_s, cache_s, key_s).compile()
             warmed.add("single")
+
+    # ---- speculative decoding (n-gram / prompt-lookup drafts) -----------
+    @staticmethod
+    def _draft_ngram(context: list, ngram: int, gamma: int) -> list:
+        """Draft gamma tokens by matching the context's trailing n-gram
+        against its own history (prompt-lookup decoding: repetitive spans —
+        code, quotes, RAG copies — predict themselves). ANY draft is safe:
+        acceptance only keeps tokens that equal the model's own argmax, so
+        a bad draft costs nothing but the slack in the verify pass."""
+        tail = context[-ngram:]
+        cand: list = []
+        for i in range(len(context) - ngram - 1, -1, -1):
+            if context[i:i + ngram] == tail:
+                cand = context[i + ngram: i + ngram + gamma]
+                break
+        while len(cand) < gamma:
+            cand.append(context[-1])
+        return cand
+
+    def _get_verify(self):
+        """One jitted verify fn — jax.jit already specializes per draft-run
+        shape, so no per-gamma bookkeeping is needed."""
+        if getattr(self, "_verify", None) is None:
+            cfg_static = self.cfg
+
+            @partial(jax.jit, donate_argnums=(2,),
+                     **({"out_shardings": (None, self._cache_shardings)}
+                        if self.mesh is not None else {}))
+            def _verify(params, tokens, cache):
+                return forward_with_cache(
+                    params, tokens, cache, cfg_static, all_logits=True
+                )
+
+            self._verify = _verify
+        return self._verify
+
+    def _warm_verify(self, gamma: int) -> None:
+        """AOT-compile the verify executable (and the single-step fallback)
+        outside the timed window — same discipline as _warm_decode, so
+        spec-vs-plain comparisons measure steady state on both sides."""
+        warmed = getattr(self, "_warmed_verify", set())
+        self._warmed_verify = warmed
+        if gamma in warmed:
+            return
+        tokens_s = jax.ShapeDtypeStruct((1, gamma + 1), jnp.int32)
+        cache_s = jax.eval_shape(self.new_cache)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            rep = NamedSharding(self.mesh, _P())
+            tokens_s = jax.ShapeDtypeStruct(tokens_s.shape, tokens_s.dtype, sharding=rep)
+            cache_s = jax.tree.map(
+                lambda st, sh: jax.ShapeDtypeStruct(st.shape, st.dtype, sharding=sh),
+                cache_s, self._cache_shardings,
+            )
+        self._get_verify().lower(self.params, tokens_s, cache_s).compile()
+        self._warm_decode(chunked=False, single=True)
+        warmed.add(gamma)
+
+    def generate_speculative(
+        self, prompt: jax.Array, max_new_tokens: int,
+        gamma: int = 8, ngram: int = 3,
+    ) -> GenerationResult:
+        """Greedy generation with n-gram speculative decoding, EXACT vs
+        generate(): each dispatch verifies `gamma` drafted tokens plus the
+        running token in ONE forward pass — on the HBM-bandwidth-bound
+        decode path the params stream once either way, so every accepted
+        draft token is nearly free. Accepted = the longest draft prefix
+        matching the model's own argmax chain; the cache position rewinds
+        past rejected rows (stale K/V masked, later overwritten — the
+        prefill_chunked trick). B=1, greedy only (sampling would need
+        rejection resampling)."""
+        import dataclasses as _dc
+
+        if self.batch_size != 1 or prompt.shape[0] != 1:
+            raise ValueError("speculative decoding is single-sequence (B=1)")
+        if self._sampling.temperature > 0:
+            raise NotImplementedError("speculative decoding is greedy-only")
+        if prompt.shape[1] + max_new_tokens > self.max_len:
+            # Same contract as the batch engines: the output shape is always
+            # [1, max_new_tokens], never silently short.
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        verify = self._get_verify()
+        self._warm_verify(gamma)
+
+        t0 = time.perf_counter()
+        token, cache = self.prefill(prompt)
+        host_sync(token)
+        ttft = time.perf_counter() - t0
+
+        t1 = time.perf_counter()
+        context = [int(t) for t in np.asarray(prompt)[0]] + [int(np.asarray(token)[0])]
+        out = [int(np.asarray(token)[0])]
+        dispatches = drafted = accepted_total = 0
+        while len(out) < max_new_tokens:
+            if int(cache.pos) + gamma + 1 > self.max_len:
+                # No room for a full verify run: finish with single steps.
+                tok = jnp.asarray([out[-1]], jnp.int32)
+                while len(out) < max_new_tokens and int(cache.pos) < self.max_len:
+                    tok, cache = self.decode(tok, cache)
+                    out.append(int(np.asarray(tok)[0]))
+                    dispatches += 1
+                break
+            drafts = self._draft_ngram(context, ngram, gamma)
+            tokens_in = jnp.asarray([[out[-1]] + drafts], jnp.int32)
+            base_pos = int(cache.pos)
+            all_logits, cache = verify(self.params, tokens_in, cache)
+            greedy = np.asarray(jnp.argmax(all_logits, axis=-1))[0]  # [gamma+1]
+            a = 0
+            while a < gamma and drafts[a] == int(greedy[a]):
+                a += 1
+            new_tokens = [int(t) for t in drafts[:a]] + [int(greedy[a])]
+            # Rewind past the rejected draft rows: only positions
+            # [0, base_pos + a + 1) are real; stale rows get overwritten.
+            cache = _dc.replace(
+                cache, pos=jnp.asarray(base_pos + a + 1, cache.pos.dtype)
+            )
+            out.extend(new_tokens)
+            context.extend(new_tokens)
+            dispatches += 1
+            drafted += gamma
+            accepted_total += a
+        out = out[:max_new_tokens]
+        dt = time.perf_counter() - t1
+        steps = len(out) - 1
+        return GenerationResult(
+            tokens=jnp.asarray([out], jnp.int32),
+            ttft_s=ttft,
+            decode_s=dt,
+            decode_steps=dispatches,
+            decode_tokens_per_s=steps / dt if steps else 0.0,
+            spec_stats={
+                "dispatches": dispatches,
+                "drafted": drafted,
+                "accepted": accepted_total,
+                "tokens_per_dispatch": round(len(out) / max(dispatches, 1), 2),
+            },
+        )
 
     def generate(self, prompt: jax.Array, max_new_tokens: int) -> GenerationResult:
         """Generation under the engine's SamplingParams (greedy by default),

@@ -272,3 +272,40 @@ def test_sharded_prefill_bundle_to_sharded_decode():
     token2, cache2, toks = decode_eng.decode_n(token2, cache2, steps)
     got = np.concatenate([np.asarray(token)[:, None], np.asarray(toks)], axis=1)
     np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_decoding_exact_and_accepting():
+    """n-gram speculative decoding must be EXACT vs greedy generate() —
+    acceptance only keeps tokens equal to the model's own argmax chain — and
+    on a repetitive prompt it must actually accept drafts (fewer dispatches
+    than tokens)."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, batch_size=1, max_len=64)
+
+    # Repetitive prompt: the n-gram lookup should find matches.
+    pattern = [5, 9, 2, 11]
+    prompt = jnp.asarray([pattern * 4], jnp.int32)  # 16 tokens
+    want = engine.generate(prompt, max_new_tokens=24)
+    got = engine.generate_speculative(prompt, max_new_tokens=24, gamma=6, ngram=3)
+    np.testing.assert_array_equal(np.asarray(got.tokens), np.asarray(want.tokens))
+    assert got.spec_stats["dispatches"] < 23, got.spec_stats
+    assert got.spec_stats["accepted"] > 0
+
+    # Non-repetitive prompt: still exact (drafts mostly rejected).
+    prompt2 = jax.random.randint(jax.random.key(7), (1, 12), 0, cfg.vocab_size).astype(jnp.int32)
+    want2 = engine.generate(prompt2, max_new_tokens=16)
+    got2 = engine.generate_speculative(prompt2, max_new_tokens=16, gamma=4, ngram=2)
+    np.testing.assert_array_equal(np.asarray(got2.tokens), np.asarray(want2.tokens))
+
+
+def test_speculative_decoding_near_max_len():
+    """The verify run must never overrun max_len: near the boundary the
+    engine finishes with single decode steps, still exact."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, batch_size=1, max_len=32)
+    prompt = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6] * 2], jnp.int32)  # 16 tokens
+    want = engine.generate(prompt, max_new_tokens=16)
+    got = engine.generate_speculative(prompt, max_new_tokens=16, gamma=8, ngram=3)
+    np.testing.assert_array_equal(np.asarray(got.tokens), np.asarray(want.tokens))

@@ -120,3 +120,16 @@ def test_tp_engine_warm_compile_donates(model):
         eng.generate(prompt(cfg), max_new_tokens=16)
     donated = [x for x in w if "donated" in str(x.message).lower()]
     assert not donated, [str(x.message) for x in donated]
+
+
+def test_tp_engine_speculative_decoding_exact(model):
+    """Speculative decoding under a tp mesh: verify-pass cache stays on its
+    shardings, tokens exact vs the single-device greedy engine."""
+    cfg, params = model
+    mesh = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=2), jax.devices()[:2])
+    single = Engine(cfg, params, batch_size=1, max_len=64)
+    sharded = Engine(cfg, params, batch_size=1, max_len=64, mesh=mesh)
+    p = jnp.asarray([[5, 9, 2, 11] * 4], jnp.int32)
+    want = single.generate(p, max_new_tokens=20)
+    got = sharded.generate_speculative(p, max_new_tokens=20, gamma=6, ngram=3)
+    np.testing.assert_array_equal(np.asarray(got.tokens), np.asarray(want.tokens))
