@@ -404,15 +404,21 @@ class Engine:
         self, prompt: jax.Array, max_new_tokens: int,
         gamma: int = 8, ngram: int = 3,
     ) -> GenerationResult:
-        """Greedy generation with n-gram speculative decoding, EXACT vs
-        generate(): each dispatch verifies `gamma` drafted tokens plus the
-        running token in ONE forward pass — on the HBM-bandwidth-bound
-        decode path the params stream once either way, so every accepted
-        draft token is nearly free. Accepted = the longest draft prefix
-        matching the model's own argmax chain; the cache position rewinds
-        past rejected rows (stale K/V masked, later overwritten — the
-        prefill_chunked trick). B=1, greedy only (sampling would need
-        rejection resampling)."""
+        """Greedy generation with n-gram speculative decoding: each dispatch
+        verifies `gamma` drafted tokens plus the running token in ONE
+        forward pass — on the HBM-bandwidth-bound decode path the params
+        stream once either way, so every accepted draft token is nearly
+        free. Accepted = the longest draft prefix matching the verify
+        pass's argmax chain; the cache position rewinds past rejected rows
+        (stale K/V masked, later overwritten — the prefill_chunked trick).
+        B=1, greedy only (sampling would need rejection resampling).
+
+        Exactness: equal to generate() up to floating-point argmax ties —
+        the verify pass computes logits at [1, gamma+1] and single-step
+        decode at [1, 1], and XLA may tile/reduce the two shapes in
+        different orders, so a near-tied top-2 can flip (the standard
+        speculative-decoding caveat; bitwise-equal in this repo's f32
+        test suite)."""
         import dataclasses as _dc
 
         if self.batch_size != 1 or prompt.shape[0] != 1:
@@ -434,19 +440,23 @@ class Engine:
         t1 = time.perf_counter()
         context = [int(t) for t in np.asarray(prompt)[0]] + [int(np.asarray(token)[0])]
         out = [int(np.asarray(token)[0])]
+        # pos is host-derivable (prompt length, then += accepted+1 per
+        # dispatch): int(cache.pos) would be a blocking device round trip
+        # per dispatch on exactly the links this engine optimizes for.
+        pos = prompt.shape[1]
         dispatches = drafted = accepted_total = 0
         while len(out) < max_new_tokens:
-            if int(cache.pos) + gamma + 1 > self.max_len:
+            if pos + gamma + 1 > self.max_len:
                 # No room for a full verify run: finish with single steps.
                 tok = jnp.asarray([out[-1]], jnp.int32)
-                while len(out) < max_new_tokens and int(cache.pos) < self.max_len:
+                while len(out) < max_new_tokens and pos < self.max_len:
                     tok, cache = self.decode(tok, cache)
                     out.append(int(np.asarray(tok)[0]))
+                    pos += 1
                     dispatches += 1
                 break
             drafts = self._draft_ngram(context, ngram, gamma)
             tokens_in = jnp.asarray([[out[-1]] + drafts], jnp.int32)
-            base_pos = int(cache.pos)
             all_logits, cache = verify(self.params, tokens_in, cache)
             greedy = np.asarray(jnp.argmax(all_logits, axis=-1))[0]  # [gamma+1]
             a = 0
@@ -454,16 +464,15 @@ class Engine:
                 a += 1
             new_tokens = [int(t) for t in drafts[:a]] + [int(greedy[a])]
             # Rewind past the rejected draft rows: only positions
-            # [0, base_pos + a + 1) are real; stale rows get overwritten.
-            cache = _dc.replace(
-                cache, pos=jnp.asarray(base_pos + a + 1, cache.pos.dtype)
-            )
+            # [0, pos + a + 1) are real; stale rows get overwritten.
+            pos = pos + a + 1
+            cache = _dc.replace(cache, pos=jnp.asarray(pos, cache.pos.dtype))
             out.extend(new_tokens)
             context.extend(new_tokens)
             dispatches += 1
             drafted += gamma
             accepted_total += a
-        out = out[:max_new_tokens]
+        out = out[: max(1, max_new_tokens)]  # generate(p, 0) also returns [1, 1]
         dt = time.perf_counter() - t1
         steps = len(out) - 1
         return GenerationResult(
@@ -474,9 +483,11 @@ class Engine:
             decode_tokens_per_s=steps / dt if steps else 0.0,
             spec_stats={
                 "dispatches": dispatches,
-                "drafted": drafted,
-                "accepted": accepted_total,
-                "tokens_per_dispatch": round(len(out) / max(dispatches, 1), 2),
+                "drafted": drafted,          # draft slots verified
+                "accepted": accepted_total,  # model-accepted draft tokens
+                # Decode tokens only — the prefill-produced first token is
+                # not a dispatch's output.
+                "tokens_per_dispatch": round(steps / max(dispatches, 1), 2),
             },
         )
 

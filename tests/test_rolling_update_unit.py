@@ -1,0 +1,171 @@
+"""Direct unit tests of the rolling-update pure math — the reference's unit
+tier (leaderworkerset_controller_test.go:818-1012 surge tables +
+calculateRollingUpdateReplicas), run WITHOUT the harness so each case pins
+one function's behavior, not the integration of the stack."""
+
+import pytest
+
+from lws_tpu.api import contract
+from lws_tpu.api.groupset import GroupSet, GroupSetSpec, GroupSetUpdateStrategy
+from lws_tpu.api.types import (
+    LeaderWorkerSet,
+    LeaderWorkerSetSpec,
+    LeaderWorkerTemplate,
+    RollingUpdateConfiguration,
+    RolloutStrategy,
+)
+from lws_tpu.controllers.lws_controller import (
+    LWSReconciler,
+    ReplicaState,
+    calculate_continuous_ready_replicas,
+    calculate_lws_unready_replicas,
+    calculate_rolling_update_replicas,
+    rolling_update_partition,
+)
+from lws_tpu.core.events import EventRecorder
+from lws_tpu.core.store import Store, new_meta
+
+
+# ---- calculateRollingUpdateReplicas (ref :818-886, table ported) ----------
+@pytest.mark.parametrize(
+    "name,lws_replicas,max_surge,max_unavailable,unready,want",
+    [
+        ("keeps surge until maxUnavailable covers unready", 1, 1, 0, 1, 2),
+        ("reclaims surge gradually once enough ready", 4, 2, 1, 2, 5),
+        ("reclaims before partition zero when permitted", 2, 2, 1, 2, 3),
+        ("falls back to desired when all ready", 1, 1, 0, 0, 1),
+        ("reclaims when maxUnavailable permits an unready", 1, 1, 1, 1, 1),
+        ("does not surge when maxSurge is zero", 3, 0, 0, 1, 3),
+    ],
+)
+def test_calculate_rolling_update_replicas(name, lws_replicas, max_surge,
+                                           max_unavailable, unready, want):
+    got = calculate_rolling_update_replicas(lws_replicas, max_surge, max_unavailable, unready)
+    assert got == want, name
+
+
+# ---- rollingUpdateParameters surge cases (ref :887-1012, ported) ----------
+
+
+def make_lws(replicas, max_unavailable, max_surge, partition=0):
+    return LeaderWorkerSet(
+        meta=new_meta("test-sample"),
+        spec=LeaderWorkerSetSpec(
+            replicas=replicas,
+            leader_worker_template=LeaderWorkerTemplate(size=1),
+            rollout_strategy=RolloutStrategy(
+                rolling_update_configuration=RollingUpdateConfiguration(
+                    partition=partition,
+                    max_unavailable=max_unavailable,
+                    max_surge=max_surge,
+                )
+            ),
+        ),
+    )
+
+
+def make_gs(replicas, annotation_replicas, partition=0):
+    return GroupSet(
+        meta=new_meta(
+            "test-sample",
+            annotations={contract.REPLICAS_ANNOTATION_KEY: str(annotation_replicas)},
+        ),
+        spec=GroupSetSpec(
+            replicas=replicas,
+            update_strategy=GroupSetUpdateStrategy(partition=partition),
+        ),
+    )
+
+
+def params_for(lws, gs, lws_updated):
+    r = LWSReconciler(Store(), EventRecorder())
+    return r._rolling_update_parameters(
+        lws, gs, "rev-new", lws_updated, leader_pods=[], gs_by_name={}
+    )
+
+
+def test_scale_up_does_not_create_extra_surge():
+    """ref :887-928: replicas 2->3 with maxSurge=1 and NO template change
+    must scale straight to 3 at partition 0, not 3+surge."""
+    lws = make_lws(replicas=3, max_unavailable=0, max_surge=1)
+    gs = make_gs(replicas=2, annotation_replicas=2)
+    assert params_for(lws, gs, lws_updated=False) == (0, 3)
+
+
+def test_scale_up_with_template_update_does_not_create_extra_surge():
+    """ref :929-970: scale-up arriving WITH a template change partitions at
+    the old count (2) and still targets 3, not 3+surge."""
+    lws = make_lws(replicas=3, max_unavailable=0, max_surge=1)
+    gs = make_gs(replicas=2, annotation_replicas=2)
+    assert params_for(lws, gs, lws_updated=True) == (2, 3)
+
+
+def test_template_update_reclaims_surge_when_allowed():
+    """ref :971-1012: maxUnavailable=1 lets the burst stop at replicas+1
+    even though maxSurge=2 would allow replicas+2."""
+    lws = make_lws(replicas=2, max_unavailable=1, max_surge=2)
+    gs = make_gs(replicas=2, annotation_replicas=2)
+    assert params_for(lws, gs, lws_updated=True) == (2, 3)
+
+
+def test_creation_case_no_groupset():
+    """Case 1 (ref :258-373): no groupset yet -> partition clamped to the
+    spec's, full replicas."""
+    lws = make_lws(replicas=4, max_unavailable=1, max_surge=0, partition=2)
+    assert params_for(lws, None, lws_updated=False) == (2, 4)
+
+
+def test_steady_state_case():
+    """Case 3: partition 0 and matched replicas -> untouched."""
+    lws = make_lws(replicas=3, max_unavailable=1, max_surge=0)
+    gs = make_gs(replicas=3, annotation_replicas=3)
+    # Steady state never consults replica states (size=1, no pods needed).
+    assert params_for(lws, gs, lws_updated=False) == (0, 3)
+
+
+# ---- partition math (ref :643-708 behaviors) ------------------------------
+
+
+def S(ready, updated):
+    return ReplicaState(ready=ready, updated=updated)
+
+
+def test_continuous_ready_counts_updated_tail():
+    states = [S(True, False), S(True, True), S(True, True)]
+    assert calculate_continuous_ready_replicas(states) == 2
+    assert calculate_continuous_ready_replicas([S(True, True)] * 3) == 3
+    assert calculate_continuous_ready_replicas([S(False, True), S(True, True)]) == 1
+
+
+def test_lws_unready_counts_missing_and_stale():
+    states = [S(True, True), S(False, True), S(True, False)]
+    # Only 2 states for 4 replicas: the missing one counts unready too.
+    assert calculate_lws_unready_replicas(states, 4) == 3
+
+
+def test_partition_advances_by_rolling_step():
+    """4 replicas, step 1: the highest index updates first; once its state
+    is ready+updated the partition moves down one."""
+    states = [S(True, False)] * 3 + [S(True, True)]
+    assert rolling_update_partition(states, 4, 1, current_partition=3) == 2
+
+
+def test_partition_monotonic_never_increases():
+    states = [S(True, False)] * 4
+    assert rolling_update_partition(states, 4, 1, current_partition=2) == 2
+
+
+def test_partition_accounts_unready_below():
+    """An unready replica below the rolling-step floor widens the partition
+    so maxUnavailable is respected (ref :650 accounting)."""
+    states = [S(False, False), S(True, False), S(True, False), S(True, True)]
+    # continuous_ready=1, step=1 -> floor=2; one unready below floor -> 3.
+    assert rolling_update_partition(states, 4, 1, current_partition=3) == 3
+
+
+def test_partition_stuck_update_escape():
+    """Continuously not-ready replicas above the floor are skipped so a
+    violated maxUnavailable cannot wedge the update (ref :660-673)."""
+    states = [S(True, False), S(False, False), S(False, False), S(True, True)]
+    got = rolling_update_partition(states, 4, 1, current_partition=3)
+    assert got <= 2, got  # escapes past the stuck replicas instead of 3
