@@ -7,7 +7,6 @@ shard_map wrapper runs each tp shard on its local kv-heads pool slice.
 This is the 70B-class llm-d serving shape (BASELINE #3/#5; ref vLLM-TPU
 TP=16, /root/reference/docs/examples/vllm/TPU/lws.yaml:30-34)."""
 
-import dataclasses
 
 import numpy as np
 import pytest
