@@ -1,0 +1,116 @@
+"""70B-class shape validation WITHOUT allocation (eval_shape only): the
+BASELINE north star is Llama-3-70B serving on v5p (ref vLLM-TPU TP=16,
+docs/examples/vllm/TPU/lws.yaml:22-34). These tests pin that the sharding
+rules actually divide the real 70B shapes — and the honest GQA bound:
+the KV cache shards over kv-heads, so serving tp <= n_kv_heads (=8 for
+Llama-3-70B); weight-only tp=16 divides fine."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lws_tpu.models.llama import (
+    LlamaConfig,
+    cache_shardings,
+    init_cache,
+    init_params,
+    paged_cache_shardings,
+    param_shardings,
+)
+from lws_tpu.parallel import MeshSpec, build_mesh
+
+
+def llama70b():
+    return LlamaConfig(
+        vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
+        n_kv_heads=8, d_ff=28672, max_seq_len=8192,
+        dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+    )
+
+
+def test_70b_param_count():
+    cfg = llama70b()
+    assert 68e9 < cfg.n_params() < 72e9, cfg.n_params()
+
+
+def test_70b_param_shardings_divide_at_tp8():
+    """Every parameter dim sharded over tp must divide at tp=8 (one v5p
+    host's worth of the 16-chip group; 8 = our virtual mesh width)."""
+    from jax.sharding import NamedSharding
+
+    cfg = llama70b()
+    mesh = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=8), jax.devices()[:8])
+    shapes = jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
+    specs = param_shardings(cfg)
+
+    def check(path, shape_struct, spec):
+        sh = NamedSharding(mesh, spec)
+        shard = sh.shard_shape(shape_struct.shape)  # raises if indivisible
+        assert all(s >= 1 for s in shard)
+
+    jax.tree_util.tree_map_with_path(check, shapes, specs)
+
+
+def test_70b_kv_cache_shards_at_tp8_and_rejects_tp16():
+    """The serving cache shards kv-heads over tp: tp=8 divides Llama-70B's
+    8 KV heads exactly (each shard: 1 kv head); tp=16 cannot — the Engine
+    rejects it up front rather than silently replicating (the reference's
+    vLLM TP=16 example relies on vLLM duplicating KV heads; this framework
+    states the bound instead)."""
+    from jax.sharding import NamedSharding
+
+    cfg = llama70b()
+    mesh = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=8), jax.devices()[:8])
+    cache_struct = jax.eval_shape(lambda: init_cache(cfg, 16, 8192))
+    sh = NamedSharding(mesh, cache_shardings(cfg).k)
+    shard = sh.shard_shape(cache_struct.k.shape)
+    assert shard[3] == 1  # one kv head per tp shard
+    # Full bf16 cache at B=16, T=8192: 2 * 80 * 16 * 8192 * 8 * 128 * 2B = 40 GiB
+    # across the group -> ~5 GiB per tp=8 shard. Sanity-pin the arithmetic.
+    per_shard_bytes = 2 * (
+        shard[0] * shard[1] * shard[2] * shard[3] * shard[4] * 2
+    )
+    assert per_shard_bytes == pytest.approx(5.4e9, rel=0.05), per_shard_bytes
+
+    from lws_tpu.serving import Engine
+
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        # tp=16 via a 8-device mesh is impossible; assert the divisibility
+        # check itself (16 > 8 devices, so fake the axis with tp=16 shape
+        # check): n_kv_heads=8 % tp=16 != 0 -> Engine must refuse.
+        class FakeMesh:
+            axis_names = ("dp", "pp", "cp", "tp")
+
+            class devices:  # noqa: N801 — mimic mesh.devices.shape
+                shape = (1, 1, 1, 16)
+
+        Engine(cfg, {}, batch_size=1, max_len=128, mesh=FakeMesh())
+
+
+def test_70b_weight_dims_divide_at_tp16():
+    """The docstring's weight-only tp=16 claim, checked arithmetically (no
+    16-device mesh needed): every tp-sharded parameter dim of the 70B
+    shapes divides 16."""
+    cfg = llama70b()
+    shapes = jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
+    specs = param_shardings(cfg)
+
+    def check(path, struct, spec):
+        for dim, axis in zip(struct.shape, tuple(spec)):
+            if axis == "tp":
+                assert dim % 16 == 0, (path, struct.shape, spec)
+
+    jax.tree_util.tree_map_with_path(check, shapes, specs)
+
+
+def test_70b_paged_pool_shardings_divide_at_tp8():
+    from jax.sharding import NamedSharding
+
+    cfg = llama70b()
+    mesh = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=8), jax.devices()[:8])
+    # Flagship paged shape scaled to 70B: block 64, 128 slots x 20 blocks.
+    num_blocks, bs = 128 * 20 + 1, 64
+    kshape = (cfg.n_layers, num_blocks, bs, cfg.n_kv_heads, cfg.head_dim)
+    sh = NamedSharding(mesh, paged_cache_shardings(cfg).k)
+    shard = sh.shard_shape(kshape)
+    assert shard[3] == 1 and shard[1] == num_blocks  # heads split, pool whole
