@@ -123,6 +123,58 @@ def run_serve_tp() -> int:
     return 0
 
 
+def run_serve_paged() -> int:
+    """The COMPOSED serving shape across process boundaries: the group's tp
+    mesh serves a PagedBatchEngine with prefix caching and mixed
+    greedy/seeded-sampled requests. Host-side allocation (slots, blocks,
+    prefix map) is deterministic, and every device value that reaches the
+    host comes from replicated computation — so all processes must emit
+    IDENTICAL tokens and identical prefix-hit stats (multi-host coherence
+    for the full density stack)."""
+    from lws_tpu.parallel import initialize_from_env
+
+    info = initialize_from_env()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from lws_tpu.models import LlamaConfig, init_params
+    from lws_tpu.parallel import mesh_from_bootstrap
+    from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+    mesh = mesh_from_bootstrap(info)
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+    with jax.set_mesh(mesh):
+        params = jax.jit(lambda: init_params(cfg, jax.random.key(7)))()
+        engine = PagedBatchEngine(
+            cfg, params, slots=2, max_len=32, block_size=8,
+            mesh=mesh, prefix_cache=True,
+        )
+        sys_prompt = (np.arange(16) % 64).astype(np.int32)
+        a = engine.submit(np.concatenate([sys_prompt, [40, 41]]).astype(np.int32),
+                          max_new_tokens=6)
+        # seed=None exercises the multi-process entropy broadcast: each
+        # process draws different urandom, process 0's wins — coherence.
+        b = engine.submit(np.concatenate([sys_prompt, [50]]).astype(np.int32),
+                          max_new_tokens=6, temperature=0.8, top_k=16, seed=None)
+        engine.run_until_drained()
+        tokens = engine.result(a) + engine.result(b)
+
+    line = (
+        f"process={info.process_id}/{info.num_processes} "
+        f"tp={mesh.devices.size} hits={engine.stats_prefix['hit_tokens']} "
+        f"tokens={tokens}"
+    )
+    _write_result(line)
+    print(f"[worker] {line}")
+    return 0
+
+
 def _write_result(line: str) -> None:
     """Atomic write: readers poll for the file and must never see it empty."""
     out = os.environ.get("LWS_TPU_RESULT_FILE")
@@ -142,6 +194,8 @@ def main() -> int:
         return run_tp_forward()
     if cmd == "serve_tp":
         return run_serve_tp()
+    if cmd == "serve_paged":
+        return run_serve_paged()
     if cmd == "sleep":
         import time
 

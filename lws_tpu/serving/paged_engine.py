@@ -186,6 +186,14 @@ class PagedBatchEngine:
 
         self._sample_first = _sample_first
 
+        # Jitted: self.tokens/self._keys may be GLOBAL (non-addressable)
+        # arrays in a multi-process mesh, where eager .at[].set is not
+        # allowed. One helper serves both (jit specializes per dtype).
+        self._set_at = jax.jit(
+            lambda arr, idx, val: arr.at[idx].set(val),
+            **({"out_shardings": self._rep} if mesh is not None else {}),
+        )
+
         @partial(jax.jit, donate_argnums=(0,), **_sh_insert)
         def _insert(cache, slot_k, slot_v, block_ids, pos_b, tokens, slot, plen,
                     first_token, slot_ks=None, slot_vs=None):
@@ -320,6 +328,17 @@ class PagedBatchEngine:
 
         return jax.set_mesh(self.mesh) if self.mesh is not None else contextlib.nullcontext()
 
+    def _put_rep(self, x):
+        """Pin a host-built input replicated on the mesh. In MULTI-PROCESS
+        meshes device_put rejects non-addressable shardings — there the raw
+        (identical-on-every-process) host array goes straight into the jit,
+        which is the supported multi-controller pattern; the explicit pin
+        only exists to stop single-process GSPMD from re-sharding host
+        inputs under the shard_map'd kernel."""
+        if self.mesh is None or not self._rep.is_fully_addressable:
+            return x
+        return jax.device_put(x, self._rep)
+
     # ------------------------------------------------------------------
     @property
     def free_blocks(self) -> int:
@@ -389,6 +408,18 @@ class PagedBatchEngine:
 
             # 63 bits: jax.random.key seeds go through np.int64.
             seed = int.from_bytes(_os.urandom(8), "little") >> 1
+            if self.mesh is not None and not self._rep.is_fully_addressable:
+                # Multi-process mesh: per-process urandom would diverge the
+                # logically-replicated key state (each process sampling
+                # different tokens for one logical slot). Broadcast process
+                # 0's entropy so unseeded sampling stays nondeterministic
+                # AND coherent. Safe ordering: admissions are deterministic
+                # and identical on every process.
+                from jax.experimental import multihost_utils
+
+                halves = np.array([seed & 0xFFFFFFFF, seed >> 32], np.uint32)
+                halves = np.asarray(multihost_utils.broadcast_one_to_all(halves))
+                seed = int(halves[0]) | (int(halves[1]) << 32)
         return jax.random.key(seed)
 
     def _sample_first_token(self, logits, req_key, slot, temperature, top_k, top_p):
@@ -399,7 +430,7 @@ class PagedBatchEngine:
             logits, first_key,
             jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
         )
-        self._keys = self._keys.at[slot].set(slot_key)
+        self._keys = self._set_at(self._keys, slot, slot_key)
         return first
 
     def _finish_admission(self, req: PagedRequest, first) -> int:
@@ -564,15 +595,14 @@ class PagedBatchEngine:
                 jnp.asarray(hit_len, jnp.int32), jnp.asarray(s_true - 1, jnp.int32),
             )
             with self._mesh_ctx():
-                if self.mesh is not None:
-                    args = tuple(jax.device_put(a, self._rep) for a in args)
+                args = tuple(self._put_rep(a) for a in args)
                 self.cache, self.pos_b, logits = self._insert_with_prefix(
                     self.params, self.cache, *args, self.pos_b, slot, plen,
                 )
                 first = self._sample_first_token(
                     logits, req_key, slot, temperature, top_k, top_p
                 )
-                self.tokens = self.tokens.at[slot].set(first)
+                self.tokens = self._set_at(self.tokens, slot, first)
 
         # Register the newly computed shareable blocks for future prompts
         # (this request holds a ref on each until it completes). A digest
@@ -644,12 +674,12 @@ class PagedBatchEngine:
         any_sampled = bool(
             any(self._active[s].temperature > 0.0 for s in self._active)
         )
-        if self.mesh is not None:
-            # Pin the host-built inputs replicated: left uncommitted, GSPMD
-            # may shard them and the shard_map'd kernel expects them whole.
-            active = jax.device_put(active, self._rep)
-            table = jax.device_put(table, self._rep)
-            sampling = tuple(jax.device_put(s, self._rep) for s in sampling)
+        # Pin the host-built inputs replicated (no-op without a mesh or in
+        # multi-process meshes — see _put_rep): left uncommitted, GSPMD may
+        # shard them and the shard_map'd kernel expects them whole.
+        active = self._put_rep(active)
+        table = self._put_rep(table)
+        sampling = tuple(self._put_rep(s) for s in sampling)
         with self._mesh_ctx():
             try:
                 step_fn = self._get_step_fn(any_sampled)

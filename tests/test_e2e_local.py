@@ -216,3 +216,45 @@ def test_real_process_group_serves_tp_sharded_engine(tmp_path):
         assert len(ast.literal_eval(token_strs.pop())) == 16  # 2 slots x 8 steps
     finally:
         backend.shutdown()
+
+
+def test_real_process_group_serves_paged_prefix_sampling(tmp_path):
+    """The COMPOSED density stack across real process boundaries: 2 procs x
+    2 virtual devices = a tp=4 mesh serving PagedBatchEngine with prefix
+    caching and mixed greedy/seeded-sampled requests. Both processes must
+    report identical tokens AND identical prefix-hit stats — host-side
+    allocation is deterministic and every device value is replicated."""
+    template = PodTemplateSpec(
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="worker",
+                    command=[sys.executable, "-m", "lws_tpu.runtime.worker", "serve_paged"],
+                    env=[EnvVar("LWS_TPU_RESULT_FILE", str(tmp_path / "$(POD_NAME).txt"))],
+                )
+            ]
+        )
+    )
+    lws = LeaderWorkerSet(
+        meta=new_meta("servepg"),
+        spec=LeaderWorkerSetSpec(
+            replicas=1,
+            leader_worker_template=LeaderWorkerTemplate(worker_template=template, size=2),
+        ),
+    )
+    cp = ControlPlane()
+    backend = make_backend(
+        cp, tmp_path, extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    )
+    cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+    try:
+        cp.create(lws)
+        cp.run_until_stable()
+        wait_for_files(cp, backend, tmp_path, {"servepg-0.txt", "servepg-0-1.txt"})
+        lines = sorted((tmp_path / n).read_text().strip() for n in ("servepg-0.txt", "servepg-0-1.txt"))
+        assert "tp=4" in lines[0], lines
+        assert "hits=16" in lines[0], lines  # B hit both 8-token sys blocks
+        payloads = {l.split(" ", 1)[1] for l in lines}  # strip process=i/n
+        assert len(payloads) == 1, f"processes diverged: {lines}"
+    finally:
+        backend.shutdown()
