@@ -7,6 +7,12 @@ into a free slot; per-slot position vectors drive RoPE, masking, and cache
 scatter (models.llama.forward_decode_slotted). Inactive slots compute but
 their outputs are ignored and their cache rows are overwritten on admission —
 the standard static-shape continuous-batching trade.
+
+Positioning: PagedBatchEngine supersedes this engine for production serving
+(a pool sized to slots x max_len is the dense-equivalent configuration, and
+it adds tp meshes, per-request sampling, and prefix caching). BatchEngine
+stays as the simplest dense implementation and the exactness oracle the
+paged tests compare against; it is greedy-only by design.
 """
 
 from __future__ import annotations
