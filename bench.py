@@ -33,7 +33,7 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".benc
 HEADLINE_KEY = "headline"
 # Single source of truth for the round's artifact suffix (DENSITY_<tag>.json
 # etc.) — bump once per round; LWS_TPU_ROUND overrides.
-ROUND_TAG = os.environ.get("LWS_TPU_ROUND", "r04")
+ROUND_TAG = os.environ.get("LWS_TPU_ROUND", "r05")
 
 
 def force_cpu_if_dev() -> None:

@@ -46,9 +46,13 @@ class Container:
 
 
 class AffinityOperator(str, Enum):
+    # Label value must be one of the listed values.
     IN = "In"
+    # Label value must not be any of the listed values.
     NOT_IN = "NotIn"
+    # Label key must be present (values ignored).
     EXISTS = "Exists"
+    # Label key must be absent (values ignored).
     DOES_NOT_EXIST = "DoesNotExist"
 
 
@@ -140,9 +144,13 @@ class PodTemplateSpec:
 
 
 class PodPhase(str, Enum):
+    # Accepted but not yet scheduled/started (image pulls live here).
     PENDING = "Pending"
+    # Bound to a node with all containers started.
     RUNNING = "Running"
+    # All containers exited 0.
     SUCCEEDED = "Succeeded"
+    # At least one container exited non-zero and will not be restarted.
     FAILED = "Failed"
 
 

@@ -19,6 +19,9 @@ from lws_tpu.api.pod import PodTemplateSpec, VolumeClaimTemplate
 
 
 class RolloutStrategyType(str, Enum):
+    # Replace groups incrementally under maxUnavailable/maxSurge/partition
+    # control — the only strategy, as in the reference
+    # (ref leaderworkerset_types.go:254-265).
     ROLLING_UPDATE = "RollingUpdate"
 
 
@@ -35,17 +38,28 @@ class RestartPolicy(str, Enum):
 
 
 class StartupPolicy(str, Enum):
+    # Workers are created as soon as the leader pod EXISTS (parallel startup).
     LEADER_CREATED = "LeaderCreated"
+    # Workers are created only after the leader pod reports Ready — for
+    # leaders that must initialize (e.g. coordinator bring-up) first
+    # (ref leaderworkerset_types.go:351-365).
     LEADER_READY = "LeaderReady"
 
 
 class SubdomainPolicy(str, Enum):
+    # All groups share one headless service / DNS subdomain.
     SHARED = "Shared"
+    # Each group gets its own headless service — needed when per-group
+    # hostnames must not collide across replicas
+    # (ref leaderworkerset_types.go:228-241).
     UNIQUE_PER_REPLICA = "UniquePerReplica"
 
 
 class SubGroupPolicyType(str, Enum):
+    # The leader is counted inside subgroup 0 (default TP x PP windowing).
     LEADER_WORKER = "LeaderWorker"
+    # The leader sits outside every subgroup window — for leaders that only
+    # coordinate and run no shard (ref leaderworkerset_types.go:150-176).
     LEADER_EXCLUDED = "LeaderExcluded"
 
 

@@ -143,6 +143,15 @@ class Store:
         # I/O error), the write fails un-acknowledged and memory is unchanged
         # — durability of every acknowledged write is the WAL contract.
         self._journal: Optional[Callable[[str, TypedObject], None]] = None
+        # Debug guard for list_shared's no-mutation contract (ADVICE r4):
+        # when LWS_TPU_STORE_DEBUG=1 (set by tests/conftest.py), every commit
+        # records a fingerprint of the stored object, and list_shared verifies
+        # it before handing out aliases — so a caller that mutated a previous
+        # shared result fails loudly at the next read instead of silently
+        # corrupting the store (no rv bump, no watch event). Off in
+        # production: fingerprinting costs a full to_plain per commit.
+        self._shared_guard = os.environ.get("LWS_TPU_STORE_DEBUG", "") == "1"
+        self._fingerprints: dict[Key, int] = {}
 
     # ---- admission registration -------------------------------------------
     def register_mutator(self, kind: str, fn) -> None:
@@ -166,6 +175,7 @@ class Store:
         self._by_kind.setdefault(key[0], {})[key] = obj
         self._index_labels(key, obj)
         self._index_owners(key, obj)
+        self._record_fingerprint(key, obj)
         self._bump_kind(key[0])  # invalidate kind_version-keyed caches
 
     def _forget_object(self, key: Key) -> None:
@@ -177,6 +187,7 @@ class Store:
             self._by_kind.get(key[0], {}).pop(key, None)
             self._unindex_labels(key, obj)
             self._unindex_owners(key, obj)
+            self._fingerprints.pop(key, None)
             self._bump_kind(key[0])
 
     def kind_version(self, kind: str) -> int:
@@ -292,11 +303,32 @@ class Store:
         (_update_locked), never mutates in place, so a returned reference
         stays a stable snapshot. Exists for hot read-only reconcile paths:
         list()'s per-call deep clone of every match was the fleet-rollout
-        bottleneck (CONTROL_r04)."""
+        bottleneck (CONTROL_r04). Under LWS_TPU_STORE_DEBUG=1 each returned
+        object is fingerprint-checked against its commit-time state so a
+        past caller's mutation fails loudly here instead of corrupting the
+        store silently."""
         with self._lock:
-            out = [obj for _, obj in self._iter_matching_locked(kind, namespace, labels)]
+            matches = list(self._iter_matching_locked(kind, namespace, labels))
+            if self._shared_guard:
+                for key, obj in matches:
+                    fp = self._fingerprints.get(key)
+                    if fp is not None and fp != self._fingerprint(obj):
+                        raise AssertionError(
+                            f"store corruption: shared object {key} was "
+                            f"mutated in place by a list_shared caller "
+                            f"(no-mutation contract violated)"
+                        )
+            out = [obj for _, obj in matches]
             out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
             return out
+
+    @staticmethod
+    def _fingerprint(obj: TypedObject) -> int:
+        return hash(repr(to_plain(obj)))
+
+    def _record_fingerprint(self, key: Key, obj: TypedObject) -> None:
+        if self._shared_guard:
+            self._fingerprints[key] = self._fingerprint(obj)
 
     def list_keys(
         self,
@@ -339,6 +371,7 @@ class Store:
                 self._by_kind.setdefault(key[0], {})[key] = obj
                 self._index_labels(key, obj)
                 self._index_owners(key, obj)
+                self._record_fingerprint(key, obj)
                 self._bump_kind(key[0])
                 stored = _clone(obj)
                 self._pending_events.append(WatchEvent("ADDED", _clone(stored)))
@@ -402,6 +435,7 @@ class Store:
             self._by_kind.setdefault(key[0], {})[key] = obj
             self._index_labels(key, obj)
             self._index_owners(key, obj)
+            self._record_fingerprint(key, obj)
             self._bump_kind(key[0])
             stored = _clone(obj)
             self._pending_events.append(WatchEvent("MODIFIED", _clone(stored)))
@@ -431,6 +465,7 @@ class Store:
         self._by_kind.get(key[0], {}).pop(key, None)
         self._unindex_labels(key, obj)
         self._unindex_owners(key, obj)
+        self._fingerprints.pop(key, None)
         self._bump_kind(key[0])
         # Cascade: anything whose controller owner is this object (same
         # namespace, as before — cross-namespace ownership is not a thing).

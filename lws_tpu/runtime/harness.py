@@ -7,6 +7,7 @@ runs them on background threads for live use.
 
 from __future__ import annotations
 
+import collections
 from typing import Optional
 
 from lws_tpu.api import contract
@@ -119,7 +120,7 @@ class ControlPlane:
                 return [("GroupSet", obj.meta.namespace, owner.name)]
             return []
 
-        _lws_fanout_gen: dict = {}
+        _lws_fanout_gen: "collections.OrderedDict" = collections.OrderedDict()
 
         def pods_of_lws(obj) -> list[Key]:
             # LWS SPEC changes (size, template) flow through leader pods.
@@ -132,16 +133,21 @@ class ControlPlane:
             # repaired by the owner_pod_of_deleted / leader_pod_of_groupset
             # DELETED-only mappers below, not by this side channel.
             # Memo keyed by uid: a deleted-and-recreated LWS restarts its
-            # generation counter and must not inherit the old memo. Bounded:
-            # DS rollouts churn uniquely-named child LWSes forever.
-            if len(_lws_fanout_gen) > 8192:
-                for stale in list(_lws_fanout_gen)[:4096]:
-                    del _lws_fanout_gen[stale]
+            # generation counter and must not inherit the old memo. Bounded
+            # LRU (DS rollouts churn uniquely-named child LWSes forever):
+            # move-to-end on hit so long-lived LWSes survive eviction —
+            # insertion-order eviction dropped exactly the live fleet
+            # entries the gate targets (ADVICE r4).
             memo_key = (obj.key(), obj.meta.uid)
             gen = obj.meta.generation
-            if _lws_fanout_gen.get(memo_key) == gen:
-                return []
+            prev = _lws_fanout_gen.get(memo_key)
+            if prev is not None:
+                _lws_fanout_gen.move_to_end(memo_key)
+                if prev == gen:
+                    return []
             _lws_fanout_gen[memo_key] = gen
+            while len(_lws_fanout_gen) > 8192:
+                _lws_fanout_gen.popitem(last=False)
             return store.list_keys(
                 "Pod",
                 obj.meta.namespace,

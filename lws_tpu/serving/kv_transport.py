@@ -17,6 +17,7 @@ service can answer).
 
 from __future__ import annotations
 
+import hmac
 import io
 import json
 import queue
@@ -210,7 +211,9 @@ class KVServer:
             meta, payload = recv_msg(conn)
             if meta is None:
                 return
-            if self._token and meta.get("token") != self._token:
+            if self._token and not hmac.compare_digest(
+                str(meta.get("token", "")).encode(), self._token.encode()
+            ):
                 send_msg(conn, {"error": "unauthorized"})
                 return
             op = meta.get("op")

@@ -683,10 +683,18 @@ class PagedBatchEngine:
         with self._mesh_ctx():
             try:
                 step_fn = self._get_step_fn(any_sampled)
-                self.cache, self.tokens, self.pos_b, toks, self._keys = step_fn(
+                out = step_fn(
                     self.params, self.cache, table, self.tokens,
                     self.pos_b, active, n, *sampling,
                 )
+                if not self._kernel_probed and self.stats["attention_path"] == "kernel":
+                    # JAX dispatch is async: a post-compile pallas RUNTIME
+                    # failure only surfaces at the first blocking consume,
+                    # which would otherwise be np.asarray(toks) OUTSIDE this
+                    # try. Force the consume here, before committing state,
+                    # so the no-donation probe can still fall back with the
+                    # old cache intact.
+                    out = jax.block_until_ready(out)
             except Exception as e:  # noqa: BLE001 — kernel trace/compile/runtime failure
                 if self.stats["attention_path"] != "kernel" or self._kernel_probed:
                     raise
@@ -707,17 +715,16 @@ class PagedBatchEngine:
                 self.stats["kernel_error"] = repr(e)[:300]
                 self._kernel_probed = True
                 self._use_kernel = False
-                self.cache, self.tokens, self.pos_b, toks, self._keys = (
-                    self._get_step_fn(any_sampled)(
-                        self.params, self.cache, table, self.tokens,
-                        self.pos_b, active, n, *sampling,
-                    )
+                out = self._get_step_fn(any_sampled)(
+                    self.params, self.cache, table, self.tokens,
+                    self.pos_b, active, n, *sampling,
                 )
             else:
                 if not self._kernel_probed:
                     # Kernel proved itself: subsequent steps use the
                     # donating executables (in-place pool updates).
                     self._kernel_probed = True
+            self.cache, self.tokens, self.pos_b, toks, self._keys = out
         host_toks = np.asarray(toks)  # [n, slots]
         for slot, req in list(self._active.items()):
             req.tokens.extend(int(t) for t in host_toks[:, slot])

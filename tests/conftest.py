@@ -15,6 +15,9 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Loud failure on list_shared no-mutation contract violations (store.py):
+# must be set before lws_tpu.core.store is imported by any test.
+os.environ["LWS_TPU_STORE_DEBUG"] = "1"
 
 import jax  # noqa: E402
 

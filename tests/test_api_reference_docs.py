@@ -18,6 +18,29 @@ def test_generated_api_reference_in_sync():
     assert p.returncode == 0, f"stale docs/reference — regenerate:\n{p.stderr}"
 
 
+def test_no_builtin_docstring_noise_or_empty_enum_rows():
+    """The r4 generator leaked inherited str.__doc__ into every str-enum
+    section and emitted empty value-description cells; pin the fix."""
+    import glob
+    import re
+
+    for path in glob.glob(os.path.join(ROOT, "docs", "reference", "*.md")):
+        text = open(path).read()
+        assert "str(object=" not in text, f"builtin docstring noise in {path}"
+        in_enum = False
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            if line.startswith("| value | description |"):
+                in_enum = True
+                continue
+            if in_enum:
+                if not line.startswith("|"):
+                    in_enum = False
+                elif re.match(r"^\|\s*`[^`]*`\s*\|\s*\|$", line):
+                    raise AssertionError(
+                        f"empty enum value description {path}:{lineno}: {line}"
+                    )
+
+
 def test_reference_covers_the_contract():
     """Every public contract constant appears in the generated page."""
     from lws_tpu.api import contract

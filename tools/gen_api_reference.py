@@ -118,10 +118,14 @@ def _default_str(f: dataclasses.Field) -> str:
 
 
 def _real_doc(cls) -> str | None:
-    """The class docstring, unless it's just the synthesized signature."""
-    doc = inspect.getdoc(cls)
+    """The class's OWN docstring, unless it's just the synthesized signature.
+
+    Must not use inspect.getdoc: it walks the MRO, so a docstring-less
+    str-enum would render `str.__doc__` builtin noise into the reference.
+    """
+    doc = cls.__dict__.get("__doc__")
     if doc and not doc.startswith(cls.__name__ + "("):
-        return doc
+        return inspect.cleandoc(doc)
     return None
 
 

@@ -12,7 +12,7 @@ while true; do
     # Outer timeout must exceed the sum of bench.py's internal stage budgets
     # (probe 1500 + density 1500 + int8w 900 + kernel 600 + pipeline 600 +
     # headline measure time) or a slow-but-succeeding run gets killed.
-    LWS_TPU_ROUND=r04 timeout 9000 python bench.py > .bench_watch_out.json 2> .bench_watch_err.log
+    LWS_TPU_ROUND=${LWS_TPU_ROUND:-r05} timeout 9000 python bench.py > .bench_watch_out.json 2> .bench_watch_err.log
     rc=$?
     echo "[watch] bench rc=$rc; stdout:"; cat .bench_watch_out.json
     # Complete = rc 0, fresh (not degraded), and no stage-level "error"
