@@ -463,11 +463,12 @@ def main() -> None:
     """One-window orchestrator (VERDICT r3 next #1): once the backend probe
     succeeds, run in strict priority order —
       1. bf16 headline (always the emitted record)
-      2. serving-density bench (paged vs dense vs plain -> DENSITY_<round>.json)
-      3. weights-only int8 experiment (the undecided lane -> recorded verdict)
-      4. paged-attention kernel on-chip validation (first hardware contact)
-      5. bf16 pipeline-body on-chip probe
-      6. training throughput (tokens/s + MFU -> TRAIN_<round>.json)
+      2. flagship 8B-int8w bench (representative scale -> FLAGSHIP_<round>.json)
+      3. serving-density bench (paged vs dense vs plain -> DENSITY_<round>.json)
+      4. weights-only int8 experiment (the undecided lane -> recorded verdict)
+      5. paged-attention kernel on-chip validation (first hardware contact)
+      6. bf16 pipeline-body on-chip probe
+      7. training throughput (tokens/s + MFU -> TRAIN_<round>.json)
     Each stage writes its artifact / per-metric cache entry IMMEDIATELY, so a
     relay window of any length captures a prefix of the list instead of
     nothing. The headline JSON line is printed right after stage 1 AND
@@ -495,7 +496,20 @@ def main() -> None:
         # testable without burning a relay window on a plumbing bug.
         return
 
-    # --- Stage 2: serving density (own artifact: DENSITY_<round>.json) ----
+    # --- Stage 2: flagship 8B-int8w (own artifact: FLAGSHIP_<round>.json) --
+    # The representative-scale row (VERDICT r4 #2): the 0.9B headline above
+    # stays the cross-round comparable; this is the scale the verdicts are
+    # rendered at. Runs FIRST among the extras — if the window closes early
+    # the representative number is the one we want captured.
+    flagship = _run_stage_subprocess(
+        [sys.executable, os.path.join("benchmarks", "flagship_bench.py")],
+        timeout_s=int(os.environ.get("BENCH_FLAGSHIP_TIMEOUT", "2400")),
+        extra_env={"LWS_TPU_ROUND": round_tag},
+    )
+    headline["flagship"] = flagship
+    print(f"[bench] flagship stage: {json.dumps(flagship)}", file=sys.stderr)
+
+    # --- Stage 3: serving density (own artifact: DENSITY_<round>.json) ----
     density = _run_stage_subprocess(
         [sys.executable, os.path.join("benchmarks", "serving_density_bench.py")],
         timeout_s=int(os.environ.get("BENCH_DENSITY_TIMEOUT", "1500")),
@@ -504,7 +518,7 @@ def main() -> None:
     headline["density"] = density
     print(f"[bench] density stage: {json.dumps(density)}", file=sys.stderr)
 
-    # --- Stage 3: weights-only int8 (record the verdict either way) -------
+    # --- Stage 4: weights-only int8 (record the verdict either way) -------
     # int8 weights via XLA's dequantize-into-dot; subprocess so a mid-window
     # relay hang can't stop stages 4-5. The stage caches its own record.
     # BENCH_INT8=1 additionally runs the int8-KV variant (known loser: KV
@@ -519,14 +533,14 @@ def main() -> None:
     if os.environ.get("BENCH_INT8") == "1":
         headline["experiment_int8kv"] = _run_json_stage("int8kv", timeout_s=900)
 
-    # --- Stage 4: paged-kernel on-chip validation --------------------------
+    # --- Stage 5: paged-kernel on-chip validation --------------------------
     kv = _run_json_stage("kernel", timeout_s=600)
     headline["paged_kernel_on_chip"] = kv
     print(f"[bench] paged kernel on-chip: {json.dumps(kv)}", file=sys.stderr)
     if on_accelerator and kv.get("ok"):  # a failure must not erase a pass
         _save_last_good("paged_kernel_on_chip", kv)
 
-    # --- Stage 5: bf16 pipeline body on-chip (never executed anywhere) -----
+    # --- Stage 6: bf16 pipeline body on-chip (never executed anywhere) -----
     pipe = _run_stage_subprocess(
         [sys.executable, os.path.join("benchmarks", "pipeline_bf16_probe.py")],
         timeout_s=600,
@@ -535,7 +549,7 @@ def main() -> None:
     if on_accelerator and pipe.get("rc") == 0:
         _save_last_good("pipeline_bf16_on_chip", pipe)
 
-    # --- Stage 6: training throughput (TRAIN_<round>.json) ----------------
+    # --- Stage 7: training throughput (TRAIN_<round>.json) ----------------
     # Training-side evidence has never been driver-captured (round 1's
     # attempt died to the relay outage); lowest priority — runs last.
     train = _run_stage_subprocess(

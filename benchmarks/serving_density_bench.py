@@ -121,7 +121,9 @@ def measure_plain_engine(cfg, params, batch, prompt_len, max_len) -> dict:
 def main() -> None:
     # Relay outages hang backend init forever; probe like bench.py does.
     # Round tag comes from bench.ROUND_TAG — one bump site per round.
-    artifact_path = os.path.join(_ROOT, f"DENSITY_{bench.ROUND_TAG}.json")
+    artifact_path = os.path.join(
+        os.environ.get("LWS_TPU_ARTIFACT_DIR", _ROOT), f"DENSITY_{bench.ROUND_TAG}.json"
+    )
     if not bench._probe_backend_with_retry(total_budget_s=600.0):
         rec = {"degraded": True, "note": "TPU relay unreachable; no fresh density numbers"}
         print(json.dumps(rec))

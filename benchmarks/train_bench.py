@@ -95,7 +95,9 @@ def main() -> None:
     if on_accel:
         # Atomic write: the orchestrator's hard timeout can SIGKILL this
         # stage mid-write; a torn artifact must be impossible.
-        path = os.path.join(_ROOT, f"TRAIN_{bench.ROUND_TAG}.json")
+        path = os.path.join(
+            os.environ.get("LWS_TPU_ARTIFACT_DIR", _ROOT), f"TRAIN_{bench.ROUND_TAG}.json"
+        )
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(record, f, indent=1)

@@ -10,9 +10,9 @@ while true; do
   if timeout 300 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[watch] $(date -u +%FT%TZ) RELAY UP — running bench orchestrator"
     # Outer timeout must exceed the sum of bench.py's internal stage budgets
-    # (probe 1500 + density 1500 + int8w 900 + kernel 600 + pipeline 600 +
+    # (probe 1500 + flagship 2400 + density 1500 + int8w 900 + kernel 600 + pipeline 600 +
     # headline measure time) or a slow-but-succeeding run gets killed.
-    LWS_TPU_ROUND=${LWS_TPU_ROUND:-r05} timeout 9000 python bench.py > .bench_watch_out.json 2> .bench_watch_err.log
+    LWS_TPU_ROUND=${LWS_TPU_ROUND:-r05} timeout 12000 python bench.py > .bench_watch_out.json 2> .bench_watch_err.log
     rc=$?
     echo "[watch] bench rc=$rc; stdout:"; cat .bench_watch_out.json
     # Complete = rc 0, fresh (not degraded), and no stage-level "error"
