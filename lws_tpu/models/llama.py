@@ -997,3 +997,82 @@ def _cached_layer_loop(x, cache, params, cfg: LlamaConfig, block):
 
     (x, cache, _), _ = jax.lax.scan(body, (x, cache, jnp.zeros((), jnp.int32)), params["layers"])
     return x, cache
+
+
+def forward_verify_paged(
+    params: dict,
+    tokens: jax.Array,
+    cache: PagedKVCache,
+    block_table: jax.Array,
+    pos_b: jax.Array,
+    cfg: LlamaConfig,
+) -> tuple[jax.Array, PagedKVCache]:
+    """Speculative-verification forward over paged slots: append `tokens`
+    [B, S] (running token + S-1 drafts per slot) at each slot's pos_b and
+    return logits for ALL S positions [B, S, V] — one dispatch scores every
+    slot's whole draft run (the batched counterpart of the plain Engine's
+    verify pass, engine.py generate_speculative). New K/V scatter into the
+    slots' table blocks at positions pos_b..pos_b+S-1; rows past the
+    accepted prefix go stale and are overwritten by later appends (the same
+    rewind trick — the paged cache has no pos scalar, pos_b IS the rewind).
+    Positions past a slot's allocated blocks hit table entries equal to 0,
+    the null block: harmless dead writes, never attendable. XLA gather path
+    only — the pallas kernel is decode(S=1)-shaped; verification amortizes
+    the gather across S positions, so the kernel matters less here. Under a
+    tp mesh, GSPMD partitions the gathers/attention on the heads dim like
+    every other XLA paged path (no shard_map involved)."""
+    B, S = tokens.shape
+    bs = cache.block_size
+    positions = pos_b[:, None] + jnp.arange(S)[None, :]  # [B, S]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
+    write_blk = jnp.take_along_axis(block_table, positions // bs, axis=1)  # [B, S]
+    write_off = positions % bs
+
+    def paged_block(x, layer_idx, lp, cache):
+        import dataclasses as _dc
+
+        updated = {}
+
+        def attn_fn(q, k, v):
+            # k, v: [B, S, Hkv, hd]
+            if cache.k_scale is not None:
+                k_q, k_s = _quantize_kv(k)
+                v_q, v_s = _quantize_kv(v)
+                new_k = cache.k.at[layer_idx, write_blk, write_off].set(k_q)
+                new_v = cache.v.at[layer_idx, write_blk, write_off].set(v_q)
+                new_ks = cache.k_scale.at[layer_idx, write_blk, write_off].set(k_s)
+                new_vs = cache.v_scale.at[layer_idx, write_blk, write_off].set(v_s)
+                updated["cache"] = _dc.replace(
+                    cache, k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs
+                )
+                k_l = jax.lax.dynamic_index_in_dim(new_k, layer_idx, 0, keepdims=False)
+                v_l = jax.lax.dynamic_index_in_dim(new_v, layer_idx, 0, keepdims=False)
+                ks_l = jax.lax.dynamic_index_in_dim(new_ks, layer_idx, 0, keepdims=False)
+                vs_l = jax.lax.dynamic_index_in_dim(new_vs, layer_idx, 0, keepdims=False)
+                k_view = _dequantize_kv(
+                    k_l[block_table], ks_l[block_table], cfg.dtype
+                ).reshape(B, -1, cfg.n_kv_heads, cfg.head_dim)
+                v_view = _dequantize_kv(
+                    v_l[block_table], vs_l[block_table], cfg.dtype
+                ).reshape(B, -1, cfg.n_kv_heads, cfg.head_dim)
+                return _cached_attention(q, k_view, v_view, pos_b)
+            new_k = cache.k.at[layer_idx, write_blk, write_off].set(
+                k.astype(cache.k.dtype)
+            )
+            new_v = cache.v.at[layer_idx, write_blk, write_off].set(
+                v.astype(cache.v.dtype)
+            )
+            updated["cache"] = _dc.replace(cache, k=new_k, v=new_v)
+            k_l = jax.lax.dynamic_index_in_dim(new_k, layer_idx, 0, keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(new_v, layer_idx, 0, keepdims=False)
+            k_view = k_l[block_table].reshape(B, -1, cfg.n_kv_heads, cfg.head_dim)
+            v_view = v_l[block_table].reshape(B, -1, cfg.n_kv_heads, cfg.head_dim)
+            return _cached_attention(q, k_view, v_view, pos_b)
+
+        x, _ = _block_core(x, positions, lp, cfg, attn_fn)
+        return x, updated["cache"]
+
+    x, cache = _cached_layer_loop(x, cache, params, cfg, paged_block)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [B, S, V]
+    return logits, cache

@@ -80,6 +80,8 @@ class PagedBatchEngine:
         num_blocks: Optional[int] = None,
         mesh=None,
         prefix_cache: bool = False,
+        prefill_chunk: Optional[int] = None,
+        interleave_steps: int = 8,
     ):
         """With `mesh` (axes incl. 'tp'), the engine serves TENSOR-PARALLEL
         paged continuous batching under GSPMD: params per param_shardings,
@@ -93,6 +95,19 @@ class PagedBatchEngine:
         stays the replica-level axis (see paged_cache_shardings)."""
         if max_len % block_size:
             raise ValueError("max_len must be a multiple of block_size")
+        # Chunked-prefill admission (vLLM-scheduler shape, VERDICT r4 #3):
+        # with prefill_chunk set, a long prompt is prefilled in fixed-size
+        # chunks with `interleave_steps` decode steps dispatched for the
+        # ACTIVE slots between chunks — a long submit() can no longer stall
+        # every active request for the whole prompt's prefill. Power of two
+        # so every bucket (itself pow2) is a whole number of chunks and the
+        # padded chunk tail can never overflow the bucket-sized dense cache.
+        if prefill_chunk is not None and (
+            prefill_chunk < block_size or prefill_chunk & (prefill_chunk - 1)
+        ):
+            raise ValueError("prefill_chunk must be a power of two >= block_size")
+        self.prefill_chunk = prefill_chunk
+        self.interleave_steps = interleave_steps
         self.cfg = cfg
         self.mesh = mesh
         self._tp = 1
@@ -206,34 +221,41 @@ class PagedBatchEngine:
             if mesh is not None else {}
         )
 
-        @partial(jax.jit, donate_argnums=(1,), **_sh_insert_prefix)
-        def _insert_with_prefix(params, cache, suffix, block_ids, hit_len,
-                                last_off, pos_b, slot, plen):
-            """Prefix-cache admission: gather the slot's table blocks into a
-            dense view (hit blocks carry the cached prefix K/V; new blocks
-            carry garbage the suffix pass overwrites), run the SUFFIX only
-            through forward_with_cache at pos=hit_len, scatter the view
-            back. Returns (cache, pos_b', last-token logits [1, V]). The
-            hit-block scatter rewrites identical bytes — harmless, and it
-            keeps one code path for quantized and plain pools."""
-            from lws_tpu.models.llama import KVCache, forward_with_cache
+        def _dense_view(cache, block_ids, pad, hit_len):
+            """Pool blocks -> dense KVCache [L, 1, bucket+pad, ...] at
+            pos=hit_len: hit blocks carry cached prefix K/V, new blocks
+            carry garbage the suffix pass overwrites. Shared by the one-shot
+            prefix insert and the chunked-admission gather."""
+            from lws_tpu.models.llama import KVCache
 
             L = cache.k.shape[0]
-            bs_ = cache.block_size
-            bucket = block_ids.shape[0] * bs_
-            s_suf = suffix.shape[1]
+            bucket = block_ids.shape[0] * cache.block_size
 
             def view(pool):  # [L, nb, bs, ...] -> [L, 1, bucket(+pad), ...]
                 v = pool[:, block_ids].reshape(L, 1, bucket, *pool.shape[3:])
-                pad = jnp.zeros((L, 1, s_suf, *pool.shape[3:]), pool.dtype)
-                return jnp.concatenate([v, pad], axis=2)
+                padz = jnp.zeros((L, 1, pad, *pool.shape[3:]), pool.dtype)
+                return jnp.concatenate([v, padz], axis=2)
 
-            dense = KVCache(
+            return KVCache(
                 k=view(cache.k), v=view(cache.v),
                 pos=hit_len.astype(jnp.int32),
                 k_scale=view(cache.k_scale) if quant else None,
                 v_scale=view(cache.v_scale) if quant else None,
             )
+
+        @partial(jax.jit, donate_argnums=(1,), **_sh_insert_prefix)
+        def _insert_with_prefix(params, cache, suffix, block_ids, hit_len,
+                                last_off, pos_b, slot, plen):
+            """Prefix-cache admission: gather the slot's table blocks into a
+            dense view, run the SUFFIX only through forward_with_cache at
+            pos=hit_len, scatter the view back. Returns (cache, pos_b',
+            last-token logits [1, V]). The hit-block scatter rewrites
+            identical bytes — harmless, and it keeps one code path for
+            quantized and plain pools."""
+            from lws_tpu.models.llama import forward_with_cache
+
+            bucket = block_ids.shape[0] * cache.block_size
+            dense = _dense_view(cache, block_ids, suffix.shape[1], hit_len)
             logits, dense = forward_with_cache(
                 params, suffix, dense, cfg_static, last_offset=last_off
             )
@@ -249,6 +271,78 @@ class PagedBatchEngine:
 
         self._insert_with_prefix = _insert_with_prefix
 
+        # ---- chunked-prefill admission helpers ---------------------------
+        # One dense [1, width] cache is built per admission (width = bucket,
+        # or bucket+chunk for the prefix path), filled chunk by chunk, then
+        # scattered into the pool in one go. Compile set stays bounded:
+        # _chunk_append specializes per (chunk, width); widths are pow2
+        # buckets, the chunk size is fixed.
+        _sh_chunk = (
+            {"out_shardings": (self._rep, self._prefill_cache_shardings)}
+            if mesh is not None else {}
+        )
+
+        @partial(jax.jit, donate_argnums=(2,), **_sh_chunk)
+        def _chunk_append(params, chunk, cache):
+            from lws_tpu.models.llama import forward_prefill_chunk
+
+            return forward_prefill_chunk(params, chunk, cache, cfg_static)
+
+        @partial(jax.jit, **({"out_shardings": self._rep} if mesh is not None else {}))
+        def _chunk_logits(params, hidden, last_off):
+            from lws_tpu.models.quant import matmul as _qmm
+
+            h = jnp.take_along_axis(
+                hidden,
+                jnp.broadcast_to(
+                    jnp.reshape(last_off, (1, 1, 1)), (1, 1, hidden.shape[-1])
+                ),
+                axis=1,
+            )[:, 0]
+            return _qmm(h, params["lm_head"]).astype(jnp.float32)
+
+        _sh_scatter = (
+            {"out_shardings": (self._pool_shardings, self._rep)}
+            if mesh is not None else {}
+        )
+
+        # Only the pool is donated: the dense chunk cache's buffers cannot
+        # alias the pool-shaped outputs (donating them just warns).
+        @partial(jax.jit, donate_argnums=(0,), **_sh_scatter)
+        def _scatter_dense(cache, dense, block_ids, pos_b, slot, plen):
+            """Scatter a chunk-filled dense cache's first bucket rows into
+            the pool blocks and commit the slot's position. Rows past the
+            true prompt length carry padded-chunk garbage — position-masked
+            out of attention and overwritten by decode appends, exactly like
+            the one-shot path's padded tail."""
+            bucket = block_ids.shape[0] * cache.block_size
+            scales = (
+                (dense.k_scale[:, 0, :bucket], dense.v_scale[:, 0, :bucket])
+                if quant else ()
+            )
+            cache = paged_insert(
+                cache, dense.k[:, 0, :bucket], dense.v[:, 0, :bucket],
+                block_ids, *scales,
+            )
+            return cache, pos_b.at[slot].set(plen)
+
+        _sh_view = (
+            {"out_shardings": self._prefill_cache_shardings}
+            if mesh is not None else {}
+        )
+
+        @partial(jax.jit, static_argnums=(2,), **_sh_view)
+        def _gather_view(cache, block_ids, pad, hit_len):
+            """Jitted _dense_view (the chunked-admission entry: chunks then
+            append incrementally outside this dispatch)."""
+            return _dense_view(cache, block_ids, pad, hit_len)
+
+        self._chunk_append = _chunk_append
+        self._chunk_logits = _chunk_logits
+        self._scatter_dense = _scatter_dense
+        self._gather_view = _gather_view
+        self._chunk_cache_init: dict = {}  # width -> jitted dense-cache init
+
         self._prefill_one = _prefill_one
         self._insert = _insert
         # Attention path: the kernel's first real-chip contact happens inside
@@ -257,7 +351,11 @@ class PagedBatchEngine:
         from lws_tpu.models.llama import paged_kernel_default
 
         kernel_intent = paged_kernel_default()
-        self.stats = {"attention_path": "kernel" if kernel_intent else "xla_fallback"}
+        self.stats = {
+            "attention_path": "kernel" if kernel_intent else "xla_fallback",
+            "chunked_admissions": 0,
+            "interleaved_decode_steps": 0,
+        }
         # The kernel's first step is the compile probe: run it WITHOUT cache
         # donation (a post-compile runtime failure would have consumed the
         # donated pool, leaving nothing for the fallback retry); switch to
@@ -487,9 +585,13 @@ class PagedBatchEngine:
             blocks=blocks, temperature=temperature, top_k=top_k, top_p=top_p,
             seed=seed,
         )
+        req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
+        if self.prefill_chunk is not None and plen > self.prefill_chunk:
+            self.table[slot] = 0  # null-mapped until _admit_chunked commits
+            first = self._admit_chunked(req, req_key, blocks, bucket, plen, 0, None)
+            return self._finish_admission(req, first)
         self.table[slot] = 0
         self.table[slot, :n_blocks] = blocks
-        req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
 
         padded = np.zeros((bucket,), np.int32)
         padded[:plen] = prompt
@@ -552,11 +654,38 @@ class PagedBatchEngine:
             shared_blocks=list(hits), temperature=temperature, top_k=top_k,
             top_p=top_p, seed=seed,
         )
-        self.table[slot] = 0
-        self.table[slot, :n_blocks] = blocks
         req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
+        chunked = (
+            self.prefill_chunk is not None
+            and plen - hit_len > self.prefill_chunk
+        )
+        if not chunked:
+            self.table[slot] = 0
+            self.table[slot, :n_blocks] = blocks
 
-        if not hits:
+        if chunked:
+            # Chunked admission composed with prefix caching: gather the hit
+            # blocks into the dense view ONCE (a copy — stable across the
+            # interleaved decodes: decode never writes below an active
+            # request's prompt length, so pinned hit blocks cannot change
+            # under it), append suffix chunks, commit. The view is padded by
+            # one chunk so the final padded tail cannot overflow the bucket.
+            self.table[slot] = 0  # null-mapped until _admit_chunked commits
+            dense = None
+            if hits:
+                with self._mesh_ctx():
+                    dense = self._gather_view(
+                        self.cache,
+                        self._put_rep(jnp.asarray(
+                            blocks[: bucket // bs], jnp.int32
+                        )),
+                        self.prefill_chunk,
+                        self._put_rep(jnp.asarray(hit_len, jnp.int32)),
+                    )
+            first = self._admit_chunked(
+                req, req_key, blocks, bucket, plen, hit_len if hits else 0, dense
+            )
+        elif not hits:
             # Cache miss: the plain prefill path is cheaper (no garbage
             # gather/concat round trip) and compiles per bucket, not per
             # (bucket, suffix) pair. Registration below still publishes the
@@ -622,6 +751,82 @@ class PagedBatchEngine:
         self.stats_prefix["hit_blocks"] += len(hits)
         return self._finish_admission(req, first)
 
+    def _get_chunk_cache(self, width: int):
+        """Fresh dense [1, width] cache for a chunked admission (jitted init
+        cached per width; widths are the pow2 buckets)."""
+        fn = self._chunk_cache_init.get(width)
+        if fn is None:
+            cfg_static = self._cfg_static
+            kw = (
+                {"out_shardings": self._prefill_cache_shardings}
+                if self.mesh is not None else {}
+            )
+            from lws_tpu.models.llama import init_cache as _init_cache
+
+            fn = jax.jit(lambda w=width: _init_cache(cfg_static, 1, w), **kw)
+            self._chunk_cache_init[width] = fn
+        with self._mesh_ctx():
+            return fn()
+
+    def _admit_chunked(
+        self, req: PagedRequest, req_key, blocks: list[int], bucket: int,
+        plen: int, hit_len: int, dense,
+    ):
+        """Chunked-prefill admission body (VERDICT r4 #3, the vLLM-scheduler
+        shape): fill a dense cache chunk by chunk, dispatching
+        `interleave_steps` decode steps for the ACTIVE slots between chunks,
+        then commit — sample the first token, bring the table row live, and
+        scatter the dense rows into the pool. Exact vs the one-shot path:
+        chunked appends produce the same K/V (Engine.prefill_chunked
+        property), interleaved decodes only touch OTHER slots, and this
+        slot's table row stays null-mapped until commit so those decodes'
+        dead writes for it land in the null block, not the fresh blocks."""
+        C = self.prefill_chunk
+        s_true = plen - hit_len
+        n_chunks = -(-s_true // C)
+        padded = np.zeros((n_chunks * C,), np.int32)
+        padded[:s_true] = req.prompt[hit_len:]
+        slot = req.slot
+        if dense is None:
+            # Width must fit every append: when max_len caps the bucket to a
+            # non-power-of-two, n_chunks*C can exceed it — and a too-small
+            # cache would silently CLAMP the final dynamic_update_slice,
+            # overwriting earlier rows with wrong-position K/V. The scatter
+            # still takes only the first `bucket` rows.
+            dense = self._get_chunk_cache(max(bucket, n_chunks * C))
+        hidden = None
+        for i in range(n_chunks):
+            chunk = jnp.asarray(padded[i * C:(i + 1) * C])[None, :]
+            with self._mesh_ctx():
+                hidden, dense = self._chunk_append(
+                    self.params, self._put_rep(chunk), dense
+                )
+            if self._active and self.interleave_steps > 0 and i < n_chunks - 1:
+                executed = self.step_n(self.interleave_steps)
+                self.stats["interleaved_decode_steps"] = (
+                    self.stats.get("interleaved_decode_steps", 0) + executed
+                )
+        with self._mesh_ctx():
+            logits = self._chunk_logits(
+                self.params, hidden,
+                self._put_rep(jnp.asarray((s_true - 1) % C, jnp.int32)),
+            )
+            first = self._sample_first_token(
+                logits, req_key, slot, req.temperature, req.top_k, req.top_p
+            )
+            # Commit: table row live only now (see docstring).
+            self.table[slot] = 0
+            self.table[slot, : len(blocks)] = blocks
+            prefill_ids = self._put_rep(
+                jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
+            )
+            self.cache, self.pos_b = self._scatter_dense(
+                self.cache, dense, prefill_ids, self.pos_b, slot, plen
+            )
+            self.tokens = self._set_at(self.tokens, slot, first)
+        self.stats["chunked_admissions"] = self.stats.get("chunked_admissions", 0) + 1
+        return first
+
     def _release(self, req: PagedRequest) -> None:
         self.table[req.slot] = 0  # dead writes + stale reads -> null block
         shared = set(req.shared_blocks)
@@ -652,13 +857,14 @@ class PagedBatchEngine:
                 for r in self._active.values()),
         )
 
-    def step_n(self, n: int) -> None:
+    def step_n(self, n: int) -> int:
         """Up to n decode steps in one device dispatch. Clamped to the
         soonest completion among active slots (admission state is frozen for
         the chunk, and a slot stepping past its block footprint would write
-        into the shared null block while its mask starts attending it)."""
+        into the shared null block while its mask starts attending it).
+        Returns the number of steps actually executed."""
         if not self._active or n <= 0:
-            return
+            return 0
         n = min(n, max(1, self._completion_bound()), 32)
         n = 1 << (n.bit_length() - 1)  # floor pow2: bounded compile set
         active = jnp.asarray(
@@ -732,6 +938,7 @@ class PagedBatchEngine:
                 self._completed[req.request_id] = req
                 del self._active[slot]
                 self._release(req)
+        return n
 
     def run_until_drained(self, max_steps: int = 10000) -> None:
         """Drain via chunked on-device stepping: each dispatch runs exactly
@@ -740,6 +947,151 @@ class PagedBatchEngine:
             if not self._active:
                 return
             self.step_n(32)  # step_n clamps to the completion bound itself
+        raise RuntimeError("engine did not drain")
+
+    # ---- speculative decoding (composed with paged continuous batching) --
+    def _get_spec_step(self, sample: bool):
+        key = ("spec", sample)
+        if key not in self._step_cache:
+            cfg_static = self._cfg_static
+            sh = (
+                {"out_shardings": (
+                    self._pool_shardings, self._rep, self._rep, self._rep
+                )}
+                if self.mesh is not None else {}
+            )
+
+            @partial(jax.jit, donate_argnums=(1,), **sh)
+            def _spec_step(params, cache, table, tokens_in, pos_b,
+                           keys, temp, top_k, top_p):
+                from lws_tpu.models.llama import forward_verify_paged
+                from lws_tpu.serving.engine import sample_logits_per_slot
+
+                all_logits, cache = forward_verify_paged(
+                    params, tokens_in, cache, table, pos_b, cfg_static,
+                )
+                greedy = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
+                if sample:
+                    # Sampled slots advance ONE token per dispatch, from the
+                    # same per-slot stream schedule as step_n: one split per
+                    # produced token.
+                    split = jax.vmap(jax.random.split)(keys)
+                    step_keys, keys = split[:, 0], split[:, 1]
+                    sampled = sample_logits_per_slot(
+                        all_logits[:, 0, :], step_keys, temp, top_k, top_p
+                    )
+                else:
+                    sampled = greedy[:, 0]
+                return cache, greedy, sampled, keys
+
+            self._step_cache[key] = _spec_step
+        return self._step_cache[key]
+
+    def step_speculative(self, gamma: int = 4, ngram: int = 3) -> bool:
+        """One speculative dispatch across every active slot (VERDICT r4 #4:
+        spec decode composed WITH paged continuous batching): each greedy
+        slot's n-gram draft run ([running token] + gamma drafts) is verified
+        in one batched forward (forward_verify_paged); the accepted prefix
+        plus the model's own next token land in one dispatch, so repetitive
+        spans (code, quotes, RAG copies) stream multiple tokens per param
+        read. Sampled slots ride the same dispatch but advance exactly one
+        token (drawn from their own PRNG stream, same key schedule as
+        step_n) — mixed batches stay exact vs the non-speculative engine.
+        Returns False (no dispatch) when inapplicable: nothing active, or a
+        slot too close to max_len for a full draft run — callers fall back
+        to step_n(1), exactly like the plain Engine's tail handling."""
+        from lws_tpu.serving.engine import Engine
+
+        if not self._active:
+            return False
+        if all(r.temperature > 0 for r in self._active.values()):
+            # No greedy slot to draft for: a gamma-wide verify pass would
+            # cost (gamma+1)x the FLOPs to advance every slot by one token —
+            # strictly worse than plain decode. Let the caller batch-step.
+            return False
+        S = gamma + 1
+        for r in self._active.values():
+            if len(r.prompt) + len(r.tokens) + S > self.max_len:
+                return False
+        tokens_in = np.zeros((self.slots, S), np.int32)
+        drafts: dict[int, list[int]] = {}
+        pos_h = np.zeros((self.slots,), np.int32)
+        for s, r in self._active.items():
+            if r.temperature <= 0:
+                d = Engine._draft_ngram(list(r.prompt) + r.tokens, ngram, gamma)
+            else:
+                d = [r.tokens[-1]] * gamma  # never accepted; slot samples
+            drafts[s] = d
+            tokens_in[s, 0] = r.tokens[-1]
+            tokens_in[s, 1:] = d
+            pos_h[s] = len(r.prompt) + len(r.tokens) - 1
+        any_sampled = bool(
+            any(r.temperature > 0.0 for r in self._active.values())
+        )
+        table = self._put_rep(jnp.asarray(self.table))
+        sampling = tuple(self._put_rep(a) for a in (
+            self._keys, jnp.asarray(self.temp), jnp.asarray(self.top_k),
+            jnp.asarray(self.top_p),
+        ))
+        tokens_dev = self._put_rep(jnp.asarray(tokens_in))
+        pos_dev = self._put_rep(jnp.asarray(pos_h))
+        with self._mesh_ctx():
+            fn = self._get_spec_step(any_sampled)
+            self.cache, greedy, sampled, self._keys = fn(
+                self.params, self.cache, table, tokens_dev, pos_dev,
+                *sampling,
+            )
+        greedy_h = np.asarray(greedy)   # [slots, S]
+        sampled_h = np.asarray(sampled)  # [slots]
+        self.stats["spec_dispatches"] = self.stats.get("spec_dispatches", 0) + 1
+        for s, r in list(self._active.items()):
+            if r.temperature > 0:
+                new = [int(sampled_h[s])]
+            else:
+                d = drafts[s]
+                a = 0
+                while a < gamma and d[a] == int(greedy_h[s, a]):
+                    a += 1
+                remaining = r.max_new_tokens - len(r.tokens)
+                new = ([*map(int, d[:a]), int(greedy_h[s, a])])[:remaining]
+                self.stats["spec_drafted"] = (
+                    self.stats.get("spec_drafted", 0) + gamma
+                )
+                self.stats["spec_accepted"] = (
+                    self.stats.get("spec_accepted", 0) + len(new) - 1
+                )
+            r.tokens.extend(new)
+            if r.done or len(r.prompt) + len(r.tokens) >= self.max_len:
+                self._completed[r.request_id] = r
+                del self._active[s]
+                self._release(r)
+        # Commit host truth back to the device state the regular step path
+        # reads (pos_b IS the paged cache's rewind: rejected draft rows sit
+        # past pos_b, masked out of attention until overwritten).
+        pos_after = np.zeros((self.slots,), np.int32)
+        last_tok = np.zeros((self.slots,), np.int32)
+        for s, r in self._active.items():
+            pos_after[s] = len(r.prompt) + len(r.tokens) - 1
+            last_tok[s] = r.tokens[-1]
+        self.pos_b = self._put_rep(jnp.asarray(pos_after))
+        self.tokens = self._put_rep(jnp.asarray(last_tok))
+        return True
+
+    def run_until_drained_speculative(
+        self, gamma: int = 4, ngram: int = 3, max_dispatches: int = 10000
+    ) -> None:
+        """Drain with speculative dispatches. Fallback when a dispatch is
+        refused: single steps while a greedy slot could re-enter speculation
+        (near-max_len tail), full 32-step scans when none can (all-sampled
+        batch — speculation would never apply again)."""
+        for _ in range(max_dispatches):
+            if not self._active:
+                return
+            if not self.step_speculative(gamma, ngram):
+                greedy_alive = any(
+                    r.temperature <= 0 for r in self._active.values()
+                )
+                self.step_n(1 if greedy_alive else 32)
         raise RuntimeError("engine did not drain")
 
     def result(self, request_id: int) -> Optional[list[int]]:

@@ -1,0 +1,114 @@
+"""Speculative decoding composed with paged continuous batching (VERDICT r4
+#4: the r4 engine had spec decode only on the plain Engine at B=1; the
+production engine had none).
+
+step_speculative verifies every greedy slot's n-gram draft run in ONE
+batched dispatch (models/llama.py forward_verify_paged); sampled slots ride
+the same dispatch advancing one token from their own PRNG stream. Pinned:
+
+  * token-exactness vs the non-speculative paged engine — all-greedy and
+    MIXED (sampled+greedy) batches, int8 KV, tp=2 mesh;
+  * acceptance actually happens on repetitive content and the drain takes
+    FEWER dispatches than sequential decode (the tokens/dispatch gain);
+  * acceptance stats are recorded in engine.stats;
+  * the near-max_len guard falls back instead of overrunning.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lws_tpu.models.llama import LlamaConfig, init_params
+from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+
+def tiny_cfg(**kw):
+    return LlamaConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=256, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    return cfg, init_params(cfg, jax.random.key(0))
+
+
+def prompts():
+    rng = np.random.RandomState(0)
+    pat = rng.randint(1, 60, size=8).astype(np.int32)
+    return [np.tile(pat, 6), rng.randint(1, 60, size=20).astype(np.int32)]
+
+
+def run(cfg, params, spec, sampled_second=False, mesh=None):
+    eng = PagedBatchEngine(cfg, params, slots=4, max_len=256, block_size=16,
+                           mesh=mesh)
+    p1, p2 = prompts()
+    kw = dict(temperature=0.8, seed=7, top_k=10) if sampled_second else {}
+    rids = [eng.submit(p1, max_new_tokens=24), eng.submit(p2, max_new_tokens=16, **kw)]
+    if spec:
+        eng.run_until_drained_speculative(gamma=4, ngram=3)
+    else:
+        eng.run_until_drained()
+    return [eng.result(r) for r in rids], dict(eng.stats)
+
+
+def test_greedy_exact_and_fewer_dispatches(setup):
+    cfg, params = setup
+    want, _ = run(cfg, params, spec=False)
+    got, stats = run(cfg, params, spec=True)
+    assert want == got
+    assert stats["spec_accepted"] > 0, "no draft ever accepted"
+    # Sequential decode needs 23 + 15 = 38 steps; spec must beat that.
+    assert stats["spec_dispatches"] < 38, stats
+    assert stats["spec_drafted"] >= stats["spec_accepted"]
+
+
+def test_mixed_sampled_greedy_exact(setup):
+    cfg, params = setup
+    want, _ = run(cfg, params, spec=False, sampled_second=True)
+    got, stats = run(cfg, params, spec=True, sampled_second=True)
+    assert want == got
+    assert stats["spec_dispatches"] > 0
+
+
+def test_int8_kv_exact(setup):
+    cfg, params = setup
+    qcfg = dataclasses.replace(cfg, kv_quant=True)
+    want, _ = run(qcfg, params, spec=False)
+    got, stats = run(qcfg, params, spec=True)
+    assert want == got
+    assert stats["spec_dispatches"] > 0
+
+
+def test_tp_mesh_exact(setup):
+    cfg, params = setup
+    from lws_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=2), jax.devices()[:2])
+    want, _ = run(cfg, params, spec=False)
+    got, stats = run(cfg, params, spec=True, mesh=mesh)
+    assert want == got
+    assert stats["spec_accepted"] > 0
+
+
+def test_near_max_len_falls_back(setup):
+    """A slot within gamma+1 of max_len must refuse the spec dispatch (no
+    block-table overrun) and still drain correctly via single steps."""
+    cfg, params = setup
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=64, block_size=16)
+    prompt = np.arange(1, 50, dtype=np.int32)  # 49 tokens, 15 of headroom
+    rid = eng.submit(prompt, max_new_tokens=14)
+    # headroom 64 - 50 = 14 < gamma+1 once a few tokens land
+    assert eng.step_speculative(gamma=20) is False
+    eng.run_until_drained_speculative(gamma=8)
+    got = eng.result(rid)
+    eng2 = PagedBatchEngine(cfg, params, slots=2, max_len=64, block_size=16)
+    rid2 = eng2.submit(prompt, max_new_tokens=14)
+    eng2.run_until_drained()
+    assert got == eng2.result(rid2)
