@@ -99,12 +99,26 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("-R", "--replicas", type=int, default=50)
     ap.add_argument("-S", "--size", type=int, default=4)
+    ap.add_argument(
+        "--curve", default="",
+        help="comma-separated extra fleet sizes for the scale curve "
+             "(e.g. 256,512); each runs the same turnup+rollout pair",
+    )
     args = ap.parse_args()
 
     turnup, cp = bench_turnup(args.replicas, args.size)
     print(json.dumps(turnup))
     rollout = bench_rollout(cp, args.replicas, args.size)
     print(json.dumps(rollout))
+
+    curve = []
+    for groups in (int(x) for x in args.curve.split(",") if x):
+        t, cp2 = bench_turnup(groups, args.size)
+        print(json.dumps(t))
+        r = bench_rollout(cp2, groups, args.size)
+        print(json.dumps(r))
+        curve.extend([t, r])
+        del cp2
 
     # In-repo artifact so fleet numbers are captured, not STATUS.md prose
     # (VERDICT r2 weak #7). Round tag from LWS_TPU_ROUND, default r03.
@@ -117,8 +131,11 @@ def main() -> None:
     artifact_path = os.path.join(
         _ROOT, f"CONTROL_{os.environ.get('LWS_TPU_ROUND', 'r03')}.json"
     )
+    artifact = {"rows": [turnup, rollout], "native_clone": native}
+    if curve:
+        artifact["scale_curve"] = curve
     with open(artifact_path, "w") as f:
-        json.dump({"rows": [turnup, rollout], "native_clone": native}, f, indent=1)
+        json.dump(artifact, f, indent=1)
     print(json.dumps({"artifact": artifact_path}))
 
 

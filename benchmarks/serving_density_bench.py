@@ -250,7 +250,9 @@ def main() -> None:
         "unit": "tokens/s/chip",
         "slots": spec["slots"],
         "tokens_per_dispatch": round(
-            spec["decode_tokens"] / max(spec.get("spec_dispatches", 1), 1), 2
+            spec["decode_tokens"]
+            / max(spec.get("spec_dispatches", 0)
+                  + spec.get("spec_fallback_dispatches", 0), 1), 2
         ),
         "accepted_drafts": spec.get("spec_accepted", 0),
         "drafted": spec.get("spec_drafted", 0),

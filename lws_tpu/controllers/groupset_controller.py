@@ -40,9 +40,13 @@ class GroupSetReconciler:
             return None
 
         update_revision = template_hash(gs.spec.template)
+        # owned_by_shared: READ-ONLY aliases (deletes go through the store by
+        # name; nothing below mutates a pod). The leader groupset owns
+        # O(replicas) leader pods — the per-reconcile deep clone of all of
+        # them was the top rollout cost at 256 groups (CONTROL_r04).
         pods = {
             ordinal: pod
-            for pod in self.store.owned_by("Pod", gs.meta.namespace, gs.meta.uid)
+            for pod in self.store.owned_by_shared("Pod", gs.meta.namespace, gs.meta.uid)
             if (parsed := parent_name_and_ordinal(pod.meta.name))[0] == gs.meta.name
             and (ordinal := parsed[1]) >= 0
         }

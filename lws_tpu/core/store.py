@@ -533,6 +533,30 @@ class Store:
         out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
         return out
 
+    def owned_by_shared(self, kind: str, namespace: str, owner_uid: str) -> list[TypedObject]:
+        """owned_by without the per-call deep clone — list_shared's contract
+        (READ-ONLY aliases of the stored objects; writes go through
+        get()+update()). The leader groupset's reconcile clones O(replicas)
+        leader pods per call through owned_by, which was the top rollout
+        cost at 256 groups (CONTROL_r04). Same debug guard as list_shared."""
+        with self._lock:
+            keys = [
+                k
+                for k in self._owner_index.get(owner_uid, ())
+                if k[0] == kind and k[1] == namespace and k in self._objects
+            ]
+            if self._shared_guard:
+                for k in keys:
+                    fp = self._fingerprints.get(k)
+                    if fp is not None and fp != self._fingerprint(self._objects[k]):
+                        raise AssertionError(
+                            f"store corruption: shared object {k} was mutated "
+                            f"in place by a shared-read caller"
+                        )
+            out = [self._objects[k] for k in keys]
+        out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
+        return out
+
 
 def owner_ref(obj: TypedObject) -> "OwnerReference":
     from lws_tpu.api.meta import OwnerReference

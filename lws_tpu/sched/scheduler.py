@@ -46,8 +46,72 @@ class Scheduler:
         self._bound: dict[Key, Pod] = {}
         self._by_gang: dict[tuple[str, str], dict[Key, Pod]] = {}
         self._gang_of: dict[Key, str] = {}  # reverse map for O(1) moves/purges
+        # Placement aggregates: _feasible_node used to rescan every bound pod
+        # per placement — O(fleet^2) turnup at 512+ pods (CONTROL_r04 note).
+        # Watch-fed counters make the common exclusive-placement terms
+        # O(group) instead:
+        #   _chips_by_node: node -> TPU chips of bound pods (capacity is
+        #                   physical, so this one is cluster-global)
+        #   _hash_nodes:    (ns, hash_label, value) -> {node: pod count}
+        #   _hash_total:    (ns, hash_label) -> {node: pod count}
+        self._chips_by_node: dict[str, int] = {}
+        self._bound_state: dict[Key, tuple[str, int, list[tuple[str, str]]]] = {}
+        self._hash_nodes: dict[tuple[str, str, str], dict[str, int]] = {}
+        self._hash_total: dict[tuple[str, str], dict[str, int]] = {}
         self._pending_lock = threading.Lock()
         store.watch(self._observe)
+
+    _TRACKED_HASH_KEYS = (
+        contract.GROUP_UNIQUE_HASH_LABEL_KEY,
+        contract.SUBGROUP_UNIQUE_HASH_LABEL_KEY,
+    )
+
+    def _unindex_bound_locked(self, key: Key) -> None:
+        prev = self._bound_state.pop(key, None)
+        if prev is None:
+            return
+        node, chips, hashes = prev
+        if chips:
+            left = self._chips_by_node.get(node, 0) - chips
+            if left > 0:
+                self._chips_by_node[node] = left
+            else:
+                self._chips_by_node.pop(node, None)
+        ns = key[1]
+        for lk, v in hashes:
+            for index, ik in ((self._hash_nodes, (ns, lk, v)),
+                              (self._hash_total, (ns, lk))):
+                bucket = index.get(ik)
+                if bucket is None:
+                    continue
+                c = bucket.get(node, 0) - 1
+                if c > 0:
+                    bucket[node] = c
+                else:
+                    bucket.pop(node, None)
+                    if not bucket:
+                        index.pop(ik, None)
+
+    def _index_bound_locked(self, key: Key, pod: Pod) -> None:
+        self._unindex_bound_locked(key)
+        node = pod.spec.node_name
+        if not node:
+            return
+        chips = pod.spec.effective_tpu_chips()
+        if chips:
+            self._chips_by_node[node] = self._chips_by_node.get(node, 0) + chips
+        ns = key[1]
+        hashes: list[tuple[str, str]] = []
+        for lk in self._TRACKED_HASH_KEYS:
+            v = pod.meta.labels.get(lk)
+            if v is None:
+                continue
+            hashes.append((lk, v))
+            for index, ik in ((self._hash_nodes, (ns, lk, v)),
+                              (self._hash_total, (ns, lk))):
+                bucket = index.setdefault(ik, {})
+                bucket[node] = bucket.get(node, 0) + 1
+        self._bound_state[key] = (node, chips, hashes)
 
     # ---- incremental pod indexes (fleet-scale event fan-out) ---------------
     def _observe(self, event) -> None:
@@ -66,6 +130,10 @@ class Scheduler:
             self._bound.clear()
             self._by_gang.clear()
             self._gang_of.clear()
+            self._chips_by_node.clear()
+            self._bound_state.clear()
+            self._hash_nodes.clear()
+            self._hash_total.clear()
         for pod in self.store.list("Pod"):
             self.note_pod(pod)
 
@@ -90,8 +158,10 @@ class Scheduler:
             if pod.spec.node_name:
                 self._pending.pop(key, None)
                 self._bound[key] = pod
+                self._index_bound_locked(key, pod)
             else:
                 self._bound.pop(key, None)
+                self._unindex_bound_locked(key)
                 if pod.status.phase == PodPhase.PENDING:
                     self._pending[key] = gang
                 else:
@@ -126,6 +196,7 @@ class Scheduler:
             for key in keys:
                 self._pending.pop(key, None)
                 self._bound.pop(key, None)
+                self._unindex_bound_locked(key)
                 gang = self._gang_of.get(key)
                 if gang is not None:
                     self._drop_from_gang_locked(key, gang)
@@ -235,12 +306,8 @@ class Scheduler:
             if topology_key and domain == "":
                 continue
             domains.setdefault(domain, []).append(n)
-        used_by_node: dict[str, int] = {}
-        for p in bound:
-            if p.spec.node_name:
-                used_by_node[p.spec.node_name] = (
-                    used_by_node.get(p.spec.node_name, 0) + p.spec.effective_tpu_chips()
-                )
+        with self._pending_lock:
+            used_by_node = dict(self._chips_by_node)
         for _, domain_nodes in sorted(domains.items()):
             free = sum(
                 n.spec.capacity.get(contract.TPU_RESOURCE_NAME, 0)
@@ -278,6 +345,30 @@ class Scheduler:
         with self._pending_lock:
             return [p for k, p in self._bound.items() if k[1] == namespace]
 
+    @staticmethod
+    def _term_fast_shape(term) -> Optional[tuple[str, str, str]]:
+        """Recognize the exclusive-placement webhook's two affinity-term
+        shapes (pod_webhook.set_exclusive_affinities) so they can be
+        answered from the watch-fed hash indexes instead of a bound-pod
+        scan: ("in", key, v) for [key IN [v]]; ("anti", key, v) for
+        [key EXISTS, key NOT_IN [v]]. Anything else -> None (the generic
+        fallback scan keeps full selector semantics)."""
+        from lws_tpu.api.pod import AffinityOperator as Op
+
+        exprs = term.match_expressions
+        if (len(exprs) == 1 and exprs[0].operator == Op.IN
+                and len(exprs[0].values) == 1
+                and exprs[0].key in Scheduler._TRACKED_HASH_KEYS):
+            return ("in", exprs[0].key, exprs[0].values[0])
+        if len(exprs) == 2:
+            by_op = {e.operator: e for e in exprs}
+            if (set(by_op) == {Op.EXISTS, Op.NOT_IN}
+                    and by_op[Op.EXISTS].key == by_op[Op.NOT_IN].key
+                    and len(by_op[Op.NOT_IN].values) == 1
+                    and by_op[Op.EXISTS].key in Scheduler._TRACKED_HASH_KEYS):
+                return ("anti", by_op[Op.EXISTS].key, by_op[Op.NOT_IN].values[0])
+        return None
+
     def _feasible_node(
         self,
         pod: Pod,
@@ -285,28 +376,40 @@ class Scheduler:
         bound: list[Pod],
         extra_assigned: dict[str, Pod],
     ) -> Optional[Node]:
-        all_pods = [p for p in bound if p.meta.name != pod.meta.name] + [
-            p for p in extra_assigned.values() if p.meta.name != pod.meta.name
-        ]
         node_by_name = {n.meta.name: n for n in nodes}
+        extras = [p for p in extra_assigned.values() if p.meta.name != pod.meta.name]
 
-        def domain_of(p: Pod, topology_key: str) -> Optional[str]:
-            n = node_by_name.get(p.spec.node_name)
+        def domain_of_node(name: Optional[str], topology_key: str) -> Optional[str]:
+            n = node_by_name.get(name)
             return None if n is None else n.meta.labels.get(topology_key)
 
-        # Everything per-pod is hoisted OUT of the per-node loop: chip usage
-        # per node, the domain sets each affinity term matches, and the
-        # same-group peer count per slice. The loop body is then O(1) per
-        # node instead of O(bound pods).
+        def domain_of(p: Pod, topology_key: str) -> Optional[str]:
+            return domain_of_node(p.spec.node_name, topology_key)
+
+        # Fast path: chip usage and the exclusive-placement affinity terms
+        # are answered from the watch-fed indexes (O(group) per placement);
+        # only terms the webhook never emits fall back to scanning the bound
+        # pods — built lazily so the common path never pays O(fleet)
+        # (CONTROL_r04: the scan made turnup O(fleet^2) at 512+ pods).
+        _lazy: list = []
+
+        def all_pods() -> list:
+            if not _lazy:
+                _lazy.append(
+                    [p for p in bound if p.meta.name != pod.meta.name] + extras
+                )
+            return _lazy[0]
+
+        ns = pod.meta.namespace
         chips_needed = pod.spec.effective_tpu_chips()
-        used_by_node: dict[str, int] = {}
-        if chips_needed > 0:
-            for p in all_pods:
-                if p.spec.node_name:
-                    used_by_node[p.spec.node_name] = (
-                        used_by_node.get(p.spec.node_name, 0)
-                        + p.spec.effective_tpu_chips()
-                    )
+        with self._pending_lock:
+            used_by_node = dict(self._chips_by_node)
+        for p in extras:
+            if p.spec.node_name:
+                used_by_node[p.spec.node_name] = (
+                    used_by_node.get(p.spec.node_name, 0)
+                    + p.spec.effective_tpu_chips()
+                )
 
         aff = pod.spec.affinity
         # (topology_key, domains): node must carry the key AND, when domains
@@ -318,7 +421,25 @@ class Scheduler:
         anti_domains: list[tuple[str, set]] = []
         if aff is not None:
             for term in aff.required_affinity:
-                matching = [p for p in all_pods if term.selector_matches(p.meta.labels)]
+                fast = self._term_fast_shape(term)
+                if fast is not None and fast[0] == "in":
+                    _, lk, v = fast
+                    with self._pending_lock:
+                        nodeset = set(self._hash_nodes.get((ns, lk, v), ()))
+                    for p in extras:
+                        if term.selector_matches(p.meta.labels):
+                            nodeset.add(p.spec.node_name)
+                    if not nodeset:
+                        if term.selector_matches(pod.meta.labels):
+                            aff_domains.append((term.topology_key, None))
+                            continue
+                        return None  # nothing can satisfy this term
+                    aff_domains.append((
+                        term.topology_key,
+                        {domain_of_node(n, term.topology_key) for n in nodeset},
+                    ))
+                    continue
+                matching = [p for p in all_pods() if term.selector_matches(p.meta.labels)]
                 if not matching:
                     if term.selector_matches(pod.meta.labels):
                         aff_domains.append((term.topology_key, None))
@@ -329,11 +450,27 @@ class Scheduler:
                      {domain_of(p, term.topology_key) for p in matching})
                 )
             for term in aff.required_anti_affinity:
-                domains = {
-                    domain_of(p, term.topology_key)
-                    for p in all_pods
-                    if term.selector_matches(p.meta.labels)
-                }
+                fast = self._term_fast_shape(term)
+                if fast is not None and fast[0] == "anti":
+                    _, lk, v = fast
+                    with self._pending_lock:
+                        total = self._hash_total.get((ns, lk), {})
+                        mine = self._hash_nodes.get((ns, lk, v), {})
+                        nodeset = {
+                            n for n, c in total.items() if c - mine.get(n, 0) > 0
+                        }
+                    for p in extras:
+                        if term.selector_matches(p.meta.labels):
+                            nodeset.add(p.spec.node_name)
+                    domains = {
+                        domain_of_node(n, term.topology_key) for n in nodeset
+                    }
+                else:
+                    domains = {
+                        domain_of(p, term.topology_key)
+                        for p in all_pods()
+                        if term.selector_matches(p.meta.labels)
+                    }
                 domains.discard(None)
                 if domains:
                     anti_domains.append((term.topology_key, domains))
@@ -341,7 +478,15 @@ class Scheduler:
         group_key = pod.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY)
         peers_by_slice: dict[str, int] = {}
         if group_key:
-            for p in all_pods:
+            with self._pending_lock:
+                gbucket = dict(self._hash_nodes.get(
+                    (ns, contract.GROUP_UNIQUE_HASH_LABEL_KEY, group_key), ()
+                ))
+            for n, c in gbucket.items():
+                slice_id = domain_of_node(n, contract.NODE_TPU_SLICE_LABEL)
+                if slice_id is not None:
+                    peers_by_slice[slice_id] = peers_by_slice.get(slice_id, 0) + c
+            for p in extras:
                 if p.meta.labels.get(contract.GROUP_UNIQUE_HASH_LABEL_KEY) == group_key:
                     slice_id = domain_of(p, contract.NODE_TPU_SLICE_LABEL)
                     if slice_id is not None:

@@ -33,11 +33,38 @@ def shard_params_for_serving(params: dict, cfg: LlamaConfig, mesh) -> dict:
     """Place params onto a serving mesh per the model's TP sharding rules
     (weights split over 'tp'; the layer-stack dim rides 'pp', size 1 on a
     pure-TP serving mesh). On a multi-host mesh every process calls this
-    with the same host params and jax builds the global sharded arrays."""
-    from jax.sharding import NamedSharding
+    with the same host params and jax builds the global sharded arrays.
 
-    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), param_shardings(cfg))
-    return jax.device_put(params, shardings)
+    int8 weights compose: a QuantizedArray's q takes the weight's spec
+    verbatim; its per-output-channel scale takes the spec with the
+    CONTRACTION dim removed (embed is scaled over its last dim, everything
+    else over -2 — quantize_params' layout contract), so tp-split output
+    channels carry their tp-split scales."""
+    import jax.tree_util as jtu
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lws_tpu.models.quant import QuantizedArray
+
+    def place(path, p, spec):
+        sh = NamedSharding(mesh, spec)
+        if isinstance(p, QuantizedArray):
+            name = next(
+                (e.key for e in reversed(path) if hasattr(e, "key")), ""
+            )
+            contract = -1 if name == "embed" else -2
+            parts = list(spec) + [None] * (p.q.ndim - len(spec))
+            del parts[contract + p.q.ndim if contract < 0 else contract]
+            scale_sh = NamedSharding(mesh, P(*parts))
+            return QuantizedArray(
+                q=jax.device_put(p.q, sh),
+                scale=jax.device_put(p.scale, scale_sh),
+            )
+        return jax.device_put(p, sh)
+
+    return jtu.tree_map_with_path(
+        place, params, param_shardings(cfg),
+        is_leaf=lambda x: isinstance(x, QuantizedArray),
+    )
 
 
 @dataclass(frozen=True)

@@ -1091,7 +1091,12 @@ class PagedBatchEngine:
                 greedy_alive = any(
                     r.temperature <= 0 for r in self._active.values()
                 )
-                self.step_n(1 if greedy_alive else 32)
+                if self.step_n(1 if greedy_alive else 32):
+                    # Counted so tokens/dispatch accounting can't silently
+                    # exclude the non-speculative tail dispatches.
+                    self.stats["spec_fallback_dispatches"] = (
+                        self.stats.get("spec_fallback_dispatches", 0) + 1
+                    )
         raise RuntimeError("engine did not drain")
 
     def result(self, request_id: int) -> Optional[list[int]]:

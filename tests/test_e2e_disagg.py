@@ -130,7 +130,7 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
         else:
             pytest.fail("prefill endpoint never accepted the prompt")
 
-        result = None
+        result = meta = None
         while time.time() < deadline:
             backend.poll_all()
             try:
@@ -138,10 +138,26 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
             except OSError:
                 got = None
             if got is not None:
-                result = kt.bytes_to_arrays(got[1])["tokens"]
+                meta, payload = got
+                result = kt.bytes_to_arrays(payload)["tokens"]
                 break
             time.sleep(0.5)
         assert result is not None, "no decode result over TCP"
+
+        # Per-handoff cost breakdown rides back with the result (VERDICT r4
+        # #5): prefill-side gather + decode-side deserialize/reshard/decode
+        # timings and the wire byte count.
+        handoff = meta.get("handoff")
+        assert handoff is not None, meta
+        for key in ("bundle_bytes", "prefill_s", "gather_s",
+                    "deserialize_s", "reshard_s", "decode_s"):
+            assert key in handoff, (key, handoff)
+        # The reported wire size must cover the real pos-truncated K/V rows
+        # (prompt-length tokens, every layer, K+V) — not just be positive.
+        from lws_tpu.models.flagship import flagship_config, kv_row_bytes
+
+        cfg = flagship_config("smoke", max_seq_len=32)
+        assert handoff["bundle_bytes"] >= len(prompt) * kv_row_bytes(cfg), handoff
 
         # Oracle: the same model end-to-end in one engine.
         from lws_tpu.serving.disagg_worker import build_engine

@@ -1,6 +1,8 @@
 """Slice-aware scheduling: exclusive topology, gang admission,
 follow-the-leader placement (≈ e2e gang + exclusive placement cases)."""
 
+import pytest
+
 from lws_tpu.api import contract
 from lws_tpu.runtime import ControlPlane
 from lws_tpu.sched import make_slice_nodes
@@ -216,6 +218,34 @@ def test_fleet_scale_reconciles_stay_linear():
     assert len(pods) == replicas * size and all(p.status.ready for p in pods)
     assert all(p.spec.node_name for p in pods)
     assert reconciles < 60 * replicas, reconciles
+
+
+@pytest.mark.slow
+def test_fleet_scale_reconciles_stay_linear_256():
+    """The 256-group extension (VERDICT r4 #6): both turnup AND a fleet-wide
+    rollout must stay O(R) reconciles at 2x the canonical fleet — the scale
+    where the r4 curve fell off super-linearly (rollout 11.4 -> 7.1 groups/s)
+    before the owned_by_shared / scheduler-index work."""
+    replicas, size = 256, 4
+    cp = ControlPlane(enable_scheduler=True, auto_ready=True, require_binding=True)
+    for i in range(replicas):
+        cp.add_nodes(make_slice_nodes(f"slice-{i}", topology=f"{size}x4"))
+    cp.create(
+        LWSBuilder().replicas(replicas).size(size).tpu_chips(4)
+        .exclusive_topology().build()
+    )
+    reconciles = cp.run_until_stable(max_iterations=1_000_000)
+    pods = lws_pods(cp.store, "sample")
+    assert len(pods) == replicas * size and all(p.status.ready for p in pods)
+    assert reconciles < 60 * replicas, reconciles  # observed ~38/group
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    lws.spec.leader_worker_template.worker_template.spec.containers[0].image = "worker:v2"
+    cp.store.update(lws)
+    rollout_reconciles = cp.run_until_stable(max_iterations=1_000_000)
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.status.updated_replicas == replicas
+    assert rollout_reconciles < 80 * replicas, rollout_reconciles  # ~53/group
 
 
 def test_bootstrap_affinity_requires_topology_label():
