@@ -1,0 +1,75 @@
+"""A THIRD-PARTY workload: bootstraps multi-host JAX purely from the
+injected environment contract — no lws_tpu import anywhere in this file.
+
+This is the whole point of the env contract (api/contract.py): an engine
+that has never heard of this framework (vLLM, SGLang, a training loop)
+assembles its distributed runtime from the variables the pod webhook
+injects, exactly like the reference's vLLM example does with
+LWS_LEADER_ADDRESS / LWS_GROUP_SIZE / LWS_WORKER_INDEX
+(/root/reference/docs/examples/vllm/TPU/lws.yaml:30-34,
+ pkg/utils/pod/pod_utils.go:131-179):
+
+  coordinator   = LWS_LEADER_ADDRESS (leader pod's stable DNS name) : 9911
+  num_processes = LWS_GROUP_SIZE
+  process_id    = LWS_WORKER_INDEX
+
+Runs a cross-process psum of (process_id + 1) over every device and writes
+"ok=True" to $LWS_TPU_RESULT_FILE when the total is n(n+1)/2.
+
+Deploy:  any LWS with  command: [python, examples/foreign_psum.py]
+(tests/test_e2e_foreign.py drives it through the real control plane).
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    # The contract, read raw from the pod environment — nothing else.
+    leader = os.environ["LWS_LEADER_ADDRESS"]
+    group_size = int(os.environ["LWS_GROUP_SIZE"])
+    worker_index = int(os.environ["LWS_WORKER_INDEX"])
+    port = os.environ.get("FOREIGN_COORD_PORT", "9911")
+
+    import jax
+
+    if plat := os.environ.get("JAX_PLATFORMS"):
+        # Site accelerator plugins may override platform selection at import;
+        # a foreign engine honors its own env the same way.
+        jax.config.update("jax_platforms", plat)
+
+    if group_size > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"{leader}:{port}",
+            num_processes=group_size,
+            process_id=worker_index,
+        )
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    n_local = jax.local_device_count()
+    local = jnp.full((n_local,), float(worker_index + 1)) / n_local
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("x")), np.asarray(local)
+    )
+    total = float(jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)[()])
+
+    expected = group_size * (group_size + 1) / 2
+    ok = abs(total - expected) < 1e-6
+    line = (
+        f"foreign process={worker_index}/{group_size} leader={leader} "
+        f"total={total} expected={expected} ok={ok}"
+    )
+    print(line, flush=True)
+    out = os.environ.get("LWS_TPU_RESULT_FILE")
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

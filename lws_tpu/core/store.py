@@ -309,15 +309,7 @@ class Store:
         store silently."""
         with self._lock:
             matches = list(self._iter_matching_locked(kind, namespace, labels))
-            if self._shared_guard:
-                for key, obj in matches:
-                    fp = self._fingerprints.get(key)
-                    if fp is not None and fp != self._fingerprint(obj):
-                        raise AssertionError(
-                            f"store corruption: shared object {key} was "
-                            f"mutated in place by a list_shared caller "
-                            f"(no-mutation contract violated)"
-                        )
+            self._verify_fingerprints_locked(k for k, _ in matches)
             out = [obj for _, obj in matches]
             out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
             return out
@@ -325,6 +317,22 @@ class Store:
     @staticmethod
     def _fingerprint(obj: TypedObject) -> int:
         return hash(repr(to_plain(obj)))
+
+    def _verify_fingerprints_locked(self, keys) -> None:
+        """Shared-read guard (LWS_TPU_STORE_DEBUG=1): fail loudly if any
+        stored object drifted from its commit-time fingerprint — i.e. a
+        list_shared/owned_by_shared caller mutated an alias in place
+        (no-mutation contract violated)."""
+        if not self._shared_guard:
+            return
+        for key in keys:
+            fp = self._fingerprints.get(key)
+            if fp is not None and fp != self._fingerprint(self._objects[key]):
+                raise AssertionError(
+                    f"store corruption: shared object {key} was mutated in "
+                    f"place by a shared-read caller (no-mutation contract "
+                    f"violated)"
+                )
 
     def _record_fingerprint(self, key: Key, obj: TypedObject) -> None:
         if self._shared_guard:
@@ -545,14 +553,7 @@ class Store:
                 for k in self._owner_index.get(owner_uid, ())
                 if k[0] == kind and k[1] == namespace and k in self._objects
             ]
-            if self._shared_guard:
-                for k in keys:
-                    fp = self._fingerprints.get(k)
-                    if fp is not None and fp != self._fingerprint(self._objects[k]):
-                        raise AssertionError(
-                            f"store corruption: shared object {k} was mutated "
-                            f"in place by a shared-read caller"
-                        )
+            self._verify_fingerprints_locked(keys)
             out = [self._objects[k] for k in keys]
         out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
         return out

@@ -220,8 +220,7 @@ class Scheduler:
             self._schedule_gang(pod.meta.namespace, gang_name)
         else:
             nodes = self._nodes()
-            bound = self._bound_pods(pod.meta.namespace)
-            node = self._feasible_node(pod, nodes, bound, extra_assigned={})
+            node = self._feasible_node(pod, nodes, extra_assigned={})
             if node is not None:
                 self._bind(pod, node)
             else:
@@ -239,7 +238,6 @@ class Scheduler:
         if not pending:
             return
         nodes = self._nodes()
-        bound = self._bound_pods(namespace)
         allowed: Optional[set[str]] = None
         members_chips = sum(p.spec.effective_tpu_chips() for p in members)
         need_chips = group.spec.min_resources.get(contract.TPU_RESOURCE_NAME, 0)
@@ -252,7 +250,7 @@ class Scheduler:
             # topology domain can RESERVE the whole group's min_resources;
             # otherwise a leader binding to a too-small slice deadlocks the
             # group (SURVEY §7 "gang admission on slices").
-            allowed = self._reserve_for_group(group, pending[0], nodes, bound)
+            allowed = self._reserve_for_group(group, pending[0], nodes)
             if allowed is None:
                 self.recorder.event(
                     group, "Warning", "GangNotSchedulable",
@@ -265,7 +263,7 @@ class Scheduler:
         extra: dict[str, Pod] = {}
         usable = nodes if allowed is None else [n for n in nodes if n.meta.name in allowed]
         for p in sorted(pending, key=lambda p: p.meta.name):
-            node = self._feasible_node(p, usable, bound, extra_assigned=extra)
+            node = self._feasible_node(p, usable, extra_assigned=extra)
             if node is None:
                 self.recorder.event(
                     group, "Warning", "GangNotSchedulable",
@@ -283,7 +281,7 @@ class Scheduler:
             self.store.update_status(group)
 
     def _reserve_for_group(
-        self, group, sample_pod: Pod, nodes: list[Node], bound: list[Pod]
+        self, group, sample_pod: Pod, nodes: list[Node]
     ) -> Optional[set[str]]:
         """Find a topology domain whose free chips fit the whole gang's
         min_resources; returns the node names of that domain (None if no fit).
@@ -373,7 +371,6 @@ class Scheduler:
         self,
         pod: Pod,
         nodes: list[Node],
-        bound: list[Pod],
         extra_assigned: dict[str, Pod],
     ) -> Optional[Node]:
         node_by_name = {n.meta.name: n for n in nodes}
@@ -395,8 +392,11 @@ class Scheduler:
 
         def all_pods() -> list:
             if not _lazy:
+                # The namespace-filtered bound snapshot is itself O(fleet);
+                # built ONLY here so webhook-shaped placements never pay it.
                 _lazy.append(
-                    [p for p in bound if p.meta.name != pod.meta.name] + extras
+                    [p for p in self._bound_pods(pod.meta.namespace)
+                     if p.meta.name != pod.meta.name] + extras
                 )
             return _lazy[0]
 

