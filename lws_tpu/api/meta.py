@@ -36,6 +36,12 @@ class ObjectMeta:
     owner_references: list[OwnerReference] = field(default_factory=list)
     creation_timestamp: float = 0.0
     deletion_timestamp: Optional[float] = None
+    # Server-side apply field ownership (≈ metadata.managedFields, ref
+    # leaderworkerset_controller.go:375-411 fieldManager "lws" + force):
+    # field-manager name -> sorted list of leaf field paths (each a list of
+    # plain-tree keys) that manager owns. Maintained exclusively by
+    # Store.apply; plain update() preserves it.
+    managed_fields: dict[str, list[list[str]]] = field(default_factory=dict)
 
     def controller_owner(self) -> Optional[OwnerReference]:
         for ref in self.owner_references:

@@ -143,6 +143,23 @@ class RemoteClient:
 
         return self.apply(yaml.safe_dump(to_manifest(obj), sort_keys=False))
 
+    def server_side_apply(
+        self, kind: str, namespace: str, name: str, fields: dict,
+        field_manager: str, force: bool = False,
+    ) -> dict:
+        """Server-side apply: merge the partial plain field tree, claiming
+        per-field ownership under `field_manager` (Store.apply semantics;
+        409 with the conflicting fields+owners when another manager owns one
+        and force is false)."""
+        import json as _json
+        from urllib.parse import quote
+
+        q = f"fieldManager={quote(field_manager)}&force={'true' if force else 'false'}"
+        return self._request(
+            "POST", f"/apis/{kind}/{namespace}/{name}/apply?{q}",
+            _json.dumps(fields).encode(),
+        )
+
     # -- subresources ----------------------------------------------------
 
     def scale(self, namespace: str, name: str, replicas: int) -> dict:

@@ -88,7 +88,7 @@ class LWSReconciler:
         partition, replicas = self._rolling_update_parameters(
             lws, leader_gs, revision_key, lws_updated, leader_pods, gs_by_name
         )
-        self._apply_leader_groupset(lws, leader_gs, partition, replicas, revision_key)
+        self._apply_leader_groupset(lws, partition, replicas, revision_key)
         if leader_gs is None:
             self.recorder.event(lws, "Normal", "GroupsProgressing", f"Created leader groupset {lws.meta.name}")
         elif not lws_updated and partition != leader_gs.spec.update_strategy.partition:
@@ -218,7 +218,7 @@ class LWSReconciler:
 
     # ---- leader groupset construction/apply (ref :768-868) -------------
     def _apply_leader_groupset(
-        self, lws: LeaderWorkerSet, existing: Optional[GroupSet], partition: int, replicas: int, revision_key: str
+        self, lws: LeaderWorkerSet, partition: int, replicas: int, revision_key: str
     ) -> None:
         tmpl_src = (
             lws.spec.leader_worker_template.leader_template
@@ -277,30 +277,29 @@ class LWSReconciler:
         labels = {contract.SET_NAME_LABEL_KEY: lws.meta.name, contract.REVISION_LABEL_KEY: revision_key}
         gs_annotations = {contract.REPLICAS_ANNOTATION_KEY: str(lws.spec.replicas)}
 
-        if existing is None:
-            gs = GroupSet(
-                meta=new_meta(
-                    lws.meta.name, lws.meta.namespace, labels=labels, annotations=gs_annotations, owners=[lws]
-                ),
-                spec=spec,
-            )
-            self.store.create(gs)
-        else:
-            fresh = self.store.get("GroupSet", lws.meta.namespace, lws.meta.name)
-            from lws_tpu.api.meta import to_plain
+        # Server-side apply with fieldManager "lws" + force — the reference's
+        # exact write pattern (leaderworkerset_controller.go:375-411): this
+        # controller durably owns the fields it sets; an external controller
+        # can co-own DISJOINT fields of the derived groupset (its own
+        # labels/annotations) and they survive every reconcile (no whole-
+        # object clobber). apply() is a no-op when nothing changed, creates
+        # when absent, and retries rv races internally.
+        from lws_tpu.api.meta import to_plain
+        from lws_tpu.core.store import owner_ref
 
-            desired_labels = {**fresh.meta.labels, **labels}
-            desired_annotations = {**fresh.meta.annotations, **gs_annotations}
-            unchanged = (
-                to_plain(fresh.spec) == to_plain(spec)
-                and fresh.meta.labels == desired_labels
-                and fresh.meta.annotations == desired_annotations
-            )
-            if not unchanged:
-                fresh.meta.labels = desired_labels
-                fresh.meta.annotations = desired_annotations
-                fresh.spec = spec
-                self.store.update(fresh)
+        self.store.apply(
+            "GroupSet", lws.meta.namespace, lws.meta.name,
+            {
+                "meta": {
+                    "labels": labels,
+                    "annotations": gs_annotations,
+                    "owner_references": [to_plain(owner_ref(lws))],
+                },
+                "spec": to_plain(spec),
+            },
+            field_manager="lws",
+            force=True,
+        )
 
     # ---- services (ref :213-221) ---------------------------------------
     def _reconcile_headless_services(self, lws: LeaderWorkerSet) -> None:

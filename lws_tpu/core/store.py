@@ -41,6 +41,16 @@ class AdmissionError(ValueError):
     """Raised when a validating admission hook rejects a write."""
 
 
+class FieldManagerConflict(RuntimeError):
+    """Server-side apply refused: another field manager owns one of the
+    applied fields and force=False. Carries [(path, owner), ...]."""
+
+    def __init__(self, conflicts: list):
+        self.conflicts = conflicts
+        lines = ", ".join(f"{'.'.join(p)} (owned by {o!r})" for p, o in conflicts)
+        super().__init__(f"field conflicts: {lines}")
+
+
 _SCALARS = frozenset((str, int, float, bool, type(None)))
 
 
@@ -100,6 +110,50 @@ class WatchEvent:
 
 
 Key = tuple[str, str, str]  # (kind, namespace, name)
+
+
+def _flatten_leaf_paths(tree: dict, prefix: tuple = ()) -> list[tuple]:
+    """Leaf field paths of a partial plain tree: dicts recurse, everything
+    else (scalars, lists, None, empty dict) is a leaf."""
+    out: list[tuple] = []
+    for k, v in tree.items():
+        if isinstance(v, dict) and v:
+            out.extend(_flatten_leaf_paths(v, prefix + (k,)))
+        else:
+            out.append(prefix + (k,))
+    return out
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    """Recursive dict merge (overlay wins; non-dict values replace). An
+    EMPTY overlay dict replaces too — _flatten_leaf_paths treats it as a
+    leaf claim of the whole subtree, so the merge must honor the same
+    atomicity ("I want this map empty"), not silently keep old entries."""
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and v and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _remove_path(tree: dict, path: tuple) -> None:
+    """Delete the leaf at `path` (and any dict nodes it empties)."""
+    node = tree
+    parents = []
+    for k in path[:-1]:
+        nxt = node.get(k)
+        if not isinstance(nxt, dict):
+            return
+        parents.append((node, k))
+        node = nxt
+    node.pop(path[-1], None)
+    for parent, k in reversed(parents):
+        if parent[k] == {}:
+            del parent[k]
+        else:
+            break
 
 
 class Store:
@@ -432,6 +486,11 @@ class Store:
                 obj.meta.uid = current.meta.uid
                 obj.meta.creation_timestamp = current.meta.creation_timestamp
                 obj.meta.generation = current.meta.generation
+                # SSA ownership is system-managed: a plain updater that
+                # didn't carry it forward (fresh desired-state object) must
+                # not silently erase the co-ownership records.
+                if not obj.meta.managed_fields and current.meta.managed_fields:
+                    obj.meta.managed_fields = _clone(current.meta.managed_fields)
                 if self._spec_changed(current, obj):
                     obj.meta.generation += 1
             obj.meta.resource_version = next(self._rv)
@@ -529,6 +588,129 @@ class Store:
                         fn(event)
         finally:
             self._tls.draining = False
+
+    # ---- server-side apply -------------------------------------------------
+    def apply(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        fields: dict,
+        field_manager: str,
+        force: bool = False,
+    ) -> TypedObject:
+        """Server-side apply (≈ client.Patch(client.Apply) with a
+        fieldManager, ref leaderworkerset_controller.go:375-411): merge the
+        partial plain tree `fields` (to_plain shape — {"spec": {...},
+        "meta": {"labels": {...}}}) into the stored object, claiming
+        ownership of exactly the leaf paths it sets.
+
+        Semantics:
+          * a leaf owned by ANOTHER manager raises FieldManagerConflict
+            unless force=True (then ownership transfers — the reference's
+            controller pattern);
+          * a leaf this manager owned before but no longer sets is REMOVED
+            from the object (k8s SSA unset-is-delete), unless some other
+            manager also owns it;
+          * dicts merge recursively; scalars and LISTS are atomic leaves
+            (no associative-list merge keys — the repo's API lists are
+            templates/containers where replace is the useful semantic);
+          * the object is created when absent; admission/validation,
+            generation, WAL and watch events all ride the normal
+            create/update path;
+          * a no-op apply (merged tree and ownership both unchanged)
+            commits nothing — reconcilers can apply every pass without
+            churning watches.
+
+        Concurrency: optimistic retry on resource_version, like every
+        controller write."""
+        from lws_tpu.core.serialize import _registry, from_plain
+
+        cls = _registry().get(kind)
+        if cls is None:
+            raise ValueError(f"unknown kind {kind!r}")
+        new_paths = set(_flatten_leaf_paths(fields))
+        for _ in range(32):
+            current = self.try_get(kind, namespace, name)
+            if current is None:
+                base = {"meta": {"name": name, "namespace": namespace}}
+                mf: dict[str, set[tuple]] = {}
+            else:
+                base = to_plain(current)
+                mf = {m: {tuple(p) for p in ps}
+                      for m, ps in current.meta.managed_fields.items()}
+            base.pop("kind", None)
+
+            # A new leaf conflicts with another manager's leaf when the
+            # paths are equal OR one is an ancestor of the other: applying a
+            # scalar/None over a dict subtree replaces every owned leaf
+            # beneath it, and applying a dict under someone's scalar leaf
+            # replaces that leaf — shape mismatches must not bypass
+            # ownership.
+            def overlaps(a: tuple, b: tuple) -> bool:
+                n = min(len(a), len(b))
+                return a[:n] == b[:n]
+
+            conflicts = [
+                (path, owner)
+                for path in sorted(new_paths)
+                for owner, owned in mf.items()
+                if owner != field_manager and any(overlaps(path, q) for q in owned)
+            ]
+            if conflicts:
+                if not force:
+                    raise FieldManagerConflict(conflicts)
+                for path, owner in conflicts:
+                    if owner in mf:
+                        mf[owner] = {q for q in mf[owner] if not overlaps(path, q)}
+                        if not mf[owner]:
+                            del mf[owner]
+
+            # Unset-is-delete for paths this manager previously owned alone —
+            # but never an ANCESTOR of a newly-set path (removing it would
+            # delete the value just applied: {} -> {"app": "x"} refines the
+            # old leaf, it doesn't abandon it).
+            abandoned = {
+                p for p in mf.get(field_manager, set()) - new_paths
+                if not any(p == q[: len(p)] for q in new_paths)
+            }
+            # _deep_merge shallow-copies, so untouched branches would alias
+            # `base` — clone first so the removals/ownership writes below
+            # can't leak into the no-op comparison baseline.
+            merged = _deep_merge(_clone(base), fields)
+            for path in abandoned:
+                if any(path in ps for m, ps in mf.items() if m != field_manager):
+                    continue
+                _remove_path(merged, path)
+            if new_paths:
+                mf[field_manager] = set(new_paths)
+            else:
+                mf.pop(field_manager, None)
+            merged.setdefault("meta", {})["managed_fields"] = {
+                m: sorted(list(p) for p in ps) for m, ps in sorted(mf.items())
+            }
+
+            if current is not None and merged == base:
+                # Steady-state reconcile fast path: byte-identical plain
+                # trees need no decode/canonicalize round trip at all.
+                return current
+
+            obj = from_plain(cls, merged)
+            obj.kind = kind
+            # No-op detection AFTER re-decoding: the partial overlay may
+            # abbreviate sub-objects (defaults omitted) that canonicalize
+            # to the stored form.
+            if current is not None and to_plain(obj) == to_plain(current):
+                return current  # no rv bump, no event
+            try:
+                if current is None:
+                    return self.create(obj)
+                obj.meta.resource_version = current.meta.resource_version
+                obj.meta.uid = current.meta.uid
+                return self.update(obj)
+            except (ConflictError, AlreadyExistsError):
+                continue  # raced another writer: re-read and re-merge
+        raise ConflictError(f"apply of {kind}/{namespace}/{name} kept racing")
 
     # ---- convenience -------------------------------------------------------
     def owned_by(self, kind: str, namespace: str, owner_uid: str) -> list[TypedObject]:

@@ -27,6 +27,7 @@ from lws_tpu.core.store import (
     AdmissionError,
     AlreadyExistsError,
     ConflictError,
+    FieldManagerConflict,
     NotFoundError,
 )
 from lws_tpu.manifest import from_manifest, to_manifest
@@ -272,7 +273,33 @@ class ApiServer:
                 path = self.path.split("?", 1)[0]
                 parts = [p for p in path.split("/") if p]
                 try:
-                    if parts[:1] == ["apply"]:
+                    if (len(parts) == 5 and parts[0] == "apis"
+                            and parts[4] == "apply"):
+                        # Server-side apply (k8s PATCH application/apply-patch
+                        # analog): body = partial plain field tree; query
+                        # carries fieldManager + force. 409 on field
+                        # conflicts so clients can distinguish them from rv
+                        # races.
+                        from urllib.parse import parse_qs
+
+                        q = (parse_qs(self.path.split("?", 1)[1])
+                             if "?" in self.path else {})
+                        manager = (q.get("fieldManager") or ["default"])[0]
+                        force = (q.get("force") or ["false"])[0].lower() == "true"
+                        try:
+                            stored = cp.store.apply(
+                                _kind(parts[1]), parts[2], parts[3],
+                                json.loads(body), field_manager=manager,
+                                force=force,
+                            )
+                        except FieldManagerConflict as e:
+                            self._json(409, {"error": str(e), "conflicts": [
+                                {"field": ".".join(pth), "manager": owner}
+                                for pth, owner in e.conflicts
+                            ]})
+                            return
+                        self._json(200, to_manifest(stored))
+                    elif parts[:1] == ["apply"]:
                         import yaml
 
                         applied = []
