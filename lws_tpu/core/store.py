@@ -138,6 +138,42 @@ def _deep_merge(base: dict, overlay: dict) -> dict:
     return out
 
 
+def _reject_null_containers(x, path: tuple = ()) -> None:
+    """AdmissionError when a decoded apply result carries None where the
+    dataclass declares a container default (labels, annotations, containers,
+    ...): from_plain materializes {\"labels\": null} as labels=None, which
+    would commit and then crash the label indexer MID-WRITE — validate
+    before anything becomes visible."""
+    import dataclasses as _dc
+
+    if _dc.is_dataclass(x) and not isinstance(x, type):
+        for f in _dc.fields(x):
+            v = getattr(x, f.name)
+            if v is None and f.default_factory is not _dc.MISSING:  # type: ignore[misc]
+                raise AdmissionError(
+                    f"field {'.'.join(path + (f.name,))} may not be null"
+                )
+            _reject_null_containers(v, path + (f.name,))
+    elif isinstance(x, (list, tuple)):
+        for i, v in enumerate(x):
+            _reject_null_containers(v, path + (str(i),))
+    elif isinstance(x, dict):
+        for k, v in x.items():
+            _reject_null_containers(v, path + (str(k),))
+
+
+def _overlay_matches(base, overlay) -> bool:
+    """True when every leaf of `overlay` already equals the value in `base`
+    (dicts recurse; anything else compares directly) — the steady-state
+    reconcile pre-check that makes a no-op apply cost one tree walk."""
+    if isinstance(overlay, dict) and overlay:
+        if not isinstance(base, dict):
+            return False
+        return all(k in base and _overlay_matches(base[k], v)
+                   for k, v in overlay.items())
+    return base == overlay
+
+
 def _remove_path(tree: dict, path: tuple) -> None:
     """Delete the leaf at `path` (and any dict nodes it empties)."""
     node = tree
@@ -641,6 +677,16 @@ class Store:
                       for m, ps in current.meta.managed_fields.items()}
             base.pop("kind", None)
 
+            # Steady-state fast path (the reconcile hot loop): when this
+            # manager already owns exactly these paths and every applied
+            # value equals the stored one, nothing can change — skip the
+            # clone/merge/decode entirely. (Overlapping ownership between
+            # managers can't exist: conflicts transfer it atomically.)
+            if (current is not None
+                    and mf.get(field_manager, set()) == new_paths
+                    and _overlay_matches(base, fields)):
+                return current
+
             # A new leaf conflicts with another manager's leaf when the
             # paths are equal OR one is an ancestor of the other: applying a
             # scalar/None over a dict subtree replaces every owned leaf
@@ -697,6 +743,10 @@ class Store:
 
             obj = from_plain(cls, merged)
             obj.kind = kind
+            # Nulls where the schema declares containers would commit and
+            # then crash the indexers mid-write — reject before anything
+            # becomes visible (maps to HTTP 400).
+            _reject_null_containers(obj)
             # No-op detection AFTER re-decoding: the partial overlay may
             # abbreviate sub-objects (defaults omitted) that canonicalize
             # to the stored form.
@@ -708,8 +758,11 @@ class Store:
                 obj.meta.resource_version = current.meta.resource_version
                 obj.meta.uid = current.meta.uid
                 return self.update(obj)
-            except (ConflictError, AlreadyExistsError):
-                continue  # raced another writer: re-read and re-merge
+            except (ConflictError, AlreadyExistsError, NotFoundError):
+                # Raced another writer — or a cascade DELETED the object
+                # between read and write (the LWS-teardown race): re-read
+                # and re-merge; the create branch handles the latter.
+                continue
         raise ConflictError(f"apply of {kind}/{namespace}/{name} kept racing")
 
     # ---- convenience -------------------------------------------------------

@@ -166,6 +166,81 @@ def test_external_manager_coowns_controller_derived_groupset():
         )
 
 
+def test_null_for_container_field_is_rejected_before_commit():
+    """{\"meta\": {\"labels\": null}} must 400, not commit labels=None and
+    crash the label indexer mid-write (store-corruption regression)."""
+    from lws_tpu.core.store import AdmissionError
+
+    s = Store()
+    s.apply("LeaderWorkerSet", "default", "demo",
+            {"spec": {"replicas": 1, "leader_worker_template": TMPL}},
+            field_manager="a")
+    rv = s.get("LeaderWorkerSet", "default", "demo").meta.resource_version
+    with pytest.raises(AdmissionError):
+        s.apply("LeaderWorkerSet", "default", "demo",
+                {"meta": {"labels": None}}, field_manager="a", force=True)
+    obj = s.get("LeaderWorkerSet", "default", "demo")
+    assert obj.meta.resource_version == rv  # nothing committed
+    assert isinstance(obj.meta.labels, dict)
+
+
+def test_apply_survives_concurrent_delete():
+    """A delete landing between apply's read and write must re-enter the
+    loop and take the create branch, not escape as NotFoundError (the
+    LWS-teardown race against the reconciler's own apply)."""
+    s = Store()
+    fields = {"spec": {"replicas": 1, "leader_worker_template": TMPL}}
+    s.apply("LeaderWorkerSet", "default", "demo", fields, field_manager="a")
+
+    real_update = s.update
+    state = {"deleted": False}
+
+    def delete_then_update(obj):
+        if not state["deleted"]:
+            state["deleted"] = True
+            s.delete("LeaderWorkerSet", "default", "demo")
+        return real_update(obj)
+
+    s.update = delete_then_update
+    try:
+        obj = s.apply("LeaderWorkerSet", "default", "demo",
+                      {"spec": {"replicas": 2, "leader_worker_template": TMPL}},
+                      field_manager="a")
+    finally:
+        s.update = real_update
+    assert obj.spec.replicas == 2  # recreated through the create branch
+
+
+def test_managed_fields_survive_wal_failover(tmp_path):
+    """SSA ownership is cluster state: after a kill -9 and WAL replay on a
+    fresh store, the co-ownership records (and so conflict protection) must
+    be exactly what was acknowledged before the crash."""
+    from lws_tpu.core.wal import StateDir
+
+    store = Store()
+    sd = StateDir(str(tmp_path))
+    sd.acquire()
+    sd.attach(store)
+    store.apply("LeaderWorkerSet", "default", "demo",
+                {"spec": {"replicas": 3, "leader_worker_template": TMPL}},
+                field_manager="a")
+    store.apply("LeaderWorkerSet", "default", "demo",
+                {"meta": {"annotations": {"team": "ml"}}}, field_manager="ext")
+    sd.close()
+
+    store2 = Store()
+    sd2 = StateDir(str(tmp_path))
+    sd2.acquire()
+    sd2.attach(store2)
+    obj = store2.get("LeaderWorkerSet", "default", "demo")
+    assert ["spec", "replicas"] in obj.meta.managed_fields["a"]
+    assert ["meta", "annotations", "team"] in obj.meta.managed_fields["ext"]
+    with pytest.raises(FieldManagerConflict):
+        store2.apply("LeaderWorkerSet", "default", "demo",
+                     {"spec": {"replicas": 9}}, field_manager="b")
+    sd2.close()
+
+
 def test_http_apply_roundtrip_and_409(tmp_path):
     from lws_tpu.client import ApiError, RemoteClient
     from lws_tpu.runtime.server import ApiServer
