@@ -1,0 +1,144 @@
+"""MoE (Mixtral-class) serving: the GShard dense-dispatch model serves
+through both engines, composed with everything the dense path has.
+
+The MoE forward was train-tested since round 1 (tests/test_model.py) but no
+serving path ever pinned it: these tests cross-check the two engines
+against each other (independent cache layouts — dense [B,S] vs paged block
+pool — agreeing on every token is a strong exactness signal) and compose
+MoE with chunked admission, speculative drain, int8 weights, and a tp mesh
+(experts ride the 'tp' axis — expert parallelism, models/llama.py ep).
+
+Also home to the dense spec-decode x prefix-cache composition test — the
+one engine-feature pairing its sibling suites (test_paged_speculative,
+test_prefix_cache) don't cover."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lws_tpu.models.llama import LlamaConfig, init_params
+from lws_tpu.serving import Engine
+from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+
+def moe_cfg(**kw):
+    return LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, n_experts=4, top_k=2, max_seq_len=256,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = moe_cfg()
+    return cfg, init_params(cfg, jax.random.key(0))
+
+
+def paged_results(cfg, params, prompts, max_new=12, **kw):
+    spec = kw.pop("speculative", False)
+    eng = PagedBatchEngine(cfg, params, slots=4, max_len=256, block_size=16, **kw)
+    rids = []
+    for p in prompts:
+        rids.append(eng.submit(p, max_new_tokens=max_new))
+        eng.step_n(2)
+    if spec:
+        eng.run_until_drained_speculative(gamma=4)
+    else:
+        eng.run_until_drained()
+    return [eng.result(r) for r in rids]
+
+
+def test_moe_engines_agree(setup):
+    """Plain Engine and PagedBatchEngine greedy trajectories must be
+    identical for the MoE model (independent cache layouts agreeing)."""
+    cfg, params = setup
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, 120, size=12).astype(np.int32)
+
+    plain = Engine(cfg, params, batch_size=1, max_len=256)
+    want = np.asarray(plain.generate(prompt.reshape(1, -1), max_new_tokens=12).tokens)[0]
+
+    got = paged_results(cfg, params, [prompt])[0]
+    assert list(want) == got, (list(want), got)
+
+
+def test_moe_chunked_and_speculative_compose(setup):
+    cfg, params = setup
+    rng = np.random.RandomState(1)
+    pat = rng.randint(1, 120, size=8).astype(np.int32)
+    prompts = [np.tile(pat, 6), rng.randint(1, 120, size=40).astype(np.int32)]
+
+    base = paged_results(cfg, params, prompts)
+    chunked_spec = paged_results(
+        cfg, params, prompts,
+        prefill_chunk=16, interleave_steps=2, speculative=True,
+    )
+    assert base == chunked_spec
+
+
+def test_moe_int8_weights_serve(setup):
+    """quantize_params covers the expert tensors ([L,E,D,F] with [L,E,F]
+    scales); the quantized MoE model must serve and agree across engines."""
+    cfg, params = setup
+    from lws_tpu.models.quant import quantize_params
+
+    qparams = quantize_params(params)
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(1, 120, size=10).astype(np.int32)
+    plain = Engine(cfg, qparams, batch_size=1, max_len=256)
+    want = np.asarray(plain.generate(prompt.reshape(1, -1), max_new_tokens=8).tokens)[0]
+    got = paged_results(cfg, qparams, [prompt], max_new=8)[0]
+    assert list(want) == got
+
+
+def test_moe_tp_mesh_expert_parallel(setup):
+    """Experts shard over 'tp' (expert parallelism): the tp=2 engine must
+    produce the single-device trajectory exactly."""
+    cfg, params = setup
+    from lws_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=1, pp=1, cp=1, tp=2), jax.devices()[:2])
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(1, 120, size=10).astype(np.int32)
+    want = paged_results(cfg, params, [prompt], max_new=8)[0]
+    got = paged_results(cfg, params, [prompt], max_new=8, mesh=mesh)[0]
+    assert want == got
+
+
+def test_spec_decode_with_prefix_cache():
+    """Speculative drain on a prefix-cache engine: draft K/V writes land at
+    pos >= prompt length, i.e. always in PRIVATE blocks — shared prefix
+    blocks must come through byte-stable (token-exactness vs the
+    non-speculative prefix-cache engine proves it)."""
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=256, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = init_params(cfg, jax.random.key(0))
+    rng = np.random.RandomState(4)
+    base = rng.randint(1, 60, size=48).astype(np.int32)
+    prompts = [
+        np.concatenate([base, rng.randint(1, 60, size=5).astype(np.int32)])
+        for _ in range(3)
+    ]
+
+    def run(spec):
+        eng = PagedBatchEngine(cfg, params, slots=4, max_len=256, block_size=16,
+                               prefix_cache=True)
+        rids = []
+        for p in prompts:
+            rids.append(eng.submit(p, max_new_tokens=16))
+            eng.step_n(2)
+        if spec:
+            eng.run_until_drained_speculative(gamma=4)
+        else:
+            eng.run_until_drained()
+        return [eng.result(r) for r in rids], dict(eng.stats_prefix)
+
+    want, p0 = run(False)
+    got, p1 = run(True)
+    assert want == got
+    assert p1["hit_tokens"] == p0["hit_tokens"] > 0
