@@ -7,6 +7,7 @@ headless service -> status/conditions -> truncate revisions when done.
 
 from __future__ import annotations
 
+import collections
 from dataclasses import dataclass
 from typing import Optional
 
@@ -47,6 +48,37 @@ class LWSReconciler:
     def __init__(self, store: Store, recorder: EventRecorder) -> None:
         self.store = store
         self.recorder = recorder
+        # Per-replica (ready, updated) memo keyed by leader-pod identity and
+        # invalidated by (pod rv, worker-gs rv, revision key): the status
+        # pass runs on EVERY LWS requeue — O(fleet) events per rollout, each
+        # paying an O(replicas) recompute, i.e. O(fleet^2) total. The flags
+        # are pure functions of the two objects (rv changes iff content
+        # changes), so unchanged replicas become two dict hits. Bounded LRU
+        # (informer-cache semantics, like the scheduler's indexes).
+        self._replica_memo: collections.OrderedDict = collections.OrderedDict()
+
+    def _replica_flags(self, namespace: str, pod, gs, revision_key: str,
+                       no_worker_gs: bool) -> tuple[bool, bool]:
+        key = (namespace, pod.meta.name)
+        gs_rv = None if no_worker_gs else gs.meta.resource_version
+        memo = self._replica_memo.get(key)
+        if (memo is not None and memo[0] == pod.meta.resource_version
+                and memo[1] == gs_rv and memo[2] == revision_key):
+            self._replica_memo.move_to_end(key)
+            return memo[3], memo[4]
+        ready = (
+            (no_worker_gs or groupset_ready(gs)) and pod_running_and_ready(pod)
+        )
+        updated = (
+            (no_worker_gs or revisionutils.get_revision_key(gs) == revision_key)
+            and revisionutils.get_revision_key(pod) == revision_key
+        )
+        self._replica_memo[key] = (
+            pod.meta.resource_version, gs_rv, revision_key, ready, updated
+        )
+        while len(self._replica_memo) > 65536:
+            self._replica_memo.popitem(last=False)
+        return ready, updated
 
     # ------------------------------------------------------------------
     def reconcile(self, key: Key) -> Result | None:
@@ -206,14 +238,10 @@ class LWSReconciler:
             ):
                 states.append(ReplicaState(False, False))
                 continue
-            leader_updated = revisionutils.get_revision_key(pod) == revision_key
-            leader_ready = pod_running_and_ready(pod)
-            if no_worker_gs:
-                states.append(ReplicaState(leader_ready, leader_updated))
-                continue
-            workers_updated = revisionutils.get_revision_key(gs) == revision_key
-            workers_ready = groupset_ready(gs)
-            states.append(ReplicaState(leader_ready and workers_ready, leader_updated and workers_updated))
+            ready, updated = self._replica_flags(
+                lws.meta.namespace, pod, gs, revision_key, no_worker_gs
+            )
+            states.append(ReplicaState(ready, updated))
         return states
 
     # ---- leader groupset construction/apply (ref :768-868) -------------
@@ -378,14 +406,12 @@ class LWSReconciler:
                     continue
             if index < replicas and index >= lws_partition:
                 part_current_non_burst += 1
-            ready = updated = False
-            if (no_worker_gs or groupset_ready(gs)) and pod_running_and_ready(pod):
-                ready = True
+            ready, updated = self._replica_flags(
+                lws.meta.namespace, pod, gs, revision_key, no_worker_gs
+            )
+            if ready:
                 ready_count += 1
-            if (no_worker_gs or revisionutils.get_revision_key(gs) == revision_key) and (
-                revisionutils.get_revision_key(pod) == revision_key
-            ):
-                updated = True
+            if updated:
                 updated_count += 1
                 if index < replicas and index >= lws_partition:
                     part_updated_non_burst += 1
