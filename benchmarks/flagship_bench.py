@@ -193,11 +193,37 @@ def main() -> None:
           f"{time.perf_counter()-t0:.1f}s "
           f"({quantized_bytes(params)/1e9:.2f} GB)", file=sys.stderr)
 
+    bf16_gb = cfg.n_params() * 2 / 1e9
+
+    def write_partial(rows, note=""):
+        # Incremental artifact: the orchestrator's hard timeout can SIGKILL
+        # this stage mid-run (8B compiles are the slowest thing this repo
+        # does); whatever rows exist must already be on disk or a
+        # slow-but-working window records NOTHING.
+        _write_artifact(artifact_path, {
+            "rows": rows,
+            "memory_plan": memory_plan(cfg, params, slots, budget),
+            "int8w_verdict_at_scale": (
+                f"bf16 weights would be {bf16_gb:.1f} GB — larger than the "
+                f"{HBM_GB:.0f} GB chip; at flagship scale int8w wins by "
+                f"feasibility, not by race"
+            ),
+            "on_chip": on_chip,
+            "scale": scale,
+            "acceptance": "headline vs_baseline >= 0.80 of the int8-adjusted "
+                          "roofline; paged slots > dense_feasible_slots_bf16kv",
+            **({"note": note} if note else {}),
+        })
+
     rows = []
+    # First write happens only once a row EXISTS: a stage-start write would
+    # clobber a previously recorded complete artifact if this re-run dies
+    # during the multi-minute 8B compile.
     headline = plain_engine_row(cfg, params, batch, prompt_len, max_len,
                                 decode_steps, gen)
     rows.append(headline)
     print(json.dumps(headline), flush=True)
+    write_partial(rows, note="paged row pending")
     if on_chip:
         bench._save_last_good("flagship", headline)
 
@@ -215,21 +241,7 @@ def main() -> None:
     if on_chip and "value" in prow:
         bench._save_last_good("flagship_paged", prow)
 
-    bf16_gb = cfg.n_params() * 2 / 1e9
-    artifact = {
-        "rows": rows,
-        "memory_plan": memory_plan(cfg, params, slots, budget),
-        "int8w_verdict_at_scale": (
-            f"bf16 weights would be {bf16_gb:.1f} GB — larger than the "
-            f"{HBM_GB:.0f} GB chip; at flagship scale int8w wins by "
-            f"feasibility, not by race"
-        ),
-        "on_chip": on_chip,
-        "scale": scale,
-        "acceptance": "headline vs_baseline >= 0.80 of the int8-adjusted "
-                      "roofline; paged slots > dense_feasible_slots_bf16kv",
-    }
-    _write_artifact(artifact_path, artifact)
+    write_partial(rows)  # complete
     print(json.dumps(headline), flush=True)  # last line = the record
 
 
