@@ -1,4 +1,4 @@
-.PHONY: test lint check native bench clean
+.PHONY: test lint metrics-catalogue check native bench bench-trace-overhead clean
 
 test:
 	python -m pytest tests/ -q
@@ -6,7 +6,10 @@ test:
 lint:  ## self-contained linter (ref parity: golangci-lint in Makefile:152-198)
 	python tools/lint.py
 
-check: lint test  ## what CI would run
+metrics-catalogue:  ## every metric/span name in source must be in docs/observability.md
+	python tools/check_metrics_catalogue.py
+
+check: lint metrics-catalogue test  ## what CI would run
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py
@@ -19,6 +22,9 @@ bench-control-plane:
 
 bench-density:
 	python benchmarks/serving_density_bench.py
+
+bench-trace-overhead:  ## <2% tracing overhead on the paged decode loop
+	python benchmarks/trace_overhead_bench.py
 
 clean:
 	rm -f lws_tpu/core/_fastclone*.so

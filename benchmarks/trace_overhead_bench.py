@@ -1,0 +1,108 @@
+"""Trace-overhead microbench: the always-on guarantee for the span spine.
+
+Tracing is only allowed to stay on in the serving hot path if it is nearly
+free — the acceptance line is <2% slowdown on the paged decode loop with
+tracing ENABLED at default sampling versus disabled (ISSUE 2). This drives
+the exact hot path step_n instruments (one span + one histogram observation
+per DISPATCH, never per token) on a smoke-scale PagedBatchEngine and prints
+one JSON line per mode plus the verdict.
+
+Run directly:  python benchmarks/trace_overhead_bench.py [--rounds 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from lws_tpu.core import trace  # noqa: E402
+from lws_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from lws_tpu.serving.paged_engine import PagedBatchEngine  # noqa: E402
+
+
+def build_engine():
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=256, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return cfg, params
+
+
+def interleaved_samples(engine, dispatches: int) -> dict:
+    """Per-dispatch wall times with tracing toggled EVERY OTHER dispatch —
+    thermal/load drift over the run hits both modes identically, so the
+    medians isolate the span cost itself (mode-per-block segments drifted
+    by several % on a loaded box; the true span cost is ~10us/dispatch).
+    step_n(1) maximizes per-dispatch span visibility."""
+    sinks = {"on": [], "off": []}
+    for i in range(dispatches * 2):
+        mode = "on" if i % 2 == 0 else "off"
+        trace.TRACER.enabled = mode == "on"
+        t0 = time.perf_counter()
+        executed = engine.step_n(1)
+        sinks[mode].append(time.perf_counter() - t0)
+        assert executed == 1, "engine drained mid-run; shrink --steps"
+    return sinks
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--rounds", type=int, default=6)
+    parser.add_argument("--budget-pct", type=float, default=2.0)
+    args = parser.parse_args()
+
+    cfg, params = build_engine()
+    # ONE engine, one warm compile, modes interleaved per dispatch.
+    engine = PagedBatchEngine(cfg, params, slots=8, max_len=2048, block_size=16)
+    dispatches = args.rounds * args.steps
+    budget = 2 * dispatches + 8
+    r = np.random.RandomState(0)
+    for _ in range(engine.slots):
+        engine.submit(r.randint(1, 255, size=24).astype(np.int32), budget)
+    trace.TRACER.sample_rate = 1.0
+    engine.step_n(1)  # compile outside every timed window
+    samples = interleaved_samples(engine, dispatches)
+    trace.TRACER.enabled = True
+
+    def median(xs: list) -> float:
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    med = {mode: median(xs) for mode, xs in samples.items()}
+    overhead_pct = (med["on"] - med["off"]) / med["off"] * 100.0
+    for mode in ("off", "on"):
+        print(json.dumps({
+            "metric": f"paged decode loop, tracing {mode}",
+            "dispatches": len(samples[mode]),
+            "value": round(engine.slots / med[mode], 1),
+            "unit": "tok/s (median dispatch)",
+        }))
+    verdict = {
+        "metric": "trace overhead on paged decode loop",
+        "value": round(overhead_pct, 3),
+        "unit": "%",
+        "budget_pct": args.budget_pct,
+        "within_budget": overhead_pct < args.budget_pct,
+    }
+    print(json.dumps(verdict))
+    return 0 if verdict["within_budget"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

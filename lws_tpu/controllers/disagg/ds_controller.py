@@ -14,6 +14,7 @@ from lws_tpu.controllers.disagg import utils as dsutils
 from lws_tpu.controllers.disagg.executor import RollingUpdateExecutor
 from lws_tpu.controllers.disagg.lws_manager import LWSManager
 from lws_tpu.controllers.disagg.service_manager import ServiceManager
+from lws_tpu.core import trace
 from lws_tpu.core.events import EventRecorder
 from lws_tpu.core.manager import Result
 from lws_tpu.core.store import Key, Store
@@ -50,16 +51,23 @@ class DSReconciler:
             total_old = sum(
                 old_revisions.total_replicas_for_role(role) for role in dsutils.get_role_names(ds)
             )
-            if old_revisions and total_old > 0:
-                self.executor.reconcile(ds, slice_idx, revision, old_revisions, new_revision)
-            else:
-                self._reconcile_simple(ds, slice_idx, revision)
+            with trace.span(
+                "reconcile.rollout_step", slice=slice_idx, revision=revision
+            ) as step_span:
+                if old_revisions and total_old > 0:
+                    step_span.set(path="rolling", old_replicas=total_old)
+                    self.executor.reconcile(ds, slice_idx, revision, old_revisions, new_revision)
+                else:
+                    step_span.set(path="simple")
+                    self._reconcile_simple(ds, slice_idx, revision)
 
-            slice_lws = self.lws_manager.list(ds.meta.namespace, ds.meta.name, slice_idx=slice_idx)
-            revision_roles = dsutils.group_by_revision(slice_lws)
-            self.service_manager.reconcile_services(ds, slice_idx, revision_roles, revision)
+            with trace.span("reconcile.placement", slice=slice_idx):
+                slice_lws = self.lws_manager.list(ds.meta.namespace, ds.meta.name, slice_idx=slice_idx)
+                revision_roles = dsutils.group_by_revision(slice_lws)
+                self.service_manager.reconcile_services(ds, slice_idx, revision_roles, revision)
 
-        self._update_status(ds, self.lws_manager.list(ds.meta.namespace, ds.meta.name), revision)
+        with trace.span("reconcile.status"):
+            self._update_status(ds, self.lws_manager.list(ds.meta.namespace, ds.meta.name), revision)
         return None
 
     # ---- slice scale-down (KEP-846: plain deletion, no drain — slices are

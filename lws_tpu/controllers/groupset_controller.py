@@ -14,6 +14,7 @@ from lws_tpu.api.groupset import GroupSet, parent_name_and_ordinal
 from lws_tpu.api.pod import Pod, PodPhase, PodSpec, PodTemplateSpec
 from lws_tpu.utils.common import stable_hash
 from lws_tpu.api.pvc import PersistentVolumeClaim, PVCSpec
+from lws_tpu.core import trace
 from lws_tpu.core.events import EventRecorder
 from lws_tpu.core.manager import Result
 from lws_tpu.core.store import clone_object, Key, Store, new_meta
@@ -52,13 +53,17 @@ class GroupSetReconciler:
         }
         want = set(gs.ordinals())
 
-        # Scale down: remove pods outside the ordinal range (highest first).
-        for ordinal in sorted(set(pods) - want, reverse=True):
-            self._delete_pod(gs, pods.pop(ordinal), scale_down=True)
+        placement = trace.span(
+            "reconcile.placement", revision=update_revision, want=len(want)
+        )
+        with placement:
+            # Scale down: remove pods outside the ordinal range (highest first).
+            for ordinal in sorted(set(pods) - want, reverse=True):
+                self._delete_pod(gs, pods.pop(ordinal), scale_down=True)
 
-        # Create missing pods (parallel pod management: all at once).
-        for ordinal in sorted(want - set(pods)):
-            pods[ordinal] = self._create_pod(gs, ordinal, update_revision)
+            # Create missing pods (parallel pod management: all at once).
+            for ordinal in sorted(want - set(pods)):
+                pods[ordinal] = self._create_pod(gs, ordinal, update_revision)
 
         # Rolling update: recreate old-revision pods with ordinal >= partition,
         # highest ordinal first, within the unavailability budget. Deleting a
@@ -75,53 +80,58 @@ class GroupSetReconciler:
                 and pod.meta.labels.get(contract.GROUPSET_POD_REVISION_LABEL_KEY) != update_revision
             )
 
-        unavailable_non_candidates = sum(
-            1
-            for ordinal, p in pods.items()
-            if not pod_available(p) and not is_candidate(ordinal, p)
-        )
-        budget = max_unavailable - unavailable_non_candidates
-        for ordinal in sorted(want, reverse=True):
-            pod = pods.get(ordinal)
-            if pod is None or not is_candidate(ordinal, pod):
-                continue
-            if pod_available(pod):
-                if budget <= 0:
+        with trace.span("reconcile.rollout_step", partition=partition) as step_span:
+            unavailable_non_candidates = sum(
+                1
+                for ordinal, p in pods.items()
+                if not pod_available(p) and not is_candidate(ordinal, p)
+            )
+            budget = max_unavailable - unavailable_non_candidates
+            recreated = 0
+            for ordinal in sorted(want, reverse=True):
+                pod = pods.get(ordinal)
+                if pod is None or not is_candidate(ordinal, pod):
                     continue
-                budget -= 1
-            self._delete_pod(gs, pod, scale_down=False)
-            del pods[ordinal]
+                if pod_available(pod):
+                    if budget <= 0:
+                        continue
+                    budget -= 1
+                self._delete_pod(gs, pod, scale_down=False)
+                del pods[ordinal]
+                recreated += 1
+            step_span.set(recreated=recreated)
 
         # Status.
-        ready = sum(1 for p in pods.values() if pod_available(p))
-        updated = sum(
-            1
-            for p in pods.values()
-            if p.meta.labels.get(contract.GROUPSET_POD_REVISION_LABEL_KEY) == update_revision
-        )
-        current = self.store.get("GroupSet", gs.meta.namespace, gs.meta.name)
-        status = current.status
-        changed = (
-            status.replicas != len(pods)
-            or status.ready_replicas != ready
-            or status.available_replicas != ready
-            or status.updated_replicas != updated
-            or status.update_revision != update_revision
-        )
-        status.replicas = len(pods)
-        status.ready_replicas = ready
-        status.available_replicas = ready
-        status.updated_replicas = updated
-        status.update_revision = update_revision
-        if updated == gs.spec.replicas and len(pods) == gs.spec.replicas:
-            if status.current_revision != update_revision:
+        with trace.span("reconcile.status"):
+            ready = sum(1 for p in pods.values() if pod_available(p))
+            updated = sum(
+                1
+                for p in pods.values()
+                if p.meta.labels.get(contract.GROUPSET_POD_REVISION_LABEL_KEY) == update_revision
+            )
+            current = self.store.get("GroupSet", gs.meta.namespace, gs.meta.name)
+            status = current.status
+            changed = (
+                status.replicas != len(pods)
+                or status.ready_replicas != ready
+                or status.available_replicas != ready
+                or status.updated_replicas != updated
+                or status.update_revision != update_revision
+            )
+            status.replicas = len(pods)
+            status.ready_replicas = ready
+            status.available_replicas = ready
+            status.updated_replicas = updated
+            status.update_revision = update_revision
+            if updated == gs.spec.replicas and len(pods) == gs.spec.replicas:
+                if status.current_revision != update_revision:
+                    status.current_revision = update_revision
+                    changed = True
+            elif not status.current_revision:
                 status.current_revision = update_revision
                 changed = True
-        elif not status.current_revision:
-            status.current_revision = update_revision
-            changed = True
-        if changed:
-            self.store.update_status(current)
+            if changed:
+                self.store.update_status(current)
         return None
 
     # ------------------------------------------------------------------

@@ -26,6 +26,7 @@ from lws_tpu.api.types import (
     SubdomainPolicy,
     SubGroupPolicyType,
 )
+from lws_tpu.core import metrics as metricsmod, trace
 from lws_tpu.core.events import EventRecorder
 from lws_tpu.core.manager import Result
 from lws_tpu.core.store import clone_object, Key, Store, new_meta
@@ -45,9 +46,12 @@ class ReplicaState:
 class LWSReconciler:
     name = "lws"
 
-    def __init__(self, store: Store, recorder: EventRecorder) -> None:
+    def __init__(self, store: Store, recorder: EventRecorder, metrics=None) -> None:
         self.store = store
         self.recorder = recorder
+        # Rollout-progress gauge sink (default: the process registry; the
+        # harness passes its per-control-plane registry).
+        self.metrics = metrics if metrics is not None else metricsmod.REGISTRY
         # Per-replica (ready, updated) memo keyed by leader-pod identity and
         # invalidated by (pod rv, worker-gs rv, revision key): the status
         # pass runs on EVERY LWS requeue — O(fleet) events per rollout, each
@@ -117,18 +121,22 @@ class LWSReconciler:
             )
         revision_key = revisionutils.get_revision_key(revision)
 
-        partition, replicas = self._rolling_update_parameters(
-            lws, leader_gs, revision_key, lws_updated, leader_pods, gs_by_name
-        )
-        self._apply_leader_groupset(lws, partition, replicas, revision_key)
-        if leader_gs is None:
-            self.recorder.event(lws, "Normal", "GroupsProgressing", f"Created leader groupset {lws.meta.name}")
-        elif not lws_updated and partition != leader_gs.spec.update_strategy.partition:
-            self.recorder.event(lws, "Normal", "GroupsUpdating", f"Updating partition to {partition}")
+        with trace.span("reconcile.rollout_step", revision=revision_key) as sp:
+            partition, replicas = self._rolling_update_parameters(
+                lws, leader_gs, revision_key, lws_updated, leader_pods, gs_by_name
+            )
+            sp.set(partition=partition, replicas=replicas)
+        with trace.span("reconcile.placement"):
+            self._apply_leader_groupset(lws, partition, replicas, revision_key)
+            if leader_gs is None:
+                self.recorder.event(lws, "Normal", "GroupsProgressing", f"Created leader groupset {lws.meta.name}")
+            elif not lws_updated and partition != leader_gs.spec.update_strategy.partition:
+                self.recorder.event(lws, "Normal", "GroupsUpdating", f"Updating partition to {partition}")
 
-        self._reconcile_headless_services(lws)
+            self._reconcile_headless_services(lws)
 
-        update_done = self._update_status(lws, revision_key, leader_pods, gs_by_name)
+        with trace.span("reconcile.status"):
+            update_done = self._update_status(lws, revision_key, leader_pods, gs_by_name)
         if update_done:
             revisionutils.truncate_revisions(self.store, lws, revision_key)
         return None
@@ -443,6 +451,20 @@ class LWSReconciler:
             conditions.append(make_condition(CONDITION_AVAILABLE))
         else:
             conditions.append(make_condition(CONDITION_PROGRESSING))
+
+        # Rollout progress gauge: fraction of desired groups already on the
+        # target revision — the "why did the 512-group rollout stall?"
+        # signal, scrape-able instead of derived from bench timers. Exactly
+        # ONE series per LWS: superseded revisions' series retire here (a
+        # stale series would misreport a stalled rollout forever AND leak
+        # label-cardinality slots across revision churn).
+        lws_label = f"{lws.meta.namespace}/{lws.meta.name}"
+        self.metrics.clear_gauge("lws_rollout_progress", {"lws": lws_label})
+        self.metrics.set(
+            "lws_rollout_progress",
+            updated_count / replicas if replicas else 1.0,
+            {"lws": lws_label, "revision": revision_key},
+        )
 
         update_done = lws_partition == 0 and part_updated_and_ready == replicas
         cond_changed = set_conditions(lws, conditions)

@@ -8,3 +8,4 @@ from lws_tpu.core.store import AdmissionError, ConflictError, NotFoundError, Sto
 from lws_tpu.core.manager import Manager, Reconciler, Result  # noqa: F401
 from lws_tpu.core.events import EventRecorder  # noqa: F401
 from lws_tpu.core.dns import DnsView  # noqa: F401
+from lws_tpu.core import metrics, trace  # noqa: F401

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
+from lws_tpu.core import trace
 from lws_tpu.core.store import ConflictError, Key, Store, WatchEvent
 
 
@@ -94,7 +95,11 @@ class Manager:
     def __init__(self, store: Store, metrics=None, gate=None) -> None:
         """`gate`: optional () -> bool checked before dispatching work; while
         False (e.g. a standby awaiting leader election) queued items are held,
-        not dropped. Applies to BOTH run_until_stable and threaded mode."""
+        not dropped. Applies to BOTH run_until_stable and threaded mode.
+
+        Reconcile root spans go to the PROCESS tracer (trace.TRACER) — the
+        same sink the reconcilers' child spans use; a per-manager tracer
+        would orphan every child."""
         self.store = store
         self.metrics = metrics
         self.gate = gate
@@ -104,22 +109,37 @@ class Manager:
         store.watch(self._on_event)
 
     def _timed_reconcile(self, reg: _Registration, key: Key):
-        if self.metrics is None:
-            return reg.reconciler.reconcile(key)
-        labels = {"controller": reg.reconciler.name}
-        start = time.perf_counter()
-        try:
-            result = reg.reconciler.reconcile(key)
-        except ConflictError:
-            # Benign optimistic-concurrency loss: requeued, not an error.
-            raise
-        except Exception:
-            self.metrics.inc("lws_reconcile_errors_total", labels)
-            raise
-        finally:
-            self.metrics.inc("lws_reconcile_total", labels)
-            self.metrics.observe("lws_reconcile_duration_seconds", time.perf_counter() - start, labels)
-        return result
+        # Every reconcile runs inside a root span: the controller-layer
+        # anchor of the trace spine (child spans live in the reconcilers;
+        # serving subtrees graft on via propagated span contexts).
+        name = reg.reconciler.name
+        with trace.TRACER.span(
+            "reconcile", controller=name,
+            kind=key[0], namespace=key[1], object=key[2],
+        ):
+            if self.metrics is None:
+                return reg.reconciler.reconcile(key)
+            labels = {"controller": name}
+            outcome = "success"
+            start = time.perf_counter()
+            try:
+                result = reg.reconciler.reconcile(key)
+            except ConflictError:
+                # Benign optimistic-concurrency loss: requeued, not an error.
+                outcome = "conflict"
+                raise
+            except Exception:
+                outcome = "error"
+                self.metrics.inc("lws_reconcile_errors_total", labels)
+                raise
+            finally:
+                self.metrics.inc("lws_reconcile_total", labels)
+                self.metrics.observe(
+                    "lws_reconcile_duration_seconds",
+                    time.perf_counter() - start,
+                    {"controller": name, "result": outcome},
+                )
+            return result
 
     def register(self, reconciler: Reconciler, watches: dict[str, MapFn]) -> None:
         self._registrations.append(_Registration(reconciler, watches))

@@ -1,12 +1,32 @@
-"""Minimal metrics registry with Prometheus text exposition
+"""Metrics registry with valid Prometheus text exposition
 (≈ controller-runtime's metrics server; SURVEY §5 adds reconcile latency
-metrics as the one custom signal worth having)."""
+metrics as the one custom signal worth having).
+
+Counters, gauges, and histograms, rendered with `# HELP` / `# TYPE` lines so
+a real scraper parses the output (not just grep-able text). Label-set
+cardinality is capped per metric name (replica-indexed labels at 512-group
+scale would otherwise grow the registry without bound): past the cap, new
+label sets are dropped and counted under
+`lws_metric_label_sets_dropped_total{metric}` so the loss is visible.
+
+The module-level REGISTRY (+ `inc`/`observe`/`set` helpers) is the process
+default the serving engines report into — a worker process has exactly one
+metrics surface, like the process-global trace.TRACER. The control plane
+builds its own per-instance MetricsRegistry.
+"""
 
 from __future__ import annotations
 
 import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
+
+# Exposition help text, keyed by metric name; describe() adds entries, and
+# names double as the docs-catalogue source of truth
+# (tools/check_metrics_catalogue.py cross-checks docs/observability.md).
+_HELP: dict[str, str] = {}
+
+DROPPED_METRIC = "lws_metric_label_sets_dropped_total"
 
 
 @dataclass
@@ -30,42 +50,140 @@ class _Histogram:
         self.counts[-1] += 1
 
 
+def describe(name: str, help_text: str) -> None:
+    """Register the # HELP line for a metric name (process-wide: exposition
+    text is a property of the name, not of any one registry)."""
+    _HELP[name] = help_text
+
+
 class MetricsRegistry:
-    def __init__(self) -> None:
+    def __init__(self, max_label_sets: int = 512) -> None:
+        """`max_label_sets` caps DISTINCT label sets per metric name; samples
+        for label sets past the cap are dropped and counted (see module
+        docstring) instead of growing the registry unboundedly."""
         self._lock = threading.Lock()
+        self._max_label_sets = max_label_sets
+        # Inner dicts used as ordered sets (the module-level `set` gauge
+        # helper shadows the builtin in this namespace).
+        self._label_sets: dict[str, dict] = defaultdict(dict)
         self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
+        self._gauges: dict[tuple[str, tuple], float] = {}
         self._histograms: dict[tuple[str, tuple], _Histogram] = {}
+
+    def _admit(self, name: str, labels: tuple) -> bool:
+        """Cardinality gate (caller holds the lock). Known label sets always
+        pass; new ones pass while the per-name cap has room."""
+        seen = self._label_sets[name]
+        if labels in seen:
+            return True
+        if len(seen) >= self._max_label_sets:
+            key = (DROPPED_METRIC, (("metric", name),))
+            self._counters[key] += 1.0
+            return False
+        seen[labels] = None
+        return True
 
     def inc(self, name: str, labels: dict[str, str] | None = None, value: float = 1.0) -> None:
         with self._lock:
-            self._counters[(name, _lk(labels))] += value
+            lk = _lk(labels)
+            if self._admit(name, lk):
+                self._counters[(name, lk)] += value
 
     def observe(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
         with self._lock:
-            key = (name, _lk(labels))
+            lk = _lk(labels)
+            if not self._admit(name, lk):
+                return
+            key = (name, lk)
             if key not in self._histograms:
                 self._histograms[key] = _Histogram()
             self._histograms[key].observe(value)
+
+    def set(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
+        """Gauge write (last value wins): rollout progress, active slots,
+        free blocks — state, not accumulation."""
+        with self._lock:
+            lk = _lk(labels)
+            if self._admit(name, lk):
+                self._gauges[(name, lk)] = float(value)
+
+    def clear_gauge(self, name: str, labels_subset: dict[str, str]) -> None:
+        """Drop every gauge series of `name` whose labels contain
+        `labels_subset`, freeing their cardinality slots. Gauge series keyed
+        by a churning label (rollout revisions) must retire when superseded
+        — otherwise stale series report forever and eventually exhaust the
+        label-set cap for live ones."""
+        wanted = tuple(sorted(labels_subset.items()))
+        with self._lock:
+            doomed = [
+                key for key in self._gauges
+                if key[0] == name and all(item in key[1] for item in wanted)
+            ]
+            seen = self._label_sets.get(name)
+            for key in doomed:
+                del self._gauges[key]
+                if seen is not None:
+                    seen.pop(key[1], None)
 
     def counter_value(self, name: str, labels: dict[str, str] | None = None) -> float:
         with self._lock:
             return self._counters.get((name, _lk(labels)), 0.0)
 
-    def render(self) -> str:
-        """Prometheus text format."""
-        lines = []
+    def gauge_value(self, name: str, labels: dict[str, str] | None = None) -> float | None:
+        with self._lock:
+            return self._gauges.get((name, _lk(labels)))
+
+    def _families(self) -> dict[str, tuple[str, list[str]]]:
+        """name -> (type, sample lines). The exposition building block —
+        render() and render_exposition() both go through here so merged
+        output keeps one HELP/TYPE block per family."""
+        fams: dict[str, tuple[str, list[str]]] = {}
         with self._lock:
             for (name, labels), value in sorted(self._counters.items()):
-                lines.append(f"{name}{_fmt(labels)} {value}")
+                fams.setdefault(name, ("counter", []))[1].append(
+                    f"{name}{_fmt(labels)} {value}"
+                )
+            for (name, labels), value in sorted(self._gauges.items()):
+                fams.setdefault(name, ("gauge", []))[1].append(
+                    f"{name}{_fmt(labels)} {value}"
+                )
             for (name, labels), h in sorted(self._histograms.items()):
+                out = fams.setdefault(name, ("histogram", []))[1]
                 cum = 0
                 for b, c in zip(h.buckets, h.counts):
                     cum += c
-                    lines.append(f'{name}_bucket{_fmt(labels, le=str(b))} {cum}')
-                lines.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {h.n}')
-                lines.append(f"{name}_sum{_fmt(labels)} {h.total}")
-                lines.append(f"{name}_count{_fmt(labels)} {h.n}")
-        return "\n".join(lines) + "\n"
+                    out.append(f'{name}_bucket{_fmt(labels, le=str(b))} {cum}')
+                out.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {h.n}')
+                out.append(f"{name}_sum{_fmt(labels)} {h.total}")
+                out.append(f"{name}_count{_fmt(labels)} {h.n}")
+        return fams
+
+    def render(self) -> str:
+        """Prometheus text exposition format: one # HELP + # TYPE block per
+        metric family, samples grouped under it — parser-valid for a real
+        scrape (validated by tests/test_dns_metrics.py's minimal parser)."""
+        return render_exposition(self)
+
+
+def render_exposition(*registries: "MetricsRegistry") -> str:
+    """Merge registries into ONE valid exposition (the API server serves
+    its control-plane registry plus the process-default serving REGISTRY):
+    a family appearing in several registries renders one HELP/TYPE block
+    with all samples under it — duplicate TYPE lines would be invalid."""
+    merged: dict[str, tuple[str, list[str]]] = {}
+    for reg in registries:
+        for name, (ftype, samples) in reg._families().items():
+            if name in merged:
+                merged[name][1].extend(samples)
+            else:
+                merged[name] = (ftype, list(samples))
+    lines: list[str] = []
+    for name in sorted(merged):
+        ftype, samples = merged[name]
+        lines.append(f"# HELP {name} {_HELP.get(name, name)}")
+        lines.append(f"# TYPE {name} {ftype}")
+        lines.extend(samples)
+    return "\n".join(lines) + "\n"
 
 
 def _lk(labels: dict[str, str] | None) -> tuple:
@@ -80,3 +198,35 @@ def _fmt(labels: tuple, le: str | None = None) -> str:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in items)
     return "{" + inner + "}"
+
+
+# Process-default registry + conveniences: the serving data plane reports
+# here (`metrics.inc/observe/set` is the call shape the catalogue checker
+# walks for); runtime/server.py merges this into its /metrics exposition.
+REGISTRY = MetricsRegistry()
+
+
+def inc(name: str, labels: dict[str, str] | None = None, value: float = 1.0) -> None:
+    REGISTRY.inc(name, labels, value)
+
+
+def observe(name: str, value: float, labels: dict[str, str] | None = None) -> None:
+    REGISTRY.observe(name, value, labels)
+
+
+def set(name: str, value: float, labels: dict[str, str] | None = None) -> None:  # noqa: A001 — mirrors the registry method
+    REGISTRY.set(name, value, labels)
+
+
+describe(DROPPED_METRIC, "Samples dropped by the per-metric label-set cardinality cap")
+describe("lws_reconcile_total", "Reconciles per controller")
+describe("lws_reconcile_errors_total", "Reconcile exceptions per controller (conflicts excluded)")
+describe("lws_reconcile_duration_seconds", "Reconcile latency per controller and result")
+describe("lws_rollout_progress", "Fraction of groups on the target revision, per LWS rollout")
+describe("serving_requests_total", "Requests admitted per engine")
+describe("serving_admission_duration_seconds", "Admission (prefill-to-slot) latency per engine")
+describe("serving_decode_dispatch_duration_seconds", "Decode dispatch latency per engine")
+describe("serving_spec_verify_duration_seconds", "Speculative verify dispatch latency")
+describe("serving_active_slots", "Active decode slots per engine")
+describe("serving_kv_handoff_bytes_total", "KV bundle bytes shipped prefill -> decode")
+describe("serving_kv_handoffs_total", "KV bundles handed off prefill -> decode")

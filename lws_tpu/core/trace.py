@@ -1,0 +1,357 @@
+"""Tracing spine: spans from controller reconcile to TPU dispatch.
+
+A deliberately tiny span layer (no OpenTelemetry dependency — the container
+bakes nothing in) shared by the control plane and the serving data plane:
+
+  * context-manager + decorator API over a thread-local span stack, so
+    nesting and parent/child links come for free;
+  * monotonic clocks for duration, wall clock for export ordering;
+  * bounded in-memory ring of finished spans + JSONL export, served live by
+    the API server's `/debug/traces` endpoint;
+  * cross-process propagation: a span's `context` is a 2-key dict that rides
+    any JSON channel (the KV transport's frame meta) and seeds a child span
+    in the peer process — the e2e disagg request's reconcile -> admission ->
+    prefill -> KV handoff -> decode tree connects this way;
+  * a no-op fast path: with tracing disabled (LWS_TPU_TRACE=0) or a root
+    sampled out, `span()` returns one shared singleton — no allocation, no
+    clock reads — so the paged decode loop keeps its throughput
+    (benchmarks/trace_overhead_bench.py holds the <2% line).
+
+The module-level TRACER is the process default (one trace surface per
+worker, exactly like the process-global metrics REGISTRY); tests build
+private `Tracer()` instances.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from functools import wraps
+from typing import Callable, Iterator, Optional
+
+
+def _new_id() -> str:
+    # 64-bit hex, cheap and collision-safe at ring scale.
+    return f"{random.getrandbits(64):016x}"
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled fast path. Implements the FULL
+    Span surface (context/set/duration_s/to_dict) so callers that serialize
+    or link spans degrade gracefully instead of crashing when tracing is
+    off."""
+
+    __slots__ = ()
+    context: Optional[dict] = None
+    duration_s: float = 0.0
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def to_dict(self) -> dict:
+        return {
+            "name": "disabled", "trace_id": "", "span_id": "",
+            "parent_id": None, "start_unix": 0.0, "duration_s": 0.0,
+            "status": "disabled", "attrs": {},
+        }
+
+
+NOOP = _NoopSpan()
+
+
+class _SuppressedSpan(_NoopSpan):
+    """Sampled-out subtree marker: a root that loses the sampling roll
+    returns one of these, and while it sits on the thread's suppress depth
+    every descendant is suppressed too — a trace is sampled WHOLE, never
+    shredded into orphan fragments."""
+
+    __slots__ = ("_tracer",)
+
+    def __init__(self, tracer: "Tracer") -> None:
+        self._tracer = tracer
+
+    def __enter__(self) -> "_SuppressedSpan":
+        self._tracer._tls_state().suppressed += 1
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer._tls_state().suppressed -= 1
+        return False
+
+
+class Span:
+    """One timed operation. Use as a context manager (via Tracer.span);
+    attributes set with `span.set(k=v)` ride into the exported record."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "attrs",
+        "start_unix", "duration_s", "status", "_t0", "_tracer",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str], attrs: dict) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.start_unix = 0.0
+        self.duration_s = 0.0
+        self.status = "ok"
+        self._t0 = 0.0
+
+    @property
+    def context(self) -> dict:
+        """Wire-portable parent reference: put it in any JSON meta and pass
+        it back as `span(..., parent=ctx)` in the receiving process."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        self.start_unix = time.time()
+        self._t0 = time.perf_counter()
+        self._tracer._push(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.duration_s = time.perf_counter() - self._t0
+        if exc_type is not None:
+            self.status = "error"
+            self.attrs.setdefault("error", f"{exc_type.__name__}: {exc}"[:200])
+        self._tracer._pop(self)
+        self._tracer._finish(self)
+        return False
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_unix": round(self.start_unix, 6),
+            "duration_s": round(self.duration_s, 6),
+            "status": self.status,
+            "attrs": self.attrs,
+        }
+
+
+class Tracer:
+    def __init__(
+        self,
+        ring: int = 4096,
+        enabled: Optional[bool] = None,
+        sample_rate: Optional[float] = None,
+        export_path: Optional[str] = None,
+    ) -> None:
+        """`ring` bounds finished spans kept in memory (oldest dropped).
+        `enabled` defaults from LWS_TPU_TRACE (on unless "0"/"false"/"off").
+        `sample_rate` (default LWS_TPU_TRACE_SAMPLE or 1.0) decides at ROOT
+        span creation; children always follow their root's decision.
+        `export_path` (default LWS_TPU_TRACE_EXPORT) appends every finished
+        span as one JSON line — the live-worker export channel."""
+        if enabled is None:
+            enabled = os.environ.get("LWS_TPU_TRACE", "1").lower() not in (
+                "0", "false", "off",
+            )
+        if sample_rate is None:
+            sample_rate = float(os.environ.get("LWS_TPU_TRACE_SAMPLE", "1.0"))
+        self.enabled = enabled
+        self.sample_rate = sample_rate
+        self._ring: "deque[dict]" = deque(maxlen=ring)
+        self._tls = threading.local()
+        self._export_path = (
+            export_path if export_path is not None
+            else os.environ.get("LWS_TPU_TRACE_EXPORT")
+        )
+        self._export_file = None  # lazily opened append handle
+        self._export_lock = threading.Lock()
+
+    # ---- span stack (thread-local: concurrent reconcile workers and
+    # serving threads each nest independently) ----------------------------
+    class _TlsState:
+        __slots__ = ("stack", "suppressed")
+
+        def __init__(self) -> None:
+            self.stack: list = []
+            self.suppressed = 0  # sampled-out subtree depth
+
+    def _tls_state(self) -> "_TlsState":
+        state = getattr(self._tls, "state", None)
+        if state is None:
+            state = self._tls.state = Tracer._TlsState()
+        return state
+
+    def _stack(self) -> list:
+        return self._tls_state().stack
+
+    def _push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def _pop(self, span: Span) -> None:
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+        elif span in stack:  # exited out of order: drop it wherever it sits
+            stack.remove(span)
+
+    def _finish(self, span: Span) -> None:
+        record = span.to_dict()
+        self._ring.append(record)
+        if self._export_path:
+            line = json.dumps(record, default=str)
+            with self._export_lock:
+                # One append handle for the tracer's lifetime: per-span
+                # open/close syscalls would tax exactly the hot dispatch
+                # loop the <2% budget protects.
+                if self._export_file is None:
+                    self._export_file = open(self._export_path, "a")
+                self._export_file.write(line + "\n")
+                self._export_file.flush()
+
+    # ---- public API ------------------------------------------------------
+    def span(self, name: str, parent: Optional[dict] = None, **attrs):
+        """Start a span. `parent` overrides the thread-local stack — pass a
+        peer process's span `context` dict to graft onto its trace. Returns
+        the shared NOOP singleton when tracing is off or the root is
+        sampled out (children of a live span are always kept: a trace is
+        sampled whole, never shredded)."""
+        if not self.enabled:
+            return NOOP
+        state = self._tls_state()
+        current = state.stack[-1] if state.stack else None
+        if parent is not None and parent.get("trace_id"):
+            # Explicit cross-process context wins: the peer already decided
+            # to sample this trace.
+            trace_id = parent["trace_id"]
+            parent_id = parent.get("span_id")
+        elif current is not None:
+            trace_id = current.trace_id
+            parent_id = current.span_id
+        else:
+            if state.suppressed > 0 or (
+                self.sample_rate < 1.0 and random.random() >= self.sample_rate
+            ):
+                # Root lost the roll (or sits under one that did): suppress
+                # the WHOLE subtree so sampling can't shred a trace into
+                # orphan fragments.
+                return _SuppressedSpan(self)
+            trace_id = _new_id()
+            parent_id = None
+        return Span(self, name, trace_id, parent_id, attrs)
+
+    def trace(self, name: Optional[str] = None, **attrs) -> Callable:
+        """Decorator form: the wrapped call runs inside a span named after
+        the function (or `name`)."""
+
+        def deco(fn: Callable) -> Callable:
+            span_name = name or fn.__qualname__
+
+            @wraps(fn)
+            def wrapper(*args, **kwargs):
+                with self.span(span_name, **attrs):
+                    return fn(*args, **kwargs)
+
+            return wrapper
+
+        return deco
+
+    def current_context(self) -> Optional[dict]:
+        stack = self._stack()
+        return stack[-1].context if stack else None
+
+    def record(self, record: dict) -> None:
+        """Ingest a span record produced elsewhere (a peer process's subtree
+        riding back over the result channel) into this ring."""
+        self._ring.append(dict(record))
+
+    def spans(self, limit: Optional[int] = None) -> list[dict]:
+        """Finished spans, oldest first; `limit` keeps the most recent N."""
+        out = list(self._ring)
+        if limit is not None and limit >= 0:
+            # out[-0:] would be the WHOLE list — limit=0 means none.
+            out = out[-limit:] if limit else []
+        return out
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def export_jsonl(self, path: str) -> int:
+        """Write the ring as JSON lines; returns the span count."""
+        spans = self.spans()
+        with open(path, "w") as f:
+            for record in spans:
+                f.write(json.dumps(record, default=str) + "\n")
+        return len(spans)
+
+    @staticmethod
+    def read_jsonl(path: str) -> list[dict]:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+def connected_tree(spans: list[dict]) -> bool:
+    """True iff the records form ONE trace whose parent links all resolve:
+    exactly one trace_id, exactly one root (parent_id None or pointing
+    outside the set counts as a root), and every other span's parent_id is
+    another span's span_id. The e2e acceptance check."""
+    if not spans:
+        return False
+    if len({s["trace_id"] for s in spans}) != 1:
+        return False
+    ids = {s["span_id"] for s in spans}
+    roots = [s for s in spans if s.get("parent_id") not in ids]
+    return len(roots) == 1
+
+
+def walk(spans: list[dict], root_id: str) -> Iterator[dict]:
+    """Depth-first iteration of a span subtree by parent links."""
+    children: dict[Optional[str], list[dict]] = {}
+    for s in spans:
+        children.setdefault(s.get("parent_id"), []).append(s)
+    todo = [s for s in spans if s["span_id"] == root_id]
+    while todo:
+        s = todo.pop()
+        yield s
+        todo.extend(children.get(s["span_id"], []))
+
+
+# Process-default tracer + conveniences: `trace.span(...)` is the call shape
+# the catalogue checker (tools/check_metrics_catalogue.py) walks for.
+TRACER = Tracer()
+
+
+def span(name: str, parent: Optional[dict] = None, **attrs):
+    return TRACER.span(name, parent=parent, **attrs)
+
+
+def traced(name: Optional[str] = None, **attrs) -> Callable:
+    return TRACER.trace(name, **attrs)
+
+
+def current_context() -> Optional[dict]:
+    return TRACER.current_context()
+
+
+def record(rec: dict) -> None:
+    TRACER.record(rec)
+
+
+def spans(limit: Optional[int] = None) -> list[dict]:
+    return TRACER.spans(limit)
+
+
+def set_enabled(enabled: bool) -> None:
+    TRACER.enabled = enabled

@@ -154,7 +154,7 @@ class ControlPlane:
                 labels={contract.SET_NAME_LABEL_KEY: obj.meta.name, contract.WORKER_INDEX_LABEL_KEY: "0"},
             )
 
-        self.lws_controller = LWSReconciler(self.store, self.recorder)
+        self.lws_controller = LWSReconciler(self.store, self.recorder, metrics=self.metrics)
         self.manager.register(
             self.lws_controller,
             {

@@ -4,7 +4,11 @@ cmd/main.go:252-262,336-348 rolled into one in-process server).
 
 Endpoints:
   GET  /healthz | /readyz             liveness/readiness
-  GET  /metrics                       Prometheus text
+  GET  /metrics                       Prometheus text (control plane +
+                                      process serving registry, one valid
+                                      exposition)
+  GET  /debug/traces[?limit=N]        recent spans from the process tracer
+                                      (reconcile -> serving trace spine)
   POST /apply                         YAML/JSON manifest (create-or-update)
   GET  /apis/{kind}                   list (JSON manifests)
   GET  /apis/{kind}/{ns}/{name}       get
@@ -172,8 +176,28 @@ class ApiServer:
                 parts = [p for p in path.split("/") if p]
                 if self.path in ("/healthz", "/readyz"):
                     self._send(200, "ok", "text/plain")
-                elif self.path == "/metrics":
-                    self._send(200, cp.metrics.render(), "text/plain")
+                elif path == "/metrics":
+                    # One merged exposition: the control plane's registry
+                    # plus the process-default registry the serving engines
+                    # report into (a live worker embedding both is
+                    # inspectable from one scrape).
+                    from lws_tpu.core import metrics as metricsmod
+
+                    regs = (cp.metrics,) if cp.metrics is metricsmod.REGISTRY \
+                        else (cp.metrics, metricsmod.REGISTRY)
+                    self._send(200, metricsmod.render_exposition(*regs), "text/plain")
+                elif path == "/debug/traces":
+                    from urllib.parse import parse_qs, urlparse
+
+                    from lws_tpu.core import trace as tracemod
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = int(q.get("limit", ["256"])[0])
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad limit: {e}"})
+                        return
+                    self._json(200, tracemod.TRACER.spans(limit))
                 elif len(parts) == 2 and parts[0] == "apis":
                     try:
                         objs = cp.store.list(_kind(parts[1]))

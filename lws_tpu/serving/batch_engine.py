@@ -18,6 +18,7 @@ paged tests compare against; it is greedy-only by design.
 from __future__ import annotations
 
 import itertools
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional
@@ -25,6 +26,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lws_tpu.core import metrics, trace
 
 from lws_tpu.models.llama import (
     LlamaConfig,
@@ -114,21 +117,29 @@ class BatchEngine:
         req = Request(next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot)
 
         plen = len(prompt)
-        # Bucket prompt lengths (next power of two) so admission compiles a
-        # handful of executables instead of one per distinct length; the
-        # padded tail is never attendable (mask is key_pos <= pos) and decode
-        # overwrites it position by position.
-        bucket = 8
-        while bucket < plen:
-            bucket *= 2
-        bucket = min(bucket, self.max_len)
-        padded = np.zeros((bucket,), np.int32)
-        padded[:plen] = prompt
-        first, slot_cache = self._prefill_one(
-            self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
-        )
-        self.cache, self.pos_b, self.tokens = self._insert(
-            slot_cache, self.cache, self.pos_b, self.tokens, slot, plen, first[0]
+        t0 = time.perf_counter()
+        with trace.span("serve.admission", engine="batch", prompt_len=plen):
+            # Bucket prompt lengths (next power of two) so admission compiles a
+            # handful of executables instead of one per distinct length; the
+            # padded tail is never attendable (mask is key_pos <= pos) and decode
+            # overwrites it position by position.
+            bucket = 8
+            while bucket < plen:
+                bucket *= 2
+            bucket = min(bucket, self.max_len)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:plen] = prompt
+            with trace.span("serve.prefill", chunked=False, prompt_len=plen):
+                first, slot_cache = self._prefill_one(
+                    self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
+                )
+            self.cache, self.pos_b, self.tokens = self._insert(
+                slot_cache, self.cache, self.pos_b, self.tokens, slot, plen, first[0]
+            )
+        metrics.inc("serving_requests_total", {"engine": "batch"})
+        metrics.observe(
+            "serving_admission_duration_seconds",
+            time.perf_counter() - t0, {"engine": "batch"},
         )
         req.tokens.append(int(first[0]))
         if req.done:
@@ -143,20 +154,29 @@ class BatchEngine:
         """One decode step across every active slot."""
         if not self._active:
             return
-        active = jnp.asarray(
-            [s in self._active and not self._active[s].done for s in range(self.slots)]
+        t0 = time.perf_counter()
+        with trace.span(
+            "serve.decode_dispatch", engine="batch", steps=1,
+            active=len(self._active),
+        ):
+            active = jnp.asarray(
+                [s in self._active and not self._active[s].done for s in range(self.slots)]
+            )
+            self.cache, self.tokens, self.pos_b = self._step_fn(
+                self.params, self.cache, self.tokens, self.pos_b, active
+            )
+            host_tokens = np.asarray(self.tokens)
+            for slot, req in list(self._active.items()):
+                req.tokens.append(int(host_tokens[slot]))
+                # Position is host-derivable: prompt length + generated tokens.
+                if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
+                    self._completed[req.request_id] = req
+                    del self._active[slot]
+                    self._free.append(slot)
+        metrics.observe(
+            "serving_decode_dispatch_duration_seconds",
+            time.perf_counter() - t0, {"engine": "batch"},
         )
-        self.cache, self.tokens, self.pos_b = self._step_fn(
-            self.params, self.cache, self.tokens, self.pos_b, active
-        )
-        host_tokens = np.asarray(self.tokens)
-        for slot, req in list(self._active.items()):
-            req.tokens.append(int(host_tokens[slot]))
-            # Position is host-derivable: prompt length + generated tokens.
-            if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
-                self._completed[req.request_id] = req
-                del self._active[slot]
-                self._free.append(slot)
 
     def run_until_drained(self, max_steps: int = 10000) -> None:
         for _ in range(max_steps):

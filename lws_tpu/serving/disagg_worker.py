@@ -63,49 +63,59 @@ def build_engine(batch: int, max_len: int):
     return Engine(cfg, params, batch_size=batch, max_len=max_len, mesh=mesh)
 
 
-def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict]:
-    """Bundle bytes -> ([B, steps+1] tokens, per-handoff stats). The
-    pos-truncated wire prefix is padded to DECODE's own max_len and, when
-    the decode engine is mesh-sharded, placed onto its cache shardings.
-    Stats time each real cost of the handoff (VERDICT r4 #5): deserialize,
-    reshard onto this side's mesh, decode."""
-    import time
-
+def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict, list]:
+    """Bundle bytes -> ([B, steps+1] tokens, per-handoff stats, span
+    records). The pos-truncated wire prefix is padded to DECODE's own
+    max_len and, when the decode engine is mesh-sharded, placed onto its
+    cache shardings. Each real cost of the handoff (VERDICT r4 #5) runs in
+    its own span — deserialize, reshard onto this side's mesh, decode — and
+    the legacy stats dict is DERIVED from the span durations (the spans
+    subsume the old ad-hoc timers; same keys on the wire)."""
     import jax
 
+    from lws_tpu.core import trace
     from lws_tpu.serving.kv_transport import bundle_to_cache
 
-    t0 = time.perf_counter()
-    cache, token = bundle_to_cache(payload, max_len=engine.max_len)
-    deser_s = time.perf_counter() - t0
-    t1 = time.perf_counter()
-    if engine.mesh is not None:
-        cache = jax.device_put(cache, engine._cache_shardings)
-        jax.block_until_ready(cache.k)
-    reshard_s = time.perf_counter() - t1
+    with trace.span("kv.deserialize", bundle_bytes=len(payload)) as s_deser:
+        cache, token = bundle_to_cache(payload, max_len=engine.max_len)
+    with trace.span("kv.reshard", tp_sharded=engine.mesh is not None) as s_reshard:
+        if engine.mesh is not None:
+            cache = jax.device_put(cache, engine._cache_shardings)
+            jax.block_until_ready(cache.k)
     first = np.asarray(token)
-    t2 = time.perf_counter()
-    _, _, tokens = engine.decode_n(token, cache, steps)
-    toks = np.asarray(tokens)  # blocks: decode_s is the real dispatch time
-    decode_s = time.perf_counter() - t2
+    with trace.span("serve.decode_dispatch", engine="dense", steps=steps) as s_decode:
+        _, _, tokens = engine.decode_n(token, cache, steps)
+        toks = np.asarray(tokens)  # blocks: decode_s is the real dispatch time
     stats = {
         "bundle_bytes": len(payload),
-        "deserialize_s": round(deser_s, 4),
-        "reshard_s": round(reshard_s, 4),
-        "decode_s": round(decode_s, 4),
+        "deserialize_s": round(s_deser.duration_s, 4),
+        "reshard_s": round(s_reshard.duration_s, 4),
+        "decode_s": round(s_decode.duration_s, 4),
     }
-    return np.concatenate([first[:, None], toks], axis=1), stats
+    spans = [s.to_dict() for s in (s_deser, s_reshard, s_decode)]
+    return np.concatenate([first[:, None], toks], axis=1), stats, spans
 
 
 def _own_pod(client, namespace: str, pod_name: str) -> dict:
     return client.get("Pod", namespace, pod_name)
 
 
+def _force_tracing() -> None:
+    """Workers keep tracing on regardless of env sampling: the span subtree
+    IS the handoff cost breakdown the protocol ships with each result."""
+    from lws_tpu.core import trace
+
+    trace.TRACER.enabled = True
+    trace.TRACER.sample_rate = 1.0
+
+
 def run_prefill_tcp(once: bool, max_len: int) -> int:
     """Serve prompts-in / KV-bundles-out on LWS_TPU_KV_PORT. With `once`,
     exit after the first bundle has been pulled AND acked by a peer."""
+    from lws_tpu.core import metrics, trace
     from lws_tpu.serving import kv_transport as kt
 
+    _force_tracing()
     engine = build_engine(batch=1, max_len=max_len)
     server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
     print(f"[prefill {os.environ.get('POD_NAME', '?')}] serving KV on :{server.port}",
@@ -120,26 +130,42 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
         req_id = meta["id"]
         prompt = kt.bytes_to_arrays(payload)["prompt"]
         import json as _json
-        import time as _t
 
-        t0 = _t.perf_counter()
-        token, cache = engine.prefill(prompt.reshape(1, -1))
-        np.asarray(token)  # block: prefill_s is the real dispatch time
-        prefill_s = _t.perf_counter() - t0
-        t1 = _t.perf_counter()
-        bundle = kt.cache_to_bundle(cache, token)  # pos-truncated (+gathered)
-        gather_s = _t.perf_counter() - t1
+        # The request's span subtree grafts onto the submitting client's
+        # trace (meta["trace"]) and replaces the old ad-hoc timers: the
+        # handoff record is DERIVED from the span durations, same keys.
+        with trace.span(
+            "serve.request", parent=meta.get("trace"),
+            role="prefill", request_id=req_id,
+        ) as s_req:
+            with trace.span("serve.prefill", chunked=False,
+                            prompt_len=int(prompt.size)) as s_prefill:
+                token, cache = engine.prefill(prompt.reshape(1, -1))
+                np.asarray(token)  # block: prefill_s is the real dispatch time
+            with trace.span("kv.gather", tp_gathered=engine.mesh is not None) as s_gather:
+                bundle = kt.cache_to_bundle(cache, token)  # pos-truncated (+gathered)
+                s_gather.set(pos=int(cache.pos), bundle_bytes=len(bundle))
         handoff = {
             "pos": int(cache.pos),
             "bundle_bytes": len(bundle),
-            "prefill_s": round(prefill_s, 4),
-            "gather_s": round(gather_s, 4),
+            "prefill_s": round(s_prefill.duration_s, 4),
+            "gather_s": round(s_gather.duration_s, 4),
             "tp_gathered": engine.mesh is not None,
         }
+        metrics.inc("serving_kv_handoffs_total")
+        metrics.inc("serving_kv_handoff_bytes_total", value=len(bundle))
         # The handoff record rides the bundle meta: decode merges its own
         # deserialize/reshard/decode timings and returns the WHOLE handoff
-        # cost breakdown to the client with the result.
-        server.offer_bundle({"id": req_id, "handoff": handoff}, bundle)
+        # cost breakdown — and the full span subtree — to the client with
+        # the result. The bundle's trace ctx parents decode's subtree under
+        # THIS request span, keeping one connected tree across processes.
+        server.offer_bundle(
+            {
+                "id": req_id, "handoff": handoff, "trace": s_req.context,
+                "spans": [s.to_dict() for s in (s_req, s_prefill, s_gather)],
+            },
+            bundle,
+        )
         print(f"[prefill] HANDOFF {req_id} {_json.dumps(handoff)}", flush=True)
 
 
@@ -153,8 +179,10 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
 
     from lws_tpu.api import disagg
     from lws_tpu.client import RemoteClient
+    from lws_tpu.core import trace
     from lws_tpu.serving import kv_transport as kt
 
+    _force_tracing()
     engine = build_engine(batch=1, max_len=max_len)
     server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
     me = os.environ.get("POD_NAME", str(os.getpid()))
@@ -174,8 +202,16 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
     def process(meta, payload):
         import json as _json
 
+        # Parent decode's subtree under the prefill-side request span (the
+        # bundle meta's trace ctx): one connected tree, client -> prefill ->
+        # decode, reassembled client-side from the "spans" records below.
+        s_req = trace.span(
+            "serve.request", parent=meta.get("trace"),
+            role="decode", request_id=meta["id"],
+        )
         try:
-            full, dstats = _decode_bundle(engine, payload, steps)
+            with s_req:
+                full, dstats, dspans = _decode_bundle(engine, payload, steps)
         except Exception as e:  # noqa: BLE001
             # Poison-message guard: a bundle this engine can't process (e.g.
             # prompt longer than decode's max_len budget) must be CONSUMED
@@ -186,8 +222,9 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
             server.post_result(meta["id"], {"id": meta["id"], "failed": repr(e)[:300]}, b"")
             return
         handoff = {**meta.get("handoff", {}), **dstats}
+        spans_out = list(meta.get("spans", [])) + dspans + [s_req.to_dict()]
         server.post_result(
-            meta["id"], {"id": meta["id"], "handoff": handoff},
+            meta["id"], {"id": meta["id"], "handoff": handoff, "spans": spans_out},
             kt.arrays_to_bytes(tokens=full),
         )
         print(f"[decode] HANDOFF {meta['id']} {_json.dumps(handoff)}", flush=True)

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lws_tpu.core import metrics, trace
 from lws_tpu.models.llama import (
     KVCache,
     LlamaConfig,
@@ -311,13 +312,17 @@ class Engine:
             )
         cache = self.new_cache()
         hidden = None
-        for i in range(0, S + pad, chunk_size):
-            hidden, cache = self._prefill_chunk(
-                self.params, padded[:, i : i + chunk_size], cache
+        with trace.span(
+            "serve.prefill", chunked=True, prompt_len=S,
+            chunks=(S + pad) // chunk_size,
+        ):
+            for i in range(0, S + pad, chunk_size):
+                hidden, cache = self._prefill_chunk(
+                    self.params, padded[:, i : i + chunk_size], cache
+                )
+            token, cache = self._finish_chunked(
+                self.params, cache, hidden, (S - 1) % chunk_size, self._next_key()
             )
-        token, cache = self._finish_chunked(
-            self.params, cache, hidden, (S - 1) % chunk_size, self._next_key()
-        )
         # Rewind pos past the padding: decode appends at the true length,
         # masking out (then overwriting) the padded tail's K/V.
         return token, _dc.replace(cache, pos=jnp.asarray(S, cache.pos.dtype))
@@ -459,49 +464,63 @@ class Engine:
         verify = self._get_verify()
         self._warm_verify(gamma)
 
-        t0 = time.perf_counter()
-        token, cache = self.prefill(prompt)
-        host_sync(token)
-        ttft = time.perf_counter() - t0
+        with trace.span(
+            "serve.request", engine="dense", speculative=True,
+            prompt_len=int(prompt.shape[1]), max_new_tokens=max_new_tokens,
+        ) as request_span:
+            t0 = time.perf_counter()
+            with trace.span("serve.prefill", chunked=False,
+                            prompt_len=int(prompt.shape[1])):
+                token, cache = self.prefill(prompt)
+                host_sync(token)
+            ttft = time.perf_counter() - t0
 
-        t1 = time.perf_counter()
-        context = [int(t) for t in np.asarray(prompt)[0]] + [int(np.asarray(token)[0])]
-        out = [int(np.asarray(token)[0])]
-        # pos is host-derivable (prompt length, then += accepted+1 per
-        # dispatch): int(cache.pos) would be a blocking device round trip
-        # per dispatch on exactly the links this engine optimizes for.
-        pos = prompt.shape[1]
-        dispatches = drafted = accepted_total = 0
-        while len(out) < max_new_tokens:
-            if pos + gamma + 1 > self.max_len:
-                # No room for a full verify run: finish with single steps.
-                tok = jnp.asarray([out[-1]], jnp.int32)
-                while len(out) < max_new_tokens and pos < self.max_len:
-                    tok, cache = self.decode(tok, cache)
-                    out.append(int(np.asarray(tok)[0]))
-                    pos += 1
-                    dispatches += 1
-                break
-            drafts = self._draft_ngram(context, ngram, gamma)
-            tokens_in = jnp.asarray([[out[-1]] + drafts], jnp.int32)
-            all_logits, cache = verify(self.params, tokens_in, cache)
-            greedy = np.asarray(jnp.argmax(all_logits, axis=-1))[0]  # [gamma+1]
-            a = 0
-            while a < gamma and drafts[a] == int(greedy[a]):
-                a += 1
-            new_tokens = [int(t) for t in drafts[:a]] + [int(greedy[a])]
-            # Rewind past the rejected draft rows: only positions
-            # [0, pos + a + 1) are real; stale rows get overwritten.
-            pos = pos + a + 1
-            cache = _dc.replace(cache, pos=jnp.asarray(pos, cache.pos.dtype))
-            out.extend(new_tokens)
-            context.extend(new_tokens)
-            dispatches += 1
-            drafted += gamma
-            accepted_total += a
-        out = out[: max(1, max_new_tokens)]  # generate(p, 0) also returns [1, 1]
-        dt = time.perf_counter() - t1
-        steps = len(out) - 1
+            t1 = time.perf_counter()
+            context = [int(t) for t in np.asarray(prompt)[0]] + [int(np.asarray(token)[0])]
+            out = [int(np.asarray(token)[0])]
+            # pos is host-derivable (prompt length, then += accepted+1 per
+            # dispatch): int(cache.pos) would be a blocking device round trip
+            # per dispatch on exactly the links this engine optimizes for.
+            pos = prompt.shape[1]
+            dispatches = drafted = accepted_total = 0
+            while len(out) < max_new_tokens:
+                if pos + gamma + 1 > self.max_len:
+                    # No room for a full verify run: finish with single steps.
+                    tok = jnp.asarray([out[-1]], jnp.int32)
+                    while len(out) < max_new_tokens and pos < self.max_len:
+                        with trace.span("serve.decode_dispatch",
+                                        engine="dense", steps=1):
+                            tok, cache = self.decode(tok, cache)
+                            out.append(int(np.asarray(tok)[0]))
+                        pos += 1
+                        dispatches += 1
+                    break
+                drafts = self._draft_ngram(context, ngram, gamma)
+                tokens_in = jnp.asarray([[out[-1]] + drafts], jnp.int32)
+                with trace.span("serve.spec_verify", engine="dense", gamma=gamma):
+                    all_logits, cache = verify(self.params, tokens_in, cache)
+                    greedy = np.asarray(jnp.argmax(all_logits, axis=-1))[0]  # [gamma+1]
+                a = 0
+                while a < gamma and drafts[a] == int(greedy[a]):
+                    a += 1
+                new_tokens = [int(t) for t in drafts[:a]] + [int(greedy[a])]
+                # Rewind past the rejected draft rows: only positions
+                # [0, pos + a + 1) are real; stale rows get overwritten.
+                pos = pos + a + 1
+                cache = _dc.replace(cache, pos=jnp.asarray(pos, cache.pos.dtype))
+                out.extend(new_tokens)
+                context.extend(new_tokens)
+                dispatches += 1
+                drafted += gamma
+                accepted_total += a
+            out = out[: max(1, max_new_tokens)]  # generate(p, 0) also returns [1, 1]
+            dt = time.perf_counter() - t1
+            steps = len(out) - 1
+            request_span.set(
+                ttft_s=round(ttft, 6), decode_s=round(dt, 6),
+                dispatches=dispatches, accepted=accepted_total,
+            )
+        metrics.inc("serving_requests_total", {"engine": "dense"})
         return GenerationResult(
             tokens=jnp.asarray([out], jnp.int32),
             ttft_s=ttft,
@@ -531,22 +550,37 @@ class Engine:
         n_full, rem = divmod(steps, self.DECODE_CHUNK)
         self._warm_decode(n_full > 0, rem > 0)
 
-        t0 = time.perf_counter()
-        token, cache = self.prefill(prompt)
-        host_sync(token)
-        ttft = time.perf_counter() - t0
+        request_span = trace.span(
+            "serve.request", engine="dense", prompt_len=int(prompt.shape[1]),
+            max_new_tokens=max_new_tokens,
+        )
+        with request_span:
+            t0 = time.perf_counter()
+            with trace.span("serve.prefill", chunked=False,
+                            prompt_len=int(prompt.shape[1])):
+                token, cache = self.prefill(prompt)
+                host_sync(token)
+            ttft = time.perf_counter() - t0
 
-        t1 = time.perf_counter()
-        chunks = [token[:, None]]
-        for _ in range(n_full):
-            token, cache, toks = self.decode_n(token, cache, self.DECODE_CHUNK)
-            chunks.append(toks)
-        for _ in range(rem):
-            token, cache = self.decode(token, cache)
-            chunks.append(token[:, None])
-        tokens = jnp.concatenate(chunks, axis=1)
-        host_sync(tokens)
-        dt = time.perf_counter() - t1
+            t1 = time.perf_counter()
+            chunks = [token[:, None]]
+            for _ in range(n_full):
+                with trace.span("serve.decode_dispatch", engine="dense",
+                                steps=self.DECODE_CHUNK):
+                    token, cache, toks = self.decode_n(token, cache, self.DECODE_CHUNK)
+                chunks.append(toks)
+            for _ in range(rem):
+                with trace.span("serve.decode_dispatch", engine="dense", steps=1):
+                    token, cache = self.decode(token, cache)
+                chunks.append(token[:, None])
+            tokens = jnp.concatenate(chunks, axis=1)
+            host_sync(tokens)
+            dt = time.perf_counter() - t1
+            request_span.set(ttft_s=round(ttft, 6), decode_s=round(dt, 6))
+        metrics.inc("serving_requests_total", {"engine": "dense"})
+        metrics.observe(
+            "serving_admission_duration_seconds", ttft, {"engine": "dense"}
+        )
         tok_per_s = (steps * self.batch_size) / dt if steps else 0.0
         return GenerationResult(
             tokens=tokens,

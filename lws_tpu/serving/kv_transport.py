@@ -278,8 +278,19 @@ def _one_shot(endpoint: tuple[str, int], meta: dict, payload: bytes = b"",
         return recv_msg(sock)
 
 
-def submit_prompt(endpoint, req_id: str, prompt_bytes: bytes) -> None:
-    meta, _ = _one_shot(endpoint, {"op": "submit_prompt", "id": req_id}, prompt_bytes)
+def submit_prompt(endpoint, req_id: str, prompt_bytes: bytes,
+                  trace_ctx: Optional[dict] = None) -> None:
+    """`trace_ctx` (default: the caller's current span context) rides the
+    frame meta so the prefill worker's span subtree grafts onto the
+    caller's trace — the cross-process leg of the trace spine."""
+    if trace_ctx is None:
+        from lws_tpu.core import trace
+
+        trace_ctx = trace.current_context()
+    meta = {"op": "submit_prompt", "id": req_id}
+    if trace_ctx:
+        meta["trace"] = trace_ctx
+    meta, _ = _one_shot(endpoint, meta, prompt_bytes)
     if not (meta or {}).get("ok"):
         raise RuntimeError(f"submit_prompt failed: {meta}")
 

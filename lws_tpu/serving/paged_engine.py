@@ -22,6 +22,7 @@ Allocation policy (host side, exclusive):
 from __future__ import annotations
 
 import itertools
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional
@@ -29,6 +30,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from lws_tpu.core import metrics, trace
 
 from lws_tpu.models.llama import (
     LlamaConfig,
@@ -558,6 +561,34 @@ class PagedBatchEngine:
         block-aligned prompt prefixes already resident in the pool are
         REUSED: only the suffix is prefilled (vLLM automatic-prefix-caching
         shape; exactness-tested against the uncached engine)."""
+        t0 = time.perf_counter()
+        with trace.span(
+            "serve.admission", engine="paged", prompt_len=len(prompt)
+        ) as sp:
+            rid = self._submit(
+                prompt, max_new_tokens, temperature, top_k, top_p, seed
+            )
+            sp.set(admitted=rid is not None)
+        if rid is not None:
+            metrics.inc("serving_requests_total", {"engine": "paged"})
+            metrics.observe(
+                "serving_admission_duration_seconds",
+                time.perf_counter() - t0, {"engine": "paged"},
+            )
+            metrics.set(
+                "serving_active_slots", len(self._active), {"engine": "paged"}
+            )
+        return rid
+
+    def _submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: Optional[int] = None,
+    ) -> Optional[int]:
         if not self._free_slots:
             return None
         plen = len(prompt)
@@ -595,23 +626,24 @@ class PagedBatchEngine:
 
         padded = np.zeros((bucket,), np.int32)
         padded[:plen] = prompt
-        with self._mesh_ctx():
-            logits, slot_cache = self._prefill_one(
-                self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
-            )
-            first = self._sample_first_token(
-                logits, req_key, slot, temperature, top_k, top_p
-            )
-            prefill_ids = jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
-            scales = (
-                (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
-                if self.cfg.kv_quant
-                else ()
-            )
-            self.cache, self.pos_b, self.tokens = self._insert(
-                self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
-                self.pos_b, self.tokens, slot, plen, first, *scales,
-            )
+        with trace.span("serve.prefill", chunked=False, prompt_len=plen):
+            with self._mesh_ctx():
+                logits, slot_cache = self._prefill_one(
+                    self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
+                )
+                first = self._sample_first_token(
+                    logits, req_key, slot, temperature, top_k, top_p
+                )
+                prefill_ids = jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
+                scales = (
+                    (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
+                    if self.cfg.kv_quant
+                    else ()
+                )
+                self.cache, self.pos_b, self.tokens = self._insert(
+                    self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
+                    self.pos_b, self.tokens, slot, plen, first, *scales,
+                )
         return self._finish_admission(req, first)
 
     def _submit_prefix(
@@ -692,22 +724,23 @@ class PagedBatchEngine:
             # computed blocks for future prompts.
             padded = np.zeros((bucket,), np.int32)
             padded[:plen] = prompt
-            with self._mesh_ctx():
-                logits, slot_cache = self._prefill_one(
-                    self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
-                )
-                first = self._sample_first_token(
-                    logits, req_key, slot, temperature, top_k, top_p
-                )
-                prefill_ids = jnp.asarray(blocks[: bucket // bs], jnp.int32)
-                scales = (
-                    (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
-                    if self.cfg.kv_quant else ()
-                )
-                self.cache, self.pos_b, self.tokens = self._insert(
-                    self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
-                    self.pos_b, self.tokens, slot, plen, first, *scales,
-                )
+            with trace.span("serve.prefill", chunked=False, prompt_len=plen):
+                with self._mesh_ctx():
+                    logits, slot_cache = self._prefill_one(
+                        self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
+                    )
+                    first = self._sample_first_token(
+                        logits, req_key, slot, temperature, top_k, top_p
+                    )
+                    prefill_ids = jnp.asarray(blocks[: bucket // bs], jnp.int32)
+                    scales = (
+                        (slot_cache.k_scale[:, 0], slot_cache.v_scale[:, 0])
+                        if self.cfg.kv_quant else ()
+                    )
+                    self.cache, self.pos_b, self.tokens = self._insert(
+                        self.cache, slot_cache.k[:, 0], slot_cache.v[:, 0], prefill_ids,
+                        self.pos_b, self.tokens, slot, plen, first, *scales,
+                    )
         else:
             # Suffix: its own power-of-two bucket (bounded compile set); true
             # rows land in [hit_len, plen) of the dense view, padding spills
@@ -723,15 +756,19 @@ class PagedBatchEngine:
                 jnp.asarray(suffix)[None, :], jnp.asarray(block_ids),
                 jnp.asarray(hit_len, jnp.int32), jnp.asarray(s_true - 1, jnp.int32),
             )
-            with self._mesh_ctx():
-                args = tuple(self._put_rep(a) for a in args)
-                self.cache, self.pos_b, logits = self._insert_with_prefix(
-                    self.params, self.cache, *args, self.pos_b, slot, plen,
-                )
-                first = self._sample_first_token(
-                    logits, req_key, slot, temperature, top_k, top_p
-                )
-                self.tokens = self._set_at(self.tokens, slot, first)
+            with trace.span(
+                "serve.prefill", chunked=False, prompt_len=plen,
+                prefix_hit_tokens=hit_len,
+            ):
+                with self._mesh_ctx():
+                    args = tuple(self._put_rep(a) for a in args)
+                    self.cache, self.pos_b, logits = self._insert_with_prefix(
+                        self.params, self.cache, *args, self.pos_b, slot, plen,
+                    )
+                    first = self._sample_first_token(
+                        logits, req_key, slot, temperature, top_k, top_p
+                    )
+                    self.tokens = self._set_at(self.tokens, slot, first)
 
         # Register the newly computed shareable blocks for future prompts
         # (this request holds a ref on each until it completes). A digest
@@ -795,35 +832,39 @@ class PagedBatchEngine:
             # still takes only the first `bucket` rows.
             dense = self._get_chunk_cache(max(bucket, n_chunks * C))
         hidden = None
-        for i in range(n_chunks):
-            chunk = jnp.asarray(padded[i * C:(i + 1) * C])[None, :]
+        with trace.span(
+            "serve.prefill", chunked=True, chunks=n_chunks,
+            prompt_len=plen, prefix_hit_tokens=hit_len,
+        ):
+            for i in range(n_chunks):
+                chunk = jnp.asarray(padded[i * C:(i + 1) * C])[None, :]
+                with self._mesh_ctx():
+                    hidden, dense = self._chunk_append(
+                        self.params, self._put_rep(chunk), dense
+                    )
+                if self._active and self.interleave_steps > 0 and i < n_chunks - 1:
+                    executed = self.step_n(self.interleave_steps)
+                    self.stats["interleaved_decode_steps"] = (
+                        self.stats.get("interleaved_decode_steps", 0) + executed
+                    )
             with self._mesh_ctx():
-                hidden, dense = self._chunk_append(
-                    self.params, self._put_rep(chunk), dense
+                logits = self._chunk_logits(
+                    self.params, hidden,
+                    self._put_rep(jnp.asarray((s_true - 1) % C, jnp.int32)),
                 )
-            if self._active and self.interleave_steps > 0 and i < n_chunks - 1:
-                executed = self.step_n(self.interleave_steps)
-                self.stats["interleaved_decode_steps"] = (
-                    self.stats.get("interleaved_decode_steps", 0) + executed
+                first = self._sample_first_token(
+                    logits, req_key, slot, req.temperature, req.top_k, req.top_p
                 )
-        with self._mesh_ctx():
-            logits = self._chunk_logits(
-                self.params, hidden,
-                self._put_rep(jnp.asarray((s_true - 1) % C, jnp.int32)),
-            )
-            first = self._sample_first_token(
-                logits, req_key, slot, req.temperature, req.top_k, req.top_p
-            )
-            # Commit: table row live only now (see docstring).
-            self.table[slot] = 0
-            self.table[slot, : len(blocks)] = blocks
-            prefill_ids = self._put_rep(
-                jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
-            )
-            self.cache, self.pos_b = self._scatter_dense(
-                self.cache, dense, prefill_ids, self.pos_b, slot, plen
-            )
-            self.tokens = self._set_at(self.tokens, slot, first)
+                # Commit: table row live only now (see docstring).
+                self.table[slot] = 0
+                self.table[slot, : len(blocks)] = blocks
+                prefill_ids = self._put_rep(
+                    jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
+                )
+                self.cache, self.pos_b = self._scatter_dense(
+                    self.cache, dense, prefill_ids, self.pos_b, slot, plen
+                )
+                self.tokens = self._set_at(self.tokens, slot, first)
         self.stats["chunked_admissions"] = self.stats.get("chunked_admissions", 0) + 1
         return first
 
@@ -843,6 +884,7 @@ class PagedBatchEngine:
         req.blocks = []
         req.shared_blocks = []
         self._free_slots.append(req.slot)
+        metrics.set("serving_active_slots", len(self._active), {"engine": "paged"})
 
     def step(self) -> None:
         """One decode step across every active slot."""
@@ -867,77 +909,90 @@ class PagedBatchEngine:
             return 0
         n = min(n, max(1, self._completion_bound()), 32)
         n = 1 << (n.bit_length() - 1)  # floor pow2: bounded compile set
-        active = jnp.asarray(
-            [s in self._active and not self._active[s].done for s in range(self.slots)]
+        # Span + histogram per DISPATCH (not per token): the decode loop is
+        # the hot path, and one ~µs span against a ms-scale device dispatch
+        # is what keeps tracing always-on viable (trace_overhead_bench).
+        t0 = time.perf_counter()
+        dispatch_span = trace.span(
+            "serve.decode_dispatch", engine="paged", steps=n,
+            active=len(self._active),
         )
-        table = jnp.asarray(self.table)
-        sampling = (
-            self._keys, jnp.asarray(self.temp), jnp.asarray(self.top_k),
-            jnp.asarray(self.top_p),
-        )
-        # All-greedy batches (the default and the benchmarked configuration)
-        # take the argmax-only executable.
-        any_sampled = bool(
-            any(self._active[s].temperature > 0.0 for s in self._active)
-        )
-        # Pin the host-built inputs replicated (no-op without a mesh or in
-        # multi-process meshes — see _put_rep): left uncommitted, GSPMD may
-        # shard them and the shard_map'd kernel expects them whole.
-        active = self._put_rep(active)
-        table = self._put_rep(table)
-        sampling = tuple(self._put_rep(s) for s in sampling)
-        with self._mesh_ctx():
-            try:
-                step_fn = self._get_step_fn(any_sampled)
-                out = step_fn(
-                    self.params, self.cache, table, self.tokens,
-                    self.pos_b, active, n, *sampling,
-                )
-                if not self._kernel_probed and self.stats["attention_path"] == "kernel":
-                    # JAX dispatch is async: a post-compile pallas RUNTIME
-                    # failure only surfaces at the first blocking consume,
-                    # which would otherwise be np.asarray(toks) OUTSIDE this
-                    # try. Force the consume here, before committing state,
-                    # so the no-donation probe can still fall back with the
-                    # old cache intact.
-                    out = jax.block_until_ready(out)
-            except Exception as e:  # noqa: BLE001 — kernel trace/compile/runtime failure
-                if self.stats["attention_path"] != "kernel" or self._kernel_probed:
-                    raise
-                # One-time probe semantics: the pallas kernel failed its
-                # first contact with this backend — log, rebuild the step on
-                # the XLA gather path (slower, never wrong), and keep
-                # serving. The probe step ran WITHOUT donation, so the cache
-                # survives even a post-compile runtime failure.
-                import sys
+        with dispatch_span:
+            active = jnp.asarray(
+                [s in self._active and not self._active[s].done for s in range(self.slots)]
+            )
+            table = jnp.asarray(self.table)
+            sampling = (
+                self._keys, jnp.asarray(self.temp), jnp.asarray(self.top_k),
+                jnp.asarray(self.top_p),
+            )
+            # All-greedy batches (the default and the benchmarked configuration)
+            # take the argmax-only executable.
+            any_sampled = bool(
+                any(self._active[s].temperature > 0.0 for s in self._active)
+            )
+            # Pin the host-built inputs replicated (no-op without a mesh or in
+            # multi-process meshes — see _put_rep): left uncommitted, GSPMD may
+            # shard them and the shard_map'd kernel expects them whole.
+            active = self._put_rep(active)
+            table = self._put_rep(table)
+            sampling = tuple(self._put_rep(s) for s in sampling)
+            with self._mesh_ctx():
+                try:
+                    step_fn = self._get_step_fn(any_sampled)
+                    out = step_fn(
+                        self.params, self.cache, table, self.tokens,
+                        self.pos_b, active, n, *sampling,
+                    )
+                    if not self._kernel_probed and self.stats["attention_path"] == "kernel":
+                        # JAX dispatch is async: a post-compile pallas RUNTIME
+                        # failure only surfaces at the first blocking consume,
+                        # which would otherwise be np.asarray(toks) OUTSIDE this
+                        # try. Force the consume here, before committing state,
+                        # so the no-donation probe can still fall back with the
+                        # old cache intact.
+                        out = jax.block_until_ready(out)
+                except Exception as e:  # noqa: BLE001 — kernel trace/compile/runtime failure
+                    if self.stats["attention_path"] != "kernel" or self._kernel_probed:
+                        raise
+                    # One-time probe semantics: the pallas kernel failed its
+                    # first contact with this backend — log, rebuild the step on
+                    # the XLA gather path (slower, never wrong), and keep
+                    # serving. The probe step ran WITHOUT donation, so the cache
+                    # survives even a post-compile runtime failure.
+                    import sys
 
-                print(
-                    f"[paged-engine] pallas kernel failed on "
-                    f"{jax.default_backend()!r}: {e!r:.300}; falling back to "
-                    f"the XLA gather path",
-                    file=sys.stderr, flush=True,
-                )
-                self.stats["attention_path"] = "xla_fallback"
-                self.stats["kernel_error"] = repr(e)[:300]
-                self._kernel_probed = True
-                self._use_kernel = False
-                out = self._get_step_fn(any_sampled)(
-                    self.params, self.cache, table, self.tokens,
-                    self.pos_b, active, n, *sampling,
-                )
-            else:
-                if not self._kernel_probed:
-                    # Kernel proved itself: subsequent steps use the
-                    # donating executables (in-place pool updates).
+                    print(
+                        f"[paged-engine] pallas kernel failed on "
+                        f"{jax.default_backend()!r}: {e!r:.300}; falling back to "
+                        f"the XLA gather path",
+                        file=sys.stderr, flush=True,
+                    )
+                    self.stats["attention_path"] = "xla_fallback"
+                    self.stats["kernel_error"] = repr(e)[:300]
                     self._kernel_probed = True
-            self.cache, self.tokens, self.pos_b, toks, self._keys = out
-        host_toks = np.asarray(toks)  # [n, slots]
-        for slot, req in list(self._active.items()):
-            req.tokens.extend(int(t) for t in host_toks[:, slot])
-            if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
-                self._completed[req.request_id] = req
-                del self._active[slot]
-                self._release(req)
+                    self._use_kernel = False
+                    out = self._get_step_fn(any_sampled)(
+                        self.params, self.cache, table, self.tokens,
+                        self.pos_b, active, n, *sampling,
+                    )
+                else:
+                    if not self._kernel_probed:
+                        # Kernel proved itself: subsequent steps use the
+                        # donating executables (in-place pool updates).
+                        self._kernel_probed = True
+                self.cache, self.tokens, self.pos_b, toks, self._keys = out
+            host_toks = np.asarray(toks)  # [n, slots]
+            for slot, req in list(self._active.items()):
+                req.tokens.extend(int(t) for t in host_toks[:, slot])
+                if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
+                    self._completed[req.request_id] = req
+                    del self._active[slot]
+                    self._release(req)
+        metrics.observe(
+            "serving_decode_dispatch_duration_seconds",
+            time.perf_counter() - t0, {"engine": "paged"},
+        )
         return n
 
     def run_until_drained(self, max_steps: int = 10000) -> None:
@@ -1035,14 +1090,22 @@ class PagedBatchEngine:
         ))
         tokens_dev = self._put_rep(jnp.asarray(tokens_in))
         pos_dev = self._put_rep(jnp.asarray(pos_h))
-        with self._mesh_ctx():
-            fn = self._get_spec_step(any_sampled)
-            self.cache, greedy, sampled, self._keys = fn(
-                self.params, self.cache, table, tokens_dev, pos_dev,
-                *sampling,
-            )
-        greedy_h = np.asarray(greedy)   # [slots, S]
-        sampled_h = np.asarray(sampled)  # [slots]
+        t0 = time.perf_counter()
+        with trace.span(
+            "serve.spec_verify", engine="paged", gamma=gamma,
+            active=len(self._active),
+        ):
+            with self._mesh_ctx():
+                fn = self._get_spec_step(any_sampled)
+                self.cache, greedy, sampled, self._keys = fn(
+                    self.params, self.cache, table, tokens_dev, pos_dev,
+                    *sampling,
+                )
+            greedy_h = np.asarray(greedy)   # [slots, S]
+            sampled_h = np.asarray(sampled)  # [slots]
+        metrics.observe(
+            "serving_spec_verify_duration_seconds", time.perf_counter() - t0
+        )
         self.stats["spec_dispatches"] = self.stats.get("spec_dispatches", 0) + 1
         for s, r in list(self._active.items()):
             if r.temperature > 0:

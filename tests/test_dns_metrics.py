@@ -1,10 +1,72 @@
-"""Rendezvous DNS view + metrics registry units."""
+"""Rendezvous DNS view + metrics registry units, including a minimal
+Prometheus text-exposition parser that validates the registry's output the
+way a real scraper would (HELP/TYPE blocks, label syntax, histogram
+invariants)."""
+
+import re
 
 from lws_tpu.api import contract
 from lws_tpu.core import DnsView
-from lws_tpu.core.metrics import MetricsRegistry
+from lws_tpu.core.metrics import MetricsRegistry, render_exposition
 from lws_tpu.runtime import ControlPlane
 from lws_tpu.testing import LWSBuilder
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\",?)*)\})?"
+    r" (?P<value>[0-9.+\-eEInf]+)$"
+)
+
+
+def parse_exposition(text: str) -> dict:
+    """Minimal Prometheus text parser: returns {family: {"type": t,
+    "samples": [(name, labels_dict, value)]}}. Raises AssertionError on
+    anything a real scraper would reject: samples before their TYPE line,
+    duplicate TYPE for a family, malformed sample lines, or histogram
+    bucket counts that are not cumulative."""
+    families: dict = {}
+    current = None
+    for line in text.strip().split("\n"):
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, ftype = line.split(" ", 3)
+            assert name not in families, f"duplicate TYPE for {name}"
+            assert ftype in ("counter", "gauge", "histogram"), line
+            families[name] = {"type": ftype, "samples": []}
+            current = name
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in families:
+                base = base[: -len(suffix)]
+        assert current is not None and base == current, (
+            f"sample {name} outside its family block ({current})"
+        )
+        labels = dict(
+            kv.split("=", 1) for kv in
+            (m.group("labels") or "").split(",") if kv
+        )
+        labels = {k: v.strip('"') for k, v in labels.items()}
+        families[base]["samples"].append((name, labels, float(m.group("value"))))
+    for fam, data in families.items():
+        if data["type"] != "histogram":
+            continue
+        # Bucket counts must be cumulative per label set, ending at +Inf.
+        series: dict = {}
+        for name, labels, value in data["samples"]:
+            if name.endswith("_bucket"):
+                key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+                series.setdefault(key, []).append((labels["le"], value))
+        for key, buckets in series.items():
+            values = [v for _, v in buckets]
+            assert values == sorted(values), f"{fam}{key}: non-cumulative buckets"
+            assert buckets[-1][0] == "+Inf", f"{fam}{key}: missing +Inf bucket"
+    return families
 
 
 def test_dns_resolves_group_members_before_ready():
@@ -56,6 +118,80 @@ def test_metrics_render_prometheus_text():
     assert reg.counter_value("lws_reconcile_total", {"controller": "lws"}) == 2.0
 
 
+def test_metrics_exposition_is_parser_valid():
+    reg = MetricsRegistry()
+    reg.inc("lws_reconcile_total", {"controller": "lws"})
+    reg.set("lws_rollout_progress", 0.5, {"lws": "default/sample", "revision": "abc"})
+    reg.observe("lws_reconcile_duration_seconds", 0.003,
+                {"controller": "lws", "result": "success"})
+    reg.observe("lws_reconcile_duration_seconds", 2.0,
+                {"controller": "lws", "result": "success"})
+    fams = parse_exposition(reg.render())
+    assert fams["lws_reconcile_total"]["type"] == "counter"
+    assert fams["lws_rollout_progress"]["type"] == "gauge"
+    assert fams["lws_rollout_progress"]["samples"][0][2] == 0.5
+    assert fams["lws_reconcile_duration_seconds"]["type"] == "histogram"
+    count = [
+        v for name, labels, v in fams["lws_reconcile_duration_seconds"]["samples"]
+        if name.endswith("_count")
+    ]
+    assert count == [2.0]
+
+
+def test_gauge_set_last_value_wins():
+    reg = MetricsRegistry()
+    reg.set("g", 1.0, {"k": "a"})
+    reg.set("g", 7.0, {"k": "a"})
+    assert reg.gauge_value("g", {"k": "a"}) == 7.0
+    assert reg.gauge_value("g", {"k": "missing"}) is None
+
+
+def test_label_cardinality_cap_drops_and_counts():
+    reg = MetricsRegistry(max_label_sets=3)
+    for i in range(10):
+        reg.inc("per_replica_total", {"replica": str(i)})
+    # First 3 label sets admitted, 7 dropped and counted.
+    assert reg.counter_value("per_replica_total", {"replica": "0"}) == 1.0
+    assert reg.counter_value("per_replica_total", {"replica": "5"}) == 0.0
+    assert reg.counter_value(
+        "lws_metric_label_sets_dropped_total", {"metric": "per_replica_total"}
+    ) == 7.0
+    # Known label sets keep accumulating after the cap trips.
+    reg.inc("per_replica_total", {"replica": "0"})
+    assert reg.counter_value("per_replica_total", {"replica": "0"}) == 2.0
+    # The drop counter renders, so the loss is scrape-visible.
+    assert "lws_metric_label_sets_dropped_total" in reg.render()
+
+
+def test_clear_gauge_retires_superseded_series():
+    reg = MetricsRegistry(max_label_sets=2)
+    reg.set("rollout", 0.5, {"lws": "a", "revision": "r1"})
+    reg.clear_gauge("rollout", {"lws": "a"})
+    reg.set("rollout", 0.1, {"lws": "a", "revision": "r2"})
+    assert reg.gauge_value("rollout", {"lws": "a", "revision": "r1"}) is None
+    assert reg.gauge_value("rollout", {"lws": "a", "revision": "r2"}) == 0.1
+    # Retiring frees cardinality slots: revision churn can't exhaust the cap.
+    for i in range(10):
+        reg.clear_gauge("rollout", {"lws": "a"})
+        reg.set("rollout", i / 10, {"lws": "a", "revision": f"r{i}"})
+    assert reg.gauge_value("rollout", {"lws": "a", "revision": "r9"}) == 0.9
+    assert reg.counter_value(
+        "lws_metric_label_sets_dropped_total", {"metric": "rollout"}
+    ) == 0.0
+
+
+def test_render_exposition_merges_registries():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.inc("shared_total", {"src": "a"})
+    b.inc("shared_total", {"src": "b"})
+    b.set("only_b", 1.0)
+    fams = parse_exposition(render_exposition(a, b))
+    # One family block with BOTH registries' samples (duplicate TYPE lines
+    # would be scraper-invalid; parse_exposition enforces that).
+    assert len(fams["shared_total"]["samples"]) == 2
+    assert "only_b" in fams
+
+
 def test_reconcile_metrics_flow_through_control_plane():
     cp = ControlPlane(auto_ready=True)
     cp.create(LWSBuilder().replicas(1).size(2).build())
@@ -63,3 +199,8 @@ def test_reconcile_metrics_flow_through_control_plane():
     assert cp.metrics.counter_value("lws_reconcile_total", {"controller": "lws"}) > 0
     assert cp.metrics.counter_value("lws_reconcile_total", {"controller": "groupset"}) > 0
     assert cp.metrics.counter_value("lws_reconcile_errors_total", {"controller": "lws"}) == 0
+    # The duration histogram is result-labeled and the whole exposition
+    # stays parser-valid end to end.
+    fams = parse_exposition(cp.metrics.render())
+    samples = fams["lws_reconcile_duration_seconds"]["samples"]
+    assert any(labels.get("result") == "success" for _, labels, _ in samples)

@@ -75,6 +75,10 @@ def role_spec(role: str, kv_port: int, api_url: str, extra_env: list | None = No
 
 def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
                     backend_env: dict | None = None):
+    from lws_tpu.core import trace as _trace
+
+    _trace.TRACER.enabled = True
+    _trace.TRACER.sample_rate = 1.0
     cp = ControlPlane()
     api = ApiServer(cp, port=0)
     api.start()
@@ -115,20 +119,43 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
             time.sleep(0.3)
         assert len(endpoints) == 2, f"-prv endpoints never published: {endpoints}"
 
+        # Trace spine, client leg: the request root span grafts onto the
+        # DS's latest reconcile root — the resulting tree spans controller
+        # reconcile -> admission -> prefill -> KV handoff -> decode across
+        # three processes (the workers' subtrees ride back with the result).
+        from lws_tpu.core import trace
+
+        ds_reconciles = [
+            s for s in trace.TRACER.spans()
+            if s["name"] == "reconcile"
+            and s["attrs"].get("controller") == "disaggregatedset"
+            and s["attrs"].get("object") == "llmd"
+        ]
+        assert ds_reconciles, "no DS reconcile root spans recorded"
+        reconcile_span = ds_reconciles[-1]
+
         # The pod goes Ready when its process is alive, which can precede the
         # worker binding its KV port (engine compile) — dial with retries,
         # exactly like a production client behind a service would.
         prompt = np.array([5, 9, 2, 11, 7], dtype=np.int32)
-        while time.time() < deadline:
-            try:
-                kt.submit_prompt(
-                    endpoints["prefill"], "req1", kt.arrays_to_bytes(prompt=prompt)
-                )
-                break
-            except OSError:
-                time.sleep(0.5)
-        else:
-            pytest.fail("prefill endpoint never accepted the prompt")
+        request_span = trace.TRACER.span(
+            "serve.request", parent={
+                "trace_id": reconcile_span["trace_id"],
+                "span_id": reconcile_span["span_id"],
+            },
+            role="client", request_id="req1",
+        )
+        with request_span:
+            while time.time() < deadline:
+                try:
+                    kt.submit_prompt(
+                        endpoints["prefill"], "req1", kt.arrays_to_bytes(prompt=prompt)
+                    )
+                    break
+                except OSError:
+                    time.sleep(0.5)
+            else:
+                pytest.fail("prefill endpoint never accepted the prompt")
 
         result = meta = None
         while time.time() < deadline:
@@ -158,6 +185,59 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
 
         cfg = flagship_config("smoke", max_seq_len=32)
         assert handoff["bundle_bytes"] >= len(prompt) * kv_row_bytes(cfg), handoff
+
+        # One CONNECTED span tree across three processes: controller
+        # reconcile (control plane) -> client request -> prefill admission +
+        # KV gather (prefill worker) -> deserialize/reshard/decode dispatch
+        # (decode worker), reassembled from the records that rode back with
+        # the result, and JSONL round-trippable.
+        from lws_tpu.core.trace import Tracer, connected_tree
+
+        remote_spans = meta.get("spans")
+        assert remote_spans, meta
+        tree = [reconcile_span, request_span.to_dict()] + list(remote_spans)
+        assert connected_tree(tree), [
+            (s["name"], s["trace_id"], s["parent_id"]) for s in tree
+        ]
+        names = {s["name"] for s in tree}
+        assert {
+            "reconcile", "serve.request", "serve.prefill", "kv.gather",
+            "kv.deserialize", "kv.reshard", "serve.decode_dispatch",
+        } <= names, names
+        # The span subtree SUBSUMES the handoff record: every wire timing is
+        # a span duration, and the gather span carries the pos/bytes attrs.
+        gather = next(s for s in tree if s["name"] == "kv.gather")
+        assert gather["attrs"]["bundle_bytes"] == handoff["bundle_bytes"]
+        assert gather["attrs"]["pos"] == handoff["pos"]
+        exported = str(tmp_path / "request_trace.jsonl")
+        collector = Tracer()
+        for s in tree:
+            collector.record(s)
+        assert collector.export_jsonl(exported) == len(tree)
+        assert connected_tree(Tracer.read_jsonl(exported))
+
+        # Live observability surface: /metrics renders parser-valid
+        # Prometheus text including the new result-labeled reconcile
+        # histogram and rollout gauge; /debug/traces serves recent spans.
+        import urllib.request
+
+        from tests.test_dns_metrics import parse_exposition
+
+        with urllib.request.urlopen(f"{api_url}/metrics", timeout=10) as resp:
+            fams = parse_exposition(resp.read().decode())
+        assert fams["lws_reconcile_duration_seconds"]["type"] == "histogram"
+        assert any(
+            labels.get("result") == "success"
+            for _, labels, _ in fams["lws_reconcile_duration_seconds"]["samples"]
+        )
+        assert fams["lws_rollout_progress"]["type"] == "gauge"
+        with urllib.request.urlopen(
+            f"{api_url}/debug/traces?limit=50", timeout=10
+        ) as resp:
+            import json as _json
+
+            debug_spans = _json.loads(resp.read().decode())
+        assert debug_spans and any(s["name"] == "reconcile" for s in debug_spans)
 
         # Oracle: the same model end-to-end in one engine.
         from lws_tpu.serving.disagg_worker import build_engine

@@ -1,0 +1,191 @@
+"""Trace spine units: span nesting, thread isolation, ring bounds, JSONL
+round-trip, the no-op fast path, and the controller/serving integration
+(reconcile root spans + a connected cross-layer tree)."""
+
+import json
+import threading
+import time
+
+from lws_tpu.core import trace
+from lws_tpu.core.trace import Tracer, connected_tree, walk
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder
+
+
+def test_span_nesting_parent_links():
+    t = Tracer()
+    with t.span("root", layer="test") as root:
+        with t.span("child") as child:
+            with t.span("grandchild") as grand:
+                assert t.current_context() == grand.context
+    spans = t.spans()
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["child"]["parent_id"] == root.span_id
+    assert by_name["grandchild"]["parent_id"] == child.span_id
+    assert {s["trace_id"] for s in spans} == {root.trace_id}
+    assert connected_tree(spans)
+    # Attributes and durations ride the record.
+    assert by_name["root"]["attrs"] == {"layer": "test"}
+    assert all(s["duration_s"] >= 0 for s in spans)
+
+
+def test_span_decorator_and_error_status():
+    t = Tracer()
+
+    @t.trace("decorated", kind="unit")
+    def work():
+        return 42
+
+    assert work() == 42
+    assert t.spans()[-1]["name"] == "decorated"
+
+    try:
+        with t.span("boom"):
+            raise ValueError("nope")
+    except ValueError:
+        pass
+    assert t.spans()[-1]["status"] == "error"
+    assert "ValueError" in t.spans()[-1]["attrs"]["error"]
+
+
+def test_thread_isolation():
+    """Concurrent threads nest independently: no cross-thread parenting."""
+    t = Tracer()
+    barrier = threading.Barrier(2)
+
+    def worker(name):
+        with t.span(name):
+            barrier.wait(timeout=5)
+            with t.span(f"{name}.child"):
+                time.sleep(0.01)
+
+    threads = [threading.Thread(target=worker, args=(f"t{i}",)) for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    by_name = {s["name"]: s for s in t.spans()}
+    for i in range(2):
+        child, parent = by_name[f"t{i}.child"], by_name[f"t{i}"]
+        assert child["parent_id"] == parent["span_id"]
+        assert child["trace_id"] == parent["trace_id"]
+    assert by_name["t0"]["trace_id"] != by_name["t1"]["trace_id"]
+
+
+def test_ring_bounds():
+    t = Tracer(ring=8)
+    for i in range(32):
+        with t.span(f"s{i}"):
+            pass
+    spans = t.spans()
+    assert len(spans) == 8
+    assert spans[-1]["name"] == "s31"  # newest kept, oldest dropped
+    assert t.spans(limit=3) == spans[-3:]
+
+
+def test_jsonl_round_trip(tmp_path):
+    t = Tracer()
+    with t.span("outer", pos=7):
+        with t.span("inner", bundle_bytes=123):
+            pass
+    path = str(tmp_path / "spans.jsonl")
+    n = t.export_jsonl(path)
+    assert n == 2
+    loaded = Tracer.read_jsonl(path)
+    assert loaded == t.spans()
+    assert connected_tree(loaded)
+
+
+def test_live_export_path(tmp_path):
+    path = str(tmp_path / "live.jsonl")
+    t = Tracer(export_path=path)
+    with t.span("a"):
+        pass
+    with t.span("b"):
+        pass
+    lines = [json.loads(line) for line in open(path)]
+    assert [rec["name"] for rec in lines] == ["a", "b"]
+
+
+def test_noop_fast_path():
+    t = Tracer(enabled=False)
+    sp = t.span("anything", heavy="attr")
+    assert sp is trace.NOOP  # one shared object, nothing allocated
+    with sp as inner:
+        inner.set(ignored=True)
+        assert t.current_context() is None
+    assert t.spans() == []
+    # A sampled-out root suppresses its WHOLE subtree (no orphan fragments
+    # from children independently re-rolling the sampler)...
+    t2 = Tracer(sample_rate=0.0)
+    with t2.span("root") as root:
+        assert root.context is None
+        with t2.span("child"):
+            with t2.span("grandchild"):
+                pass
+    assert t2.spans() == []
+    # ...and suppression ends with the root: an always-sample tracer nested
+    # after a suppressed region records normally.
+    t3 = Tracer(sample_rate=0.5)
+    recorded = orphans = 0
+    for _ in range(200):
+        with t3.span("root"):
+            with t3.span("child"):
+                pass
+    for s in t3.spans():
+        if s["name"] == "child" and s["parent_id"] is None:
+            orphans += 1
+        recorded += 1
+    assert orphans == 0, "sampling shredded a trace"
+    assert 0 < recorded < 400  # sampled some, not all
+    # Children of a live span (or an explicit peer context) are always kept.
+    with t2.span("root", parent={"trace_id": "abc", "span_id": "def"}):
+        assert t2.span("child") is not trace.NOOP
+
+
+def test_reconcile_root_spans_flow_through_control_plane():
+    trace.TRACER.clear()
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(2).size(2).build())
+    cp.run_until_stable()
+    spans = trace.TRACER.spans()
+    roots = [s for s in spans if s["name"] == "reconcile"]
+    controllers = {s["attrs"]["controller"] for s in roots}
+    assert {"lws", "groupset", "pod"} <= controllers
+    # Child spans parent under their reconcile root.
+    ids = {s["span_id"] for s in roots}
+    for child_name in ("reconcile.rollout_step", "reconcile.placement",
+                      "reconcile.status"):
+        children = [s for s in spans if s["name"] == child_name]
+        assert children, f"no {child_name} spans recorded"
+        assert all(c["parent_id"] in ids for c in children)
+    # The rollout gauge fed by the status pass is live.
+    assert cp.metrics.gauge_value(
+        "lws_rollout_progress",
+        {"lws": "default/sample",
+         "revision": _revision_of(cp)},
+    ) == 1.0
+
+
+def _revision_of(cp):
+    from lws_tpu.utils import revision as revisionutils
+
+    gs = cp.store.get("GroupSet", "default", "sample")
+    return revisionutils.get_revision_key(gs)
+
+
+def test_connected_tree_helpers_reject_forests():
+    t = Tracer()
+    with t.span("a"):
+        pass
+    with t.span("b"):
+        pass
+    assert not connected_tree(t.spans())  # two roots, two traces
+    t2 = Tracer()
+    with t2.span("root") as r:
+        with t2.span("x"):
+            pass
+        with t2.span("y"):
+            pass
+    names = {s["name"] for s in walk(t2.spans(), r.span_id)}
+    assert names == {"root", "x", "y"}

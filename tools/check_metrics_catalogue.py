@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Catalogue drift check: every metric and span name used in lws_tpu/ must
+be documented in docs/observability.md.
+
+Walks the source AST for the two observability call shapes:
+
+  * metrics writes — `metrics.inc/observe/set("name", ...)` or
+    `self.metrics.inc/observe/set("name", ...)` (any attribute chain ending
+    in `metrics`);
+  * spans — `<anything>.span("name", ...)`.
+
+Only string-literal first arguments count (a dynamic name can't be
+catalogued). Fails with the missing names and their call sites, so adding a
+metric without documenting it breaks `make check` — the catalogue is the
+contract that dashboards and scrape configs are built against.
+
+Run: `make metrics-catalogue` or `python tools/check_metrics_catalogue.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+SOURCE_DIR = ROOT / "lws_tpu"
+CATALOGUE = ROOT / "docs" / "observability.md"
+
+METRIC_METHODS = {"inc", "observe", "set"}
+
+
+def _is_metrics_receiver(node: ast.expr) -> bool:
+    """True for `metrics`, `self.metrics`, `cp.metrics`, `metricsmod`, ...:
+    a Name or attribute chain whose final segment names a metrics object."""
+    if isinstance(node, ast.Name):
+        return node.id in ("metrics", "metricsmod", "REGISTRY")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("metrics", "REGISTRY")
+    return False
+
+
+def collect(path: Path) -> list[tuple[str, str, int]]:
+    """[(kind, name, lineno)] for one file; kind in {metric, span}."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out: list[tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        name = node.args[0].value
+        if node.func.attr == "span":
+            out.append(("span", name, node.lineno))
+        elif node.func.attr in METRIC_METHODS and _is_metrics_receiver(node.func.value):
+            out.append(("metric", name, node.lineno))
+    return out
+
+
+def main() -> int:
+    catalogue = CATALOGUE.read_text()
+    missing: list[str] = []
+    seen: set[tuple[str, str]] = set()
+    for path in sorted(SOURCE_DIR.rglob("*.py")):
+        for kind, name, lineno in collect(path):
+            # Exact backticked mention only: a bare-substring fallback would
+            # let `serving_requests` pass inside `serving_requests_total`.
+            if f"`{name}`" in catalogue:
+                seen.add((kind, name))
+                continue
+            missing.append(
+                f"{path.relative_to(ROOT)}:{lineno}: {kind} {name!r} "
+                f"not documented in docs/observability.md"
+            )
+    if missing:
+        print("\n".join(missing))
+        print(f"\n{len(missing)} undocumented observability name(s); "
+              f"add them to {CATALOGUE.relative_to(ROOT)}")
+        return 1
+    metrics_n = len({n for k, n in seen if k == "metric"})
+    spans_n = len({n for k, n in seen if k == "span"})
+    print(f"catalogue ok: {metrics_n} metric names, {spans_n} span names "
+          f"all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
