@@ -1,4 +1,4 @@
-.PHONY: test lint metrics-catalogue check native bench bench-trace-overhead clean
+.PHONY: test lint metrics-catalogue check native bench bench-trace-overhead bench-decode-overlap clean
 
 test:
 	python -m pytest tests/ -q
@@ -9,7 +9,10 @@ lint:  ## self-contained linter (ref parity: golangci-lint in Makefile:152-198)
 metrics-catalogue:  ## every metric/span name in source must be in docs/observability.md
 	python tools/check_metrics_catalogue.py
 
-check: lint metrics-catalogue test  ## what CI would run
+bench-decode-overlap:  ## pipelined decode must beat the sync loop's host-blocked fraction (budget json)
+	python benchmarks/decode_overlap_bench.py --check
+
+check: lint metrics-catalogue test bench-decode-overlap  ## what CI would run
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

@@ -180,6 +180,11 @@ def _emit_degraded() -> None:
             "TPU relay unreachable for the whole retry budget; value is the "
             f"last on-chip measurement (cached {when}), not fresh"
         )
+        # Explicit flag consumers can key on (vs parsing the note): a real
+        # past measurement is being replayed, not a fresh one — and never
+        # value: 0.0 once any round has succeeded, so a one-round outage
+        # stops reading as "never measured".
+        rec["cached"] = True
     else:
         rec = {
             "metric": "llama-0.9B-bf16 greedy decode throughput, single chip (v5e)",
@@ -187,6 +192,7 @@ def _emit_degraded() -> None:
             "unit": "tokens/s/chip",
             "vs_baseline": 0.0,
             "note": "TPU relay unreachable and no cached on-chip headline exists; 0.0 means never measured, not a measurement",
+            "cached": False,
         }
     rec["degraded"] = True
     # Stage artifacts must EXIST even on a dead relay: a missing

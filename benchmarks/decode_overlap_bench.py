@@ -1,0 +1,196 @@
+"""Decode overlap bench: pipelined vs synchronous serving loop (ISSUE 3).
+
+Measures the paged engine's decode hot path in two configurations on the
+CPU backend (always runnable — the perf axis's first relay-independent
+number):
+
+  * sync      — `pipeline_depth=0`: every dispatch immediately blocks on
+                `np.asarray(toks)`, the loop this repo shipped before the
+                in-flight ring existed;
+  * pipelined — `pipeline_depth=2`: up to two dispatched chunks in flight,
+                tokens consumed while the next chunk computes.
+
+Two numbers per mode, from the pipeline's own accounting:
+
+  * host_blocked_fraction — fraction of the drain loop's wall time the host
+    spent scheduling (input build + dispatch + token bookkeeping) while NO
+    dispatched chunk was in flight, i.e. with the device idle waiting on
+    the host (`serving_host_blocked_seconds`). This is the overlap win.
+  * tok_s — steady-state decode tokens/s over the drain.
+
+Greedy token streams must be BYTE-IDENTICAL between the modes (pipelining
+reorders host consumption, never device math) — checked every run.
+
+Run:    python benchmarks/decode_overlap_bench.py           # report only
+CI:     python benchmarks/decode_overlap_bench.py --check   # enforce budget
+The budget lives in benchmarks/decode_overlap_budget.json; --check fails if
+the host-blocked-fraction reduction regresses below it or the streams
+diverge. Deterministic step counts (fixed seeds, fixed chunking) keep the
+token comparison exact; the timing side is a fraction-of-own-wall measure,
+so a loaded box shifts both modes together.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+import bench  # noqa: E402
+
+bench.force_cpu_if_dev()  # axon plugin overrides JAX_PLATFORMS; see helper
+
+import jax.numpy as jnp  # noqa: E402
+
+from lws_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from lws_tpu.serving.paged_engine import PagedBatchEngine  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "decode_overlap_budget.json")
+
+SLOTS = 8
+MAX_NEW = 96
+CHUNK = 4    # fixed dispatch width -> a deterministic dispatch schedule
+REPEATS = 3  # median fraction per mode: one OS scheduling blip in a ~us
+             # host section must not decide a CI verdict
+
+
+def build_model():
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=512, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return cfg, params
+
+
+def make_prompts():
+    r = np.random.RandomState(0)
+    return [r.randint(1, 255, size=24).astype(np.int32) for _ in range(SLOTS)]
+
+
+def _timed_drain(engine, prompts) -> dict:
+    ids = [engine.submit(p, max_new_tokens=MAX_NEW) for p in prompts]
+    assert all(i is not None for i in ids)
+    stats = engine._pipeline.stats
+    for k in ("host_blocked_s", "device_wait_s"):
+        stats[k] = 0.0
+    t0 = time.perf_counter()
+    dispatched = 0
+    while engine.active_count:
+        dispatched += engine.step_n(CHUNK)
+        if dispatched > MAX_NEW * 4:
+            raise RuntimeError("drain did not converge")
+    engine._pipeline.flush()
+    wall = time.perf_counter() - t0
+    # Request ids restart per engine: key results by submission index so
+    # streams compare across engines and repeats.
+    results = [engine.result(i) for i in ids]
+    return {
+        "wall_s": wall,
+        "host_blocked_s": stats["host_blocked_s"],
+        "device_wait_s": stats["device_wait_s"],
+        "host_blocked_fraction": stats["host_blocked_s"] / wall,
+        "tok_s": sum(len(t) for t in results) / wall,
+        "results": results,
+    }
+
+
+def run_mode(cfg, params, prompts, depth: int, donate_steps=None) -> dict:
+    engine = PagedBatchEngine(
+        cfg, params, slots=SLOTS, max_len=512, block_size=16,
+        pipeline_depth=depth, donate_steps=donate_steps,
+    )
+    # Warm pass: compiles prefill (one bucket) and the CHUNK/2/1 step
+    # executables outside the timed window.
+    for p in prompts:
+        assert engine.submit(p, max_new_tokens=MAX_NEW) is not None
+    while engine.active_count:
+        engine.step_n(CHUNK)
+    engine._pipeline.flush()
+
+    runs = [_timed_drain(engine, prompts) for _ in range(REPEATS)]
+    for r in runs[1:]:  # determinism: every repeat emits the same streams
+        assert r["results"] == runs[0]["results"], "nondeterministic streams"
+    med = sorted(runs, key=lambda r: r["host_blocked_fraction"])[REPEATS // 2]
+    return {
+        "pipeline_depth": depth,
+        "repeats": REPEATS,
+        "wall_s": round(med["wall_s"], 4),
+        "host_blocked_s": round(med["host_blocked_s"], 4),
+        "device_wait_s": round(med["device_wait_s"], 4),
+        "host_blocked_fraction": round(med["host_blocked_fraction"], 5),
+        "tok_s": round(med["tok_s"], 1),
+        "max_inflight": engine._pipeline.stats["max_inflight"],
+        "_results": runs[0]["results"],
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true",
+                        help="enforce decode_overlap_budget.json (CI mode)")
+    args = parser.parse_args()
+
+    cfg, params = build_model()
+    prompts = make_prompts()
+    # The BUDGETED sync baseline runs the pipelined path's non-donating
+    # executables at depth 0: same device work, so the host-blocked delta is
+    # purely the overlap. (The depth-0 engine's shipped config donates the
+    # pool, but on CPU a donating dispatch executes synchronously INSIDE the
+    # call — its entire device compute would land in the host-blocked
+    # window, inflating the baseline fraction to ~95% and making the budget
+    # trivially passable. That shipped-config row is still reported below,
+    # as `sync_donating`, for the donation-vs-overlap attribution.)
+    sync = run_mode(cfg, params, prompts, depth=0, donate_steps=False)
+    pipelined = run_mode(cfg, params, prompts, depth=2)
+    sync_donating = run_mode(cfg, params, prompts, depth=0)
+
+    identical = (
+        sync["_results"] == pipelined["_results"] == sync_donating.pop("_results")
+    )
+    sync.pop("_results"), pipelined.pop("_results")
+    f_sync = sync["host_blocked_fraction"]
+    f_pipe = pipelined["host_blocked_fraction"]
+    reduction = 1.0 - (f_pipe / f_sync) if f_sync > 0 else 0.0
+
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    ok = identical and reduction >= budget["min_host_blocked_reduction"]
+    record = {
+        "metric": "paged decode host-blocked fraction, pipelined vs synchronous "
+                  f"({jax.default_backend()})",
+        "sync": sync,
+        "sync_donating": sync_donating,
+        "pipelined": pipelined,
+        "host_blocked_reduction": round(reduction, 4),
+        "tokens_identical": identical,
+        "budget": budget,
+        "ok": ok,
+    }
+    print(json.dumps(record), flush=True)
+    if args.check and not ok:
+        print(
+            f"[decode-overlap] FAIL: reduction {reduction:.2%} < budget "
+            f"{budget['min_host_blocked_reduction']:.0%} or streams diverged "
+            f"(identical={identical})",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -119,9 +119,12 @@ def plain_engine_row(cfg, params, batch, prompt_len, max_len, decode_steps, gen)
 
 def paged_row(cfg, params, scale, slots, prompt_len, budget_tokens, block, gen) -> dict:
     num_blocks = slots * (budget_tokens // block) + 1
+    # pipeline_depth=0: this bench two-point-differences step_n wall time to
+    # isolate per-step device compute — with the default in-flight ring a
+    # step_n call's wall is an OLDER chunk's eviction wait, not n steps.
     engine = PagedBatchEngine(
         cfg, params, slots=slots, max_len=budget_tokens,
-        block_size=block, num_blocks=num_blocks,
+        block_size=block, num_blocks=num_blocks, pipeline_depth=0,
     )
     rng = np.random.RandomState(0)
     warm_chunk, timed_chunk = (4, 32) if jax.default_backend() != "cpu" else (2, 8)

@@ -178,9 +178,14 @@ def main() -> None:
         dense_gb = slots * max_len * kv_row / 1e9
 
         def run_config():
+            # pipeline_depth=0: measure() two-point-differences step_n wall
+            # time to isolate per-step device compute — with the default
+            # in-flight ring a step_n call's wall is an OLDER chunk's
+            # eviction wait, not n steps (decode_overlap_bench owns the
+            # pipelined-vs-sync comparison).
             engine = PagedBatchEngine(
                 cfg, params, slots=slots, max_len=max_len, block_size=bs,
-                num_blocks=num_blocks, prefix_cache=prefix,
+                num_blocks=num_blocks, prefix_cache=prefix, pipeline_depth=0,
             )
             try:
                 # The engine itself probes the kernel on first decode and

@@ -69,7 +69,13 @@ def main() -> int:
 
     cfg, params = build_engine()
     # ONE engine, one warm compile, modes interleaved per dispatch.
-    engine = PagedBatchEngine(cfg, params, slots=8, max_len=2048, block_size=16)
+    # pipeline_depth=0: each timed step_n(1) must contain its own chunk's
+    # device compute (the denominator of the overhead fraction) — with the
+    # default in-flight ring the call returns after dispatch and the chunk a
+    # mode-'on' call dispatched would be consumed inside a call timed as
+    # 'off', leaking span cost across modes.
+    engine = PagedBatchEngine(cfg, params, slots=8, max_len=2048, block_size=16,
+                              pipeline_depth=0)
     dispatches = args.rounds * args.steps
     budget = 2 * dispatches + 8
     r = np.random.RandomState(0)

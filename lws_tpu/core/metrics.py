@@ -228,5 +228,7 @@ describe("serving_admission_duration_seconds", "Admission (prefill-to-slot) late
 describe("serving_decode_dispatch_duration_seconds", "Decode dispatch latency per engine")
 describe("serving_spec_verify_duration_seconds", "Speculative verify dispatch latency")
 describe("serving_active_slots", "Active decode slots per engine")
+describe("serving_inflight_dispatches", "Dispatched-but-unconsumed decode chunks in the engine's pipeline ring")
+describe("serving_host_blocked_seconds", "Seconds the serving loop spent on host-side scheduling with no device work in flight")
 describe("serving_kv_handoff_bytes_total", "KV bundle bytes shipped prefill -> decode")
 describe("serving_kv_handoffs_total", "KV bundles handed off prefill -> decode")

@@ -58,6 +58,9 @@ class _NoopSpan:
     def set(self, **attrs) -> None:
         pass
 
+    def add(self, **attrs) -> None:
+        pass
+
     def to_dict(self) -> dict:
         return {
             "name": "disabled", "trace_id": "", "span_id": "",
@@ -119,6 +122,13 @@ class Span:
 
     def set(self, **attrs) -> None:
         self.attrs.update(attrs)
+
+    def add(self, **attrs) -> None:
+        """Accumulate numeric attributes (missing keys start at 0): the
+        host-blocked / device-wait attribution the serving pipeline folds
+        into its enclosing dispatch span, one increment per window."""
+        for k, v in attrs.items():
+            self.attrs[k] = self.attrs.get(k, 0) + v
 
     def __enter__(self) -> "Span":
         self.start_unix = time.time()
@@ -272,6 +282,12 @@ class Tracer:
         stack = self._stack()
         return stack[-1].context if stack else None
 
+    def current_span(self):
+        """The innermost live span on this thread, or the shared NOOP when
+        none is open — callers may unconditionally set()/add() on it."""
+        stack = self._stack()
+        return stack[-1] if stack else NOOP
+
     def record(self, record: dict) -> None:
         """Ingest a span record produced elsewhere (a peer process's subtree
         riding back over the result channel) into this ring."""
@@ -343,6 +359,10 @@ def traced(name: Optional[str] = None, **attrs) -> Callable:
 
 def current_context() -> Optional[dict]:
     return TRACER.current_context()
+
+
+def current_span():
+    return TRACER.current_span()
 
 
 def record(rec: dict) -> None:

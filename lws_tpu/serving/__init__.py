@@ -4,4 +4,5 @@ KV cache; decode slice consumes it)."""
 
 from lws_tpu.serving.batch_engine import BatchEngine  # noqa: F401
 from lws_tpu.serving.paged_engine import PagedBatchEngine  # noqa: F401
+from lws_tpu.serving.pipeline import DecodePipeline  # noqa: F401
 from lws_tpu.serving.engine import Engine, GenerationResult  # noqa: F401

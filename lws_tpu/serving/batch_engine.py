@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lws_tpu.core import metrics, trace
+from lws_tpu.serving.pipeline import DecodePipeline, remaining_steps
 
 from lws_tpu.models.llama import (
     LlamaConfig,
@@ -53,7 +54,8 @@ class Request:
 class BatchEngine:
     """Slot-based continuously-batched greedy engine."""
 
-    def __init__(self, cfg: LlamaConfig, params: dict, slots: int = 8, max_len: int = 512):
+    def __init__(self, cfg: LlamaConfig, params: dict, slots: int = 8,
+                 max_len: int = 512, pipeline_depth: int = 2):
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -62,6 +64,13 @@ class BatchEngine:
         self._free = list(range(slots))
         self._active: dict[int, Request] = {}  # slot -> request
         self._completed: dict[int, Request] = {}
+        # Same overlap primitive as the paged engine: up to `pipeline_depth`
+        # dispatched steps stay in flight, their tokens consumed while the
+        # device runs the next step (depth 0 = the old synchronous loop).
+        # _step donates the cache, which CPU PJRT dispatches synchronously —
+        # on the CPU test backend this engine stays effectively sequential
+        # (it is the exactness oracle; the paged engine owns the perf path).
+        self._pipeline = DecodePipeline(depth=pipeline_depth, engine="batch")
 
         self.cache = init_cache(cfg, slots, max_len)
         self.pos_b = jnp.zeros((slots,), jnp.int32)
@@ -109,6 +118,9 @@ class BatchEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt: np.ndarray, max_new_tokens: int) -> Optional[int]:
         """Admit a request into a free slot; returns request id (None = full)."""
+        if not self._free and self._pipeline:
+            # A completion may be sitting unconsumed in the in-flight ring.
+            self._pipeline.flush()
         if not self._free:
             return None
         if len(prompt) + max_new_tokens > self.max_len:
@@ -151,28 +163,49 @@ class BatchEngine:
         return req.request_id
 
     def step(self) -> None:
-        """One decode step across every active slot."""
+        """One decode step across every active slot, pipelined: the dispatch
+        is pushed onto the in-flight ring and its tokens consumed on a later
+        call (or flush). A step that would run the soonest-finishing slot
+        past its budget flushes the ring first, so no request can be stepped
+        beyond max_new_tokens by work already in flight."""
         if not self._active:
+            self._pipeline.flush()
             return
+        bound = min(
+            remaining_steps(r, self.max_len) for r in self._active.values()
+        ) - self._pipeline.inflight_steps()
+        if bound < 1:
+            self._pipeline.flush()
+            if not self._active:
+                return
         t0 = time.perf_counter()
         with trace.span(
             "serve.decode_dispatch", engine="batch", steps=1,
-            active=len(self._active),
+            active=len(self._active), inflight=len(self._pipeline),
         ):
-            active = jnp.asarray(
-                [s in self._active and not self._active[s].done for s in range(self.slots)]
-            )
-            self.cache, self.tokens, self.pos_b = self._step_fn(
-                self.params, self.cache, self.tokens, self.pos_b, active
-            )
-            host_tokens = np.asarray(self.tokens)
-            for slot, req in list(self._active.items()):
-                req.tokens.append(int(host_tokens[slot]))
-                # Position is host-derivable: prompt length + generated tokens.
-                if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
-                    self._completed[req.request_id] = req
-                    del self._active[slot]
-                    self._free.append(slot)
+            with self._pipeline.host_section():
+                active = jnp.asarray(
+                    [s in self._active for s in range(self.slots)]
+                )
+                self.cache, self.tokens, self.pos_b = self._step_fn(
+                    self.params, self.cache, self.tokens, self.pos_b, active
+                )
+            # Only requests active AT DISPATCH got a real token this step.
+            snapshot = dict(self._active)
+
+            def commit(host_tokens, snapshot=snapshot):
+                for slot, req in snapshot.items():
+                    req.tokens.append(int(host_tokens[slot]))
+                    # Position is host-derivable: prompt + generated tokens.
+                    if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
+                        self._completed[req.request_id] = req
+                        # Identity-guarded as a whole: retiring twice would
+                        # put the slot on the free list twice.
+                        if self._active.get(slot) is req:
+                            del self._active[slot]
+                            self._free.append(slot)
+
+            self._pipeline.push(1, self.tokens, commit)
         metrics.observe(
             "serving_decode_dispatch_duration_seconds",
             time.perf_counter() - t0, {"engine": "batch"},
@@ -181,12 +214,26 @@ class BatchEngine:
     def run_until_drained(self, max_steps: int = 10000) -> None:
         for _ in range(max_steps):
             if not self._active:
+                self._pipeline.flush()  # commits only retire, never admit
                 return
             self.step()
         raise RuntimeError("engine did not drain")
 
     def result(self, request_id: int) -> Optional[list[int]]:
         req = self._completed.get(request_id)
+        if req is None and self._pipeline:
+            # Flush only when the request could have finished in-flight: a
+            # poll-while-decoding driver must not drain the ring per call.
+            live = next(
+                (r for r in self._active.values() if r.request_id == request_id),
+                None,
+            )
+            if live is None or (
+                remaining_steps(live, self.max_len)
+                <= self._pipeline.inflight_steps()
+            ):
+                self._pipeline.flush()
+                req = self._completed.get(request_id)
         return list(req.tokens) if req is not None else None
 
     @property

@@ -75,6 +75,7 @@ def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict
 
     from lws_tpu.core import trace
     from lws_tpu.serving.kv_transport import bundle_to_cache
+    from lws_tpu.serving.pipeline import DecodePipeline
 
     with trace.span("kv.deserialize", bundle_bytes=len(payload)) as s_deser:
         cache, token = bundle_to_cache(payload, max_len=engine.max_len)
@@ -82,10 +83,21 @@ def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict
         if engine.mesh is not None:
             cache = jax.device_put(cache, engine._cache_shardings)
             jax.block_until_ready(cache.k)
-    first = np.asarray(token)
-    with trace.span("serve.decode_dispatch", engine="dense", steps=steps) as s_decode:
-        _, _, tokens = engine.decode_n(token, cache, steps)
-        toks = np.asarray(tokens)  # blocks: decode_s is the real dispatch time
+    # Same overlap primitive as the engines' decode loops: dispatch FIRST,
+    # then pull the first token to host while the decode chunk runs on
+    # device (the old order host-synced `token` with the device idle).
+    pipe = DecodePipeline(depth=1, engine="disagg")
+    out: dict = {}
+    # engine="disagg" on BOTH the span and the pipeline's metrics: the span's
+    # host_blocked_s attribute and serving_host_blocked_seconds{engine} must
+    # reconcile per engine label (docs/observability.md ledger contract).
+    with trace.span("serve.decode_dispatch", engine="disagg", steps=steps) as s_decode:
+        with pipe.host_section():
+            _, _, tokens = engine.decode_n(token, cache, steps)
+        pipe.push(steps, tokens, lambda h: out.__setitem__("toks", h))
+        first = np.asarray(token)  # overlaps the in-flight decode dispatch
+        pipe.flush()  # blocks: decode_s is the real dispatch time
+    toks = out["toks"]
     stats = {
         "bundle_bytes": len(payload),
         "deserialize_s": round(s_deser.duration_s, 4),

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lws_tpu.core import metrics, trace
+from lws_tpu.serving.pipeline import DecodePipeline
 from lws_tpu.models.llama import (
     KVCache,
     LlamaConfig,
@@ -144,7 +145,11 @@ def host_sync(x) -> None:
 
 @dataclass
 class GenerationResult:
-    tokens: jax.Array  # [B, steps]
+    # [B, steps]; host np.ndarray from the pipelined generate() (tokens were
+    # already consumed to host chunk by chunk — re-uploading them only for
+    # the caller to download again would be two wasted transfers on exactly
+    # the relay-backed links this engine optimizes), jax.Array elsewhere.
+    tokens: "np.ndarray | jax.Array"
     ttft_s: float
     decode_s: float
     decode_steps: int
@@ -163,6 +168,7 @@ class Engine:
         sampling: SamplingParams = SamplingParams(),
         seed: int = 0,
         mesh=None,
+        pipeline_depth: int = 2,
     ):
         """With `mesh` (axes incl. 'tp'/'dp'), the engine serves TENSOR-
         PARALLEL under GSPMD: params are placed per param_shardings (pass
@@ -198,6 +204,15 @@ class Engine:
         self.max_len = max_len
         self._sampling = sampling  # baked into the jitted paths below
         self._key = jax.random.key(seed)
+        # Bounded in-flight decode dispatches for generate(): the host
+        # consumes chunk N's tokens while chunk N+1 runs on device, instead
+        # of queueing every chunk then fencing once at the end (unbounded
+        # in-flight) — 0 restores a strictly synchronous per-chunk loop.
+        # Caveat: the decode executables donate the cache, and CPU PJRT
+        # blocks a dispatch whose donated input is still computing — real
+        # overlap therefore needs a TPU backend (the paged engine, which
+        # owns the benchmarked hot path, disables donation on CPU instead).
+        self.pipeline_depth = pipeline_depth
 
         cfg_static = cfg
         sampling_static = sampling
@@ -543,9 +558,12 @@ class Engine:
 
         The decode loop runs ON DEVICE via decode_n in fixed-size chunks (one
         dispatch per DECODE_CHUNK steps — no per-token host round trips, which
-        dominate on relay-backed links), with single compiled steps for the
-        remainder. One host-transfer fence at the end; callers benching on
-        high-latency links should still difference two runs (see bench.py)."""
+        dominate on relay-backed links). Dispatches ride a bounded in-flight
+        ring (`pipeline_depth`): chunk N's tokens land on the host while
+        chunk N+1 computes, so results STREAM instead of arriving in one
+        end-of-run fence — and in-flight device state stays bounded. Callers
+        benching on high-latency links should still difference two runs
+        (see bench.py)."""
         steps = max(0, max_new_tokens - 1)
         n_full, rem = divmod(steps, self.DECODE_CHUNK)
         self._warm_decode(n_full > 0, rem > 0)
@@ -563,18 +581,23 @@ class Engine:
             ttft = time.perf_counter() - t0
 
             t1 = time.perf_counter()
-            chunks = [token[:, None]]
+            pipe = DecodePipeline(depth=self.pipeline_depth, engine="dense")
+            host_chunks: list[np.ndarray] = [np.asarray(token)[:, None]]
             for _ in range(n_full):
                 with trace.span("serve.decode_dispatch", engine="dense",
                                 steps=self.DECODE_CHUNK):
-                    token, cache, toks = self.decode_n(token, cache, self.DECODE_CHUNK)
-                chunks.append(toks)
+                    with pipe.host_section():
+                        token, cache, toks = self.decode_n(
+                            token, cache, self.DECODE_CHUNK
+                        )
+                    pipe.push(self.DECODE_CHUNK, toks, host_chunks.append)
             for _ in range(rem):
                 with trace.span("serve.decode_dispatch", engine="dense", steps=1):
-                    token, cache = self.decode(token, cache)
-                chunks.append(token[:, None])
-            tokens = jnp.concatenate(chunks, axis=1)
-            host_sync(tokens)
+                    with pipe.host_section():
+                        token, cache = self.decode(token, cache)
+                    pipe.push(1, token[:, None], host_chunks.append)
+            pipe.flush()
+            tokens = np.concatenate(host_chunks, axis=1)
             dt = time.perf_counter() - t1
             request_span.set(ttft_s=round(ttft, 6), decode_s=round(dt, 6))
         metrics.inc("serving_requests_total", {"engine": "dense"})

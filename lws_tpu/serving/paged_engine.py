@@ -17,6 +17,22 @@ Allocation policy (host side, exclusive):
   * submit() takes ceil(max(bucket, plen+max_new)/bs) blocks up front and
     returns None when the pool (or slot set) is exhausted — callers retry
     after a drain, exactly like a full BatchEngine.
+
+Pipelined dispatch (ISSUE 3): step_n never blocks on its own chunk's
+tokens. Dispatched chunks ride a bounded in-flight ring
+(serving/pipeline.py); the host commits chunk N's tokens — and retires the
+requests they complete — while chunk N+1 computes. Correctness invariants:
+  * the completion bound subtracts in-flight steps, so a chunk that would
+    run the soonest-finishing slot past its budget is never dispatched —
+    which also means no in-flight chunk can ever read blocks of a request
+    that has already been released;
+  * host-built dispatch inputs (active mask, block table, sampling params)
+    are device-resident dirty-tracked buffers rebuilt only on
+    admission/release — in-flight chunks keep their own handles;
+  * the ring flushes before anything that must see host truth or roll back
+    cleanly: the pallas-probe dispatch, speculative dispatch, LRU eviction,
+    and admission backpressure checks (an in-flight completion may be about
+    to free the slot/blocks being refused).
 """
 
 from __future__ import annotations
@@ -32,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lws_tpu.core import metrics, trace
+from lws_tpu.serving.pipeline import DecodePipeline, remaining_steps
 
 from lws_tpu.models.llama import (
     LlamaConfig,
@@ -85,6 +102,8 @@ class PagedBatchEngine:
         prefix_cache: bool = False,
         prefill_chunk: Optional[int] = None,
         interleave_steps: int = 8,
+        pipeline_depth: Optional[int] = None,
+        donate_steps: Optional[bool] = None,
     ):
         """With `mesh` (axes incl. 'tp'), the engine serves TENSOR-PARALLEL
         paged continuous batching under GSPMD: params per param_shardings,
@@ -184,7 +203,45 @@ class PagedBatchEngine:
         self.temp = np.zeros((slots,), np.float32)
         self.top_k = np.zeros((slots,), np.int32)
         self.top_p = np.ones((slots,), np.float32)
-        self._keys = jax.random.split(jax.random.key(0), slots)
+        # Pinned replicated up front: every later _keys value comes out of a
+        # jitted fn with replicated out_shardings, so only this initial
+        # array could reach a dispatch uncommitted (GSPMD may shard it and
+        # the shard_map'd kernel expects it whole).
+        self._keys = self._put_rep(jax.random.split(jax.random.key(0), slots))
+        # Pipelined dispatch (ISSUE 3): a bounded in-flight ring of decode
+        # chunks — the host consumes chunk N's tokens while chunk N+1 runs
+        # on device. depth=0 restores the strictly synchronous loop. The
+        # default is backend-dependent because overlap on CPU requires
+        # giving up step donation (see donate_steps below) and the per-step
+        # pool copy costs more than the ~ms-scale host windows overlap
+        # saves — CPU defaults to the donating synchronous loop, real
+        # accelerators to depth 2 (decode_overlap_bench pins both modes
+        # explicitly, so its comparison is backend-independent).
+        if pipeline_depth is None:
+            pipeline_depth = 0 if jax.default_backend() == "cpu" else 2
+        self._pipeline = DecodePipeline(depth=pipeline_depth, engine="paged")
+        # Host-built dispatch inputs are device-resident, dirty-tracked
+        # buffers: admission/release marks them dirty; step_n re-uploads
+        # only what changed instead of jnp.asarray-ing every dispatch.
+        self._active_mask = np.zeros((slots,), bool)
+        self._active_dev = None
+        self._table_dev = None
+        self._sampling_dev = None
+        self._dirty_active = self._dirty_table = self._dirty_sampling = True
+        # Sampled-slot counter (maintained by _assign_sampling/_release):
+        # replaces the per-dispatch any() scan over self._active.
+        self._sampled_active = 0
+        if donate_steps is None:
+            # CPU PJRT blocks a dispatch whose donated input is still being
+            # computed — donation there would serialize the pipeline back to
+            # the synchronous loop. TPU runtimes donate in-flight buffers
+            # without blocking, and there the in-place pool update is the
+            # memory win donation exists for. With pipelining OFF the
+            # donated input is always a fully-consumed chunk's output, so
+            # donation keeps its in-place win on every backend (the
+            # two-point-differencing benches run depth 0 and rely on it).
+            donate_steps = pipeline_depth == 0 or jax.default_backend() != "cpu"
+        self._donate_steps = donate_steps
 
         @partial(jax.jit, **_sh_prefill)
         def _prefill_one(params, prompt, last_pos):
@@ -372,12 +429,37 @@ class PagedBatchEngine:
         self._step_cache: dict = {}
 
     def _get_step_fn(self, sample: bool):
-        key = (self._use_kernel, self._kernel_probed, sample)
+        donate = self._kernel_probed and self._donate_steps
+        key = (self._use_kernel, donate, sample)
         if key not in self._step_cache:
             self._step_cache[key] = self._make_step_n(
-                use_kernel=self._use_kernel, donate=self._kernel_probed, sample=sample
+                use_kernel=self._use_kernel, donate=donate, sample=sample
             )
         return self._step_cache[key]
+
+    def _dispatch_inputs(self):
+        """Device-resident dispatch inputs, re-uploaded only when dirty.
+        Old in-flight chunks keep references to the arrays they were
+        dispatched with — a dirty rebuild replaces the cached handle, never
+        mutates a buffer under a dispatched executable. Each upload COPIES
+        the host array: jnp.asarray of an aligned numpy array can be
+        ZERO-COPY on the CPU backend, and an aliased buffer would let the
+        next host-side admission/release mutate an input an in-flight chunk
+        is still reading (nondeterministic tokens — caught by the
+        pipelined-vs-sync prefix-cache equivalence test)."""
+        if self._dirty_active:
+            self._active_dev = self._put_rep(jnp.asarray(np.array(self._active_mask)))
+            self._dirty_active = False
+        if self._dirty_table:
+            self._table_dev = self._put_rep(jnp.asarray(np.array(self.table)))
+            self._dirty_table = False
+        if self._dirty_sampling:
+            self._sampling_dev = tuple(
+                self._put_rep(jnp.asarray(np.array(a)))
+                for a in (self.temp, self.top_k, self.top_p)
+            )
+            self._dirty_sampling = False
+        return self._active_dev, self._table_dev, (self._keys, *self._sampling_dev)
 
     def _make_step_n(self, use_kernel: bool, donate: bool = True, sample: bool = False):
         cfg_static = self._cfg_static
@@ -469,6 +551,13 @@ class PagedBatchEngine:
         demand (unmapping their digests). Returns None when the pool cannot
         supply n — checked UP FRONT so a refused oversized request cannot
         flush parked prefixes it would never have used."""
+        if self._pipeline and n > len(self._free_blocks):
+            # Eviction (or an allocation failure) ahead with chunks still in
+            # flight: consume them first. Retiring requests both returns
+            # their private blocks (the allocation may no longer need to
+            # evict at all) and guarantees eviction can never reclaim a
+            # block an in-flight dispatch could still read.
+            self._pipeline.flush()
         if n > len(self._free_blocks) + len(self._lru):
             return None
         out: list[int] = []
@@ -500,6 +589,11 @@ class PagedBatchEngine:
         self.temp[slot] = temperature
         self.top_k[slot] = top_k
         self.top_p[slot] = top_p
+        self._dirty_sampling = True
+        if temperature > 0.0:
+            # Counter, not a per-dispatch scan: _release decrements when the
+            # request retires, so `> 0` is exactly "any sampled slot live".
+            self._sampled_active += 1
         # Unseeded sampling must be nondeterministic (vLLM seed=None): draw
         # from process entropy, not a counter — a counter would collide with
         # small user seeds and make every dp replica replay identical
@@ -541,7 +635,25 @@ class PagedBatchEngine:
             self._release(req)
         else:
             self._active[req.slot] = req
+            self._active_mask[req.slot] = True
+            self._dirty_active = True
         return req.request_id
+
+    def _retire(self, slot: int, req: PagedRequest) -> None:
+        """Move a finished request out of the active set and return its
+        resources. Called from the pipeline's commit path and the
+        speculative loop — the ONLY places a slot leaves self._active. The
+        identity check makes retire idempotent as a whole: a request already
+        retired by an earlier chunk's commit must not release twice (a
+        double _release would double-free its blocks and underflow the
+        sampled-slot counter)."""
+        self._completed[req.request_id] = req
+        if self._active.get(slot) is not req:
+            return
+        del self._active[slot]
+        self._active_mask[slot] = False
+        self._dirty_active = True
+        self._release(req)
 
     def submit(
         self,
@@ -589,6 +701,10 @@ class PagedBatchEngine:
         top_p: float = 1.0,
         seed: Optional[int] = None,
     ) -> Optional[int]:
+        if not self._free_slots and self._pipeline:
+            # Backpressure with chunks in flight: completions may be sitting
+            # unconsumed in the ring — consume before refusing admission.
+            self._pipeline.flush()
         if not self._free_slots:
             return None
         plen = len(prompt)
@@ -607,6 +723,8 @@ class PagedBatchEngine:
                 prompt, max_new_tokens, temperature, top_k, top_p, seed,
                 plen, bucket, n_blocks,
             )
+        if n_blocks > len(self._free_blocks) and self._pipeline:
+            self._pipeline.flush()  # in-flight completions may free blocks
         if n_blocks > len(self._free_blocks):
             return None
         slot = self._free_slots.pop(0)
@@ -619,10 +737,12 @@ class PagedBatchEngine:
         req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
         if self.prefill_chunk is not None and plen > self.prefill_chunk:
             self.table[slot] = 0  # null-mapped until _admit_chunked commits
+            self._dirty_table = True
             first = self._admit_chunked(req, req_key, blocks, bucket, plen, 0, None)
             return self._finish_admission(req, first)
         self.table[slot] = 0
         self.table[slot, :n_blocks] = blocks
+        self._dirty_table = True
 
         padded = np.zeros((bucket,), np.int32)
         padded[:plen] = prompt
@@ -694,6 +814,7 @@ class PagedBatchEngine:
         if not chunked:
             self.table[slot] = 0
             self.table[slot, :n_blocks] = blocks
+            self._dirty_table = True
 
         if chunked:
             # Chunked admission composed with prefix caching: gather the hit
@@ -703,6 +824,7 @@ class PagedBatchEngine:
             # under it), append suffix chunks, commit. The view is padded by
             # one chunk so the final padded tail cannot overflow the bucket.
             self.table[slot] = 0  # null-mapped until _admit_chunked commits
+            self._dirty_table = True
             dense = None
             if hits:
                 with self._mesh_ctx():
@@ -858,6 +980,7 @@ class PagedBatchEngine:
                 # Commit: table row live only now (see docstring).
                 self.table[slot] = 0
                 self.table[slot, : len(blocks)] = blocks
+                self._dirty_table = True
                 prefill_ids = self._put_rep(
                     jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
                 )
@@ -870,6 +993,9 @@ class PagedBatchEngine:
 
     def _release(self, req: PagedRequest) -> None:
         self.table[req.slot] = 0  # dead writes + stale reads -> null block
+        self._dirty_table = True
+        if req.temperature > 0.0:
+            self._sampled_active -= 1
         shared = set(req.shared_blocks)
         for blk in req.blocks:
             if blk in shared:
@@ -892,22 +1018,42 @@ class PagedBatchEngine:
 
     def _completion_bound(self) -> int:
         """Steps until the soonest completion/length-overflow among active
-        slots — the longest chunk that cannot overrun any budget."""
-        return min(
-            min(r.max_new_tokens - len(r.tokens) for r in self._active.values()),
-            min(self.max_len - len(r.prompt) - len(r.tokens)
-                for r in self._active.values()),
-        )
+        slots — the longest chunk that cannot overrun any budget. One pass:
+        both budgets of a slot are folded before crossing slots."""
+        return min(remaining_steps(r, self.max_len) for r in self._active.values())
 
     def step_n(self, n: int) -> int:
-        """Up to n decode steps in one device dispatch. Clamped to the
-        soonest completion among active slots (admission state is frozen for
-        the chunk, and a slot stepping past its block footprint would write
-        into the shared null block while its mask starts attending it).
-        Returns the number of steps actually executed."""
-        if not self._active or n <= 0:
+        """Up to n decode steps in one device dispatch, PIPELINED: the chunk
+        is pushed onto the in-flight ring and its tokens are consumed on a
+        later call (or flush) while the device keeps computing — the host
+        never blocks on `np.asarray(toks)` inside the dispatch path. Clamped
+        to the soonest completion among active slots MINUS the steps already
+        in flight (admission state is frozen per chunk, and a slot stepping
+        past its block footprint would write into the shared null block
+        while its mask starts attending it); when every remaining step of
+        the soonest-finishing slot is already in the ring, the ring is
+        flushed first and the bound re-clamped over whatever survives.
+        Returns the number of steps actually dispatched."""
+        if n <= 0:
             return 0
-        n = min(n, max(1, self._completion_bound()), 32)
+        if not self._active:
+            self._pipeline.flush()
+            return 0
+        bound = self._completion_bound() - self._pipeline.inflight_steps()
+        if bound < 1:
+            self._pipeline.flush()  # consume; retires re-clamp the bound
+            if not self._active:
+                return 0
+            bound = self._completion_bound()
+        probing = not self._kernel_probed and self.stats["attention_path"] == "kernel"
+        if probing and self._pipeline:
+            # Probe rollback contract: a failed kernel dispatch must leave
+            # nothing half-committed — enter the probe with an empty ring.
+            self._pipeline.flush()
+            if not self._active:
+                return 0
+            bound = self._completion_bound()
+        n = min(n, max(1, bound), 32)
         n = 1 << (n.bit_length() - 1)  # floor pow2: bounded compile set
         # Span + histogram per DISPATCH (not per token): the decode loop is
         # the hot path, and one ~µs span against a ms-scale device dispatch
@@ -915,80 +1061,80 @@ class PagedBatchEngine:
         t0 = time.perf_counter()
         dispatch_span = trace.span(
             "serve.decode_dispatch", engine="paged", steps=n,
-            active=len(self._active),
+            active=len(self._active), inflight=len(self._pipeline),
         )
         with dispatch_span:
-            active = jnp.asarray(
-                [s in self._active and not self._active[s].done for s in range(self.slots)]
-            )
-            table = jnp.asarray(self.table)
-            sampling = (
-                self._keys, jnp.asarray(self.temp), jnp.asarray(self.top_k),
-                jnp.asarray(self.top_p),
-            )
-            # All-greedy batches (the default and the benchmarked configuration)
-            # take the argmax-only executable.
-            any_sampled = bool(
-                any(self._active[s].temperature > 0.0 for s in self._active)
-            )
-            # Pin the host-built inputs replicated (no-op without a mesh or in
-            # multi-process meshes — see _put_rep): left uncommitted, GSPMD may
-            # shard them and the shard_map'd kernel expects them whole.
-            active = self._put_rep(active)
-            table = self._put_rep(table)
-            sampling = tuple(self._put_rep(s) for s in sampling)
-            with self._mesh_ctx():
-                try:
-                    step_fn = self._get_step_fn(any_sampled)
-                    out = step_fn(
-                        self.params, self.cache, table, self.tokens,
-                        self.pos_b, active, n, *sampling,
-                    )
-                    if not self._kernel_probed and self.stats["attention_path"] == "kernel":
-                        # JAX dispatch is async: a post-compile pallas RUNTIME
-                        # failure only surfaces at the first blocking consume,
-                        # which would otherwise be np.asarray(toks) OUTSIDE this
-                        # try. Force the consume here, before committing state,
-                        # so the no-donation probe can still fall back with the
-                        # old cache intact.
-                        out = jax.block_until_ready(out)
-                except Exception as e:  # noqa: BLE001 — kernel trace/compile/runtime failure
-                    if self.stats["attention_path"] != "kernel" or self._kernel_probed:
-                        raise
-                    # One-time probe semantics: the pallas kernel failed its
-                    # first contact with this backend — log, rebuild the step on
-                    # the XLA gather path (slower, never wrong), and keep
-                    # serving. The probe step ran WITHOUT donation, so the cache
-                    # survives even a post-compile runtime failure.
-                    import sys
+            # Host-side scheduling window: with chunks in flight it overlaps
+            # device compute; with an empty ring it counts as host-blocked.
+            with self._pipeline.host_section():
+                # Dirty-tracked device inputs (already pinned replicated —
+                # see _put_rep; uncommitted, GSPMD may shard them and the
+                # shard_map'd kernel expects them whole).
+                active, table, sampling = self._dispatch_inputs()
+                # All-greedy batches (the default and the benchmarked
+                # configuration) take the argmax-only executable.
+                any_sampled = self._sampled_active > 0
+                with self._mesh_ctx():
+                    try:
+                        step_fn = self._get_step_fn(any_sampled)
+                        out = step_fn(
+                            self.params, self.cache, table, self.tokens,
+                            self.pos_b, active, n, *sampling,
+                        )
+                        if probing:
+                            # JAX dispatch is async: a post-compile pallas
+                            # RUNTIME failure only surfaces at the first
+                            # blocking consume, which would otherwise happen
+                            # chunks later in the pipeline. Force the consume
+                            # here, before committing state, so the
+                            # no-donation probe can still fall back with the
+                            # old cache intact.
+                            out = jax.block_until_ready(out)
+                    except Exception as e:  # noqa: BLE001 — kernel trace/compile/runtime failure
+                        if self.stats["attention_path"] != "kernel" or self._kernel_probed:
+                            raise
+                        # One-time probe semantics: the pallas kernel failed
+                        # its first contact with this backend — log, rebuild
+                        # the step on the XLA gather path (slower, never
+                        # wrong), and keep serving. The probe step ran
+                        # WITHOUT donation, so the cache survives even a
+                        # post-compile runtime failure.
+                        import sys
 
-                    print(
-                        f"[paged-engine] pallas kernel failed on "
-                        f"{jax.default_backend()!r}: {e!r:.300}; falling back to "
-                        f"the XLA gather path",
-                        file=sys.stderr, flush=True,
-                    )
-                    self.stats["attention_path"] = "xla_fallback"
-                    self.stats["kernel_error"] = repr(e)[:300]
-                    self._kernel_probed = True
-                    self._use_kernel = False
-                    out = self._get_step_fn(any_sampled)(
-                        self.params, self.cache, table, self.tokens,
-                        self.pos_b, active, n, *sampling,
-                    )
-                else:
-                    if not self._kernel_probed:
-                        # Kernel proved itself: subsequent steps use the
-                        # donating executables (in-place pool updates).
+                        print(
+                            f"[paged-engine] pallas kernel failed on "
+                            f"{jax.default_backend()!r}: {e!r:.300}; falling back to "
+                            f"the XLA gather path",
+                            file=sys.stderr, flush=True,
+                        )
+                        self.stats["attention_path"] = "xla_fallback"
+                        self.stats["kernel_error"] = repr(e)[:300]
                         self._kernel_probed = True
-                self.cache, self.tokens, self.pos_b, toks, self._keys = out
-            host_toks = np.asarray(toks)  # [n, slots]
-            for slot, req in list(self._active.items()):
-                req.tokens.extend(int(t) for t in host_toks[:, slot])
-                if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
-                    self._completed[req.request_id] = req
-                    del self._active[slot]
-                    self._release(req)
+                        self._use_kernel = False
+                        out = self._get_step_fn(any_sampled)(
+                            self.params, self.cache, table, self.tokens,
+                            self.pos_b, active, n, *sampling,
+                        )
+                    else:
+                        if not self._kernel_probed:
+                            # Kernel proved itself: subsequent steps may use
+                            # the donating executables (in-place pool
+                            # updates) where the backend supports async
+                            # donation.
+                            self._kernel_probed = True
+                    self.cache, self.tokens, self.pos_b, toks, self._keys = out
+            # Commit runs at consume time: only requests active AT DISPATCH
+            # received real tokens from this chunk (later admissions into
+            # freed slots computed masked-out garbage for it).
+            snapshot = dict(self._active)
+
+            def commit(host_toks, snapshot=snapshot):  # host_toks [n, slots]
+                for slot, req in snapshot.items():
+                    req.tokens.extend(int(t) for t in host_toks[:, slot])
+                    if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
+                        self._retire(slot, req)
+
+            self._pipeline.push(n, toks, commit)
         metrics.observe(
             "serving_decode_dispatch_duration_seconds",
             time.perf_counter() - t0, {"engine": "paged"},
@@ -997,9 +1143,11 @@ class PagedBatchEngine:
 
     def run_until_drained(self, max_steps: int = 10000) -> None:
         """Drain via chunked on-device stepping: each dispatch runs exactly
-        up to the soonest completion, so no slot oversteps its budget."""
+        up to the soonest completion (in-flight steps included), so no slot
+        oversteps its budget; the final in-flight chunks are flushed."""
         for _ in range(max_steps):
             if not self._active:
+                self._pipeline.flush()  # commits only retire, never admit
                 return
             self.step_n(32)  # step_n clamps to the completion bound itself
         raise RuntimeError("engine did not drain")
@@ -1057,6 +1205,11 @@ class PagedBatchEngine:
         to step_n(1), exactly like the plain Engine's tail handling."""
         from lws_tpu.serving.engine import Engine
 
+        # Speculative dispatch drafts from host-side token history and
+        # rewrites pos/tokens from host truth afterwards — both require the
+        # in-flight ring drained first (the same flush contract as the
+        # pallas probe).
+        self._pipeline.flush()
         if not self._active:
             return False
         if all(r.temperature > 0 for r in self._active.values()):
@@ -1080,14 +1233,8 @@ class PagedBatchEngine:
             tokens_in[s, 0] = r.tokens[-1]
             tokens_in[s, 1:] = d
             pos_h[s] = len(r.prompt) + len(r.tokens) - 1
-        any_sampled = bool(
-            any(r.temperature > 0.0 for r in self._active.values())
-        )
-        table = self._put_rep(jnp.asarray(self.table))
-        sampling = tuple(self._put_rep(a) for a in (
-            self._keys, jnp.asarray(self.temp), jnp.asarray(self.top_k),
-            jnp.asarray(self.top_p),
-        ))
+        any_sampled = self._sampled_active > 0
+        _, table, sampling = self._dispatch_inputs()
         tokens_dev = self._put_rep(jnp.asarray(tokens_in))
         pos_dev = self._put_rep(jnp.asarray(pos_h))
         t0 = time.perf_counter()
@@ -1125,9 +1272,7 @@ class PagedBatchEngine:
                 )
             r.tokens.extend(new)
             if r.done or len(r.prompt) + len(r.tokens) >= self.max_len:
-                self._completed[r.request_id] = r
-                del self._active[s]
-                self._release(r)
+                self._retire(s, r)
         # Commit host truth back to the device state the regular step path
         # reads (pos_b IS the paged cache's rewind: rejected draft rows sit
         # past pos_b, masked out of attention until overwritten).
@@ -1149,6 +1294,7 @@ class PagedBatchEngine:
         batch — speculation would never apply again)."""
         for _ in range(max_dispatches):
             if not self._active:
+                self._pipeline.flush()  # commits only retire, never admit
                 return
             if not self.step_speculative(gamma, ngram):
                 greedy_alive = any(
@@ -1164,6 +1310,22 @@ class PagedBatchEngine:
 
     def result(self, request_id: int) -> Optional[list[int]]:
         req = self._completed.get(request_id)
+        if req is None and self._pipeline:
+            # The request may have finished inside an unconsumed chunk —
+            # but only flush when it actually could have: a poll-style
+            # driver calling result() for still-running requests after
+            # every step must not degrade the ring back to the synchronous
+            # loop.
+            live = next(
+                (r for r in self._active.values() if r.request_id == request_id),
+                None,
+            )
+            if live is None or (
+                remaining_steps(live, self.max_len)
+                <= self._pipeline.inflight_steps()
+            ):
+                self._pipeline.flush()
+                req = self._completed.get(request_id)
         return list(req.tokens) if req is not None else None
 
     @property

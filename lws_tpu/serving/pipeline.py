@@ -1,0 +1,165 @@
+"""Bounded in-flight dispatch ring: the serving overlap primitive.
+
+JAX dispatch is asynchronous — a jitted decode chunk returns device futures
+long before the compute finishes — but every engine loop in this repo used
+to force a host sync (`np.asarray(toks)`) immediately after each dispatch,
+so the device idled through every host-side admission/bookkeeping window
+and the host idled through every device window. `DecodePipeline` keeps up
+to `depth` dispatched-but-unconsumed chunks in flight: the host consumes
+chunk N's tokens while chunk N+1 runs on device.
+
+One instance per engine loop; three operations:
+
+  * `push(steps, payload, commit)` — enqueue a dispatched chunk; `payload`
+    is the device array carrying its tokens, `commit(host)` applies the
+    host-side bookkeeping once the transfer lands. Pushing past `depth`
+    consumes the oldest chunk (FIFO — commit order is dispatch order, which
+    the engines' host truth depends on). `depth=0` is the synchronous loop:
+    every push consumes immediately.
+  * `flush()` — consume everything in flight. Engines call it before any
+    operation that must see host truth up to date (speculative dispatch,
+    the pallas-probe step, block eviction) or that re-reads device state
+    the ring still owns.
+  * `discard()` — drop in-flight chunks WITHOUT committing. The pallas
+    probe itself never needs it (the paged engine flushes BEFORE the probe
+    dispatch, so a failed probe leaves an empty ring); discard is the
+    escape hatch for callers that must abandon in-flight work whose
+    results are known-invalid rather than commit garbage.
+
+Attribution (the host-blocked vs device-busy split):
+
+  * `host_section()` wraps an engine's host-side scheduling window (input
+    build + dispatch). Time spent there while the ring is EMPTY is time the
+    device sat idle waiting on the host — counted into
+    `serving_host_blocked_seconds{engine}` and added as `host_blocked_s` on
+    the enclosing span. With chunks in flight the same window overlaps
+    device compute and costs nothing.
+  * each consume runs in a `serve.decode_consume` span whose
+    `device_wait_s` attribute is the blocking part of the transfer — the
+    device-busy side of the ledger.
+  * `serving_inflight_dispatches{engine}` gauges the ring depth live.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from lws_tpu.core import metrics, trace
+
+
+def remaining_steps(req, max_len: int) -> int:
+    """Decode steps a request can still take before completing: its token
+    budget or the engine's length ceiling, whichever is nearer. THE
+    completion predicate — the engines' bound clamps, flush gates, and
+    result() fast paths all share it so their semantics cannot drift."""
+    return min(
+        req.max_new_tokens - len(req.tokens),
+        max_len - len(req.prompt) - len(req.tokens),
+    )
+
+
+class _HostSection:
+    """Times a host-side scheduling window; counts it as host-blocked only
+    when no dispatched chunk was in flight at entry (device idle, host is
+    the bottleneck). Re-entrant nesting is the caller's job to avoid —
+    engines open one section per dispatch and one per commit."""
+
+    __slots__ = ("_pipe", "_blocked", "_t0")
+
+    def __init__(self, pipe: "DecodePipeline") -> None:
+        self._pipe = pipe
+
+    def __enter__(self) -> "_HostSection":
+        self._blocked = not self._pipe._ring
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._blocked:
+            dt = time.perf_counter() - self._t0
+            self._pipe.stats["host_blocked_s"] += dt
+            metrics.inc(
+                "serving_host_blocked_seconds",
+                {"engine": self._pipe.engine_label}, value=dt,
+            )
+            trace.current_span().add(host_blocked_s=dt)
+        return False
+
+
+class DecodePipeline:
+    def __init__(self, depth: int = 2, engine: str = "paged") -> None:
+        """`depth` caps dispatched-but-unconsumed chunks (0 = synchronous);
+        `engine` labels the metrics this ring reports."""
+        self.depth = max(0, int(depth))
+        self.engine_label = engine
+        self._ring: "deque[tuple[int, object, Callable]]" = deque()
+        self.stats = {
+            "dispatched": 0, "consumed": 0, "flushes": 0, "discarded": 0,
+            "host_blocked_s": 0.0, "device_wait_s": 0.0, "max_inflight": 0,
+        }
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def __bool__(self) -> bool:
+        return bool(self._ring)
+
+    def inflight_steps(self) -> int:
+        """Total decode steps dispatched but not yet committed to host truth
+        — the engines subtract this from their completion bound so no slot's
+        budget can be overrun by work already in the ring."""
+        return sum(steps for steps, _, _ in self._ring)
+
+    def host_section(self) -> _HostSection:
+        return _HostSection(self)
+
+    def push(self, steps: int, payload, commit: Callable) -> None:
+        self._ring.append((steps, payload, commit))
+        self.stats["dispatched"] += 1
+        while len(self._ring) > self.depth:
+            self._consume_oldest()
+        # Gauge/max AFTER settling to depth: the documented contract is
+        # "0 in a synchronous loop, up to the configured depth" — the
+        # transient depth+1 during eviction is not an observable state.
+        if len(self._ring) > self.stats["max_inflight"]:
+            self.stats["max_inflight"] = len(self._ring)
+        self._gauge()
+
+    def flush(self) -> None:
+        if self._ring:
+            self.stats["flushes"] += 1
+        while self._ring:
+            self._consume_oldest()
+
+    def discard(self) -> None:
+        self.stats["discarded"] += len(self._ring)
+        self._ring.clear()
+        self._gauge()
+
+    def _consume_oldest(self) -> None:
+        steps, payload, commit = self._ring.popleft()
+        with trace.span(
+            "serve.decode_consume", engine=self.engine_label, steps=steps,
+            inflight=len(self._ring),
+        ) as sp:
+            t0 = time.perf_counter()
+            # np.asarray is the completion fence (block_until_ready is not
+            # reliable on relay-backed remote backends — see engine.host_sync).
+            host = np.asarray(payload)
+            wait = time.perf_counter() - t0
+            self.stats["device_wait_s"] += wait
+            sp.set(device_wait_s=round(wait, 6))
+            with self.host_section():
+                commit(host)
+        self.stats["consumed"] += 1
+        self._gauge()
+
+    def _gauge(self) -> None:
+        metrics.set(
+            "serving_inflight_dispatches", len(self._ring),
+            {"engine": self.engine_label},
+        )

@@ -1,0 +1,348 @@
+"""Pipelined decode (ISSUE 3): the bounded in-flight dispatch ring must
+change WHEN tokens reach the host, never WHICH tokens — pipelined engines
+are token-identical to the synchronous (`pipeline_depth=0`) loop under
+mid-stream admission, eviction, early completion, sampling, and the
+pallas→XLA fallback probe with a non-empty in-flight queue."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu.models.llama import LlamaConfig, init_params
+from lws_tpu.serving.batch_engine import BatchEngine
+from lws_tpu.serving.paged_engine import PagedBatchEngine
+from lws_tpu.serving.pipeline import DecodePipeline
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return cfg, params
+
+
+def prompts(n, rng=3):
+    r = np.random.RandomState(rng)
+    return [r.randint(1, 255, size=r.randint(4, 40)).astype(np.int32) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# DecodePipeline unit behavior (no engine, numpy payloads).
+
+
+def test_pipeline_fifo_depth_and_flush():
+    pipe = DecodePipeline(depth=2, engine="batch")
+    order = []
+    for i in range(4):
+        pipe.push(3, np.asarray([i]), lambda h: order.append(int(h[0])))
+    # depth 2: pushes 3 and 4 evicted chunks 0 and 1, in dispatch order.
+    assert order == [0, 1]
+    assert len(pipe) == 2 and pipe.inflight_steps() == 6
+    pipe.flush()
+    assert order == [0, 1, 2, 3]
+    assert not pipe and pipe.inflight_steps() == 0
+    assert pipe.stats["dispatched"] == pipe.stats["consumed"] == 4
+
+
+def test_pipeline_depth_zero_is_synchronous():
+    pipe = DecodePipeline(depth=0, engine="batch")
+    seen = []
+    pipe.push(1, np.asarray([7]), lambda h: seen.append(int(h[0])))
+    assert seen == [7] and not pipe
+
+
+def test_pipeline_discard_drops_without_commit():
+    pipe = DecodePipeline(depth=4, engine="batch")
+    seen = []
+    pipe.push(1, np.asarray([1]), lambda h: seen.append(int(h[0])))
+    pipe.discard()
+    assert seen == [] and not pipe
+    assert pipe.stats["discarded"] == 1
+
+
+def test_pipeline_host_blocked_only_when_ring_empty():
+    pipe = DecodePipeline(depth=2, engine="batch")
+    with pipe.host_section():
+        pass
+    blocked_empty = pipe.stats["host_blocked_s"]
+    assert blocked_empty >= 0.0
+    pipe.push(1, np.asarray([0]), lambda h: None)
+    before = pipe.stats["host_blocked_s"]
+    with pipe.host_section():  # ring non-empty: overlapped, not blocked
+        pass
+    assert pipe.stats["host_blocked_s"] == before
+
+
+# ---------------------------------------------------------------------------
+# Engine equivalence: pipelined vs synchronous.
+
+
+def _run_paged(cfg, params, depth, schedule, **engine_kw):
+    eng = PagedBatchEngine(cfg, params, pipeline_depth=depth, **engine_kw)
+    return schedule(eng), eng
+
+
+def test_paged_pipelined_matches_sync_greedy_early_completion(small_model):
+    """Mixed budgets: the soonest completion forces in-flight-aware bound
+    re-clamping; every stream must match the synchronous loop exactly."""
+    cfg, params = small_model
+    ps = prompts(4)
+    budgets = (12, 3, 7, 1)  # 1: completes at admission; 3/7: early retires
+
+    def schedule(eng):
+        ids = [eng.submit(p, max_new_tokens=m) for p, m in zip(ps, budgets)]
+        eng.run_until_drained()
+        return [eng.result(i) for i in ids]
+
+    kw = dict(slots=4, max_len=64, block_size=8)
+    sync, _ = _run_paged(cfg, params, 0, schedule, **kw)
+    piped, eng = _run_paged(cfg, params, 3, schedule, **kw)
+    assert sync == piped
+    assert [len(t) for t in piped] == list(budgets)
+    assert eng._pipeline.stats["max_inflight"] >= 2  # overlap actually happened
+
+
+def test_paged_pipelined_matches_sync_midstream_admission(small_model):
+    """Admission into slots/blocks freed by in-flight completions: submit
+    flushes the ring instead of refusing, and later chunks' commits only
+    touch requests active at their dispatch."""
+    cfg, params = small_model
+    ps = prompts(3, rng=7)
+
+    def schedule(eng):
+        a = eng.submit(ps[0], max_new_tokens=4)
+        b = eng.submit(ps[1], max_new_tokens=20)
+        third = None
+        for _ in range(200):
+            eng.step_n(2)
+            if third is None and eng.active_count < 2:
+                third = eng.submit(ps[2], max_new_tokens=10)
+                assert third is not None
+            if eng.active_count == 0 and third is not None:
+                break
+        return [eng.result(i) for i in (a, b, third)]
+
+    # Pool sized so the third request NEEDS the first's released blocks.
+    kw = dict(slots=2, max_len=64, block_size=8, num_blocks=2 * 8 + 1)
+    sync, _ = _run_paged(cfg, params, 0, schedule, **kw)
+    piped, _ = _run_paged(cfg, params, 2, schedule, **kw)
+    assert sync == piped
+
+
+def test_paged_pipelined_matches_sync_sampled(small_model):
+    """Seeded sampling: the per-slot PRNG key schedule is one split per
+    dispatched step regardless of when tokens are consumed — pipelined and
+    sync streams must be identical, mixed greedy/sampled batch included."""
+    cfg, params = small_model
+    ps = prompts(3, rng=11)
+
+    def schedule(eng):
+        ids = [
+            eng.submit(ps[0], max_new_tokens=10, temperature=0.8, seed=5),
+            eng.submit(ps[1], max_new_tokens=10),  # greedy slot in the mix
+            eng.submit(ps[2], max_new_tokens=6, temperature=1.2, top_k=20, seed=9),
+        ]
+        eng.run_until_drained()
+        return [eng.result(i) for i in ids]
+
+    kw = dict(slots=3, max_len=64, block_size=8)
+    sync, _ = _run_paged(cfg, params, 0, schedule, **kw)
+    piped, eng = _run_paged(cfg, params, 2, schedule, **kw)
+    assert sync == piped
+    assert eng._sampled_active == 0  # counter balanced after drain
+
+
+def test_paged_pipelined_matches_sync_prefix_eviction(small_model):
+    """Prefix cache: a pool sized so a later admission must EVICT parked
+    prefix blocks while decode chunks are in flight (_alloc_blocks flushes
+    the ring before evicting), with a prefix HIT pinned across the same
+    window — streams must still match the synchronous engine."""
+    cfg, params = small_model
+    r = np.random.RandomState(13)
+    prompt_a = r.randint(1, 255, size=24).astype(np.int32)
+    prompt_b = r.randint(1, 255, size=24).astype(np.int32)
+    b_variant = prompt_b.copy()
+    b_variant[-1] = 1  # shares B's two full prefix blocks, distinct tail
+    fresh = r.randint(1, 255, size=24).astype(np.int32)
+    # All footprints = max(bucket 32, 24 + max_new<=8) = 4 blocks. Usable
+    # pool = 9: after A and B park 2 blocks each and B-variant pins B's,
+    # fresh's 4-block allocation finds 3 free + A's 2 parked -> eviction.
+
+    def schedule(eng):
+        a = eng.submit(prompt_a, max_new_tokens=6)
+        eng.run_until_drained()  # A's prefix blocks park in the LRU
+        b = eng.submit(prompt_b, max_new_tokens=6)
+        eng.run_until_drained()  # B's park too
+        bv = eng.submit(b_variant, max_new_tokens=8)  # HIT: pins B's blocks
+        eng.step_n(2)  # chunks in flight when fresh's allocation evicts
+        f = eng.submit(fresh, max_new_tokens=6)
+        assert f is not None
+        eng.run_until_drained()
+        return [eng.result(i) for i in (a, b, bv, f)], dict(eng.stats_prefix)
+
+    kw = dict(slots=2, max_len=64, block_size=8, num_blocks=10,
+              prefix_cache=True)
+    (sync, sync_stats), _ = _run_paged(cfg, params, 0, schedule, **kw)
+    (piped, piped_stats), _ = _run_paged(cfg, params, 2, schedule, **kw)
+    assert sync == piped
+    assert piped_stats["hit_blocks"] >= 2  # the hit path engaged
+    assert piped_stats["evictions"] >= 1   # the eviction path engaged
+    assert piped_stats == sync_stats
+
+
+def test_paged_fallback_probe_flushes_inflight_queue(small_model):
+    """The pallas→XLA fallback probe with a NON-EMPTY in-flight queue: the
+    probe dispatch flushes the ring first (rollback contract — a failed
+    probe must leave nothing half-committed), falls back, and the final
+    streams still match a synchronous no-kernel run."""
+    cfg, params = small_model
+    ps = prompts(3, rng=17)
+
+    def reference():
+        eng = PagedBatchEngine(cfg, params, slots=3, max_len=64, block_size=8,
+                               pipeline_depth=0)
+        ids = [eng.submit(p, max_new_tokens=12) for p in ps]
+        eng.run_until_drained()
+        return [eng.result(i) for i in ids]
+
+    eng = PagedBatchEngine(cfg, params, slots=3, max_len=64, block_size=8,
+                           pipeline_depth=2)
+    ids = [eng.submit(p, max_new_tokens=12) for p in ps]
+    eng.step_n(1)
+    eng.step_n(1)
+    assert len(eng._pipeline) == 2  # queue genuinely non-empty
+    # Simulate the kernel's first real-backend contact happening mid-stream:
+    # force probe mode; the pallas path cannot compile on CPU (no interpret
+    # override), so the next dispatch must flush, fail, and fall back.
+    eng._use_kernel = True
+    eng._kernel_probed = False
+    eng.stats["attention_path"] = "kernel"
+    executed = eng.step_n(1)
+    assert executed == 1
+    assert eng.stats["attention_path"] == "xla_fallback"
+    assert "kernel_error" in eng.stats
+    eng.run_until_drained()
+    assert [eng.result(i) for i in ids] == reference()
+
+
+def test_paged_bound_never_overruns_budget_with_inflight(small_model):
+    """step_n(32) back to back: in-flight steps count against the completion
+    bound, so no request's token list can exceed max_new_tokens even before
+    any flush."""
+    cfg, params = small_model
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=64, block_size=8,
+                           pipeline_depth=2)
+    ids = [eng.submit(p, max_new_tokens=5) for p in prompts(2, rng=23)]
+    for _ in range(6):
+        eng.step_n(32)
+    eng._pipeline.flush()
+    for i in ids:
+        assert len(eng.result(i)) == 5
+
+
+def test_batch_engine_pipelined_matches_sync(small_model):
+    cfg, params = small_model
+    ps = prompts(4, rng=29)
+    budgets = (12, 3, 7, 12)
+
+    def run(depth):
+        eng = BatchEngine(cfg, params, slots=4, max_len=64, pipeline_depth=depth)
+        ids = [eng.submit(p, max_new_tokens=m) for p, m in zip(ps, budgets)]
+        eng.run_until_drained()
+        return [eng.result(i) for i in ids]
+
+    assert run(0) == run(2)
+
+
+def test_batch_engine_pipelined_midstream_admission(small_model):
+    """Slot freed by an in-flight completion is reclaimable: submit flushes
+    the ring instead of returning None."""
+    cfg, params = small_model
+    ps = prompts(3, rng=31)
+
+    def run(depth):
+        eng = BatchEngine(cfg, params, slots=2, max_len=64, pipeline_depth=depth)
+        a = eng.submit(ps[0], max_new_tokens=3)
+        b = eng.submit(ps[1], max_new_tokens=15)
+        third = None
+        for _ in range(60):
+            eng.step()
+            if third is None:
+                third = eng.submit(ps[2], max_new_tokens=8)  # None until a slot frees
+            if eng.active_count == 0 and third is not None:
+                break
+        assert third is not None
+        return [eng.result(i) for i in (a, b, third)]
+
+    assert run(0) == run(2)
+
+
+def test_dense_engine_generate_pipelined_matches_sync(small_model):
+    """Engine.generate: bounded in-flight chunked decode must reproduce the
+    synchronous per-chunk loop bit for bit (greedy and seeded sampling — the
+    key schedule is per dispatch, not per consume)."""
+    from lws_tpu.serving.engine import Engine, SamplingParams
+
+    cfg, params = small_model
+    prompt = jnp.asarray(prompts(1, rng=37)[0][None, :])
+
+    for sampling in (SamplingParams(), SamplingParams(temperature=1.1)):
+        outs = []
+        for depth in (0, 2):
+            eng = Engine(cfg, params, batch_size=1, max_len=128,
+                         sampling=sampling, seed=4, pipeline_depth=depth)
+            outs.append(np.asarray(eng.generate(prompt, 40).tokens))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_speculative_flushes_pipeline(small_model):
+    """step_speculative drafts from host token history: it must flush the
+    in-flight ring first, and the spec+pipelined engine still matches the
+    plain synchronous engine's greedy streams."""
+    cfg, params = small_model
+    ps = prompts(2, rng=41)
+
+    def plain():
+        eng = PagedBatchEngine(cfg, params, slots=2, max_len=64, block_size=8,
+                               pipeline_depth=0)
+        ids = [eng.submit(p, max_new_tokens=10) for p in ps]
+        eng.run_until_drained()
+        return [eng.result(i) for i in ids]
+
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=64, block_size=8,
+                           pipeline_depth=2)
+    ids = [eng.submit(p, max_new_tokens=10) for p in ps]
+    eng.step_n(1)  # put a chunk in flight before the speculative dispatch
+    assert len(eng._pipeline) == 1
+    eng.run_until_drained_speculative()
+    assert not eng._pipeline
+    assert [eng.result(i) for i in ids] == plain()
+
+
+def test_inflight_metrics_surface(small_model):
+    """The observability contract: the gauge tracks the ring and the
+    host-blocked counter accumulates for the engine label."""
+    from lws_tpu.core import metrics
+
+    cfg, params = small_model
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=64, block_size=8,
+                           pipeline_depth=2)
+    for p in prompts(2, rng=43):
+        eng.submit(p, max_new_tokens=8)
+    eng.step_n(1)
+    assert metrics.REGISTRY.gauge_value(
+        "serving_inflight_dispatches", {"engine": "paged"}
+    ) == len(eng._pipeline) == 1
+    eng.run_until_drained()
+    assert metrics.REGISTRY.gauge_value(
+        "serving_inflight_dispatches", {"engine": "paged"}
+    ) == 0
+    assert metrics.REGISTRY.counter_value(
+        "serving_host_blocked_seconds", {"engine": "paged"}
+    ) > 0.0
