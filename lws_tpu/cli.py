@@ -5,6 +5,7 @@
   python -m lws_tpu get    KIND [NAME] [--server HOST:PORT] [-o yaml]
   python -m lws_tpu delete KIND NAMESPACE NAME [--server HOST:PORT]
   python -m lws_tpu scale  NAME REPLICAS [--server HOST:PORT]
+  python -m lws_tpu top    [--watch] [--server HOST:PORT]
   python -m lws_tpu plan-steps --initial 4,4 --target 4,4 [--surge 1,1] [--unavailable 0,0]
 """
 
@@ -495,6 +496,178 @@ def cmd_install(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# lws-tpu top: the operator's live fleet view, rendered from the aggregated
+# /metrics/fleet surface + the /debug/flightrecorder alert state.
+
+
+def _histogram_quantile(buckets: list[tuple[float, float]], q: float):
+    """Estimate a quantile from cumulative (le, count) pairs — the PromQL
+    histogram_quantile shape, linear within the winning bucket."""
+    if not buckets:
+        return None
+    buckets = sorted(buckets, key=lambda b: b[0])
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= rank:
+            if le == float("inf"):
+                return prev_le  # open-ended bucket: report its lower bound
+            span = cum - prev_cum
+            frac = (rank - prev_cum) / span if span > 0 else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return buckets[-1][0]
+
+
+def _top_rows(fams: dict) -> dict:
+    """Fold parsed fleet families into {(instance, engine): row}. Pure
+    function of the exposition so tests drive it from canned text."""
+    rows: dict = {}
+
+    def row(labels):
+        key = (labels.get("instance", "-"), labels.get("engine", "-"))
+        return rows.setdefault(key, {})
+
+    def fold(family, field, reducer=lambda old, v: old + v, start=0.0):
+        for name, labels, value, _ in fams.get(family, {}).get("samples", []):
+            # Histograms fold their _count sample; plain metrics their name.
+            if name != family and name != f"{family}_count":
+                continue
+            r = row(labels)
+            r[field] = reducer(r.get(field, start), value)
+
+    fold("serving_requests_total", "requests")
+    fold("serving_active_slots", "active")
+    fold("serving_inflight_dispatches", "inflight")
+    fold("serving_slo_attainment", "slo", reducer=lambda old, v: v)
+    fold("serving_decode_dispatch_duration_seconds", "dispatches")
+
+    for family, field in (("serving_ttft_seconds", "ttft"),
+                          ("serving_itl_seconds", "itl")):
+        per_key: dict = {}
+        for name, labels, value, _ in fams.get(family, {}).get("samples", []):
+            if not name.endswith("_bucket"):
+                continue
+            le = labels.get("le", "+Inf")
+            le_f = float("inf") if le == "+Inf" else float(le)
+            key = (labels.get("instance", "-"), labels.get("engine", "-"))
+            per_key.setdefault(key, []).append((le_f, value))
+        for key, buckets in per_key.items():
+            r = rows.setdefault(key, {})
+            r[f"{field}_p95"] = _histogram_quantile(buckets, 0.95)
+    return rows
+
+
+def render_top(fams: dict, alerts: dict | None = None,
+               prev: dict | None = None, dt_s: float | None = None,
+               rows: dict | None = None) -> str:
+    """One frame of `lws-tpu top`. `prev`/`dt_s` (a previous _top_rows fold
+    and the seconds since it) turn cumulative counters into rates in
+    --watch mode; one-shot renders totals. `rows` takes a precomputed
+    _top_rows fold so --watch folds each frame once, not twice."""
+    if rows is None:
+        rows = _top_rows(fams)
+    instances = None
+    for name, _labels, value, _ in fams.get("lws_fleet_instances", {}).get("samples", []):
+        if name == "lws_fleet_instances":
+            instances = int(value)
+    lines = []
+    header = f"FLEET  instances={instances if instances is not None else len({k[0] for k in rows})}"
+    firing = sorted((alerts or {}).keys())
+    header += f"  alerts={','.join(firing) if firing else 'none'}"
+    lines.append(header)
+    for name, details in sorted((alerts or {}).items()):
+        for d in details:
+            lines.append(f"  ALERT {name}: {json.dumps(d)}")
+    lines.append(
+        f"{'INSTANCE':<18}{'ENGINE':<9}{'SLO':>6}{'REQS':>7}{'ACTIVE':>7}"
+        f"{'INFL':>6}{'TTFT_P95':>10}{'ITL_P95':>10}{'DISP/S':>8}"
+    )
+
+    def fmt(v, pattern="{:.3f}", dash="-"):
+        return pattern.format(v) if v is not None else dash
+
+    for (instance, engine), r in sorted(rows.items()):
+        if engine == "-" and "requests" not in r and "slo" not in r:
+            continue  # fleet-plumbing rows without serving data
+        rate = None
+        if prev is not None and dt_s:
+            before = prev.get((instance, engine), {}).get("dispatches", 0.0)
+            rate = max(0.0, r.get("dispatches", 0.0) - before) / dt_s
+        lines.append(
+            f"{instance:<18}{engine:<9}"
+            f"{fmt(r.get('slo'), '{:.2f}'):>6}"
+            f"{fmt(r.get('requests'), '{:.0f}'):>7}"
+            f"{fmt(r.get('active'), '{:.0f}'):>7}"
+            f"{fmt(r.get('inflight'), '{:.0f}'):>6}"
+            f"{fmt(r.get('ttft_p95'), '{:.3f}s'):>10}"
+            f"{fmt(r.get('itl_p95'), '{:.4f}s'):>10}"
+            f"{fmt(rate, '{:.1f}'):>8}"
+        )
+    return "\n".join(lines)
+
+
+def _fetch_top_state(server: str) -> tuple[dict, dict]:
+    """(parsed fleet families, active alerts) from the API server. Alerts
+    merge two feeds: the control plane's own watchdog (live detail via
+    /debug/flightrecorder) and any WORKER whose `lws_watchdog_active` gauge
+    rides the fleet scrape at 1 — a worker-side stall renders here too."""
+    from lws_tpu.core.metrics import parse_exposition
+
+    url = f"{_server_base(server)}/metrics/fleet"
+    req = urllib.request.Request(url, headers=_auth_headers())
+    with urllib.request.urlopen(req, context=_url_context(url)) as resp:
+        fams = parse_exposition(resp.read().decode())
+    alerts = {}
+    for name, labels, value, _ in fams.get("lws_watchdog_active", {}).get("samples", []):
+        if name == "lws_watchdog_active" and value >= 1.0:
+            alerts.setdefault(labels.get("watchdog", "?"), []).append(
+                {"instance": labels.get("instance", "-")}
+            )
+    try:
+        fr = _http(server, "GET", "/debug/flightrecorder?limit=0")
+        for name, details in (fr.get("alerts") or {}).items():
+            alerts[name] = details  # richer detail wins over the gauge row
+    except SystemExit:
+        pass  # an older server without the endpoint still gets the table
+    return fams, alerts
+
+
+def cmd_top(args) -> int:
+    """Live fleet view: SLO attainment, throughput/occupancy, in-flight
+    depth, and watchdog alerts from the aggregated /metrics/fleet surface.
+    One-shot by default; --watch redraws every --interval seconds (floored
+    at 1s — the fleet collector caches scrapes for ~1s, and rating a cached
+    exposition against a shorter dt would flap between 0 and 2x)."""
+    args.interval = max(args.interval, 1.0)
+    prev = prev_t = None
+    while True:
+        try:
+            fams, alerts = _fetch_top_state(args.server)
+        except urllib.error.URLError as e:
+            raise SystemExit(
+                f"error: cannot reach server {args.server}: {e.reason}"
+            ) from None
+        now = time.monotonic()
+        rows = _top_rows(fams)
+        frame = render_top(
+            fams, alerts, prev=prev,
+            dt_s=(now - prev_t) if prev_t is not None else None,
+            rows=rows,
+        )
+        if not args.watch:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev, prev_t = rows, now
+        time.sleep(args.interval)
+
+
 def cmd_plan_steps(args) -> int:
     """≈ hack/plan-steps/main.go: print the DS rollout step table."""
     from lws_tpu.controllers.disagg.planner import (
@@ -629,6 +802,14 @@ def main(argv=None) -> int:
     pp.add_argument("--surge", default="")
     pp.add_argument("--unavailable", default="")
     pp.set_defaults(fn=cmd_plan_steps)
+
+    tp = sub.add_parser("top", help="live fleet view: SLO attainment, throughput, "
+                        "in-flight depth, watchdog alerts (from /metrics/fleet)")
+    tp.add_argument("--server", default="127.0.0.1:9443")
+    tp.add_argument("--watch", action="store_true",
+                    help="redraw every --interval seconds (rates need two frames)")
+    tp.add_argument("--interval", type=float, default=2.0)
+    tp.set_defaults(fn=cmd_top)
 
     ep = sub.add_parser("events", help="controller decision trace (k8s Events)")
     ep.add_argument("name", nargs="?")

@@ -1,0 +1,350 @@
+"""Flight recorder + stall watchdogs: capture the anomalous window, not the
+average one.
+
+Two cheap feeds, one bounded ring:
+
+  * `record(kind, **fields)` appends a structured event (wall time, kind,
+    the active trace context, fields) to a bounded deque — the black-box
+    ring a diagnostics dump replays. Call sites are the NOTABLE paths
+    (kernel fallback, pipeline rollback, watchdog trips), not the hot loop.
+  * `beat(name, progress=None, depth=0.0)` updates a per-source heartbeat:
+    `progress` is a monotonic work counter (auto-incremented when omitted),
+    `depth` is the work currently pending behind it. Heartbeats are a dict
+    write + one clock read — cheap enough for the decode dispatch ring
+    (the <2% trace budget covers them; benchmarks/trace_overhead_bench.py).
+
+The Watchdog evaluates rules over the heartbeat table:
+
+  * StallRule     — pending work (`depth > 0`) whose progress counter has
+    not advanced for `stall_after` seconds: a wedged decode ring or a
+    KV pull loop stuck on a dead peer. Slow-but-progressing sources never
+    trip (progress advancing resets the clock — tested explicitly).
+  * HotLoopRule   — a source whose `depth` (the manager reports its
+    same-key reconcile streak there) exceeds `streak`: a controller
+    requeue-looping on one object.
+  * BacklogRule   — `depth` at or above `depth_threshold` for `sustain`
+    seconds: KV bundles piling up faster than decode drains them.
+
+On an alert transitioning inactive -> firing the watchdog appends a ring
+event, bumps `lws_watchdog_alerts_total{watchdog}`, flips
+`lws_watchdog_active{watchdog}` to 1, and captures a diagnostics bundle
+(ring + recent spans + metrics snapshot + heartbeat table) retrievable at
+`GET /debug/flightrecorder`. `check_now()` is the deterministic entry tests
+and the API server use; `start()` runs the same check on a thread.
+
+The module-level RECORDER is the process default (one black box per
+process, like metrics.REGISTRY and trace.TRACER).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from lws_tpu.core import metrics, trace
+from lws_tpu.utils.common import env_float as _env_float
+
+
+@dataclass
+class Heartbeat:
+    name: str
+    progress: float = 0.0
+    depth: float = 0.0
+    last_beat: float = 0.0     # monotonic time of the last beat
+    last_advance: float = 0.0  # monotonic time progress last CHANGED
+
+
+class FlightRecorder:
+    def __init__(self, ring: int = 2048) -> None:
+        self._ring: "deque[dict]" = deque(maxlen=ring)
+        self._beats: dict[str, Heartbeat] = {}
+        self._lock = threading.Lock()
+
+    # ---- feeds -----------------------------------------------------------
+    def record(self, kind: str, **fields) -> dict:
+        ctx = trace.current_context()
+        event = {
+            "ts": round(time.time(), 6),
+            "kind": kind,
+            "trace": ctx,
+            **fields,
+        }
+        with self._lock:
+            self._ring.append(event)
+        metrics.inc("lws_flightrecorder_events_total", {"kind": kind})
+        return event
+
+    def beat(self, name: str, progress: Optional[float] = None,
+             depth: float = 0.0, now: Optional[float] = None) -> None:
+        """`now` (monotonic seconds) exists for deterministic tests — the
+        production feeds never pass it."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            hb = self._beats.get(name)
+            if hb is None:
+                hb = self._beats[name] = Heartbeat(
+                    name, last_beat=now, last_advance=now
+                )
+            if progress is None:
+                progress = hb.progress + 1.0
+            if progress != hb.progress:
+                hb.last_advance = now
+            hb.progress = progress
+            hb.depth = depth
+            hb.last_beat = now
+
+    # ---- views -----------------------------------------------------------
+    def events(self, limit: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            out = list(self._ring)
+        if limit is not None and limit >= 0:
+            out = out[-limit:] if limit else []
+        return out
+
+    def snapshot_beats(self) -> dict[str, Heartbeat]:
+        """Consistent point-in-time copies for the watchdog rules: reading
+        the live Heartbeat objects field-by-field outside the lock could
+        tear (new depth, stale last_advance) into a one-tick false alert."""
+        with self._lock:
+            return {
+                name: Heartbeat(hb.name, hb.progress, hb.depth,
+                                hb.last_beat, hb.last_advance)
+                for name, hb in self._beats.items()
+            }
+
+    def heartbeats(self) -> dict[str, dict]:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                name: {
+                    "progress": hb.progress,
+                    "depth": hb.depth,
+                    "beat_age_s": round(now - hb.last_beat, 3),
+                    "advance_age_s": round(now - hb.last_advance, 3),
+                }
+                for name, hb in self._beats.items()
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._beats.clear()
+
+    def dump(self, reason: str = "manual", registries: tuple = (),
+             span_limit: int = 256) -> dict:
+        """The diagnostics bundle: everything an operator needs to explain
+        the window that just went wrong, in one JSON-serializable dict."""
+        exposition = (
+            metrics.render_exposition(metrics.REGISTRY, *registries)
+            if registries else metrics.REGISTRY.render()
+        )
+        return {
+            "reason": reason,
+            "captured_unix": round(time.time(), 6),
+            "events": self.events(),
+            "heartbeats": self.heartbeats(),
+            "spans": trace.TRACER.spans(span_limit),
+            "metrics": exposition,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Watchdog rules. Each rule names the sources it watches by fnmatch pattern
+# and returns firing (name, detail) pairs from the heartbeat table.
+
+
+@dataclass(frozen=True)
+class StallRule:
+    """Pending work with a non-advancing progress counter = a stall."""
+
+    name: str
+    pattern: str
+    stall_after_s: float = 5.0
+
+    def firing(self, beats: dict[str, Heartbeat], now: float) -> list[dict]:
+        out = []
+        for src, hb in beats.items():
+            if not fnmatch.fnmatch(src, self.pattern):
+                continue
+            if hb.depth > 0 and now - hb.last_advance > self.stall_after_s:
+                out.append({
+                    "source": src, "depth": hb.depth,
+                    "stalled_for_s": round(now - hb.last_advance, 3),
+                })
+        return out
+
+
+@dataclass(frozen=True)
+class HotLoopRule:
+    """depth carries a same-key streak counter; past `streak` it's a loop.
+    A source whose beats went quiet for `idle_after_s` stops firing: the
+    streak value latches in the table (nothing resets it once the loop's
+    queue drains), so staleness — not depth — is the clear signal."""
+
+    name: str
+    pattern: str
+    streak: float = 100.0
+    idle_after_s: float = 5.0
+
+    def firing(self, beats: dict[str, Heartbeat], now: float) -> list[dict]:
+        return [
+            {"source": src, "streak": hb.depth}
+            for src, hb in beats.items()
+            if fnmatch.fnmatch(src, self.pattern) and hb.depth >= self.streak
+            and now - hb.last_beat <= self.idle_after_s
+        ]
+
+
+@dataclass(frozen=True)
+class BacklogRule:
+    """Sustained queue depth at/over the threshold = a backlog."""
+
+    name: str
+    pattern: str
+    depth_threshold: float = 8.0
+    sustain_s: float = 5.0
+
+    def firing(self, beats: dict[str, Heartbeat], now: float) -> list[dict]:
+        # A beat below threshold bumps nothing; sustain is measured as time
+        # since progress last advanced while depth sits at/over threshold —
+        # a draining backlog advances progress and never fires.
+        out = []
+        for src, hb in beats.items():
+            if not fnmatch.fnmatch(src, self.pattern):
+                continue
+            if hb.depth >= self.depth_threshold and \
+                    now - hb.last_advance > self.sustain_s:
+                out.append({
+                    "source": src, "depth": hb.depth,
+                    "backlogged_for_s": round(now - hb.last_advance, 3),
+                })
+        return out
+
+
+def default_rules() -> list:
+    """The three fleet failure modes the tentpole names: a non-advancing
+    decode dispatch ring, a reconcile hot loop, KV-handoff backlog. The
+    ring's progress counter cannot distinguish one legitimately long device
+    dispatch from a wedge, so the default stall window is generous (30s —
+    far past any sane dispatch, short enough to catch a real wedge) and
+    env-tunable per deployment."""
+    return [
+        StallRule("decode_ring_stall", "decode_ring:*",
+                  stall_after_s=_env_float("LWS_TPU_WATCHDOG_STALL_S", 30.0)),
+        HotLoopRule("reconcile_hot_loop", "reconcile:*",
+                    streak=_env_float("LWS_TPU_WATCHDOG_STREAK", 100.0)),
+        BacklogRule("kv_handoff_backlog", "kv_backlog:*",
+                    depth_threshold=_env_float("LWS_TPU_WATCHDOG_DEPTH", 8.0),
+                    sustain_s=_env_float("LWS_TPU_WATCHDOG_SUSTAIN_S", 5.0)),
+    ]
+
+
+class Watchdog:
+    def __init__(
+        self,
+        recorder: Optional[FlightRecorder] = None,
+        rules: Optional[list] = None,
+        registries: tuple = (),
+        on_alert: Optional[Callable[[dict], None]] = None,
+    ) -> None:
+        self.recorder = recorder if recorder is not None else RECORDER
+        self.rules = rules if rules is not None else default_rules()
+        self._registries = registries
+        self._on_alert = on_alert
+        self._active: dict[str, list[dict]] = {}
+        self.last_dump: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # ---- deterministic entry (tests + API server) ------------------------
+    def check_now(self, now: Optional[float] = None) -> dict[str, list[dict]]:
+        """Evaluate every rule; returns {alert_name: [detail, ...]} for the
+        currently-firing set. Transitions drive the metrics/ring/dump side
+        effects; steady firing states don't re-dump."""
+        now = time.monotonic() if now is None else now
+        beats = self.recorder.snapshot_beats()
+        firing: dict[str, list[dict]] = {}
+        for rule in self.rules:
+            hits = rule.firing(beats, now)
+            if hits:
+                firing[rule.name] = hits
+        with self._lock:
+            started = {k: v for k, v in firing.items() if k not in self._active}
+            cleared = [k for k in self._active if k not in firing]
+            self._active = firing
+        for name in cleared:
+            metrics.set("lws_watchdog_active", 0.0, {"watchdog": name})
+        for name, hits in started.items():
+            metrics.inc("lws_watchdog_alerts_total", {"watchdog": name})
+            metrics.set("lws_watchdog_active", 1.0, {"watchdog": name})
+            event = self.recorder.record(
+                "watchdog_alert", watchdog=name, detail=hits
+            )
+            # Capture the window NOW: the ring still holds the events that
+            # led here, the tracer still holds the request's spans.
+            self.last_dump = self.recorder.dump(
+                reason=f"watchdog:{name}", registries=self._registries
+            )
+            self.last_dump["alert"] = event
+            if self._on_alert is not None:
+                self._on_alert(event)
+        return firing
+
+    def active(self) -> dict[str, list[dict]]:
+        with self._lock:
+            return dict(self._active)
+
+    # ---- threaded mode ---------------------------------------------------
+    def start(self, interval_s: float = 1.0) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                try:
+                    self.check_now()
+                except Exception:  # noqa: BLE001 — the watchdog must outlive bad beats
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# Process-default recorder + conveniences (one black box per process).
+RECORDER = FlightRecorder()
+
+
+def record(kind: str, **fields) -> dict:
+    return RECORDER.record(kind, **fields)
+
+
+def beat(name: str, progress: Optional[float] = None, depth: float = 0.0) -> None:
+    RECORDER.beat(name, progress, depth)
+
+
+def dump(reason: str = "manual", registries: tuple = ()) -> dict:
+    return RECORDER.dump(reason, registries)
+
+
+def debug_snapshot(limit: int, watchdog: Optional[Watchdog] = None) -> dict:
+    """The GET /debug/flightrecorder response body — one shape for every
+    surface that serves it (worker telemetry server, API server)."""
+    return {
+        "events": RECORDER.events(limit),
+        "heartbeats": RECORDER.heartbeats(),
+        "alerts": watchdog.active() if watchdog else {},
+        "last_dump": watchdog.last_dump if watchdog else None,
+    }

@@ -20,7 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
-from lws_tpu.core import trace
+from lws_tpu.core import flightrecorder, trace
 from lws_tpu.core.store import ConflictError, Key, Store, WatchEvent
 
 
@@ -51,6 +51,9 @@ class _Registration:
     # promoted into the live queue once due (controller-runtime RequeueAfter).
     delayed: list[tuple[float, int, Key]] = field(default_factory=list)
     _seq: "itertools.count" = field(default_factory=lambda: itertools.count())
+    # (last key, last reconcile time, same-key streak) — the hot-loop
+    # watchdog feed (Manager._hot_loop_beat).
+    hot_loop: tuple = (None, 0.0, 0)
 
     def enqueue(self, key: Key) -> None:
         with self.lock:
@@ -113,6 +116,7 @@ class Manager:
         # anchor of the trace spine (child spans live in the reconcilers;
         # serving subtrees graft on via propagated span contexts).
         name = reg.reconciler.name
+        self._hot_loop_beat(reg, key, name)
         with trace.TRACER.span(
             "reconcile", controller=name,
             kind=key[0], namespace=key[1], object=key[2],
@@ -140,6 +144,30 @@ class Manager:
                     {"controller": name, "result": outcome},
                 )
             return result
+
+    # Same-key reconciles inside this window extend the hot-loop streak;
+    # a gap longer than the window (or a different key) resets it.
+    HOT_LOOP_WINDOW_S = 1.0
+
+    def _hot_loop_beat(self, reg: _Registration, key: Key, name: str) -> None:
+        """Hot-loop watchdog feed: the heartbeat's depth carries this
+        controller's current same-key reconcile streak — a controller
+        requeue-looping on one object shows as an ever-growing streak with
+        the flight recorder holding the offending key."""
+        now = time.monotonic()
+        last_key, last_t, streak = reg.hot_loop
+        if key == last_key and now - last_t < self.HOT_LOOP_WINDOW_S:
+            streak += 1
+        else:
+            streak = 1
+        reg.hot_loop = (key, now, streak)
+        flightrecorder.beat(f"reconcile:{name}", depth=streak)
+        if streak in (100, 1000, 10000):  # log the key at escalation points
+            flightrecorder.record(
+                "reconcile_hot_loop", controller=name,
+                object_kind=key[0], namespace=key[1], object=key[2],
+                streak=streak,
+            )
 
     def register(self, reconciler: Reconciler, watches: dict[str, MapFn]) -> None:
         self._registrations.append(_Registration(reconciler, watches))

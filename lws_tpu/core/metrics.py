@@ -17,6 +17,7 @@ builds its own per-instance MetricsRegistry.
 
 from __future__ import annotations
 
+import re
 import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -26,49 +27,88 @@ from dataclasses import dataclass, field
 # (tools/check_metrics_catalogue.py cross-checks docs/observability.md).
 _HELP: dict[str, str] = {}
 
+# Per-name default histogram buckets (describe(..., buckets=...)): the one
+# fixed ladder saturates for minute-scale rollout durations and lumps every
+# sub-ms inter-token latency into its first bucket, so a metric whose range
+# is known declares its own. Process-wide, like _HELP: bucket layout is a
+# property of the name, not of any one registry (a fleet merge of two
+# layouts for one family would be scraper-invalid).
+_BUCKETS: dict[str, tuple] = {}
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
 DROPPED_METRIC = "lws_metric_label_sets_dropped_total"
 
 
 @dataclass
 class _Histogram:
-    buckets: tuple = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+    buckets: tuple = DEFAULT_BUCKETS
     counts: list = field(default_factory=list)
     total: float = 0.0
     n: int = 0
+    # bucket index -> (exemplar labels, observed value): the most recent
+    # exemplar-carrying observation per bucket, rendered OpenMetrics-style
+    # so an SLO-breach bucket links straight to its trace in /debug/traces.
+    exemplars: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.counts:
             self.counts = [0] * (len(self.buckets) + 1)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: dict | None = None) -> None:
         self.total += v
         self.n += 1
         for i, b in enumerate(self.buckets):
             if v <= b:
                 self.counts[i] += 1
+                if exemplar:
+                    self.exemplars[i] = (exemplar, v)
                 return
         self.counts[-1] += 1
+        if exemplar:
+            self.exemplars[len(self.buckets)] = (exemplar, v)
 
 
-def describe(name: str, help_text: str) -> None:
+def describe(name: str, help_text: str, buckets: tuple | list | None = None) -> None:
     """Register the # HELP line for a metric name (process-wide: exposition
-    text is a property of the name, not of any one registry)."""
+    text is a property of the name, not of any one registry). For a
+    histogram, `buckets` overrides the DEFAULT_BUCKETS ladder for every
+    series of this name created afterwards."""
     _HELP[name] = help_text
+    if buckets is not None:
+        _BUCKETS[name] = tuple(sorted(float(b) for b in buckets))
 
 
 class MetricsRegistry:
-    def __init__(self, max_label_sets: int = 512) -> None:
+    def __init__(self, max_label_sets: int = 512,
+                 buckets: dict[str, tuple] | None = None) -> None:
         """`max_label_sets` caps DISTINCT label sets per metric name; samples
         for label sets past the cap are dropped and counted (see module
-        docstring) instead of growing the registry unboundedly."""
+        docstring) instead of growing the registry unboundedly. `buckets`
+        maps metric names to per-registry histogram ladders, overriding both
+        the describe()-declared and the default buckets."""
         self._lock = threading.Lock()
         self._max_label_sets = max_label_sets
+        self._bucket_overrides: dict[str, tuple] = {
+            name: tuple(sorted(float(x) for x in bs))
+            for name, bs in (buckets or {}).items()
+        }
         # Inner dicts used as ordered sets (the module-level `set` gauge
         # helper shadows the builtin in this namespace).
         self._label_sets: dict[str, dict] = defaultdict(dict)
         self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
         self._gauges: dict[tuple[str, tuple], float] = {}
         self._histograms: dict[tuple[str, tuple], _Histogram] = {}
+
+    def set_buckets(self, name: str, buckets: tuple | list) -> None:
+        """Override the bucket ladder for NEW series of `name` in this
+        registry (existing series keep the layout they were created with —
+        re-bucketing live counts would fabricate history)."""
+        with self._lock:
+            self._bucket_overrides[name] = tuple(sorted(float(b) for b in buckets))
+
+    def _buckets_for(self, name: str) -> tuple:
+        return self._bucket_overrides.get(name) or _BUCKETS.get(name) or DEFAULT_BUCKETS
 
     def _admit(self, name: str, labels: tuple) -> bool:
         """Cardinality gate (caller holds the lock). Known label sets always
@@ -89,15 +129,19 @@ class MetricsRegistry:
             if self._admit(name, lk):
                 self._counters[(name, lk)] += value
 
-    def observe(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
+    def observe(self, name: str, value: float, labels: dict[str, str] | None = None,
+                exemplar: dict[str, str] | None = None) -> None:
+        """`exemplar` (e.g. {"trace_id": ..., "span_id": ...}) rides the
+        sample's bucket into the exposition OpenMetrics-style, so a breach
+        bucket resolves straight to its trace in /debug/traces."""
         with self._lock:
             lk = _lk(labels)
             if not self._admit(name, lk):
                 return
             key = (name, lk)
             if key not in self._histograms:
-                self._histograms[key] = _Histogram()
-            self._histograms[key].observe(value)
+                self._histograms[key] = _Histogram(buckets=self._buckets_for(name))
+            self._histograms[key].observe(value, exemplar)
 
     def set(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
         """Gauge write (last value wins): rollout progress, active slots,
@@ -150,10 +194,16 @@ class MetricsRegistry:
             for (name, labels), h in sorted(self._histograms.items()):
                 out = fams.setdefault(name, ("histogram", []))[1]
                 cum = 0
-                for b, c in zip(h.buckets, h.counts):
+                for i, (b, c) in enumerate(zip(h.buckets, h.counts)):
                     cum += c
-                    out.append(f'{name}_bucket{_fmt(labels, le=str(b))} {cum}')
-                out.append(f'{name}_bucket{_fmt(labels, le="+Inf")} {h.n}')
+                    out.append(
+                        f'{name}_bucket{_fmt(labels, le=str(b))} {cum}'
+                        f'{_fmt_exemplar(h.exemplars.get(i))}'
+                    )
+                out.append(
+                    f'{name}_bucket{_fmt(labels, le="+Inf")} {h.n}'
+                    f'{_fmt_exemplar(h.exemplars.get(len(h.buckets)))}'
+                )
                 out.append(f"{name}_sum{_fmt(labels)} {h.total}")
                 out.append(f"{name}_count{_fmt(labels)} {h.n}")
         return fams
@@ -200,6 +250,157 @@ def _fmt(labels: tuple, le: str | None = None) -> str:
     return "{" + inner + "}"
 
 
+def _fmt_exemplar(entry: tuple | None) -> str:
+    """OpenMetrics exemplar suffix for a bucket line: ` # {labels} value`.
+    OpenMetrics scrapers resolve the trace_id to a trace backend; servers
+    strip the suffix for classic text-format clients (strip_exemplars) —
+    the classic 0.0.4 format has no exemplar syntax."""
+    if not entry:
+        return ""
+    labels, value = entry
+    return f" # {_fmt(_lk(labels))} {value}"
+
+
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+_EXEMPLAR_SUFFIX_RE = re.compile(r" # \{[^}]*\} \S+$", re.MULTILINE)
+
+
+def wants_openmetrics(accept: str | None) -> bool:
+    """Content negotiation for the /metrics surfaces: exemplars ride only
+    when the client asked for OpenMetrics (a classic Prometheus text parser
+    rejects a sample line with an exemplar suffix)."""
+    return bool(accept and "openmetrics" in accept)
+
+
+def strip_exemplars(text: str) -> str:
+    return _EXEMPLAR_SUFFIX_RE.sub("", text)
+
+
+def negotiate_exposition(text: str, accept: str | None) -> tuple[str, str]:
+    """(body, content_type) for a /metrics response — the ONE negotiation
+    rule every serving surface (worker telemetry, API server, fleet view)
+    applies: OpenMetrics clients get exemplar suffixes and the mandatory
+    `# EOF` terminator; classic clients get the suffixes stripped (the
+    0.0.4 text format has no exemplar syntax)."""
+    if wants_openmetrics(accept):
+        if not text.endswith("\n"):
+            text += "\n"
+        return text + "# EOF\n", OPENMETRICS_CONTENT_TYPE
+    return strip_exemplars(text), "text/plain"
+
+
+# ---------------------------------------------------------------------------
+# Exposition text parsing + fleet merging: the control plane scrapes each
+# worker's /metrics and serves ONE fleet view (/metrics/fleet) with
+# per-instance labels injected — see runtime/fleet.py.
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ #]+)"
+    r"(?P<exemplar> # \{[^}]*\} \S+)?$"
+)
+
+
+def parse_exposition(text: str) -> dict:
+    """Prometheus text -> {family: {"type": t, "help": h, "samples":
+    [(sample_name, labels_dict, value, exemplar_suffix)]}}. Lenient enough
+    for production use (the fleet merger and `lws-tpu top` consume scraped
+    worker output); tests/test_dns_metrics.py keeps the strict
+    scraper-semantics validator."""
+    families: dict = {}
+    for line in text.strip().split("\n"):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, help_text = line.split(" ", 3)
+            families.setdefault(name, {"type": "untyped", "help": "", "samples": []})
+            families[name]["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, ftype = line.split(" ", 3)
+            families.setdefault(name, {"type": "untyped", "help": "", "samples": []})
+            families[name]["type"] = ftype
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"malformed sample line: {line!r}")
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in families:
+                base = base[: -len(suffix)]
+                break
+        if base not in families:
+            families[base] = {"type": "untyped", "help": "", "samples": []}
+        labels = {}
+        for kv in (m.group("labels") or "").split(","):
+            if kv:
+                k, _, v = kv.partition("=")
+                labels[k.strip()] = v.strip().strip('"')
+        families[base]["samples"].append(
+            (name, labels, float(m.group("value")), m.group("exemplar") or "")
+        )
+    return families
+
+
+def merge_expositions(
+    sources: list[tuple[dict, str]], max_label_sets: int = 512
+) -> str:
+    """Merge scraped expositions into ONE valid fleet view: `sources` is
+    [(extra_labels, exposition_text)] — each instance's samples get its
+    extra labels (instance/role/revision) injected, families dedup to one
+    HELP/TYPE block, and the same per-family label-set cardinality cap as a
+    registry applies (drops counted under the usual dropped-sample metric,
+    labeled with the offending family). Exemplar suffixes survive the merge
+    verbatim."""
+    merged: dict[str, dict] = {}
+    dropped: dict[str, int] = {}
+    # Inner dicts as ordered sets (the module-level `set` gauge helper
+    # shadows the builtin here, same trick as MetricsRegistry._label_sets).
+    seen_sets: dict[str, dict] = defaultdict(dict)
+    for extra, text in sources:
+        for fam, data in parse_exposition(text).items():
+            slot = merged.setdefault(
+                fam, {"type": data["type"], "help": data["help"], "lines": []}
+            )
+            if slot["type"] == "untyped" and data["type"] != "untyped":
+                slot["type"] = data["type"]
+            if not slot["help"]:
+                slot["help"] = data["help"]
+            for name, labels, value, exemplar in data["samples"]:
+                labels = {**labels, **extra}
+                key = _lk({k: v for k, v in labels.items() if k != "le"})
+                sets = seen_sets[fam]
+                if key not in sets:
+                    if len(sets) >= max_label_sets:
+                        dropped[fam] = dropped.get(fam, 0) + 1
+                        continue
+                    sets[key] = None
+                slot["lines"].append(f"{name}{_fmt(_lk(labels))} {value}{exemplar}")
+    if dropped:
+        slot = merged.setdefault(
+            DROPPED_METRIC,
+            {"type": "counter", "help": _HELP.get(DROPPED_METRIC, DROPPED_METRIC),
+             "lines": []},
+        )
+        for fam, n in sorted(dropped.items()):
+            slot["lines"].append(
+                f'{DROPPED_METRIC}{_fmt(_lk({"metric": fam, "scope": "fleet"}))} {float(n)}'
+            )
+    lines: list[str] = []
+    for fam in sorted(merged):
+        slot = merged[fam]
+        ftype = slot["type"] if slot["type"] != "untyped" else "gauge"
+        lines.append(f"# HELP {fam} {slot['help'] or _HELP.get(fam, fam)}")
+        lines.append(f"# TYPE {fam} {ftype}")
+        lines.extend(slot["lines"])
+    return "\n".join(lines) + "\n"
+
+
 # Process-default registry + conveniences: the serving data plane reports
 # here (`metrics.inc/observe/set` is the call shape the catalogue checker
 # walks for); runtime/server.py merges this into its /metrics exposition.
@@ -210,15 +411,19 @@ def inc(name: str, labels: dict[str, str] | None = None, value: float = 1.0) -> 
     REGISTRY.inc(name, labels, value)
 
 
-def observe(name: str, value: float, labels: dict[str, str] | None = None) -> None:
-    REGISTRY.observe(name, value, labels)
+def observe(name: str, value: float, labels: dict[str, str] | None = None,
+            exemplar: dict[str, str] | None = None) -> None:
+    REGISTRY.observe(name, value, labels, exemplar=exemplar)
 
 
 def set(name: str, value: float, labels: dict[str, str] | None = None) -> None:  # noqa: A001 — mirrors the registry method
     REGISTRY.set(name, value, labels)
 
 
-describe(DROPPED_METRIC, "Samples dropped by the per-metric label-set cardinality cap")
+# Literal name (== DROPPED_METRIC): the catalogue checker anchors names on
+# string-literal describe()/emission sites.
+describe("lws_metric_label_sets_dropped_total",
+         "Samples dropped by the per-metric label-set cardinality cap")
 describe("lws_reconcile_total", "Reconciles per controller")
 describe("lws_reconcile_errors_total", "Reconcile exceptions per controller (conflicts excluded)")
 describe("lws_reconcile_duration_seconds", "Reconcile latency per controller and result")
@@ -232,3 +437,33 @@ describe("serving_inflight_dispatches", "Dispatched-but-unconsumed decode chunks
 describe("serving_host_blocked_seconds", "Seconds the serving loop spent on host-side scheduling with no device work in flight")
 describe("serving_kv_handoff_bytes_total", "KV bundle bytes shipped prefill -> decode")
 describe("serving_kv_handoffs_total", "KV bundles handed off prefill -> decode")
+# --- per-request SLO telemetry (core/slo.py) -------------------------------
+# Declared bucket ladders are the whole point of describe(..., buckets=...):
+# ITL distributions live sub-millisecond, queue waits can hit minutes.
+describe(
+    "serving_queue_wait_seconds",
+    "Time a request waited between arrival and admission, per engine",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 120.0),
+)
+describe(
+    "serving_ttft_seconds",
+    "Time to first token per engine (queue wait + prefill)",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+)
+describe(
+    "serving_itl_seconds",
+    "Inter-token latency per engine (per-dispatch mean of the step gaps)",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 1.0),
+)
+describe(
+    "serving_slo_attainment",
+    "Fraction of the trailing request window meeting every SLO target, per engine",
+)
+# --- stall watchdogs + flight recorder (core/flightrecorder.py) ------------
+describe("lws_watchdog_alerts_total", "Watchdog alert transitions (inactive -> firing)")
+describe("lws_watchdog_active", "1 while the named watchdog alert is firing, else 0")
+describe("lws_flightrecorder_events_total", "Structured events appended to the flight-recorder ring")
+# --- fleet aggregation (runtime/fleet.py) ----------------------------------
+describe("lws_fleet_instances", "Ready workers the fleet scraper merged on the last pass")
+describe("lws_fleet_scrape_errors_total", "Worker /metrics scrapes that failed, per instance")

@@ -1,0 +1,190 @@
+"""Per-request SLO telemetry: the request-lifecycle recorder the serving
+engines thread their timings through.
+
+Serving a fleet is managed against latency DISTRIBUTIONS, not single-process
+averages (PAPERS.md, "Fine-Tuning and Serving Gemma on Cloud TPU"): the
+operator question is "what fraction of requests met the targets", asked per
+engine and per worker, aggregated by /metrics/fleet. Three histograms and
+one gauge carry it:
+
+  * `serving_queue_wait_seconds{engine}` — arrival -> admission;
+  * `serving_ttft_seconds{engine}`      — arrival -> first token;
+  * `serving_itl_seconds{engine}`       — inter-token latency, observed once
+    per decode dispatch as the mean step gap of that chunk (a per-token
+    observation would tax exactly the hot loop the <2% trace budget
+    protects);
+  * `serving_slo_attainment{engine}`    — fraction of the trailing request
+    window (default 256 requests) that met EVERY target.
+
+Every histogram observation carries the active trace/span context as an
+OpenMetrics exemplar, so a breach bucket in a scrape resolves directly to
+its request tree in `/debug/traces`.
+
+Targets come from `SLOTargets` (env-overridable: LWS_TPU_SLO_TTFT_S,
+LWS_TPU_SLO_ITL_S, LWS_TPU_SLO_QUEUE_S). The module-level RECORDER is the
+process default, like metrics.REGISTRY and trace.TRACER.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from lws_tpu.core import metrics, trace
+from lws_tpu.utils.common import env_float as _env_float
+
+
+@dataclass(frozen=True)
+class SLOTargets:
+    """Per-request latency targets. A request attains its SLO when every
+    recorded phase met its target (phases never recorded don't count
+    against it — a dense generate() has no queue)."""
+
+    ttft_s: float = 1.0
+    itl_s: float = 0.1
+    queue_wait_s: float = 0.5
+
+    @classmethod
+    def from_env(cls) -> "SLOTargets":
+        return cls(
+            ttft_s=_env_float("LWS_TPU_SLO_TTFT_S", cls.ttft_s),
+            itl_s=_env_float("LWS_TPU_SLO_ITL_S", cls.itl_s),
+            queue_wait_s=_env_float("LWS_TPU_SLO_QUEUE_S", cls.queue_wait_s),
+        )
+
+
+class RequestTimeline:
+    """One request's lifecycle clock. Engines create it at arrival (submit /
+    generate entry), mark admission and first token, feed decode chunks, and
+    finish it on completion. All marks are idempotent-safe in the sense that
+    the attainment verdict folds whatever was recorded by finish() time."""
+
+    __slots__ = (
+        "engine", "_rec", "_arrival", "_ttft_s", "_queue_wait_s",
+        "_worst_itl_s", "_last_token_t", "_finished",
+    )
+
+    def __init__(self, recorder: "SLORecorder", engine: str,
+                 arrival_t: Optional[float] = None) -> None:
+        self.engine = engine
+        self._rec = recorder
+        self._arrival = time.perf_counter() if arrival_t is None else arrival_t
+        self._ttft_s: Optional[float] = None
+        self._queue_wait_s: Optional[float] = None
+        self._worst_itl_s: Optional[float] = None
+        self._last_token_t: Optional[float] = None
+        self._finished = False
+
+    # ---- lifecycle marks -------------------------------------------------
+    def queue_wait(self, seconds: Optional[float] = None) -> None:
+        """Arrival -> admission. Without an explicit value, measures from
+        the timeline's own arrival clock."""
+        if seconds is None:
+            seconds = time.perf_counter() - self._arrival
+        self._queue_wait_s = max(0.0, seconds)
+        self._rec._observe(
+            "serving_queue_wait_seconds", self.engine, self._queue_wait_s
+        )
+
+    def first_token(self, ttft_s: Optional[float] = None) -> None:
+        if ttft_s is None:
+            ttft_s = time.perf_counter() - self._arrival
+        self._ttft_s = max(0.0, ttft_s)
+        self._last_token_t = time.perf_counter()
+        self._rec._observe("serving_ttft_seconds", self.engine, self._ttft_s)
+
+    def tokens(self, n: int, elapsed_s: Optional[float] = None) -> None:
+        """A decode chunk of `n` tokens landed. `elapsed_s` defaults to the
+        gap since the previous chunk (or first token) on this timeline; the
+        ITL sample is the chunk's mean step gap — one histogram observation
+        per dispatch, never per token."""
+        if n <= 0:
+            return
+        now = time.perf_counter()
+        if elapsed_s is None:
+            since = self._last_token_t if self._last_token_t is not None else self._arrival
+            elapsed_s = now - since
+        self._last_token_t = now
+        itl = max(0.0, elapsed_s) / n
+        if self._worst_itl_s is None or itl > self._worst_itl_s:
+            self._worst_itl_s = itl
+        self._rec._observe("serving_itl_seconds", self.engine, itl)
+
+    def finish(self) -> bool:
+        """Fold the recorded phases into the attainment window; returns the
+        verdict. Safe to call more than once (later calls are no-ops)."""
+        if self._finished:
+            return True
+        self._finished = True
+        return self._rec._finish(self)
+
+    # ---- verdict ---------------------------------------------------------
+    def attained(self, targets: SLOTargets) -> bool:
+        if self._queue_wait_s is not None and self._queue_wait_s > targets.queue_wait_s:
+            return False
+        if self._ttft_s is not None and self._ttft_s > targets.ttft_s:
+            return False
+        if self._worst_itl_s is not None and self._worst_itl_s > targets.itl_s:
+            return False
+        return True
+
+
+class SLORecorder:
+    def __init__(
+        self,
+        targets: Optional[SLOTargets] = None,
+        registry=None,
+        window: int = 256,
+    ) -> None:
+        """`registry` defaults to the process metrics helpers; `window` is
+        the trailing request count the attainment gauge averages over (a
+        cumulative ratio would never recover from one bad hour)."""
+        self.targets = targets if targets is not None else SLOTargets.from_env()
+        self._registry = registry
+        self._window = window
+        self._outcomes: dict[str, deque] = {}
+        self._lock = threading.Lock()
+
+    def request(self, engine: str, arrival_t: Optional[float] = None) -> RequestTimeline:
+        return RequestTimeline(self, engine, arrival_t)
+
+    def attainment(self, engine: str) -> Optional[float]:
+        with self._lock:
+            window = self._outcomes.get(engine)
+            if not window:
+                return None
+            return sum(window) / len(window)
+
+    # ---- plumbing --------------------------------------------------------
+    def _observe(self, name: str, engine: str, value: float) -> None:
+        ctx = trace.current_context()
+        if self._registry is not None:
+            self._registry.observe(name, value, {"engine": engine}, exemplar=ctx)
+        else:
+            metrics.observe(name, value, {"engine": engine}, exemplar=ctx)
+
+    def _finish(self, tl: RequestTimeline) -> bool:
+        ok = tl.attained(self.targets)
+        with self._lock:
+            window = self._outcomes.get(tl.engine)
+            if window is None:
+                window = self._outcomes[tl.engine] = deque(maxlen=self._window)
+            window.append(1.0 if ok else 0.0)
+            value = sum(window) / len(window)
+        if self._registry is not None:
+            self._registry.set("serving_slo_attainment", value, {"engine": tl.engine})
+        else:
+            metrics.set("serving_slo_attainment", value, {"engine": tl.engine})
+        return ok
+
+
+# Process-default recorder: the serving engines report here, exactly like
+# the process-global metrics.REGISTRY and trace.TRACER.
+RECORDER = SLORecorder()
+
+
+def request(engine: str, arrival_t: Optional[float] = None) -> RequestTimeline:
+    return RECORDER.request(engine, arrival_t)

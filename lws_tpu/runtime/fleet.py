@@ -1,0 +1,206 @@
+"""Fleet metric aggregation: one scrape surface for every worker.
+
+The control plane already knows where the workers are — pod records carry
+a published address and the pod spec declares the telemetry port
+(LWS_TPU_METRICS_PORT, the containerPort analog — same discovery contract
+as the KV endpoint's LWS_TPU_KV_PORT). The FleetCollector walks READY pods
+with a declared port, scrapes each `http://addr:port/metrics`, injects
+`instance` (pod name) plus `role`/`revision` labels where the pod carries
+them, and merges everything — control-plane registries included, as
+instance "control-plane" — into ONE parser-valid exposition served at
+`GET /metrics/fleet` (runtime/server.py).
+
+Operators get fleet-level latency distributions instead of per-process
+averages (the serving-at-scale case PAPERS.md makes): a PromQL quantile
+over the merged `serving_ttft_seconds` IS the fleet TTFT distribution, and
+`lws-tpu top` renders the same surface live. Scrapes are bounded (short
+per-worker timeout, cached for `cache_ttl` so a dashboard refresh loop
+can't DOS the data plane) and failures degrade per instance:
+`lws_fleet_scrape_errors_total{instance}` counts them, the merged view
+carries whatever answered."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import urllib.request
+from http.client import HTTPException
+from typing import Optional
+
+from lws_tpu.api import contract
+from lws_tpu.core import metrics, trace
+from lws_tpu.runtime.telemetry import METRICS_PORT_ENV, METRICS_TOKEN_ENV
+
+
+def _pod_metrics_endpoint(pod) -> Optional[tuple[str, int]]:
+    """(host, port) when the pod declares a telemetry port, else None.
+    Mirrors kv_transport.discover_role_endpoint: the published address is
+    used VERBATIM (LocalBackend publishes 127.0.0.1; a rendezvous FQDN
+    resolves through cluster DNS). An unresolvable address fails that one
+    instance's scrape — never silently rewritten to loopback, which off
+    this host would scrape the wrong process under the pod's label."""
+    for container in pod.spec.containers:
+        for env in container.env:
+            if env.name == METRICS_PORT_ENV and env.value:
+                return pod.status.address or "127.0.0.1", int(env.value)
+    return None
+
+
+def _pod_scrape_labels(pod) -> dict[str, str]:
+    from lws_tpu.api import disagg
+
+    labels = {"instance": pod.meta.name}
+    role = pod.meta.labels.get(disagg.DS_ROLE_LABEL_KEY)
+    if role:
+        labels["role"] = role
+    revision = pod.meta.labels.get(disagg.DS_REVISION_LABEL_KEY) or \
+        pod.meta.labels.get(contract.REVISION_LABEL_KEY)
+    if revision:
+        labels["revision"] = revision
+    return labels
+
+
+class FleetCollector:
+    def __init__(
+        self,
+        store,
+        control_registries: tuple = (),
+        timeout_s: float = 2.0,
+        cache_ttl_s: float = 1.0,
+        max_label_sets: int = 512,
+        metrics_registry=None,
+    ) -> None:
+        """`control_registries` join the merge as instance "control-plane";
+        `metrics_registry` receives the collector's own health metrics
+        (defaults to the first control registry, else the process one)."""
+        self.store = store
+        self.control_registries = control_registries
+        self.timeout_s = timeout_s
+        self.cache_ttl_s = cache_ttl_s
+        self.max_label_sets = max_label_sets
+        self._own_metrics = (
+            metrics_registry if metrics_registry is not None
+            else (control_registries[0] if control_registries else metrics.REGISTRY)
+        )
+        self._lock = threading.Lock()
+        self._refill_lock = threading.Lock()
+        self._cached: Optional[str] = None
+        self._cached_at = 0.0
+        # Instances currently failing to scrape: ring events fire on the
+        # healthy->failing edge only (the counter still counts every miss).
+        self._failing: set[str] = set()
+
+    # ---- discovery + scrape ----------------------------------------------
+    def targets(self) -> list[tuple[dict, tuple[str, int]]]:
+        """[(labels, (host, port))] for every READY pod declaring a
+        telemetry port — k8s Endpoints semantics, same readiness gate as
+        the KV endpoint discovery."""
+        out = []
+        for pod in self.store.list("Pod"):
+            if not getattr(pod.status, "ready", False):
+                continue
+            endpoint = _pod_metrics_endpoint(pod)
+            if endpoint is None:
+                continue
+            out.append((_pod_scrape_labels(pod), endpoint))
+        return out
+
+    def _scrape_one(self, host: str, port: int) -> str:
+        # Negotiate OpenMetrics: the merge must carry the workers' trace
+        # exemplars (classic text-format responses have them stripped).
+        headers = {"Accept": metrics.OPENMETRICS_CONTENT_TYPE}
+        token = os.environ.get(METRICS_TOKEN_ENV)
+        if token:  # same-deployment convention: one token, CP + workers
+            headers["Authorization"] = f"Bearer {token}"
+        req = urllib.request.Request(
+            f"http://{host}:{port}/metrics", headers=headers
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return resp.read().decode()
+
+    def _scrape_target(self, labels: dict, host: str, port: int) -> Optional[str]:
+        instance = labels["instance"]
+        try:
+            text = self._scrape_one(host, port)
+            # Validate HERE, inside the per-instance guard: one worker
+            # answering with garbage (port reused mid-restart, truncated
+            # body) must not blank the whole fleet view when the merge
+            # parses it later.
+            metrics.parse_exposition(text)
+            self._failing.discard(instance)
+            return text
+        except (OSError, ValueError, HTTPException) as e:
+            self._own_metrics.inc(
+                "lws_fleet_scrape_errors_total", {"instance": instance},
+            )
+            # The failure is also a flight-recorder event — but only on the
+            # healthy->failing EDGE: a dead worker re-scraped every cache
+            # TTL would otherwise flood the bounded ring and evict the rare
+            # notable events the black box exists to retain.
+            if instance not in self._failing:
+                self._failing.add(instance)
+                from lws_tpu.core import flightrecorder
+
+                flightrecorder.record(
+                    "fleet_scrape_error",
+                    instance=instance, error=repr(e)[:200],
+                )
+            return None
+
+    def collect(self) -> list[tuple[dict, str]]:
+        """One scrape pass over the ready fleet: [(labels, exposition)].
+        Control-plane registries ride along as instance "control-plane" so
+        the fleet view is genuinely ONE surface. Per-instance failures are
+        counted and skipped — a dead worker must not blank the fleet.
+        Targets are scraped concurrently: a partitioned worker costs one
+        timeout of wall clock, not one per victim."""
+        sources: list[tuple[dict, str]] = []
+        targets = self.targets()
+        with trace.span("fleet.scrape", instances=len(targets)):
+            if targets:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+                    scraped = pool.map(
+                        lambda t: self._scrape_target(t[0], *t[1]), targets
+                    )
+                    sources = [
+                        (labels, text)
+                        for (labels, _), text in zip(targets, scraped)
+                        if text is not None
+                    ]
+        self._own_metrics.set("lws_fleet_instances", float(len(sources)))
+        # Render the control plane LAST: this pass's own health metrics
+        # (instance gauge, scrape-error counts) must appear in THIS pass's
+        # merged view, not trail one scrape behind.
+        if self.control_registries:
+            sources.insert(0, (
+                {"instance": "control-plane"},
+                metrics.render_exposition(*self.control_registries),
+            ))
+        return sources
+
+    def render_fleet(self, force: bool = False) -> str:
+        """The merged exposition, cached for `cache_ttl_s` (a dashboard
+        polling loop must not multiply into per-worker scrape storms).
+        Refills are single-flight: concurrent cache misses wait for the one
+        in-progress scrape instead of each launching their own pass."""
+        with self._lock:
+            if (not force and self._cached is not None
+                    and time.monotonic() - self._cached_at < self.cache_ttl_s):
+                return self._cached
+        with self._refill_lock:
+            # Re-check under the refill lock: the scraper we waited on has
+            # just filled the cache for us.
+            with self._lock:
+                if (not force and self._cached is not None
+                        and time.monotonic() - self._cached_at < self.cache_ttl_s):
+                    return self._cached
+            merged = metrics.merge_expositions(
+                self.collect(), max_label_sets=self.max_label_sets
+            )
+            with self._lock:
+                self._cached = merged
+                self._cached_at = time.monotonic()
+            return merged

@@ -276,11 +276,32 @@ class ControlPlane:
             self.kubelet = FakeKubelet(self.store, require_binding=require_binding)
             self.manager.register(self.kubelet, {"Pod": lambda o: [o.key()]})
 
+        # Fleet telemetry plane: the collector merges every ready worker's
+        # /metrics into /metrics/fleet (this registry + the process serving
+        # registry ride along as instance "control-plane"); the watchdog
+        # evaluates stall/hot-loop/backlog rules over the process flight
+        # recorder's heartbeats. run_until_stable ticks it deterministically;
+        # start() runs it on a thread.
+        from lws_tpu.core.flightrecorder import Watchdog
+        from lws_tpu.core.metrics import REGISTRY as _process_registry
+        from lws_tpu.runtime.fleet import FleetCollector
+
+        control_regs = (
+            (self.metrics,) if self.metrics is _process_registry
+            else (self.metrics, _process_registry)
+        )
+        self.fleet = FleetCollector(self.store, control_registries=control_regs)
+        self.watchdog = Watchdog(registries=(self.metrics,))
+
     # ------------------------------------------------------------------
     def run_until_stable(self, max_iterations: int = 10000) -> int:
         if self.elector is not None:
             self.elector.tick()
-        return self.manager.run_until_stable(max_iterations)
+        n = self.manager.run_until_stable(max_iterations)
+        # Deterministic watchdog tick: non-threaded control planes (the
+        # dominant test shape) still get alert evaluation after each drain.
+        self.watchdog.check_now()
+        return n
 
     def start(self) -> None:
         """Threaded mode: election loop (if configured) + controller workers.
@@ -288,8 +309,10 @@ class ControlPlane:
         if self.elector is not None:
             self.elector.start()
         self.manager.start()
+        self.watchdog.start()
 
     def stop(self) -> None:
+        self.watchdog.stop()
         self.manager.stop()
         if self.elector is not None:
             self.elector.stop()

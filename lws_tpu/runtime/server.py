@@ -7,8 +7,15 @@ Endpoints:
   GET  /metrics                       Prometheus text (control plane +
                                       process serving registry, one valid
                                       exposition)
+  GET  /metrics/fleet                 the AGGREGATED fleet exposition: every
+                                      ready worker's /metrics scraped and
+                                      merged with instance/role/revision
+                                      labels (runtime/fleet.py)
   GET  /debug/traces[?limit=N]        recent spans from the process tracer
                                       (reconcile -> serving trace spine)
+  GET  /debug/flightrecorder[?limit=N] flight-recorder snapshot: event ring,
+                                      heartbeats, active watchdog alerts,
+                                      and the last alert's diagnostics dump
   POST /apply                         YAML/JSON manifest (create-or-update)
   GET  /apis/{kind}                   list (JSON manifests)
   GET  /apis/{kind}/{ns}/{name}       get
@@ -153,6 +160,14 @@ class ApiServer:
             def _json(self, code: int, obj):
                 self._send(code, json.dumps(obj, indent=1, default=str))
 
+            def _send_exposition(self, text: str) -> None:
+                from lws_tpu.core import metrics as metricsmod
+
+                body, ctype = metricsmod.negotiate_exposition(
+                    text, self.headers.get("Accept")
+                )
+                self._send(200, body, ctype)
+
             def _authorized(self) -> bool:
                 if auth is None:
                     return True
@@ -185,19 +200,46 @@ class ApiServer:
 
                     regs = (cp.metrics,) if cp.metrics is metricsmod.REGISTRY \
                         else (cp.metrics, metricsmod.REGISTRY)
-                    self._send(200, metricsmod.render_exposition(*regs), "text/plain")
+                    self._send_exposition(metricsmod.render_exposition(*regs))
+                elif path == "/metrics/fleet":
+                    # The aggregated fleet view: every ready worker's
+                    # /metrics merged with instance/role/revision labels
+                    # under the cardinality cap (runtime/fleet.py).
+                    fleet = getattr(cp, "fleet", None)
+                    if fleet is None:
+                        self._json(404, {"error": "fleet collector not wired"})
+                        return
+                    self._send_exposition(fleet.render_fleet())
                 elif path == "/debug/traces":
                     from urllib.parse import parse_qs, urlparse
 
                     from lws_tpu.core import trace as tracemod
+                    from lws_tpu.runtime.telemetry import parse_limit
 
                     q = parse_qs(urlparse(self.path).query)
                     try:
-                        limit = int(q.get("limit", ["256"])[0])
+                        limit = parse_limit(q)
                     except ValueError as e:
+                        # 400, never a 500: non-integer AND negative limits
+                        # are both caller errors.
                         self._json(400, {"error": f"bad limit: {e}"})
                         return
                     self._json(200, tracemod.TRACER.spans(limit))
+                elif path == "/debug/flightrecorder":
+                    from urllib.parse import parse_qs, urlparse
+
+                    from lws_tpu.core import flightrecorder as frmod
+                    from lws_tpu.runtime.telemetry import parse_limit
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad limit: {e}"})
+                        return
+                    self._json(200, frmod.debug_snapshot(
+                        limit, getattr(cp, "watchdog", None)
+                    ))
                 elif len(parts) == 2 and parts[0] == "apis":
                     try:
                         objs = cp.store.list(_kind(parts[1]))

@@ -1,0 +1,152 @@
+"""Worker telemetry server: the per-process /metrics surface the control
+plane's fleet scraper aggregates.
+
+A serving worker (disagg prefill/decode, or any process embedding an
+engine) exposes its process-default registries over one tiny HTTP server:
+
+  GET /metrics               process metrics.REGISTRY, Prometheus text
+  GET /debug/traces?limit=N  recent spans from the process trace.TRACER
+  GET /debug/flightrecorder  the process flight-recorder snapshot (ring +
+                             heartbeats; ?limit=N bounds the event list)
+  GET /healthz               liveness
+
+Workers declare the port via LWS_TPU_METRICS_PORT in their pod env — the
+containerPort analog the fleet collector (runtime/fleet.py) reads from the
+pod spec, exactly like the KV endpoint's LWS_TPU_KV_PORT. Port 0 binds an
+ephemeral port (tests). When LWS_TPU_METRICS_TOKEN is set (on worker AND
+control plane — same-deployment convention), everything except /healthz
+requires `Authorization: Bearer <token>`: the debug surface carries span
+trees and request ids, the same data the API server gates behind auth.
+
+start_from_env also runs a worker-side Watchdog over the process flight
+recorder: a wedged decode ring or KV backlog in a WORKER process must trip
+`lws_watchdog_*` (which ride the fleet scrape) and capture a dump, not
+just beat a heartbeat table nothing evaluates."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+METRICS_PORT_ENV = "LWS_TPU_METRICS_PORT"
+METRICS_TOKEN_ENV = "LWS_TPU_METRICS_TOKEN"
+
+
+def parse_limit(query: dict, default: int = 256) -> int:
+    """Parse a ?limit=N value: non-integer or negative raises ValueError
+    (callers answer 400 — malformed input must never 500 a debug surface)."""
+    raw = query.get("limit", [str(default)])[0]
+    limit = int(raw)  # ValueError on non-integer
+    if limit < 0:
+        raise ValueError(f"limit must be >= 0, got {limit}")
+    return limit
+
+
+class TelemetryServer:
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 watchdog=None, token: Optional[str] = None) -> None:
+        """`watchdog` (a flightrecorder.Watchdog) contributes alerts and the
+        last diagnostics dump to /debug/flightrecorder; `token` gates every
+        path except /healthz behind `Authorization: Bearer <token>`."""
+        from lws_tpu.core import flightrecorder as frmod
+        from lws_tpu.core import metrics as metricsmod
+        from lws_tpu.core import trace as tracemod
+
+        self.watchdog = watchdog
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            sys_version = ""
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _authorized(self) -> bool:
+                if token is None:
+                    return True
+                return self.headers.get("Authorization") == f"Bearer {token}"
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                path, q = parsed.path, parse_qs(parsed.query)
+                if path == "/healthz":
+                    self._send(200, "ok", "text/plain")
+                    return
+                if not self._authorized():
+                    self._send(401, json.dumps({"error": "unauthorized"}),
+                               "application/json")
+                    return
+                if path == "/metrics":
+                    body, ctype = metricsmod.negotiate_exposition(
+                        metricsmod.REGISTRY.render(), self.headers.get("Accept")
+                    )
+                    self._send(200, body, ctype)
+                elif path == "/debug/traces":
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": f"bad limit: {e}"}),
+                                   "application/json")
+                        return
+                    self._send(200, json.dumps(tracemod.TRACER.spans(limit),
+                                               default=str),
+                               "application/json")
+                elif path == "/debug/flightrecorder":
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": f"bad limit: {e}"}),
+                                   "application/json")
+                        return
+                    snapshot = frmod.debug_snapshot(limit, outer.watchdog)
+                    self._send(200, json.dumps(snapshot, default=str),
+                               "application/json")
+                else:
+                    self._send(404, json.dumps({"error": "unknown path"}),
+                               "application/json")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+
+    def start(self) -> None:
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        if self.watchdog is not None:
+            self.watchdog.start()
+
+    def stop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self._httpd.shutdown()
+
+
+def start_from_env() -> Optional[TelemetryServer]:
+    """Start the telemetry server on the pod-declared port, with a
+    worker-side Watchdog evaluating the default stall/hot-loop/backlog
+    rules over this process's heartbeats; None when the env doesn't declare
+    a port (telemetry is opt-in per pod spec)."""
+    import os
+
+    from lws_tpu.core.flightrecorder import Watchdog
+
+    raw = os.environ.get(METRICS_PORT_ENV)
+    if not raw:
+        return None
+    server = TelemetryServer(
+        port=int(raw),
+        watchdog=Watchdog(),
+        token=os.environ.get(METRICS_TOKEN_ENV) or None,
+    )
+    server.start()
+    return server

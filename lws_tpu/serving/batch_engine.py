@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from lws_tpu.core import metrics, trace
+from lws_tpu.core import metrics, slo, trace
 from lws_tpu.serving.pipeline import DecodePipeline, remaining_steps
 
 from lws_tpu.models.llama import (
@@ -45,6 +45,8 @@ class Request:
     max_new_tokens: int
     tokens: list[int] = field(default_factory=list)
     slot: int = -1
+    # Per-request SLO timeline (queue wait / TTFT / ITL; core/slo.py).
+    slo: "slo.RequestTimeline | None" = None
 
     @property
     def done(self) -> bool:
@@ -126,7 +128,8 @@ class BatchEngine:
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens exceeds max_len")
         slot = self._free.pop(0)
-        req = Request(next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot)
+        req = Request(next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot,
+                      slo=slo.request("batch"))
 
         plen = len(prompt)
         t0 = time.perf_counter()
@@ -154,8 +157,14 @@ class BatchEngine:
             time.perf_counter() - t0, {"engine": "batch"},
         )
         req.tokens.append(int(first[0]))
+        # Queue wait (arrival -> slot) and TTFT (arrival -> prefill token):
+        # for this engine both end here — the prompt queued only in the
+        # sense that submit() was the admission.
+        req.slo.queue_wait(0.0)
+        req.slo.first_token()
         if req.done:
             # max_new_tokens == 1: the prefill token alone finishes it.
+            req.slo.finish()
             self._completed[req.request_id] = req
             self._free.append(slot)
         else:
@@ -196,8 +205,10 @@ class BatchEngine:
             def commit(host_tokens, snapshot=snapshot):
                 for slot, req in snapshot.items():
                     req.tokens.append(int(host_tokens[slot]))
+                    req.slo.tokens(1)  # ITL: gap since this request's last commit
                     # Position is host-derivable: prompt + generated tokens.
                     if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
+                        req.slo.finish()
                         self._completed[req.request_id] = req
                         # Identity-guarded as a whole: retiring twice would
                         # put the slot on the free list twice.

@@ -73,7 +73,7 @@ def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict
     subsume the old ad-hoc timers; same keys on the wire)."""
     import jax
 
-    from lws_tpu.core import trace
+    from lws_tpu.core import slo, trace
     from lws_tpu.serving.kv_transport import bundle_to_cache
     from lws_tpu.serving.pipeline import DecodePipeline
 
@@ -98,6 +98,11 @@ def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict
         first = np.asarray(token)  # overlaps the in-flight decode dispatch
         pipe.flush()  # blocks: decode_s is the real dispatch time
     toks = out["toks"]
+    # SLO timeline, decode leg: the chunk's mean step gap is the ITL sample
+    # (same per-dispatch discipline as the engines' commit paths).
+    timeline = slo.request("disagg")
+    timeline.tokens(steps, s_decode.duration_s)
+    timeline.finish()
     stats = {
         "bundle_bytes": len(payload),
         "deserialize_s": round(s_deser.duration_s, 4),
@@ -121,13 +126,27 @@ def _force_tracing() -> None:
     trace.TRACER.sample_rate = 1.0
 
 
+def _start_telemetry():
+    """Expose this worker's /metrics when the pod declares a telemetry port
+    (LWS_TPU_METRICS_PORT) — the surface the control plane's fleet scraper
+    merges into /metrics/fleet."""
+    from lws_tpu.runtime.telemetry import start_from_env
+
+    server = start_from_env()
+    if server is not None:
+        print(f"[{os.environ.get('POD_NAME', '?')}] telemetry on :{server.port}",
+              flush=True)
+    return server
+
+
 def run_prefill_tcp(once: bool, max_len: int) -> int:
     """Serve prompts-in / KV-bundles-out on LWS_TPU_KV_PORT. With `once`,
     exit after the first bundle has been pulled AND acked by a peer."""
-    from lws_tpu.core import metrics, trace
+    from lws_tpu.core import metrics, slo, trace
     from lws_tpu.serving import kv_transport as kt
 
     _force_tracing()
+    _start_telemetry()
     engine = build_engine(batch=1, max_len=max_len)
     server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
     print(f"[prefill {os.environ.get('POD_NAME', '?')}] serving KV on :{server.port}",
@@ -150,10 +169,18 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
             "serve.request", parent=meta.get("trace"),
             role="prefill", request_id=req_id,
         ) as s_req:
+            # SLO timeline, prefill leg: the KVServer stamped the prompt at
+            # enqueue, so queue wait is the REAL socket-to-worker wait; TTFT
+            # covers queue + prefill (the token exists after this dispatch).
+            timeline = slo.request("disagg")
+            wait = float(meta.get("queue_wait_s", 0.0))
+            timeline.queue_wait(wait)
             with trace.span("serve.prefill", chunked=False,
                             prompt_len=int(prompt.size)) as s_prefill:
                 token, cache = engine.prefill(prompt.reshape(1, -1))
                 np.asarray(token)  # block: prefill_s is the real dispatch time
+            timeline.first_token(wait + s_prefill.duration_s)
+            timeline.finish()
             with trace.span("kv.gather", tp_gathered=engine.mesh is not None) as s_gather:
                 bundle = kt.cache_to_bundle(cache, token)  # pos-truncated (+gathered)
                 s_gather.set(pos=int(cache.pos), bundle_bytes=len(bundle))
@@ -195,6 +222,7 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
     from lws_tpu.serving import kv_transport as kt
 
     _force_tracing()
+    _start_telemetry()
     engine = build_engine(batch=1, max_len=max_len)
     server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
     me = os.environ.get("POD_NAME", str(os.getpid()))

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from lws_tpu.core import metrics, trace
+from lws_tpu.core import metrics, slo, trace
 from lws_tpu.serving.pipeline import DecodePipeline
 from lws_tpu.models.llama import (
     KVCache,
@@ -483,12 +483,14 @@ class Engine:
             "serve.request", engine="dense", speculative=True,
             prompt_len=int(prompt.shape[1]), max_new_tokens=max_new_tokens,
         ) as request_span:
+            timeline = slo.request("dense")
             t0 = time.perf_counter()
             with trace.span("serve.prefill", chunked=False,
                             prompt_len=int(prompt.shape[1])):
                 token, cache = self.prefill(prompt)
                 host_sync(token)
             ttft = time.perf_counter() - t0
+            timeline.first_token(ttft)
 
             t1 = time.perf_counter()
             context = [int(t) for t in np.asarray(prompt)[0]] + [int(np.asarray(token)[0])]
@@ -531,6 +533,9 @@ class Engine:
             out = out[: max(1, max_new_tokens)]  # generate(p, 0) also returns [1, 1]
             dt = time.perf_counter() - t1
             steps = len(out) - 1
+            if steps:
+                timeline.tokens(steps, dt)
+            timeline.finish()
             request_span.set(
                 ttft_s=round(ttft, 6), decode_s=round(dt, 6),
                 dispatches=dispatches, accepted=accepted_total,
@@ -573,12 +578,14 @@ class Engine:
             max_new_tokens=max_new_tokens,
         )
         with request_span:
+            timeline = slo.request("dense")
             t0 = time.perf_counter()
             with trace.span("serve.prefill", chunked=False,
                             prompt_len=int(prompt.shape[1])):
                 token, cache = self.prefill(prompt)
                 host_sync(token)
             ttft = time.perf_counter() - t0
+            timeline.first_token(ttft)
 
             t1 = time.perf_counter()
             pipe = DecodePipeline(depth=self.pipeline_depth, engine="dense")
@@ -599,6 +606,9 @@ class Engine:
             pipe.flush()
             tokens = np.concatenate(host_chunks, axis=1)
             dt = time.perf_counter() - t1
+            if steps:
+                timeline.tokens(steps, dt)
+            timeline.finish()
             request_span.set(ttft_s=round(ttft, 6), decode_s=round(dt, 6))
         metrics.inc("serving_requests_total", {"engine": "dense"})
         metrics.observe(

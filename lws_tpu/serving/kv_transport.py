@@ -179,12 +179,33 @@ class KVServer:
     # ---- worker-side (in-process) ----------------------------------------
     def next_prompt(self, timeout: float = 0.2) -> Optional[tuple[dict, bytes]]:
         try:
-            return self._prompts.get(timeout=timeout)
+            item = self._prompts.get(timeout=timeout)
         except queue.Empty:
             return None
+        meta, payload = item
+        # Queue wait (enqueue stamp -> worker pickup) for the SLO recorder:
+        # the one place in this repo a request actually queues.
+        enq = meta.pop("_enq_t", None)
+        if enq is not None:
+            import time as _time
+
+            meta["queue_wait_s"] = max(0.0, _time.time() - enq)
+        return meta, payload
 
     def offer_bundle(self, meta: dict, payload: bytes) -> None:
         self._bundles.put((meta, payload))
+        self._backlog_beat()
+
+    def _backlog_beat(self) -> None:
+        # KV-handoff backlog feed for the watchdog: progress = bundles the
+        # decode side has pulled AND acked, depth = bundles still waiting.
+        from lws_tpu.core import flightrecorder
+
+        flightrecorder.beat(
+            f"kv_backlog:{self.port}",
+            progress=self.bundles_delivered,
+            depth=self._bundles.qsize(),
+        )
 
     def post_result(self, req_id: str, meta: dict, payload: bytes) -> None:
         with self._results_lock:
@@ -218,6 +239,9 @@ class KVServer:
                 return
             op = meta.get("op")
             if op == "submit_prompt":
+                import time as _time
+
+                meta["_enq_t"] = _time.time()  # queue-wait stamp (same host)
                 self._prompts.put((meta, payload))
                 send_msg(conn, {"ok": True})
             elif op == "pull_bundle":
@@ -240,8 +264,10 @@ class KVServer:
                     if not (ack or {}).get("ack"):
                         raise OSError("no ack")
                     self.bundles_delivered += 1
+                    self._backlog_beat()  # progress advanced: backlog drains
                 except OSError:
                     self._bundles.put((bmeta, bpayload))
+                    self._backlog_beat()
             elif op == "pull_result":
                 # Pop under the lock BEFORE sending: two concurrent pulls for
                 # the same id must not both deliver (results_served drives

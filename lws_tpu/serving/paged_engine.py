@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from lws_tpu.core import metrics, trace
+from lws_tpu.core import flightrecorder, metrics, slo, trace
 from lws_tpu.serving.pipeline import DecodePipeline, remaining_steps
 
 from lws_tpu.models.llama import (
@@ -79,6 +79,8 @@ class PagedRequest:
     top_k: int = 0
     top_p: float = 1.0
     seed: Optional[int] = None
+    # Per-request SLO timeline (queue wait / TTFT / ITL; core/slo.py).
+    slo: "slo.RequestTimeline | None" = None
 
     @property
     def done(self) -> bool:
@@ -630,7 +632,13 @@ class PagedBatchEngine:
 
     def _finish_admission(self, req: PagedRequest, first) -> int:
         req.tokens.append(int(first))
+        if req.slo is not None:
+            # The prefill token in hand marks TTFT on the arrival clock
+            # (queue wait was recorded at slot acquisition).
+            req.slo.first_token()
         if req.done:
+            if req.slo is not None:
+                req.slo.finish()
             self._completed[req.request_id] = req
             self._release(req)
         else:
@@ -647,6 +655,8 @@ class PagedBatchEngine:
         retired by an earlier chunk's commit must not release twice (a
         double _release would double-free its blocks and underflow the
         sampled-slot counter)."""
+        if req.slo is not None:
+            req.slo.finish()  # idempotent: later duplicate retires no-op
         self._completed[req.request_id] = req
         if self._active.get(slot) is not req:
             return
@@ -674,11 +684,13 @@ class PagedBatchEngine:
         REUSED: only the suffix is prefilled (vLLM automatic-prefix-caching
         shape; exactness-tested against the uncached engine)."""
         t0 = time.perf_counter()
+        timeline = slo.request("paged")  # arrival clock starts at submit()
         with trace.span(
             "serve.admission", engine="paged", prompt_len=len(prompt)
         ) as sp:
             rid = self._submit(
-                prompt, max_new_tokens, temperature, top_k, top_p, seed
+                prompt, max_new_tokens, temperature, top_k, top_p, seed,
+                timeline=timeline,
             )
             sp.set(admitted=rid is not None)
         if rid is not None:
@@ -700,7 +712,10 @@ class PagedBatchEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         seed: Optional[int] = None,
+        timeline: "slo.RequestTimeline | None" = None,
     ) -> Optional[int]:
+        if timeline is None:
+            timeline = slo.request("paged")
         if not self._free_slots and self._pipeline:
             # Backpressure with chunks in flight: completions may be sitting
             # unconsumed in the ring — consume before refusing admission.
@@ -721,18 +736,19 @@ class PagedBatchEngine:
         if self.prefix_cache:
             return self._submit_prefix(
                 prompt, max_new_tokens, temperature, top_k, top_p, seed,
-                plen, bucket, n_blocks,
+                plen, bucket, n_blocks, timeline,
             )
         if n_blocks > len(self._free_blocks) and self._pipeline:
             self._pipeline.flush()  # in-flight completions may free blocks
         if n_blocks > len(self._free_blocks):
             return None
         slot = self._free_slots.pop(0)
+        timeline.queue_wait()  # arrival -> slot (includes any ring flushes)
         blocks = [self._free_blocks.pop(0) for _ in range(n_blocks)]
         req = PagedRequest(
             next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot,
             blocks=blocks, temperature=temperature, top_k=top_k, top_p=top_p,
-            seed=seed,
+            seed=seed, slo=timeline,
         )
         req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
         if self.prefill_chunk is not None and plen > self.prefill_chunk:
@@ -768,7 +784,7 @@ class PagedBatchEngine:
 
     def _submit_prefix(
         self, prompt, max_new_tokens, temperature, top_k, top_p, seed,
-        plen, bucket, n_blocks,
+        plen, bucket, n_blocks, timeline=None,
     ) -> Optional[int]:
         prompt = np.asarray(prompt)
         bs = self.block_size
@@ -800,11 +816,13 @@ class PagedBatchEngine:
                     self._lru[blk] = None
             return None
         slot = self._free_slots.pop(0)
+        if timeline is not None:
+            timeline.queue_wait()  # arrival -> slot
         blocks = hits + new_blocks
         req = PagedRequest(
             next(self._ids), prompt, max_new_tokens, slot=slot, blocks=blocks,
             shared_blocks=list(hits), temperature=temperature, top_k=top_k,
-            top_p=top_p, seed=seed,
+            top_p=top_p, seed=seed, slo=timeline,
         )
         req_key = self._assign_sampling(slot, temperature, top_k, top_p, seed)
         chunked = (
@@ -1098,14 +1116,27 @@ class PagedBatchEngine:
                         # the step on the XLA gather path (slower, never
                         # wrong), and keep serving. The probe step ran
                         # WITHOUT donation, so the cache survives even a
-                        # post-compile runtime failure.
+                        # post-compile runtime failure. The log line carries
+                        # the active trace id + the dispatch's request ids so
+                        # a flight-recorder dump correlates the fallback with
+                        # the requests that hit it.
                         import sys
 
+                        ctx = trace.current_context() or {}
+                        req_ids = sorted(
+                            r.request_id for r in self._active.values()
+                        )
                         print(
                             f"[paged-engine] pallas kernel failed on "
                             f"{jax.default_backend()!r}: {e!r:.300}; falling back to "
-                            f"the XLA gather path",
+                            f"the XLA gather path "
+                            f"(trace_id={ctx.get('trace_id', '-')} "
+                            f"requests={req_ids})",
                             file=sys.stderr, flush=True,
+                        )
+                        flightrecorder.record(
+                            "kernel_fallback", engine="paged",
+                            error=repr(e)[:300], requests=req_ids,
                         )
                         self.stats["attention_path"] = "xla_fallback"
                         self.stats["kernel_error"] = repr(e)[:300]
@@ -1131,6 +1162,9 @@ class PagedBatchEngine:
             def commit(host_toks, snapshot=snapshot):  # host_toks [n, slots]
                 for slot, req in snapshot.items():
                     req.tokens.extend(int(t) for t in host_toks[:, slot])
+                    if req.slo is not None:
+                        # ITL: per-dispatch mean of this chunk's step gaps.
+                        req.slo.tokens(host_toks.shape[0])
                     if req.done or len(req.prompt) + len(req.tokens) >= self.max_len:
                         self._retire(slot, req)
 
@@ -1271,6 +1305,8 @@ class PagedBatchEngine:
                     self.stats.get("spec_accepted", 0) + len(new) - 1
                 )
             r.tokens.extend(new)
+            if r.slo is not None:
+                r.slo.tokens(len(new))
             if r.done or len(r.prompt) + len(r.tokens) >= self.max_len:
                 self._retire(s, r)
         # Commit host truth back to the device state the regular step path

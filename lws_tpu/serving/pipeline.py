@@ -48,7 +48,7 @@ from typing import Callable
 
 import numpy as np
 
-from lws_tpu.core import metrics, trace
+from lws_tpu.core import flightrecorder, metrics, trace
 
 
 def remaining_steps(req, max_len: int) -> int:
@@ -128,6 +128,7 @@ class DecodePipeline:
         if len(self._ring) > self.stats["max_inflight"]:
             self.stats["max_inflight"] = len(self._ring)
         self._gauge()
+        self._heartbeat()
 
     def flush(self) -> None:
         if self._ring:
@@ -136,9 +137,18 @@ class DecodePipeline:
             self._consume_oldest()
 
     def discard(self) -> None:
+        # The rollback escape hatch: in-flight results abandoned as known-
+        # invalid. Ring event + trace id so a flight-recorder dump
+        # correlates the rollback with the request that triggered it.
+        if self._ring:
+            flightrecorder.record(
+                "pipeline_discard", engine=self.engine_label,
+                chunks=len(self._ring), steps=self.inflight_steps(),
+            )
         self.stats["discarded"] += len(self._ring)
         self._ring.clear()
         self._gauge()
+        self._heartbeat()
 
     def _consume_oldest(self) -> None:
         steps, payload, commit = self._ring.popleft()
@@ -157,9 +167,21 @@ class DecodePipeline:
                 commit(host)
         self.stats["consumed"] += 1
         self._gauge()
+        self._heartbeat()
 
     def _gauge(self) -> None:
         metrics.set(
             "serving_inflight_dispatches", len(self._ring),
             {"engine": self.engine_label},
+        )
+
+    def _heartbeat(self) -> None:
+        # Stall-watchdog feed: progress = chunks that LEFT the ring
+        # (consumed or discarded), depth = chunks still in flight. A wedged
+        # device dispatch shows as depth > 0 with frozen progress; a slow
+        # but draining ring keeps advancing and never trips the watchdog.
+        flightrecorder.beat(
+            f"decode_ring:{self.engine_label}",
+            progress=self.stats["consumed"] + self.stats["discarded"],
+            depth=len(self._ring),
         )

@@ -3,9 +3,19 @@
 from __future__ import annotations
 
 import hashlib
+import os
 from typing import Callable, Optional, TypeVar
 
 T = TypeVar("T")
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with a safe fallback (shared by the SLO targets and
+    the watchdog windows — one parse rule for every telemetry tunable)."""
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 def sha1_hash(s: str) -> str:

@@ -14,7 +14,11 @@ from lws_tpu.testing import LWSBuilder
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\",?)*)\})?"
-    r" (?P<value>[0-9.+\-eEInf]+)$"
+    r" (?P<value>[0-9.+\-eEInf]+)"
+    # OpenMetrics exemplar on bucket lines (` # {trace_id="..."} 0.004`):
+    # classic scrapers treat everything after # as a comment; ours validates
+    # the shape so a malformed exemplar can't hide in the suffix.
+    r"(?P<exemplar> # \{(?:[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\",?)*\} [0-9.+\-eEInf]+)?$"
 )
 
 
@@ -35,6 +39,8 @@ def parse_exposition(text: str) -> dict:
             assert ftype in ("counter", "gauge", "histogram"), line
             families[name] = {"type": ftype, "samples": []}
             current = name
+            continue
+        if line == "# EOF":  # OpenMetrics terminator (negotiated responses)
             continue
         assert not line.startswith("#"), f"unknown comment line: {line}"
         m = _SAMPLE_RE.match(line)

@@ -39,7 +39,12 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def role_spec(role: str, kv_port: int, api_url: str, extra_env: list | None = None):
+def role_spec(role: str, kv_port: int, api_url: str, extra_env: list | None = None,
+              metrics_port: int | None = None):
+    telemetry_env = (
+        [EnvVar("LWS_TPU_METRICS_PORT", str(metrics_port))]
+        if metrics_port is not None else []
+    )
     return DisaggregatedRoleSpec(
         name=role,
         replicas=1,
@@ -62,7 +67,7 @@ def role_spec(role: str, kv_port: int, api_url: str, extra_env: list | None = No
                                         # endpoint port the service routes to.
                                         EnvVar("LWS_TPU_KV_PORT", str(kv_port)),
                                         EnvVar("LWS_TPU_API", api_url),
-                                    ] + list(extra_env or []),
+                                    ] + telemetry_env + list(extra_env or []),
                                 )
                             ]
                         )
@@ -84,13 +89,16 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
     api.start()
     api_url = f"http://127.0.0.1:{api.port}"
     prefill_port, decode_port = free_port(), free_port()
+    prefill_metrics, decode_metrics = free_port(), free_port()
 
     ds = DisaggregatedSet(
         meta=new_meta("llmd"),
         spec=DisaggregatedSetSpec(
             roles=[
-                role_spec("prefill", prefill_port, api_url, extra_env),
-                role_spec("decode", decode_port, api_url, extra_env),
+                role_spec("prefill", prefill_port, api_url, extra_env,
+                          metrics_port=prefill_metrics),
+                role_spec("decode", decode_port, api_url, extra_env,
+                          metrics_port=decode_metrics),
             ]
         ),
     )
@@ -238,6 +246,88 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
 
             debug_spans = _json.loads(resp.read().decode())
         assert debug_spans and any(s["name"] == "reconcile" for s in debug_spans)
+
+        # Fleet telemetry plane (ISSUE 4): the control plane scrapes BOTH
+        # worker processes' /metrics (addresses from pod records, ports from
+        # the pod-declared LWS_TPU_METRICS_PORT) and serves ONE merged
+        # exposition with instance/role/revision labels. The workers' SLO
+        # histograms ride in — TTFT from the prefill leg, ITL from the
+        # decode leg — with trace exemplars on the bucket lines.
+        fleet = fleet_text = None
+        # OpenMetrics negotiation: exemplars ride only for clients that ask
+        # (a classic Prometheus text parser rejects the suffix).
+        fleet_req = urllib.request.Request(
+            f"{api_url}/metrics/fleet",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        while time.time() < deadline:
+            with urllib.request.urlopen(fleet_req, timeout=10) as resp:
+                fleet_text = resp.read().decode()
+            fleet = parse_exposition(fleet_text)
+            roles = {
+                labels.get("role")
+                for fam in fleet.values()
+                for _, labels, _ in fam["samples"]
+            }
+            if {"prefill", "decode"} <= roles:
+                break
+            time.sleep(1.1)  # collector cache TTL is 1s
+        by_role = {}
+        for fam in fleet.values():
+            for _, labels, _ in fam["samples"]:
+                if labels.get("role"):
+                    by_role.setdefault(labels["role"], set()).add(labels["instance"])
+        assert {"prefill", "decode"} <= set(by_role), by_role
+        assert by_role["prefill"].isdisjoint(by_role["decode"])  # distinct pods
+        assert all(len(v) == 1 for v in by_role.values()), by_role
+        # Prefill leg recorded TTFT (+ the socket queue wait), decode ITL.
+        assert any(
+            labels.get("role") == "prefill" and labels.get("engine") == "disagg"
+            and name.endswith("_count") and value > 0
+            for name, labels, value in fleet["serving_ttft_seconds"]["samples"]
+        ), fleet["serving_ttft_seconds"]["samples"]
+        assert any(
+            labels.get("role") == "decode" and labels.get("engine") == "disagg"
+            and name.endswith("_count") and value > 0
+            for name, labels, value in fleet["serving_itl_seconds"]["samples"]
+        ), fleet["serving_itl_seconds"]["samples"]
+        # Exemplars survive scrape + merge: a breach bucket links to a trace.
+        assert 'trace_id="' in fleet_text
+        # The control plane's own registries merged in under their instance.
+        assert any(
+            labels.get("instance") == "control-plane"
+            for _, labels, _ in fleet["lws_reconcile_total"]["samples"]
+        )
+
+        # The exemplar RESOLVES: pull the prefill TTFT exemplar's trace id
+        # out of the merged text and find its span tree in the emitting
+        # worker's own /debug/traces — the fleet-surface -> trace-backend
+        # round trip an operator walks after an SLO breach.
+        from lws_tpu.core.metrics import parse_exposition as parse_prod
+
+        prod_fams = parse_prod(fleet_text)
+        exemplar_ids = {
+            ex.split('trace_id="')[1].split('"')[0]
+            for name, labels, _, ex in prod_fams["serving_ttft_seconds"]["samples"]
+            if labels.get("role") == "prefill" and 'trace_id="' in ex
+        }
+        assert exemplar_ids, prod_fams["serving_ttft_seconds"]["samples"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{prefill_metrics}/debug/traces?limit=512",
+            timeout=10,
+        ) as resp:
+            worker_spans = _json.loads(resp.read().decode())
+        known = {s["trace_id"] for s in worker_spans}
+        assert exemplar_ids & known, (exemplar_ids, known)
+
+        # `lws-tpu top` renders the operator view from this exact surface:
+        # both worker instances appear as rows of the fleet table.
+        from lws_tpu.cli import render_top
+
+        frame = render_top(prod_fams)
+        assert frame.startswith("FLEET"), frame
+        for instance in by_role["prefill"] | by_role["decode"]:
+            assert instance in frame, frame
 
         # Oracle: the same model end-to-end in one engine.
         from lws_tpu.serving.disagg_worker import build_engine

@@ -1,0 +1,734 @@
+"""Fleet telemetry plane (ISSUE 4): per-request SLO histograms with trace
+exemplars, the flight recorder + stall watchdogs, control-plane fleet
+metric aggregation, and the `lws-tpu top` renderer.
+
+The watchdog tests drive time explicitly (beat(now=...)/check_now(now=...))
+so stall windows need no sleeping; the fleet tests run REAL worker
+telemetry HTTP servers scraped over localhost sockets by a real control
+plane — the same path the multi-process e2e (test_e2e_disagg) exercises
+with separate OS processes."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu.core import flightrecorder, metrics, trace
+from lws_tpu.core.flightrecorder import (
+    BacklogRule,
+    FlightRecorder,
+    HotLoopRule,
+    StallRule,
+    Watchdog,
+)
+from lws_tpu.core.metrics import MetricsRegistry, merge_expositions
+from lws_tpu.core.slo import SLORecorder, SLOTargets
+from tests.test_dns_metrics import parse_exposition
+
+T0 = 1000.0  # arbitrary monotonic origin for time-injected watchdog tests
+
+
+# ---------------------------------------------------------------------------
+# SLO recorder
+
+
+def test_slo_timeline_emits_histograms_and_attainment():
+    reg = MetricsRegistry()
+    rec = SLORecorder(SLOTargets(ttft_s=1.0, itl_s=1.0, queue_wait_s=1.0),
+                      registry=reg, window=8)
+    tl = rec.request("paged")
+    tl.queue_wait(0.01)
+    tl.first_token(0.05)
+    tl.tokens(4, 0.02)  # mean ITL 0.005
+    assert tl.finish() is True
+    assert rec.attainment("paged") == 1.0
+    fams = parse_exposition(reg.render())
+    for name in ("serving_queue_wait_seconds", "serving_ttft_seconds",
+                 "serving_itl_seconds"):
+        assert fams[name]["type"] == "histogram"
+        counts = [v for n, _, v in fams[name]["samples"] if n.endswith("_count")]
+        assert counts == [1.0], (name, counts)
+    assert fams["serving_slo_attainment"]["samples"][0][2] == 1.0
+
+
+def test_slo_breach_degrades_attainment_window():
+    reg = MetricsRegistry()
+    rec = SLORecorder(SLOTargets(ttft_s=0.1, itl_s=1.0, queue_wait_s=1.0),
+                      registry=reg, window=4)
+    for ttft in (0.05, 0.5, 0.05, 0.05):  # one breach in four
+        tl = rec.request("dense")
+        tl.first_token(ttft)
+        tl.finish()
+    assert rec.attainment("dense") == 0.75
+    assert reg.gauge_value("serving_slo_attainment", {"engine": "dense"}) == 0.75
+    # The window is trailing: four clean requests push the breach out.
+    for _ in range(4):
+        tl = rec.request("dense")
+        tl.first_token(0.01)
+        tl.finish()
+    assert rec.attainment("dense") == 1.0
+
+
+def test_slo_observation_carries_trace_exemplar():
+    reg = MetricsRegistry()
+    rec = SLORecorder(registry=reg)
+    tracer_enabled = trace.TRACER.enabled
+    trace.TRACER.enabled = True
+    try:
+        with trace.span("serve.request", engine="paged") as sp:
+            tl = rec.request("paged")
+            tl.first_token(0.02)
+            trace_id = sp.trace_id
+    finally:
+        trace.TRACER.enabled = tracer_enabled
+    text = reg.render()
+    assert f'trace_id="{trace_id}"' in text
+    # The exemplar parses under the STRICT scraper-semantics validator.
+    fams = parse_exposition(text)
+    assert fams["serving_ttft_seconds"]["type"] == "histogram"
+
+
+# ---------------------------------------------------------------------------
+# Configurable histogram buckets (satellite)
+
+
+def test_describe_buckets_override_default_ladder():
+    metrics.describe("test_rollout_minutes_seconds", "minute-scale", buckets=(30.0, 300.0, 1800.0))
+    try:
+        reg = MetricsRegistry()
+        reg.observe("test_rollout_minutes_seconds", 200.0)
+        text = reg.render()
+        assert 'le="300.0"} 1' in text
+        assert 'le="5.0"' not in text  # default ladder NOT in play
+    finally:
+        metrics._BUCKETS.pop("test_rollout_minutes_seconds", None)
+        metrics._HELP.pop("test_rollout_minutes_seconds", None)
+
+
+def test_registry_bucket_override_beats_describe_and_default():
+    reg = MetricsRegistry(buckets={"x_seconds": (1.0, 2.0)})
+    reg.observe("x_seconds", 1.5)
+    assert 'x_seconds_bucket{le="2.0"} 1' in reg.render()
+    reg.set_buckets("y_seconds", (0.25,))
+    reg.observe("y_seconds", 0.1)
+    assert 'y_seconds_bucket{le="0.25"} 1' in reg.render()
+    # Existing series keep their layout (no fabricated history).
+    reg.set_buckets("x_seconds", (9.0,))
+    reg.observe("x_seconds", 1.5)
+    assert 'x_seconds_bucket{le="2.0"} 2' in reg.render()
+
+
+def test_sub_ms_itl_buckets_do_not_collapse():
+    reg = MetricsRegistry()
+    reg.observe("serving_itl_seconds", 0.0002, {"engine": "paged"})
+    reg.observe("serving_itl_seconds", 0.004, {"engine": "paged"})
+    fams = parse_exposition(reg.render())
+    by_le = {
+        labels["le"]: v
+        for n, labels, v in fams["serving_itl_seconds"]["samples"]
+        if n.endswith("_bucket")
+    }
+    # The two sub-5ms observations land in DIFFERENT buckets.
+    assert by_le["0.00025"] == 1.0 and by_le["0.005"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Fleet exposition merge
+
+
+def _worker_exposition(requests: float) -> str:
+    reg = MetricsRegistry()
+    reg.inc("serving_requests_total", {"engine": "paged"}, value=requests)
+    reg.set("serving_active_slots", 3.0, {"engine": "paged"})
+    reg.observe("serving_ttft_seconds", 0.03, {"engine": "paged"},
+                exemplar={"trace_id": "abc123", "span_id": "def456"})
+    return reg.render()
+
+
+def test_merge_expositions_labels_help_type_roundtrip():
+    merged = merge_expositions([
+        ({"instance": "w0", "role": "prefill", "revision": "r1"}, _worker_exposition(2)),
+        ({"instance": "w1", "role": "decode", "revision": "r1"}, _worker_exposition(5)),
+    ])
+    fams = parse_exposition(merged)  # strict: one TYPE block per family
+    reqs = {
+        labels["instance"]: (v, labels)
+        for _, labels, v in fams["serving_requests_total"]["samples"]
+    }
+    assert reqs["w0"][0] == 2.0 and reqs["w1"][0] == 5.0
+    assert reqs["w0"][1]["role"] == "prefill"
+    assert reqs["w1"][1]["revision"] == "r1"
+    assert fams["serving_ttft_seconds"]["type"] == "histogram"
+    # HELP text survives the merge; exemplars ride the bucket lines.
+    assert "# HELP serving_requests_total Requests admitted per engine" in merged
+    assert 'trace_id="abc123"' in merged
+
+
+def test_merge_expositions_cardinality_cap_drops_and_counts():
+    sources = [
+        ({"instance": f"w{i}"}, _worker_exposition(1)) for i in range(6)
+    ]
+    merged = merge_expositions(sources, max_label_sets=4)
+    fams = parse_exposition(merged)
+    assert len(fams["serving_requests_total"]["samples"]) == 4
+    drops = {
+        labels["metric"]: v
+        for _, labels, v in fams["lws_metric_label_sets_dropped_total"]["samples"]
+        if labels.get("scope") == "fleet"
+    }
+    assert drops["serving_requests_total"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder + watchdogs
+
+
+def test_flight_recorder_ring_and_heartbeats():
+    fr = FlightRecorder(ring=4)
+    for i in range(6):
+        fr.record("test_event", i=i)
+    events = fr.events()
+    assert len(events) == 4 and events[-1]["i"] == 5  # bounded, newest kept
+    assert fr.events(limit=2)[0]["i"] == 4
+    assert fr.events(limit=0) == []
+    fr.beat("decode_ring:paged", progress=3, depth=1, now=T0)
+    hb = fr.heartbeats()["decode_ring:paged"]
+    assert hb["progress"] == 3 and hb["depth"] == 1
+
+
+def test_flight_recorder_event_captures_trace_context():
+    fr = FlightRecorder()
+    enabled = trace.TRACER.enabled
+    trace.TRACER.enabled = True
+    try:
+        with trace.span("serve.request", engine="paged") as sp:
+            fr.record("pipeline_discard", engine="paged")
+            trace_id = sp.trace_id
+    finally:
+        trace.TRACER.enabled = enabled
+    assert fr.events()[-1]["trace"]["trace_id"] == trace_id
+
+
+def test_stall_watchdog_trips_on_frozen_ring():
+    fr = FlightRecorder()
+    wd = Watchdog(recorder=fr, rules=[StallRule("decode_ring_stall", "decode_ring:*",
+                                                stall_after_s=5.0)])
+    fr.beat("decode_ring:paged", progress=7, depth=2, now=T0)
+    assert wd.check_now(now=T0 + 1) == {}  # inside the window: quiet
+    before = metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "decode_ring_stall"})
+    firing = wd.check_now(now=T0 + 10)
+    assert "decode_ring_stall" in firing
+    assert firing["decode_ring_stall"][0]["source"] == "decode_ring:paged"
+    after = metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "decode_ring_stall"})
+    assert after == before + 1
+    assert metrics.REGISTRY.gauge_value(
+        "lws_watchdog_active", {"watchdog": "decode_ring_stall"}) == 1.0
+    # Steady firing does not re-count; recovery clears the gauge.
+    wd.check_now(now=T0 + 11)
+    assert metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "decode_ring_stall"}) == after
+    fr.beat("decode_ring:paged", progress=8, depth=0, now=T0 + 12)
+    assert wd.check_now(now=T0 + 12) == {}
+    assert metrics.REGISTRY.gauge_value(
+        "lws_watchdog_active", {"watchdog": "decode_ring_stall"}) == 0.0
+    # The trip captured a diagnostics bundle: ring + heartbeats + metrics.
+    dump = wd.last_dump
+    assert dump["reason"] == "watchdog:decode_ring_stall"
+    assert dump["heartbeats"]["decode_ring:paged"]["depth"] == 2
+    assert "# TYPE lws_watchdog_alerts_total counter" in dump["metrics"]
+    assert any(e["kind"] == "watchdog_alert" for e in dump["events"])
+
+
+def test_slow_but_progressing_ring_never_trips():
+    """The false-positive guard: a ring that is SLOW (one consume per 3s
+    against a 5s stall window) but advancing must not alarm."""
+    fr = FlightRecorder()
+    wd = Watchdog(recorder=fr, rules=[StallRule("decode_ring_stall", "decode_ring:*",
+                                                stall_after_s=5.0)])
+    progress = 0
+    for step in range(8):  # 24 seconds of slow progress, depth always > 0
+        progress += 1
+        fr.beat("decode_ring:paged", progress=progress, depth=3, now=T0 + 3 * step)
+        assert wd.check_now(now=T0 + 3 * step + 2) == {}, f"tripped at step {step}"
+    assert wd.last_dump is None
+
+
+def test_hot_loop_and_backlog_rules():
+    fr = FlightRecorder()
+    wd = Watchdog(recorder=fr, rules=[
+        HotLoopRule("reconcile_hot_loop", "reconcile:*", streak=100),
+        BacklogRule("kv_handoff_backlog", "kv_backlog:*",
+                    depth_threshold=8, sustain_s=5.0),
+    ])
+    fr.beat("reconcile:lws", depth=99, now=T0)
+    fr.beat("kv_backlog:9000", progress=4, depth=12, now=T0)
+    assert wd.check_now(now=T0 + 1) == {}  # streak under, backlog young
+    fr.beat("reconcile:lws", depth=150, now=T0 + 2)
+    firing = wd.check_now(now=T0 + 6)  # backlog depth 12 for 6s, no progress
+    assert set(firing) == {"reconcile_hot_loop", "kv_handoff_backlog"}
+    # A DRAINING backlog (progress advancing) clears even at high depth.
+    fr.beat("kv_backlog:9000", progress=5, depth=12, now=T0 + 7)
+    fr.beat("reconcile:lws", depth=1, now=T0 + 7)
+    assert wd.check_now(now=T0 + 8) == {}
+
+
+def test_manager_feeds_hot_loop_streak():
+    """A reconciler requeue-looping on one key grows the heartbeat streak
+    the HotLoopRule watches, and the flight recorder logs the offending
+    key at the escalation point."""
+    from lws_tpu.core.manager import Manager
+    from lws_tpu.core.store import Store
+
+    class Spinner:
+        name = "spinner"
+
+        def __init__(self):
+            self.count = 0
+
+        def reconcile(self, key):
+            from lws_tpu.core.manager import Result
+
+            self.count += 1
+            if self.count < 120:
+                return Result(requeue=True)
+            return None
+
+    store = Store()
+    mgr = Manager(store)
+    spinner = Spinner()
+    mgr.register(spinner, {"Node": lambda o: [o.key()]})
+    from lws_tpu.api.node import CLUSTER_NAMESPACE, Node
+    from lws_tpu.core.store import new_meta
+
+    store.create(Node(meta=new_meta("spin-target", namespace=CLUSTER_NAMESPACE)))
+    mgr.run_until_stable(max_iterations=500)
+    hb = flightrecorder.RECORDER.heartbeats()["reconcile:spinner"]
+    assert hb["depth"] >= 100
+    hot = [e for e in flightrecorder.RECORDER.events()
+           if e["kind"] == "reconcile_hot_loop" and e["controller"] == "spinner"]
+    assert hot and hot[-1]["object"] == "spin-target"
+
+
+def test_pipeline_heartbeat_and_stall_injection_end_to_end():
+    """An injected decode-ring stall on a REAL paged engine: a dispatched
+    chunk parks in the ring (depth 1, progress frozen), the watchdog trips,
+    and the dump's spans reference the stalled request's trace id."""
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+    enabled, rate = trace.TRACER.enabled, trace.TRACER.sample_rate
+    trace.TRACER.enabled, trace.TRACER.sample_rate = True, 1.0
+    try:
+        cfg = LlamaConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+            d_ff=64, max_seq_len=64, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False,
+        )
+        params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+        engine = PagedBatchEngine(cfg, params, slots=2, max_len=64,
+                                  block_size=16, pipeline_depth=2)
+        with trace.span("serve.request", engine="paged", request_id="stalled") as sp:
+            rid = engine.submit(np.arange(1, 9, dtype=np.int32), 16)
+            assert rid is not None
+            engine.step_n(4)  # chunk rides the ring, unconsumed
+            stalled_trace = sp.trace_id
+        hb = flightrecorder.RECORDER.heartbeats()["decode_ring:paged"]
+        assert hb["depth"] >= 1
+        wd = Watchdog(rules=[StallRule("decode_ring_stall", "decode_ring:*",
+                                       stall_after_s=5.0)])
+        firing = wd.check_now(now=time.monotonic() + 30)
+        assert "decode_ring_stall" in firing
+        dump = wd.last_dump
+        assert any(s.get("trace_id") == stalled_trace for s in dump["spans"]), \
+            "dump does not reference the stalled request's trace"
+        engine.run_until_drained()  # leave the engine clean
+    finally:
+        trace.TRACER.enabled, trace.TRACER.sample_rate = enabled, rate
+
+
+def test_pipeline_discard_records_rollback_event():
+    from lws_tpu.serving.pipeline import DecodePipeline
+
+    pipe = DecodePipeline(depth=2, engine="paged")
+    pipe.push(4, np.zeros((4, 1), np.int32), lambda h: None)
+    pipe.discard()
+    ev = [e for e in flightrecorder.RECORDER.events()
+          if e["kind"] == "pipeline_discard"]
+    assert ev and ev[-1]["chunks"] == 1 and ev[-1]["steps"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: SLO histograms + resolvable exemplars
+
+
+def test_paged_engine_emits_slo_metrics_with_resolvable_exemplars(monkeypatch):
+    from lws_tpu.core import slo
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+    enabled, rate = trace.TRACER.enabled, trace.TRACER.sample_rate
+    trace.TRACER.enabled, trace.TRACER.sample_rate = True, 1.0
+    # A fresh registry/recorder pair: the process REGISTRY accumulates SLO
+    # exemplars from every earlier engine test in the suite, whose spans the
+    # bounded tracer ring has long evicted — only THIS test's exemplars can
+    # be held to the resolvable-in-the-live-tracer contract.
+    registry = MetricsRegistry()
+    monkeypatch.setattr(slo, "RECORDER", SLORecorder(registry=registry))
+    try:
+        cfg = LlamaConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+            d_ff=64, max_seq_len=64, dtype=jnp.float32,
+            param_dtype=jnp.float32, remat=False,
+        )
+        params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+        engine = PagedBatchEngine(cfg, params, slots=2, max_len=64, block_size=16)
+        rid = engine.submit(np.arange(1, 9, dtype=np.int32), 8)
+        engine.run_until_drained()
+        assert engine.result(rid) is not None
+        fams = parse_exposition(registry.render())
+        for name in ("serving_queue_wait_seconds", "serving_ttft_seconds",
+                     "serving_itl_seconds"):
+            assert any(
+                labels.get("engine") == "paged" and n.endswith("_count") and v > 0
+                for n, labels, v in fams[name]["samples"]
+            ), name
+        att = [
+            v for _, labels, v in fams["serving_slo_attainment"]["samples"]
+            if labels.get("engine") == "paged"
+        ]
+        assert att and 0.0 <= att[0] <= 1.0
+        # Exemplars on the SLO buckets resolve to spans in the live tracer
+        # (the /debug/traces contract).
+        text = registry.render()
+        known = {s["trace_id"] for s in trace.TRACER.spans()}
+        exemplar_ids = {
+            m.split('trace_id="')[1].split('"')[0]
+            for m in text.splitlines()
+            if "serving_ttft_seconds_bucket" in m and 'trace_id="' in m
+        }
+        assert exemplar_ids and exemplar_ids <= known
+    finally:
+        trace.TRACER.enabled, trace.TRACER.sample_rate = enabled, rate
+
+
+# ---------------------------------------------------------------------------
+# Worker telemetry server + fleet aggregation + API surface
+
+
+def _make_worker_pod(name: str, port: int, role: str | None = None):
+    from lws_tpu.api.pod import Container, EnvVar, Pod, PodPhase, PodSpec
+    from lws_tpu.core.store import new_meta
+
+    pod = Pod(
+        meta=new_meta(name),
+        spec=PodSpec(containers=[Container(
+            name="w",
+            command=["sleep", "1"],
+            env=[EnvVar("LWS_TPU_METRICS_PORT", str(port))],
+        )]),
+    )
+    if role is not None:
+        from lws_tpu.api import disagg
+
+        pod.meta.labels[disagg.DS_ROLE_LABEL_KEY] = role
+        pod.meta.labels[disagg.DS_REVISION_LABEL_KEY] = "rev1"
+    return pod
+
+
+def test_fleet_scrape_merges_worker_surfaces_and_serves_http(tmp_path):
+    from lws_tpu.api.pod import PodPhase
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    metrics.REGISTRY.inc("serving_requests_total", {"engine": "paged"})
+    workers = [TelemetryServer(port=0) for _ in range(2)]
+    for w in workers:
+        w.start()
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    try:
+        for i, w in enumerate(workers):
+            pod = cp.store.create(_make_worker_pod(
+                f"fleet-w{i}", w.port, role="prefill" if i == 0 else "decode"
+            ))
+            pod.status.phase = PodPhase.RUNNING
+            pod.status.ready = True
+            pod.status.address = "127.0.0.1"
+            cp.store.update_status(pod)
+        merged = cp.fleet.render_fleet(force=True)
+        fams = parse_exposition(merged)
+        instances = {
+            labels.get("instance")
+            for _, labels, _ in fams["serving_requests_total"]["samples"]
+        }
+        assert {"fleet-w0", "fleet-w1"} <= instances
+        roles = {
+            labels.get("instance"): labels.get("role")
+            for _, labels, _ in fams["serving_requests_total"]["samples"]
+        }
+        assert roles["fleet-w0"] == "prefill" and roles["fleet-w1"] == "decode"
+        # Control-plane registries ride along under their own instance.
+        assert any(
+            labels.get("instance") == "control-plane"
+            for fam in fams.values() for _, labels, _ in fam["samples"]
+        )
+        assert cp.metrics.gauge_value("lws_fleet_instances") == 2.0
+        # And the API server serves the same surface over HTTP.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/metrics/fleet", timeout=10
+        ) as resp:
+            via_http = parse_exposition(resp.read().decode())
+        assert "serving_requests_total" in via_http
+    finally:
+        api.stop()
+        for w in workers:
+            w.stop()
+
+
+def test_fleet_scrape_failure_degrades_per_instance():
+    from lws_tpu.api.pod import PodPhase
+    from lws_tpu.runtime import ControlPlane
+
+    cp = ControlPlane()
+    # Port 1: nothing listening — the scrape must fail fast and visibly.
+    pod = cp.store.create(_make_worker_pod("fleet-dead", 1))
+    pod.status.phase = PodPhase.RUNNING
+    pod.status.ready = True
+    pod.status.address = "127.0.0.1"
+    cp.store.update_status(pod)
+    cp.fleet.timeout_s = 0.2
+    merged = cp.fleet.render_fleet(force=True)
+    parse_exposition(merged)  # still valid with zero reachable workers
+    assert cp.metrics.counter_value(
+        "lws_fleet_scrape_errors_total", {"instance": "fleet-dead"}) == 1.0
+
+
+def test_debug_endpoint_limit_validation(tmp_path):
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    try:
+        for path in ("/debug/traces", "/debug/flightrecorder"):
+            for bad in ("abc", "-5", "1.5"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(f"{base}{path}?limit={bad}", timeout=10)
+                assert err.value.code == 400, (path, bad)
+            with urllib.request.urlopen(f"{base}{path}?limit=3", timeout=10) as resp:
+                assert resp.status == 200
+        with urllib.request.urlopen(
+            f"{base}/debug/flightrecorder?limit=5", timeout=10
+        ) as resp:
+            body = json.loads(resp.read().decode())
+        assert set(body) == {"events", "heartbeats", "alerts", "last_dump"}
+    finally:
+        api.stop()
+
+
+def test_metrics_exemplar_content_negotiation():
+    """Classic text-format clients must get a parseable exposition with NO
+    exemplar suffixes (the 0.0.4 format has no exemplar syntax); OpenMetrics
+    clients get the suffixes and the OpenMetrics content type."""
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    metrics.REGISTRY.observe(
+        "serving_ttft_seconds", 0.02, {"engine": "paged"},
+        exemplar={"trace_id": "negotiate1", "span_id": "s1"},
+    )
+    server = TelemetryServer(port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            classic = resp.read().decode()
+            assert "openmetrics" not in (resp.headers.get("Content-Type") or "")
+        assert 'trace_id="negotiate1"' not in classic
+        assert " # {" not in classic
+        req = urllib.request.Request(
+            f"{base}/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            openmetrics = resp.read().decode()
+            assert "openmetrics" in resp.headers.get("Content-Type")
+        assert 'trace_id="negotiate1"' in openmetrics
+    finally:
+        server.stop()
+
+
+def test_fleet_survives_malformed_worker_exposition():
+    """One worker answering garbage (port reused mid-restart, truncated
+    body) degrades per instance — it must not blank the fleet view."""
+    import http.server
+    import threading as _threading
+
+    from lws_tpu.api.pod import PodPhase
+    from lws_tpu.runtime import ControlPlane
+
+    class GarbageHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b"this is { not a metrics exposition\n=== 12"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), GarbageHandler)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    cp = ControlPlane()
+    try:
+        pod = cp.store.create(_make_worker_pod("fleet-garbage", httpd.server_port))
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.ready = True
+        pod.status.address = "127.0.0.1"
+        cp.store.update_status(pod)
+        merged = cp.fleet.render_fleet(force=True)
+        parse_exposition(merged)  # the fleet view stays parser-valid
+        assert cp.metrics.counter_value(
+            "lws_fleet_scrape_errors_total", {"instance": "fleet-garbage"}) == 1.0
+    finally:
+        httpd.shutdown()
+
+
+def test_worker_telemetry_token_and_watchdog():
+    """A token-configured worker rejects unauthenticated reads of every
+    surface except /healthz, and a worker-side watchdog's alerts appear in
+    the worker's own /debug/flightrecorder — a stalled ring in a WORKER
+    process must be detectable, not just heartbeat into a table nothing
+    evaluates."""
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    fr = FlightRecorder()
+    wd = Watchdog(recorder=fr, rules=[StallRule("decode_ring_stall", "decode_ring:*",
+                                                stall_after_s=0.0)])
+    fr.beat("decode_ring:w", progress=1, depth=2, now=T0)
+    wd.check_now(now=T0 + 1)
+    server = TelemetryServer(port=0, watchdog=wd, token="s3cret")
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            assert resp.status == 200  # liveness stays open
+        for path in ("/metrics", "/debug/traces", "/debug/flightrecorder"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{base}{path}", timeout=10)
+            assert err.value.code == 401, path
+        req = urllib.request.Request(
+            f"{base}/debug/flightrecorder",
+            headers={"Authorization": "Bearer s3cret"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert "decode_ring_stall" in body["alerts"]
+        assert body["last_dump"]["reason"] == "watchdog:decode_ring_stall"
+    finally:
+        server.stop()
+
+
+def test_worker_telemetry_server_surfaces(tmp_path):
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    server = TelemetryServer(port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            parse_exposition(resp.read().decode())
+        with urllib.request.urlopen(f"{base}/debug/flightrecorder", timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert set(body) == {"events", "heartbeats", "alerts", "last_dump"}
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/traces?limit=-1", timeout=10)
+        assert err.value.code == 400
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# lws-tpu top
+
+
+TOP_EXPOSITION = """\
+# HELP serving_slo_attainment x
+# TYPE serving_slo_attainment gauge
+serving_slo_attainment{engine="paged",instance="w0"} 0.875
+# HELP serving_requests_total x
+# TYPE serving_requests_total counter
+serving_requests_total{engine="paged",instance="w0"} 42.0
+# HELP serving_active_slots x
+# TYPE serving_active_slots gauge
+serving_active_slots{engine="paged",instance="w0"} 6.0
+# HELP serving_inflight_dispatches x
+# TYPE serving_inflight_dispatches gauge
+serving_inflight_dispatches{engine="paged",instance="w0"} 2.0
+# HELP serving_decode_dispatch_duration_seconds x
+# TYPE serving_decode_dispatch_duration_seconds histogram
+serving_decode_dispatch_duration_seconds_bucket{engine="paged",instance="w0",le="+Inf"} 100
+serving_decode_dispatch_duration_seconds_sum{engine="paged",instance="w0"} 1.0
+serving_decode_dispatch_duration_seconds_count{engine="paged",instance="w0"} 100
+# HELP serving_ttft_seconds x
+# TYPE serving_ttft_seconds histogram
+serving_ttft_seconds_bucket{engine="paged",instance="w0",le="0.05"} 8
+serving_ttft_seconds_bucket{engine="paged",instance="w0",le="0.1"} 10
+serving_ttft_seconds_bucket{engine="paged",instance="w0",le="+Inf"} 10
+serving_ttft_seconds_sum{engine="paged",instance="w0"} 0.5
+serving_ttft_seconds_count{engine="paged",instance="w0"} 10
+# HELP lws_fleet_instances x
+# TYPE lws_fleet_instances gauge
+lws_fleet_instances 1.0
+"""
+
+
+def test_render_top_formats_fleet_view():
+    from lws_tpu.cli import _top_rows, render_top
+    from lws_tpu.core.metrics import parse_exposition as parse_prod
+
+    fams = parse_prod(TOP_EXPOSITION)
+    frame = render_top(fams, alerts={"decode_ring_stall": [{"source": "decode_ring:paged"}]})
+    assert "instances=1" in frame
+    assert "alerts=decode_ring_stall" in frame
+    assert "ALERT decode_ring_stall" in frame
+    row = next(l for l in frame.splitlines() if l.startswith("w0"))
+    assert "paged" in row and "0.88" in row and "42" in row and "6" in row
+    # TTFT p95: between the 0.05 and 0.1 bucket bounds.
+    assert "0.0" in row
+    rows = _top_rows(fams)
+    assert 0.05 <= rows[("w0", "paged")]["ttft_p95"] <= 0.1
+    # Rates appear once a previous frame exists.
+    prev = {("w0", "paged"): {"dispatches": 60.0}}
+    frame2 = render_top(fams, alerts={}, prev=prev, dt_s=2.0)
+    assert "20.0" in frame2  # (100-60)/2 dispatches per second
+
+
+def test_cmd_top_one_shot_against_live_server(capsys):
+    from lws_tpu import cli
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+
+    metrics.REGISTRY.set("serving_slo_attainment", 0.9, {"engine": "paged"})
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    try:
+        rc = cli.main(["top", "--server", f"127.0.0.1:{api.port}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "FLEET" in out and "INSTANCE" in out
+        assert "control-plane" in out  # the CP's own registries render
+    finally:
+        api.stop()

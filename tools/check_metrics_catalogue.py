@@ -1,18 +1,24 @@
 #!/usr/bin/env python
-"""Catalogue drift check: every metric and span name used in lws_tpu/ must
-be documented in docs/observability.md.
+"""Catalogue drift check, BOTH directions: every metric and span name used
+in lws_tpu/ must be documented in docs/observability.md, and every name the
+catalogue's Metrics/Spans tables list must have an emitting call site
+(orphaned docs rows rot into dashboards built on metrics that never come).
 
-Walks the source AST for the two observability call shapes:
+Walks the source AST for the observability call shapes:
 
   * metrics writes — `metrics.inc/observe/set("name", ...)` or
     `self.metrics.inc/observe/set("name", ...)` (any attribute chain ending
     in `metrics`);
-  * spans — `<anything>.span("name", ...)`.
+  * spans — `<anything>.span("name", ...)`;
+  * declarations — `describe("name", ...)`, which anchor metrics emitted
+    through indirection (e.g. the registry's own cardinality-drop counter,
+    incremented under its lock rather than through inc()).
 
 Only string-literal first arguments count (a dynamic name can't be
-catalogued). Fails with the missing names and their call sites, so adding a
-metric without documenting it breaks `make check` — the catalogue is the
-contract that dashboards and scrape configs are built against.
+catalogued). Fails with the missing names and their call sites (forward)
+or the orphaned table rows (reverse), so drift in either direction breaks
+`make check` — the catalogue is the contract that dashboards and scrape
+configs are built against.
 
 Run: `make metrics-catalogue` or `python tools/check_metrics_catalogue.py`.
 """
@@ -20,6 +26,7 @@ Run: `make metrics-catalogue` or `python tools/check_metrics_catalogue.py`.
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
@@ -40,20 +47,51 @@ def _is_metrics_receiver(node: ast.expr) -> bool:
 
 
 def collect(path: Path) -> list[tuple[str, str, int]]:
-    """[(kind, name, lineno)] for one file; kind in {metric, span}."""
+    """[(kind, name, lineno)] for one file; kind in {metric, span,
+    declared}. `declared` rows are describe() declarations — they anchor
+    the reverse (orphan) check but are not themselves emissions."""
     tree = ast.parse(path.read_text(), filename=str(path))
     out: list[tuple[str, str, int]] = []
     for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+        if not isinstance(node, ast.Call):
             continue
         if not node.args or not isinstance(node.args[0], ast.Constant) \
                 or not isinstance(node.args[0].value, str):
             continue
         name = node.args[0].value
-        if node.func.attr == "span":
+        if isinstance(node.func, ast.Name) and node.func.id == "describe":
+            out.append(("declared", name, node.lineno))
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr == "describe":
+            out.append(("declared", name, node.lineno))
+        elif node.func.attr == "span":
             out.append(("span", name, node.lineno))
         elif node.func.attr in METRIC_METHODS and _is_metrics_receiver(node.func.value):
             out.append(("metric", name, node.lineno))
+    return out
+
+
+# Catalogue table rows: `| `name` | ...` under the ## Metrics / ## Spans
+# headings — the set the reverse check validates against the source.
+_ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|")
+
+
+def catalogue_tables(text: str) -> dict[str, set[str]]:
+    """{"metric": names, "span": names} from the catalogue's two tables."""
+    out: dict[str, set[str]] = {"metric": set(), "span": set()}
+    section = None
+    for line in text.splitlines():
+        if line.startswith("## "):
+            heading = line[3:].strip().lower()
+            section = {"metrics": "metric", "spans": "span"}.get(heading)
+            continue
+        if section is None:
+            continue
+        m = _ROW_RE.match(line)
+        if m and m.group(1) not in ("Name", "name"):
+            out[section].add(m.group(1))
     return out
 
 
@@ -61,8 +99,15 @@ def main() -> int:
     catalogue = CATALOGUE.read_text()
     missing: list[str] = []
     seen: set[tuple[str, str]] = set()
+    emitted: dict[str, set[str]] = {"metric": set(), "span": set()}
     for path in sorted(SOURCE_DIR.rglob("*.py")):
         for kind, name, lineno in collect(path):
+            if kind == "declared":
+                # describe() anchors the orphan check (metrics emitted
+                # through indirection) but needs no catalogue row itself.
+                emitted["metric"].add(name)
+                continue
+            emitted[kind].add(name)
             # Exact backticked mention only: a bare-substring fallback would
             # let `serving_requests` pass inside `serving_requests_total`.
             if f"`{name}`" in catalogue:
@@ -77,10 +122,24 @@ def main() -> int:
         print(f"\n{len(missing)} undocumented observability name(s); "
               f"add them to {CATALOGUE.relative_to(ROOT)}")
         return 1
+    # Reverse direction: catalogue rows with no emitting call site are
+    # orphaned docs — dashboards built on them watch metrics that never
+    # arrive. A row must match a call site OR a describe() declaration.
+    orphans = [
+        f"docs/observability.md: {kind} {name!r} has no emitting call site "
+        f"in lws_tpu/ (orphaned catalogue row)"
+        for kind, names in catalogue_tables(catalogue).items()
+        for name in sorted(names - emitted[kind])
+    ]
+    if orphans:
+        print("\n".join(orphans))
+        print(f"\n{len(orphans)} orphaned catalogue row(s); delete them or "
+              f"restore the emitting code")
+        return 1
     metrics_n = len({n for k, n in seen if k == "metric"})
     spans_n = len({n for k, n in seen if k == "span"})
     print(f"catalogue ok: {metrics_n} metric names, {spans_n} span names "
-          f"all documented")
+          f"all documented, no orphaned rows")
     return 0
 
 
