@@ -1,10 +1,13 @@
-.PHONY: test lint metrics-catalogue check native bench bench-trace-overhead bench-decode-overlap clean
+.PHONY: test lint vet metrics-catalogue check native bench bench-trace-overhead bench-decode-overlap clean
 
 test:
 	python -m pytest tests/ -q
 
-lint:  ## self-contained linter (ref parity: golangci-lint in Makefile:152-198)
-	python tools/lint.py
+vet:  ## project-aware static analysis (ref parity: go vet + golangci-lint + -race; docs/static-analysis.md)
+	python -m tools.vet
+
+lint:  ## alias: the old linter is vet's style pass (tools/vet/style.py)
+	python -m tools.vet --only style
 
 metrics-catalogue:  ## every metric/span name in source must be in docs/observability.md
 	python tools/check_metrics_catalogue.py
@@ -12,7 +15,7 @@ metrics-catalogue:  ## every metric/span name in source must be in docs/observab
 bench-decode-overlap:  ## pipelined decode must beat the sync loop's host-blocked fraction (budget json)
 	python benchmarks/decode_overlap_bench.py --check
 
-check: lint metrics-catalogue test bench-decode-overlap  ## what CI would run
+check: vet metrics-catalogue test bench-decode-overlap  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

@@ -218,8 +218,8 @@ class Informer:
         self.client = client
         self.kinds = kinds or self.KINDS
         self.on_event = on_event
-        self.cache: dict[tuple[str, str, str], dict] = {}
-        self._seq = 0
+        self.cache: dict[tuple[str, str, str], dict] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = None
         self._thread = None
@@ -250,7 +250,9 @@ class Informer:
 
     def sync(self, timeout: float = 0.0) -> int:
         """Apply events since the last bookmark; returns how many applied."""
-        out = self.client.watch(self._seq, timeout=timeout)
+        with self._lock:
+            seq = self._seq  # a relist on another thread may be moving the bookmark
+        out = self.client.watch(seq, timeout=timeout)
         if out.get("expired"):
             self.relist()
             return 0

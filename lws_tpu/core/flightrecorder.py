@@ -60,8 +60,8 @@ class Heartbeat:
 
 class FlightRecorder:
     def __init__(self, ring: int = 2048) -> None:
-        self._ring: "deque[dict]" = deque(maxlen=ring)
-        self._beats: dict[str, Heartbeat] = {}
+        self._ring: "deque[dict]" = deque(maxlen=ring)  # guarded-by: _lock
+        self._beats: dict[str, Heartbeat] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ---- feeds -----------------------------------------------------------
@@ -256,8 +256,11 @@ class Watchdog:
         self.rules = rules if rules is not None else default_rules()
         self._registries = registries
         self._on_alert = on_alert
-        self._active: dict[str, list[dict]] = {}
-        self.last_dump: Optional[dict] = None
+        self._active: dict[str, list[dict]] = {}  # guarded-by: _lock
+        # Written by check_now (any thread: the watchdog loop, the API
+        # server's deterministic check) and read by debug surfaces — the
+        # `last_dump` property serializes both sides.
+        self._last_dump: Optional[dict] = None  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -288,13 +291,22 @@ class Watchdog:
             )
             # Capture the window NOW: the ring still holds the events that
             # led here, the tracer still holds the request's spans.
-            self.last_dump = self.recorder.dump(
+            dump = self.recorder.dump(
                 reason=f"watchdog:{name}", registries=self._registries
             )
-            self.last_dump["alert"] = event
+            dump["alert"] = event
+            with self._lock:
+                self._last_dump = dump
             if self._on_alert is not None:
                 self._on_alert(event)
         return firing
+
+    @property
+    def last_dump(self) -> Optional[dict]:
+        """Diagnostics bundle captured at the most recent inactive->firing
+        transition (None until the first alert)."""
+        with self._lock:
+            return self._last_dump
 
     def active(self) -> dict[str, list[dict]]:
         with self._lock:

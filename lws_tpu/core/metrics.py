@@ -89,16 +89,16 @@ class MetricsRegistry:
         the describe()-declared and the default buckets."""
         self._lock = threading.Lock()
         self._max_label_sets = max_label_sets
-        self._bucket_overrides: dict[str, tuple] = {
+        self._bucket_overrides: dict[str, tuple] = {  # guarded-by: _lock
             name: tuple(sorted(float(x) for x in bs))
             for name, bs in (buckets or {}).items()
         }
         # Inner dicts used as ordered sets (the module-level `set` gauge
         # helper shadows the builtin in this namespace).
-        self._label_sets: dict[str, dict] = defaultdict(dict)
-        self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
-        self._gauges: dict[tuple[str, tuple], float] = {}
-        self._histograms: dict[tuple[str, tuple], _Histogram] = {}
+        self._label_sets: dict[str, dict] = defaultdict(dict)  # guarded-by: _lock
+        self._counters: dict[tuple[str, tuple], float] = defaultdict(float)  # guarded-by: _lock
+        self._gauges: dict[tuple[str, tuple], float] = {}  # guarded-by: _lock
+        self._histograms: dict[tuple[str, tuple], _Histogram] = {}  # guarded-by: _lock
 
     def set_buckets(self, name: str, buckets: tuple | list) -> None:
         """Override the bucket ladder for NEW series of `name` in this
@@ -107,10 +107,10 @@ class MetricsRegistry:
         with self._lock:
             self._bucket_overrides[name] = tuple(sorted(float(b) for b in buckets))
 
-    def _buckets_for(self, name: str) -> tuple:
+    def _buckets_for(self, name: str) -> tuple:  # holds-lock: _lock
         return self._bucket_overrides.get(name) or _BUCKETS.get(name) or DEFAULT_BUCKETS
 
-    def _admit(self, name: str, labels: tuple) -> bool:
+    def _admit(self, name: str, labels: tuple) -> bool:  # holds-lock: _lock
         """Cardinality gate (caller holds the lock). Known label sets always
         pass; new ones pass while the per-name cap has room."""
         seen = self._label_sets[name]

@@ -194,21 +194,21 @@ def _remove_path(tree: dict, path: tuple) -> None:
 
 class Store:
     def __init__(self) -> None:
-        self._objects: dict[Key, TypedObject] = {}
+        self._objects: dict[Key, TypedObject] = {}  # guarded-by: _lock
         # Per-kind index: list() is the hottest store op (every reconcile
         # scans peers); iterating only the kind's bucket beats a full scan.
-        self._by_kind: dict[str, dict[Key, TypedObject]] = {}
+        self._by_kind: dict[str, dict[Key, TypedObject]] = {}  # guarded-by: _lock
         # Label index: (kind, label_key, label_value) -> keys. Controllers
         # list by owner labels constantly (pods of an LWS, role members of a
         # DS); without this every such list is a full scan of the kind.
-        self._label_index: dict[tuple[str, str, str], set[Key]] = {}
+        self._label_index: dict[tuple[str, str, str], set[Key]] = {}  # guarded-by: _lock
         # Controller-owner index: owner uid -> dependent keys. owned_by() and
         # delete-cascade were full-store scans; at fleet scale (512+ pods)
         # those scans — each cloning every object — dominated convergence.
-        self._owner_index: dict[str, set[Key]] = {}
+        self._owner_index: dict[str, set[Key]] = {}  # guarded-by: _lock
         # Per-kind mutation counter: lets read-heavy consumers (scheduler)
         # cache derived views and invalidate them precisely.
-        self._kind_version: dict[str, int] = {}
+        self._kind_version: dict[str, int] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
         self._watchers: list[Callable[[WatchEvent], None]] = []
@@ -241,7 +241,7 @@ class Store:
         # corrupting the store (no rv bump, no watch event). Off in
         # production: fingerprinting costs a full to_plain per commit.
         self._shared_guard = os.environ.get("LWS_TPU_STORE_DEBUG", "") == "1"
-        self._fingerprints: dict[Key, int] = {}
+        self._fingerprints: dict[Key, int] = {}  # guarded-by: _lock
 
     # ---- admission registration -------------------------------------------
     def register_mutator(self, kind: str, fn) -> None:
@@ -250,7 +250,7 @@ class Store:
     def register_validator(self, kind: str, fn) -> None:
         self._validators.setdefault(kind, []).append(fn)
 
-    def _restore_object(self, obj: TypedObject) -> None:
+    def _restore_object(self, obj: TypedObject) -> None:  # holds-lock: _lock
         """Snapshot/WAL restore: place an already-admitted object verbatim
         (no admission, no events), maintaining all indexes. WAL replay of an
         'update' record re-restores over an existing key — the previous
@@ -268,7 +268,7 @@ class Store:
         self._record_fingerprint(key, obj)
         self._bump_kind(key[0])  # invalidate kind_version-keyed caches
 
-    def _forget_object(self, key: Key) -> None:
+    def _forget_object(self, key: Key) -> None:  # holds-lock: _lock
         """WAL-replay counterpart of _restore_object: remove an object
         verbatim (no admission, no cascade, no events) — the journal already
         carries one record per cascaded deletion."""
@@ -286,14 +286,14 @@ class Store:
         with self._lock:
             return self._kind_version.get(kind, 0)
 
-    def _bump_kind(self, kind: str) -> None:
+    def _bump_kind(self, kind: str) -> None:  # holds-lock: _lock
         self._kind_version[kind] = self._kind_version.get(kind, 0) + 1
 
-    def _index_labels(self, key: Key, obj: TypedObject) -> None:
+    def _index_labels(self, key: Key, obj: TypedObject) -> None:  # holds-lock: _lock
         for lk, lv in obj.meta.labels.items():
             self._label_index.setdefault((key[0], lk, lv), set()).add(key)
 
-    def _unindex_labels(self, key: Key, obj: TypedObject) -> None:
+    def _unindex_labels(self, key: Key, obj: TypedObject) -> None:  # holds-lock: _lock
         for lk, lv in obj.meta.labels.items():
             bucket = self._label_index.get((key[0], lk, lv))
             if bucket is not None:
@@ -301,12 +301,12 @@ class Store:
                 if not bucket:
                     del self._label_index[(key[0], lk, lv)]
 
-    def _index_owners(self, key: Key, obj: TypedObject) -> None:
+    def _index_owners(self, key: Key, obj: TypedObject) -> None:  # holds-lock: _lock
         for ref in obj.meta.owner_references:
             if ref.controller:
                 self._owner_index.setdefault(ref.uid, set()).add(key)
 
-    def _unindex_owners(self, key: Key, obj: TypedObject) -> None:
+    def _unindex_owners(self, key: Key, obj: TypedObject) -> None:  # holds-lock: _lock
         for ref in obj.meta.owner_references:
             if ref.controller:
                 bucket = self._owner_index.get(ref.uid)
@@ -424,7 +424,7 @@ class Store:
                     f"violated)"
                 )
 
-    def _record_fingerprint(self, key: Key, obj: TypedObject) -> None:
+    def _record_fingerprint(self, key: Key, obj: TypedObject) -> None:  # holds-lock: _lock
         if self._shared_guard:
             self._fingerprints[key] = self._fingerprint(obj)
 

@@ -85,11 +85,14 @@ class FleetCollector:
         )
         self._lock = threading.Lock()
         self._refill_lock = threading.Lock()
-        self._cached: Optional[str] = None
-        self._cached_at = 0.0
+        self._cached: Optional[str] = None  # guarded-by: _lock
+        self._cached_at = 0.0  # guarded-by: _lock
         # Instances currently failing to scrape: ring events fire on the
         # healthy->failing edge only (the counter still counts every miss).
-        self._failing: set[str] = set()
+        # Mutated from the scrape pool's threads, so it shares _lock: two
+        # concurrent misses for one instance must produce ONE edge event,
+        # and a lock-free set mutation under churn can corrupt the set.
+        self._failing: set[str] = set()  # guarded-by: _lock
 
     # ---- discovery + scrape ----------------------------------------------
     def targets(self) -> list[tuple[dict, tuple[str, int]]]:
@@ -128,7 +131,8 @@ class FleetCollector:
             # body) must not blank the whole fleet view when the merge
             # parses it later.
             metrics.parse_exposition(text)
-            self._failing.discard(instance)
+            with self._lock:
+                self._failing.discard(instance)
             return text
         except (OSError, ValueError, HTTPException) as e:
             self._own_metrics.inc(
@@ -137,9 +141,15 @@ class FleetCollector:
             # The failure is also a flight-recorder event — but only on the
             # healthy->failing EDGE: a dead worker re-scraped every cache
             # TTL would otherwise flood the bounded ring and evict the rare
-            # notable events the black box exists to retain.
-            if instance not in self._failing:
-                self._failing.add(instance)
+            # notable events the black box exists to retain. The test-and-
+            # set runs under _lock: this method executes on the scrape
+            # pool's threads, and two lock-free concurrent misses could
+            # both pass the membership test and double-record the edge.
+            with self._lock:
+                newly_failing = instance not in self._failing
+                if newly_failing:
+                    self._failing.add(instance)
+            if newly_failing:
                 from lws_tpu.core import flightrecorder
 
                 flightrecorder.record(

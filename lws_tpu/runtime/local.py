@@ -82,7 +82,7 @@ class LocalBackend:
         self.env_drop = env_drop
         self.default_command = default_command or ["sleep", "infinity"]
         self.log_dir = log_dir
-        self._procs: dict[str, subprocess.Popen] = {}  # pod uid -> process
+        self._procs: dict[str, subprocess.Popen] = {}  # guarded-by: _lock — pod uid -> process
         self._lock = threading.Lock()
 
     def pod_logs(self, namespace: str, name: str) -> Optional[str]:

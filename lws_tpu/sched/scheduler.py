@@ -42,8 +42,8 @@ class Scheduler:
         #   _by_gang:  (ns, gang) -> {key: Pod} membership.
         # A scheduler stood up over PRE-EXISTING state (restart/restore) must
         # have rebuild_from_store() called — ControlPlane.resync() does.
-        self._pending: dict[Key, Optional[str]] = {}
-        self._bound: dict[Key, Pod] = {}
+        self._pending: dict[Key, Optional[str]] = {}  # guarded-by: _pending_lock
+        self._bound: dict[Key, Pod] = {}  # guarded-by: _pending_lock
         self._by_gang: dict[tuple[str, str], dict[Key, Pod]] = {}
         self._gang_of: dict[Key, str] = {}  # reverse map for O(1) moves/purges
         # Placement aggregates: _feasible_node used to rescan every bound pod
@@ -55,7 +55,7 @@ class Scheduler:
         #   _hash_nodes:    (ns, hash_label, value) -> {node: pod count}
         #   _hash_total:    (ns, hash_label) -> {node: pod count}
         self._chips_by_node: dict[str, int] = {}
-        self._bound_state: dict[Key, tuple[str, int, list[tuple[str, str]]]] = {}
+        self._bound_state: dict[Key, tuple[str, int, list[tuple[str, str]]]] = {}  # guarded-by: _pending_lock
         self._hash_nodes: dict[tuple[str, str, str], dict[str, int]] = {}
         self._hash_total: dict[tuple[str, str], dict[str, int]] = {}
         self._pending_lock = threading.Lock()

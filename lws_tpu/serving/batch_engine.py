@@ -171,7 +171,7 @@ class BatchEngine:
             self._active[slot] = req
         return req.request_id
 
-    def step(self) -> None:
+    def step(self) -> None:  # hot-path
         """One decode step across every active slot, pipelined: the dispatch
         is pushed onto the in-flight ring and its tokens consumed on a later
         call (or flush). A step that would run the soonest-finishing slot

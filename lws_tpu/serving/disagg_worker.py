@@ -63,7 +63,7 @@ def build_engine(batch: int, max_len: int):
     return Engine(cfg, params, batch_size=batch, max_len=max_len, mesh=mesh)
 
 
-def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict, list]:
+def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict, list]:  # hot-path
     """Bundle bytes -> ([B, steps+1] tokens, per-handoff stats, span
     records). The pos-truncated wire prefix is padded to DECODE's own
     max_len and, when the decode engine is mesh-sharded, placed onto its
@@ -82,7 +82,7 @@ def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict
     with trace.span("kv.reshard", tp_sharded=engine.mesh is not None) as s_reshard:
         if engine.mesh is not None:
             cache = jax.device_put(cache, engine._cache_shardings)
-            jax.block_until_ready(cache.k)
+            jax.block_until_ready(cache.k)  # vet: ignore[hotpath-host-sync]: reshard fence — s_reshard must time the placement, not the next dispatch
     # Same overlap primitive as the engines' decode loops: dispatch FIRST,
     # then pull the first token to host while the decode chunk runs on
     # device (the old order host-synced `token` with the device idle).
@@ -95,7 +95,7 @@ def _decode_bundle(engine, payload: bytes, steps: int) -> tuple[np.ndarray, dict
         with pipe.host_section():
             _, _, tokens = engine.decode_n(token, cache, steps)
         pipe.push(steps, tokens, lambda h: out.__setitem__("toks", h))
-        first = np.asarray(token)  # overlaps the in-flight decode dispatch
+        first = np.asarray(token)  # vet: ignore[hotpath-host-sync]: overlaps the in-flight decode dispatch — the ring still owns the chunk
         pipe.flush()  # blocks: decode_s is the real dispatch time
     toks = out["toks"]
     # SLO timeline, decode leg: the chunk's mean step gap is the ITL sample
@@ -152,7 +152,7 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
     print(f"[prefill {os.environ.get('POD_NAME', '?')}] serving KV on :{server.port}",
           flush=True)
     while True:
-        if once and server.bundles_delivered >= 1:
+        if once and server.delivery_counts()[0] >= 1:
             return 0
         item = server.next_prompt(timeout=0.5)
         if item is None:
@@ -272,7 +272,7 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
 
     endpoint = None
     while True:
-        if once and server.results_served >= 1:
+        if once and server.delivery_counts()[1] >= 1:
             return 0
         if endpoint is None:
             # The -prv service exists only once the revision is ready on ALL

@@ -140,7 +140,7 @@ def sample_logits_per_slot(
 def host_sync(x) -> None:
     """Force completion via a host transfer — `block_until_ready` is not a
     reliable fence on relay-backed remote TPU backends."""
-    np.asarray(x)
+    np.asarray(x)  # vet: ignore[hotpath-host-sync]: host_sync IS the named fence — callers invoke it exactly where a sync is the point
 
 
 @dataclass
@@ -447,7 +447,7 @@ class Engine:
         self._warm_decode(chunked=False, single=True)
         warmed.add(gamma)
 
-    def generate_speculative(
+    def generate_speculative(  # hot-path
         self, prompt: jax.Array, max_new_tokens: int,
         gamma: int = 8, ngram: int = 3,
     ) -> GenerationResult:
@@ -557,7 +557,7 @@ class Engine:
             },
         )
 
-    def generate(self, prompt: jax.Array, max_new_tokens: int) -> GenerationResult:
+    def generate(self, prompt: jax.Array, max_new_tokens: int) -> GenerationResult:  # hot-path
         """Generation under the engine's SamplingParams (greedy by default),
         with timing split (TTFT vs steady decode).
 
@@ -589,7 +589,7 @@ class Engine:
 
             t1 = time.perf_counter()
             pipe = DecodePipeline(depth=self.pipeline_depth, engine="dense")
-            host_chunks: list[np.ndarray] = [np.asarray(token)[:, None]]
+            host_chunks: list[np.ndarray] = [np.asarray(token)[:, None]]  # vet: ignore[hotpath-host-sync]: first token already fenced for TTFT — this transfer is free
             for _ in range(n_full):
                 with trace.span("serve.decode_dispatch", engine="dense",
                                 steps=self.DECODE_CHUNK):

@@ -163,14 +163,25 @@ class KVServer:
         self._token = token if token is not None else os.environ.get("LWS_TPU_KV_TOKEN")
         self._prompts: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
         self._bundles: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
-        self._results: dict[str, tuple[dict, bytes]] = {}
+        self._results: dict[str, tuple[dict, bytes]] = {}  # guarded-by: _results_lock
         self._results_lock = threading.Lock()
-        self.bundles_delivered = 0  # acked pulls (drives prefill --once)
-        self.results_served = 0     # delivered results (drives decode --once)
+        # Delivery counters are bumped from per-connection threads — every
+        # touch IN THIS CLASS goes through _counts_lock (`+=` is a
+        # read-modify-write; two racing acks used to be able to drop a
+        # count). External pollers read through delivery_counts().
+        self._counts_lock = threading.Lock()
+        self.bundles_delivered = 0  # guarded-by: _counts_lock — acked pulls (drives prefill --once)
+        self.results_served = 0     # guarded-by: _counts_lock — delivered results (drives decode --once)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(16)
+        try:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self._sock.listen(16)
+        except OSError:
+            # Error-path hygiene (vet: resource-ctor-leak): a failed bind —
+            # port in use, bad host — must not leak the socket until GC.
+            self._sock.close()
+            raise
         self.port = self._sock.getsockname()[1]
         self._closed = False
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -201,11 +212,19 @@ class KVServer:
         # decode side has pulled AND acked, depth = bundles still waiting.
         from lws_tpu.core import flightrecorder
 
+        with self._counts_lock:
+            delivered = self.bundles_delivered
         flightrecorder.beat(
             f"kv_backlog:{self.port}",
-            progress=self.bundles_delivered,
+            progress=delivered,
             depth=self._bundles.qsize(),
         )
+
+    def delivery_counts(self) -> tuple[int, int]:
+        """(bundles_delivered, results_served) read under the counter lock
+        — the accessor the worker --once exit loops poll."""
+        with self._counts_lock:
+            return self.bundles_delivered, self.results_served
 
     def post_result(self, req_id: str, meta: dict, payload: bytes) -> None:
         with self._results_lock:
@@ -263,7 +282,8 @@ class KVServer:
                     ack, _ = recv_msg(conn)
                     if not (ack or {}).get("ack"):
                         raise OSError("no ack")
-                    self.bundles_delivered += 1
+                    with self._counts_lock:
+                        self.bundles_delivered += 1
                     self._backlog_beat()  # progress advanced: backlog drains
                 except OSError:
                     self._bundles.put((bmeta, bpayload))
@@ -283,7 +303,8 @@ class KVServer:
                     with self._results_lock:
                         self._results.setdefault(meta.get("id", ""), entry)
                     return
-                self.results_served += 1
+                with self._counts_lock:
+                    self.results_served += 1
             else:
                 send_msg(conn, {"error": f"unknown op {op!r}"})
 

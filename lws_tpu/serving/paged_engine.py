@@ -1040,7 +1040,7 @@ class PagedBatchEngine:
         both budgets of a slot are folded before crossing slots."""
         return min(remaining_steps(r, self.max_len) for r in self._active.values())
 
-    def step_n(self, n: int) -> int:
+    def step_n(self, n: int) -> int:  # hot-path
         """Up to n decode steps in one device dispatch, PIPELINED: the chunk
         is pushed onto the in-flight ring and its tokens are consumed on a
         later call (or flush) while the device keeps computing — the host
@@ -1107,7 +1107,7 @@ class PagedBatchEngine:
                             # here, before committing state, so the
                             # no-donation probe can still fall back with the
                             # old cache intact.
-                            out = jax.block_until_ready(out)
+                            out = jax.block_until_ready(out)  # vet: ignore[hotpath-host-sync]: one-time probe fence — a pallas runtime failure must surface before state commits
                     except Exception as e:  # noqa: BLE001 — kernel trace/compile/runtime failure
                         if self.stats["attention_path"] != "kernel" or self._kernel_probed:
                             raise

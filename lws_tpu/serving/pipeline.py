@@ -42,6 +42,7 @@ Attribution (the host-blocked vs device-busy split):
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Callable
@@ -74,14 +75,15 @@ class _HostSection:
         self._pipe = pipe
 
     def __enter__(self) -> "_HostSection":
-        self._blocked = not self._pipe._ring
+        self._blocked = not self._pipe  # ring emptiness, read under the pipe's lock
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc) -> bool:
         if self._blocked:
             dt = time.perf_counter() - self._t0
-            self._pipe.stats["host_blocked_s"] += dt
+            with self._pipe._lock:
+                self._pipe.stats["host_blocked_s"] += dt
             metrics.inc(
                 "serving_host_blocked_seconds",
                 {"engine": self._pipe.engine_label}, value=dt,
@@ -96,61 +98,77 @@ class DecodePipeline:
         `engine` labels the metrics this ring reports."""
         self.depth = max(0, int(depth))
         self.engine_label = engine
-        self._ring: "deque[tuple[int, object, Callable]]" = deque()
-        self.stats = {
+        # One engine loop owns the ring, but other threads reach it (disagg
+        # drivers flush from their pull loops, tests/tools poll depth), so
+        # ring + stats are RLock-guarded: re-entrant because flush()
+        # consumes, and a consume's commit may call back into flush()/len()
+        # on the same thread. The lock is DELIBERATELY held across the
+        # consume's device fence + commit: FIFO commit order is the ring's
+        # contract, so concurrent consumers must serialize for exactly that
+        # long anyway — a reader arriving mid-consume waits one chunk, it
+        # does not deadlock (and the owning engine loop never contends).
+        self._lock = threading.RLock()
+        self._ring: "deque[tuple[int, object, Callable]]" = deque()  # guarded-by: _lock
+        self.stats = {  # guarded-by: _lock
             "dispatched": 0, "consumed": 0, "flushes": 0, "discarded": 0,
             "host_blocked_s": 0.0, "device_wait_s": 0.0, "max_inflight": 0,
         }
 
     def __len__(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     def __bool__(self) -> bool:
-        return bool(self._ring)
+        with self._lock:
+            return bool(self._ring)
 
     def inflight_steps(self) -> int:
         """Total decode steps dispatched but not yet committed to host truth
         — the engines subtract this from their completion bound so no slot's
         budget can be overrun by work already in the ring."""
-        return sum(steps for steps, _, _ in self._ring)
+        with self._lock:
+            return sum(steps for steps, _, _ in self._ring)
 
     def host_section(self) -> _HostSection:
         return _HostSection(self)
 
-    def push(self, steps: int, payload, commit: Callable) -> None:
-        self._ring.append((steps, payload, commit))
-        self.stats["dispatched"] += 1
-        while len(self._ring) > self.depth:
-            self._consume_oldest()
-        # Gauge/max AFTER settling to depth: the documented contract is
-        # "0 in a synchronous loop, up to the configured depth" — the
-        # transient depth+1 during eviction is not an observable state.
-        if len(self._ring) > self.stats["max_inflight"]:
-            self.stats["max_inflight"] = len(self._ring)
-        self._gauge()
-        self._heartbeat()
+    def push(self, steps: int, payload, commit: Callable) -> None:  # hot-path
+        with self._lock:
+            self._ring.append((steps, payload, commit))
+            self.stats["dispatched"] += 1
+            while len(self._ring) > self.depth:
+                self._consume_oldest()
+            # Gauge/max AFTER settling to depth: the documented contract is
+            # "0 in a synchronous loop, up to the configured depth" — the
+            # transient depth+1 during eviction is not an observable state.
+            if len(self._ring) > self.stats["max_inflight"]:
+                self.stats["max_inflight"] = len(self._ring)
+            self._gauge()
+            self._heartbeat()
 
-    def flush(self) -> None:
-        if self._ring:
-            self.stats["flushes"] += 1
-        while self._ring:
-            self._consume_oldest()
+    def flush(self) -> None:  # hot-path
+        with self._lock:
+            if self._ring:
+                self.stats["flushes"] += 1
+            while self._ring:
+                self._consume_oldest()
 
     def discard(self) -> None:
         # The rollback escape hatch: in-flight results abandoned as known-
         # invalid. Ring event + trace id so a flight-recorder dump
         # correlates the rollback with the request that triggered it.
-        if self._ring:
-            flightrecorder.record(
-                "pipeline_discard", engine=self.engine_label,
-                chunks=len(self._ring), steps=self.inflight_steps(),
-            )
-        self.stats["discarded"] += len(self._ring)
-        self._ring.clear()
-        self._gauge()
-        self._heartbeat()
+        with self._lock:
+            if self._ring:
+                flightrecorder.record(
+                    "pipeline_discard", engine=self.engine_label,
+                    chunks=len(self._ring), steps=self.inflight_steps(),
+                )
+            self.stats["discarded"] += len(self._ring)
+            self._ring.clear()
+            self._gauge()
+            self._heartbeat()
 
-    def _consume_oldest(self) -> None:
+    def _consume_oldest(self) -> None:  # hot-path — holds-lock: _lock
         steps, payload, commit = self._ring.popleft()
         with trace.span(
             "serve.decode_consume", engine=self.engine_label, steps=steps,
@@ -159,7 +177,7 @@ class DecodePipeline:
             t0 = time.perf_counter()
             # np.asarray is the completion fence (block_until_ready is not
             # reliable on relay-backed remote backends — see engine.host_sync).
-            host = np.asarray(payload)
+            host = np.asarray(payload)  # vet: ignore[hotpath-host-sync]: this IS the consume fence — the one deliberate device wait the ring exists to schedule
             wait = time.perf_counter() - t0
             self.stats["device_wait_s"] += wait
             sp.set(device_wait_s=round(wait, 6))
@@ -169,13 +187,13 @@ class DecodePipeline:
         self._gauge()
         self._heartbeat()
 
-    def _gauge(self) -> None:
+    def _gauge(self) -> None:  # holds-lock: _lock
         metrics.set(
             "serving_inflight_dispatches", len(self._ring),
             {"engine": self.engine_label},
         )
 
-    def _heartbeat(self) -> None:
+    def _heartbeat(self) -> None:  # holds-lock: _lock
         # Stall-watchdog feed: progress = chunks that LEFT the ring
         # (consumed or discarded), depth = chunks still in flight. A wedged
         # device dispatch shows as depth > 0 with frozen progress; a slow

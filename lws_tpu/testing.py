@@ -370,3 +370,168 @@ def assert_valid_lws(store: Store, lws_name: str, namespace: str = "default") ->
     for g in range(lws.spec.replicas):
         if store.try_get("Pod", namespace, f"{lws_name}-{g}") is not None:
             assert_valid_group(store, lws, g)
+
+
+# ---------------------------------------------------------------------------
+# Instrumented-lock race harness: the runtime counterpart of `make vet`'s
+# lock-discipline pass (≈ the reference repo's `go test -race`).
+#
+# The vet pass proves LEXICAL discipline (guarded attrs touched under their
+# lock); this harness proves the discipline holds at RUNTIME, including
+# paths the static pass cannot see (cross-object access, callbacks,
+# socket-spawned threads). It implements the Eraser lockset algorithm:
+#
+#   * `InstrumentedLock` wraps a real Lock/RLock and maintains a
+#     thread-local set of locks currently held;
+#   * `RaceDetector.watch(obj, fields)` swaps the object's class for a
+#     subclass whose `__getattribute__`/`__setattr__` record every access
+#     to the named fields along with the caller's held-lock set;
+#   * per (object, field) a candidate lockset is intersected across
+#     accesses once a SECOND thread shows up (first-thread accesses are
+#     the init phase, exempt — Eraser's shared-exclusive transition). An
+#     empty intersection means no common lock protects the field: a race,
+#     reported deterministically WITHOUT needing the racy interleaving to
+#     actually strike.
+#
+# Register only genuinely-mutated shared state: the harness treats every
+# access to a watched field as part of the conflict set (a deque mutated
+# in place never shows an attribute WRITE, so reads count too).
+#
+# `NullLock` is the seeded-mutation aid: swapping an instance's lock for
+# it simulates deleting the `with self._lock:` discipline from the source
+# (tests/test_race_harness.py seeds exactly that mutation against
+# serving/pipeline.py and asserts the detector catches it).
+
+
+import threading as _threading
+
+_HELD = _threading.local()
+
+
+def _held_locks() -> list:
+    locks = getattr(_HELD, "locks", None)
+    if locks is None:
+        locks = _HELD.locks = []
+    return locks
+
+
+class InstrumentedLock:
+    """Drop-in Lock/RLock replacement feeding the detector's locksets."""
+
+    def __init__(self, name: str = "lock", lock=None) -> None:
+        self.name = name
+        self._lock = lock if lock is not None else _threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _held_locks().append(self)
+        return ok
+
+    def release(self) -> None:
+        held = _held_locks()
+        if self in held:
+            # Remove ONE entry: an RLock held re-entrantly stays held.
+            held.reverse()
+            held.remove(self)
+            held.reverse()
+        self._lock.release()
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+
+class NullLock:
+    """A lock that locks nothing: the seeded `lock-removal` mutation.
+    Swapping it in for an instance's real lock simulates deleting the
+    `with self._lock:` discipline from the source under test."""
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return True
+
+    def release(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullLock":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+class RaceDetector:
+    """Happens-before-via-locksets checker for registered shared objects."""
+
+    def __init__(self) -> None:
+        self._mutex = _threading.Lock()
+        # (name, field) -> {"threads": set, "lockset": None|frozenset}
+        self._state: dict[tuple[str, str], dict] = {}
+        self._races: list[dict] = []
+
+    # ---- instrumentation --------------------------------------------------
+    def watch(self, obj, fields, name: Optional[str] = None):
+        """Instrument `obj` so every access to `fields` is recorded. The
+        object's class is swapped for a recording subclass (objects using
+        __slots__ are not supported); returns `obj` for chaining."""
+        label = name or type(obj).__name__
+        watched = frozenset(fields)
+        detector = self
+        cls = type(obj)
+
+        class _Watched(cls):  # type: ignore[misc, valid-type]
+            def __getattribute__(self, attr):
+                if attr in watched:
+                    detector._note(label, attr, is_write=False)
+                return super().__getattribute__(attr)
+
+            def __setattr__(self, attr, value):
+                if attr in watched:
+                    detector._note(label, attr, is_write=True)
+                object.__setattr__(self, attr, value)
+
+        _Watched.__name__ = f"Watched{cls.__name__}"
+        obj.__class__ = _Watched
+        return obj
+
+    def _note(self, name: str, field: str, is_write: bool) -> None:
+        tid = _threading.get_ident()
+        held = frozenset(id(lk) for lk in _held_locks())
+        names = {id(lk): getattr(lk, "name", "?") for lk in _held_locks()}
+        with self._mutex:
+            st = self._state.setdefault(
+                (name, field),
+                {"threads": set(), "lockset": None, "locknames": {}, "raced": False},
+            )
+            st["threads"].add(tid)
+            st["locknames"].update(names)
+            if len(st["threads"]) < 2:
+                return  # init phase: a single owner thread never races
+            st["lockset"] = held if st["lockset"] is None else (st["lockset"] & held)
+            if not st["lockset"] and not st["raced"]:
+                st["raced"] = True
+                self._races.append({
+                    "object": name,
+                    "field": field,
+                    "threads": len(st["threads"]),
+                    "write": is_write,
+                    "detail": (
+                        f"{name}.{field} accessed by {len(st['threads'])} "
+                        "threads with no common lock held"
+                    ),
+                })
+
+    # ---- verdicts ---------------------------------------------------------
+    def races(self) -> list[dict]:
+        with self._mutex:
+            return list(self._races)
+
+    def assert_clean(self) -> None:
+        races = self.races()
+        assert not races, "lock-free conflicting accesses detected:\n" + "\n".join(
+            r["detail"] for r in races
+        )

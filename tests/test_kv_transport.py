@@ -107,3 +107,25 @@ def test_pull_result_concurrent_single_delivery(server):
     for t in threads:
         t.join()
     assert len(hits) == 1 and server.results_served == 1
+
+
+def test_bind_failure_closes_socket(server):
+    """Error-path resource hygiene (vet: resource-ctor-leak): a KVServer
+    that fails to bind — port already owned by the fixture's server — must
+    close the socket it created instead of leaking it until GC."""
+    created = []
+    real_socket = kt.socket.socket
+
+    def recording_socket(*args, **kwargs):
+        s = real_socket(*args, **kwargs)
+        created.append(s)
+        return s
+
+    kt.socket.socket = recording_socket
+    try:
+        with pytest.raises(OSError):
+            kt.KVServer(port=server.port, host="127.0.0.1")
+    finally:
+        kt.socket.socket = real_socket
+    assert len(created) == 1
+    assert created[0].fileno() == -1, "failed bind leaked its socket"

@@ -1,0 +1,271 @@
+"""Instrumented-lock race harness (lws_tpu.testing) driven against the
+three shared-state hot spots the vet tentpole names: the decode dispatch
+ring, the KV server backlog/counters, and the FleetCollector
+single-flight cache.
+
+Each surface gets a clean run (real locks, thread churn, detector must
+stay silent) and the pipeline additionally gets the SEEDED MUTATION run:
+the `with self._lock:` discipline of serving/pipeline.py is simulated
+away by swapping the instance lock for NullLock, and the detector must
+deterministically report the race — lockset detection needs two threads
+with no common lock, not a lucky interleaving.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from lws_tpu.core import flightrecorder
+from lws_tpu.core.metrics import MetricsRegistry
+from lws_tpu.serving import kv_transport
+from lws_tpu.serving.pipeline import DecodePipeline
+from lws_tpu.testing import InstrumentedLock, NullLock, RaceDetector
+
+
+def _churn(workers, n_threads=None):
+    """Run worker callables on threads behind a start barrier; re-raise
+    the first worker exception unless the worker opted out."""
+    threads = []
+    errors = []
+    barrier = threading.Barrier(len(workers))
+
+    def wrap(fn):
+        def run():
+            barrier.wait(timeout=10)
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — collected for the caller
+                errors.append(e)
+
+        return run
+
+    for fn in workers:
+        t = threading.Thread(target=wrap(fn), daemon=True)
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# Decode dispatch ring
+
+
+def _pipe_with_detector(lock):
+    det = RaceDetector()
+    pipe = DecodePipeline(depth=4, engine="racetest")
+    pipe._lock = lock
+    det.watch(pipe, {"_ring", "stats"}, name="DecodePipeline")
+    return det, pipe
+
+
+def test_dispatch_ring_churn_is_clean_with_real_lock():
+    """Producer pushes chunks while a consumer flushes and polls: the
+    RLock discipline serving/pipeline.py ships must keep every ring/stats
+    access covered — the detector stays silent."""
+    det, pipe = _pipe_with_detector(
+        InstrumentedLock("pipe._lock", threading.RLock())
+    )
+
+    def producer():
+        for i in range(200):
+            pipe.push(1, np.array([i]), lambda h: None)
+
+    def consumer():
+        for _ in range(200):
+            pipe.flush()
+            len(pipe)
+            pipe.inflight_steps()
+
+    errors = _churn([producer, consumer])
+    assert not errors, errors
+    pipe.flush()
+    det.assert_clean()
+    stats = pipe.stats
+    assert stats["consumed"] + stats["discarded"] == stats["dispatched"]
+    assert len(pipe) == 0
+
+
+def test_seeded_lock_removal_in_pipeline_is_caught():
+    """The seeded mutation: delete serving/pipeline.py's lock discipline
+    (simulated by swapping the instance lock for NullLock) and the same
+    churn must DETERMINISTICALLY produce a race report — two threads
+    touched ring/stats with provably no common lock held, which the
+    lockset algorithm flags regardless of interleaving luck."""
+    det, pipe = _pipe_with_detector(NullLock())
+
+    def producer():
+        for i in range(200):
+            try:
+                pipe.push(1, np.array([i]), lambda h: None)
+            except Exception:  # noqa: BLE001 — the genuine corruption the mutation invites
+                pass
+
+    def consumer():
+        for _ in range(200):
+            try:
+                pipe.flush()
+            except Exception:  # noqa: BLE001 — ditto: detection, not survival, is under test
+                pass
+            len(pipe)
+
+    _churn([producer, consumer])
+    races = det.races()
+    assert races, "lock-removal mutation went undetected"
+    racy_fields = {r["field"] for r in races}
+    assert racy_fields & {"_ring", "stats"}, races
+
+
+def test_detector_ignores_single_thread_and_guarded_access():
+    """No false positives: single-threaded mutation is the init phase,
+    and two threads sharing one InstrumentedLock never race."""
+    det = RaceDetector()
+    pipe = DecodePipeline(depth=2, engine="racetest-st")
+    pipe._lock = InstrumentedLock("st._lock", threading.RLock())
+    det.watch(pipe, {"_ring", "stats"}, name="single")
+    for i in range(50):
+        pipe.push(1, np.array([i]), lambda h: None)
+    pipe.flush()
+    det.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# KV server backlog + delivery counters
+
+
+@pytest.mark.parametrize("mutate", [False, True])
+def test_kv_server_counter_discipline(mutate):
+    """Concurrent pull_bundle clients drive the per-connection server
+    threads through the delivery counters. With the shipped _counts_lock
+    the detector is silent AND the count is exact; with the lock seeded
+    away (NullLock) the detector reports the race."""
+    server = kv_transport.KVServer(port=0, host="127.0.0.1")
+    det = RaceDetector()
+    try:
+        server._counts_lock = (
+            NullLock() if mutate
+            else InstrumentedLock("kv._counts_lock")
+        )
+        det.watch(
+            server, {"bundles_delivered", "results_served"}, name="KVServer"
+        )
+        n_bundles = 24
+        for i in range(n_bundles):
+            server.offer_bundle({"id": f"b{i}"}, b"payload")
+        endpoint = ("127.0.0.1", server.port)
+
+        def puller():
+            while True:
+                got = kv_transport.pull_bundle(endpoint, timeout=0.05)
+                if got is None:
+                    return
+
+        errors = _churn([puller, puller, puller])
+        assert not errors, errors
+        if mutate:
+            assert any(
+                r["field"] == "bundles_delivered" for r in det.races()
+            ), det.races()
+        else:
+            det.assert_clean()
+            assert server.delivery_counts()[0] == n_bundles
+    finally:
+        server.close()
+
+
+def test_kv_server_result_eviction_still_single_delivery():
+    """Regression guard for the counter-lock change: double-pulling one
+    result id still delivers exactly once (pop-under-lock contract)."""
+    server = kv_transport.KVServer(port=0, host="127.0.0.1")
+    try:
+        server.post_result("r1", {"id": "r1"}, b"tokens")
+        endpoint = ("127.0.0.1", server.port)
+        delivered = []
+
+        def puller():
+            got = kv_transport.pull_result(endpoint, "r1")
+            if got is not None:
+                delivered.append(got)
+
+        errors = _churn([puller, puller])
+        assert not errors, errors
+        assert len(delivered) == 1
+        assert server.delivery_counts()[1] == 1
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# FleetCollector single-flight cache
+
+
+class _EmptyStore:
+    def list(self, kind):
+        return []
+
+
+def _collector():
+    from lws_tpu.runtime.fleet import FleetCollector
+
+    reg = MetricsRegistry()
+    reg.inc("racetest_control_total")
+    fc = FleetCollector(
+        _EmptyStore(), control_registries=(reg,),
+        cache_ttl_s=0.0, metrics_registry=reg,
+    )
+    fc._lock = InstrumentedLock("fleet._lock")
+    fc._refill_lock = InstrumentedLock("fleet._refill_lock")
+    return fc
+
+
+def test_fleet_single_flight_cache_churn_is_clean():
+    """render_fleet refills race scrape-failure bookkeeping across
+    threads: cache fields and the _failing set must stay lock-covered."""
+    det = RaceDetector()
+    fc = _collector()
+    det.watch(fc, {"_cached", "_cached_at", "_failing"}, name="FleetCollector")
+
+    def renderer():
+        for _ in range(20):
+            text = fc.render_fleet()
+            assert "racetest_control_total" in text
+
+    def failer():
+        for i in range(40):
+            fc._scrape_target({"instance": "w-dead"}, "127.0.0.1", 1)
+
+    errors = _churn([renderer, renderer, failer])
+    assert not errors, errors
+    det.assert_clean()
+
+
+def test_fleet_failing_edge_records_once_under_concurrency():
+    """Regression test for the fleet fix: N concurrent scrape failures
+    for one instance record exactly ONE healthy->failing ring event (the
+    unguarded set allowed double edges — and could corrupt the set)."""
+    fc = _collector()
+    flightrecorder.RECORDER.clear()
+    barrier = threading.Barrier(4)
+    real_scrape = fc._scrape_one
+
+    def dead_scrape(host, port):
+        barrier.wait(timeout=10)  # maximize overlap on the edge transition
+        raise OSError("connection refused")
+
+    fc._scrape_one = dead_scrape
+    try:
+        errors = _churn([
+            lambda: fc._scrape_target({"instance": "w-edge"}, "127.0.0.1", 1)
+        ] * 4)
+        assert not errors, errors
+    finally:
+        fc._scrape_one = real_scrape
+    events = [
+        e for e in flightrecorder.RECORDER.events()
+        if e["kind"] == "fleet_scrape_error" and e.get("instance") == "w-edge"
+    ]
+    assert len(events) == 1, events

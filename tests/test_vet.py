@@ -1,0 +1,301 @@
+"""Analyzer self-tests: each vet pass against the fixture snippets under
+tests/vet_fixtures/ (true positives AND the false-positive guards), the
+suppression/baseline machinery, and the repo-level contract that
+`python -m tools.vet` runs clean with the committed baseline.
+
+The fixtures are excluded from normal vet discovery (deliberate
+violations) and never imported — the passes only parse them."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = ROOT / "tests" / "vet_fixtures"
+
+sys.path.insert(0, str(ROOT))
+
+from tools.vet import run_pass  # noqa: E402
+from tools.vet.core import (  # noqa: E402
+    Module,
+    apply_baseline,
+    iter_source_files,
+    load_baseline,
+    malformed_suppressions,
+    write_baseline,
+)
+
+
+def findings_for(pass_name: str, *names: str, root: Path = FIXTURES):
+    return run_pass(pass_name, [FIXTURES / n for n in names], root=root)
+
+
+# ---------------------------------------------------------------------------
+# locks pass
+
+
+def test_locks_flags_unguarded_access_and_order_inversion():
+    found = findings_for("locks", "lock_unguarded.py")
+    details = {f.detail for f in found}
+    assert any(f.rule == "lock-guarded-attr" and f.detail == "bad_read._items"
+               for f in found), found
+    assert any(f.rule == "lock-guarded-attr" and f.detail == "bad_write.count"
+               for f in found), found
+    # After a try/finally release the region has ENDED: the trailing
+    # access is flagged even though the locked one inside the try is not.
+    after = [f for f in found if f.detail == "bad_after_finally_release.count"]
+    assert len(after) == 1, found
+    assert not any(f.detail == "bad_after_finally_release._items" for f in found)
+    # The locked accesses in good() are never flagged.
+    assert not any("good." in d for d in details), details
+    assert any(f.rule == "lock-order" and "Guarded" in f.detail
+               for f in found), found
+
+
+def test_lock_order_does_not_merge_same_named_classes(tmp_path):
+    """Two unrelated classes that happen to share a name in different
+    modules must not merge into one phantom ABBA pair."""
+    src = (
+        "import threading\n\n\nclass Mgr:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n\n"
+        "    def op(self):\n"
+        "        with self.{0}:\n"
+        "            with self.{1}:\n"
+        "                return None\n"
+    )
+    (tmp_path / "mod_a.py").write_text(src.format("_a_lock", "_b_lock"))
+    (tmp_path / "mod_b.py").write_text(src.format("_b_lock", "_a_lock"))
+    found = run_pass(
+        "locks", [tmp_path / "mod_a.py", tmp_path / "mod_b.py"], root=tmp_path
+    )
+    assert not any(f.rule == "lock-order" for f in found), found
+
+
+def test_locks_false_positive_guards_stay_silent():
+    """with-blocks, acquire/try/finally, RLock re-entrancy, _locked
+    suffix, holds-lock annotations (incl. the decorated-lock shape) and
+    nested callbacks must produce ZERO findings."""
+    assert findings_for("locks", "lock_guards_ok.py") == []
+
+
+# ---------------------------------------------------------------------------
+# hotpath pass
+
+
+def test_hotpath_roots_reachability_and_suppression():
+    found = findings_for("hotpath", "hotpath_cases.py")
+    by_detail = {f.detail: f.rule for f in found}
+    # Direct violations in the annotated root.
+    assert by_detail.get("hot_root:time.sleep") == "hotpath-blocking-call"
+    assert by_detail.get("hot_root:socket.create_connection") == "hotpath-blocking-call"
+    assert by_detail.get("hot_root:np.asarray") == "hotpath-host-sync"
+    # Reachability: a helper the root calls, and a self-method call.
+    assert by_detail.get("helper_sleeps:time.sleep") == "hotpath-blocking-call"
+    assert by_detail.get("Engine._inner:np.asarray") == "hotpath-host-sync"
+    # The suppressed fence produced no finding beyond the flagged one
+    # (same detail key would collide — assert by line instead).
+    suppressed_line = next(
+        i for i, text in enumerate(
+            (FIXTURES / "hotpath_cases.py").read_text().splitlines(), 1
+        ) if "vet: ignore[hotpath-host-sync]" in text
+    )
+    assert not any(f.line == suppressed_line for f in found)
+    # A closure inside a BFS-REACHED callee (not just an annotated root)
+    # is hot too: blocking hidden in a helper's nested def is found.
+    assert by_detail.get("helper_with_closure.inner:time.sleep") == \
+        "hotpath-blocking-call"
+    # Lambdas are scanned inline with their containing hot function —
+    # the engines' commit callbacks are exactly this shape.
+    assert by_detail.get("hot_root3:np.asarray") == "hotpath-host-sync"
+    # cold() is unreachable from any hot root: blocking is fine there.
+    assert not any(f.detail.startswith("cold:") for f in found), found
+
+
+# ---------------------------------------------------------------------------
+# resources pass
+
+
+def test_resources_flags_leaks_and_honors_ownership_shapes():
+    found = findings_for("resources", "resource_cases.py")
+    rules = {(f.rule, f.detail) for f in found}
+    assert ("resource-unclosed", "leaky_local:sock") in rules, found
+    assert any(r == "resource-unclosed" and "discarded:" in d
+               for r, d in rules), found
+    assert any(r == "resource-ctor-leak" and d.startswith("LeakyServer.__init__")
+               for r, d in rules), found
+    # Every ok_* shape and the try/except-close server stay silent.
+    for f in found:
+        assert not f.detail.startswith(("ok_", "SafeServer")), f
+
+
+# ---------------------------------------------------------------------------
+# spans pass
+
+
+def test_spans_context_and_literal_rules():
+    found = run_pass(
+        "spans", [FIXTURES / "lws_tpu" / "span_cases.py"], root=FIXTURES
+    )
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    ctx = by_rule.get("span-context-manager", [])
+    assert {f.detail.split(":")[0] for f in ctx} == {
+        "bad_span", "bad_span_shared_name",
+    }, ctx
+    # bad_span_shared_name is the masking case: ANOTHER function enters a
+    # same-named variable — per-scope matching must still flag the leak.
+    assert len(by_rule.get("metric-name-literal", [])) == 1
+    assert "bad_metric_name" in by_rule["metric-name-literal"][0].detail
+    assert len(by_rule.get("span-name-literal", [])) == 1
+    assert "bad_span_name" in by_rule["span-name-literal"][0].detail
+
+
+def test_spans_name_rules_scoped_to_catalogue_source():
+    """The same file OUTSIDE an lws_tpu/ root only keeps the context-
+    manager rule — test code can't pollute the metrics catalogue."""
+    found = run_pass(
+        "spans", [FIXTURES / "lws_tpu" / "span_cases.py"], root=FIXTURES / "lws_tpu"
+    )
+    rules = {f.rule for f in found}
+    assert "metric-name-literal" not in rules
+    assert "span-name-literal" not in rules
+    assert "span-context-manager" in rules
+
+
+# ---------------------------------------------------------------------------
+# style pass (the folded-in linter)
+
+
+def test_style_pass_keeps_lint_behavior():
+    found = findings_for("style", "style_cases.py")
+    rules = sorted(f.rule for f in found)
+    assert "style-mutable-default" in rules
+    assert "style-eq-none" in rules
+    assert "style-bare-except" in rules
+    assert "style-fstring" in rules
+    unused = [f for f in found if f.rule == "style-unused-import"]
+    # json/sys are used; os carries noqa — NOTHING unused is reported.
+    assert unused == [], unused
+
+
+def test_style_trailing_ws_tabs_and_malformed_suppression():
+    found = findings_for("style", "suppress_cases.py")
+    rules = {f.rule for f in found}
+    assert "style-trailing-ws" in rules
+    assert "style-tab-indent" in rules
+    mod = Module(FIXTURES / "suppress_cases.py", FIXTURES)
+    malformed = malformed_suppressions(mod)
+    # Line 1 lacks the rule id; line 3 has an id but NO `: reason` — both
+    # are malformed (and suppress nothing). The well-formed line 2 is not.
+    assert [f.line for f in malformed] == [1, 3], malformed
+    assert not any(f.line == 2 for f in malformed)
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+
+
+def test_baseline_allows_known_and_errors_on_orphans(tmp_path):
+    found = findings_for("locks", "lock_unguarded.py")
+    assert found
+    keys = [f.key() for f in found]
+    baseline = dict.fromkeys(keys, 1)
+    baseline["tests/gone.py::X::lock-guarded-attr::stale.entry"] = 1
+    new, old, orphans = apply_baseline(found, baseline)
+    assert new == [] and len(old) == len(found)
+    assert orphans == ["tests/gone.py::X::lock-guarded-attr::stale.entry"]
+    # Round-trip through the committed-file format (key -> count).
+    path = tmp_path / "baseline.json"
+    write_baseline(keys, path)
+    loaded = load_baseline(path)
+    assert set(loaded) == set(keys) and all(n == 1 for n in loaded.values())
+    assert "_comment" in json.loads(path.read_text())
+
+
+def test_baseline_counts_bound_same_key_findings():
+    """One baselined key must not absorb NEW findings of the same shape:
+    with count=N, an (N+1)-th occurrence fails; with more allowed than
+    present, the stale count is an orphan (the file may only shrink)."""
+    found = findings_for("locks", "lock_unguarded.py")
+    a = [f for f in found if f.rule == "lock-guarded-attr"]
+    assert len(a) >= 2
+    key0 = a[0].key()
+    same = [f for f in a if f.key() == key0]
+    other = {f.key(): 1 for f in found if f.key() != key0}
+    # Allowed count one LESS than present: exactly one finding is new.
+    new, old, orphans = apply_baseline(found, {**other, key0: len(same) - 1})
+    assert len(new) == 1 and new[0].key() == key0 and orphans == []
+    # Allowed count one MORE than present: stale -> orphan.
+    new, old, orphans = apply_baseline(found, {**other, key0: len(same) + 1})
+    assert new == [] and orphans == [key0]
+
+
+def test_baseline_keys_are_line_stable(tmp_path):
+    """Shifting a finding DOWN by unrelated edits above it must not churn
+    its baseline key (keys carry scope+detail, never line numbers)."""
+    src = (FIXTURES / "lock_unguarded.py").read_text()
+    shifted = tmp_path / "lock_unguarded.py"
+    shifted.write_text("# pad\n# pad\n# pad\n" + src)
+    orig = {f.key() for f in findings_for("locks", "lock_unguarded.py")}
+    moved = {f.key() for f in run_pass("locks", [shifted], root=tmp_path)}
+    assert orig == moved
+
+
+# ---------------------------------------------------------------------------
+# repo-level contract
+
+
+def test_fixture_dir_is_excluded_from_discovery():
+    files = {p.as_posix() for p in iter_source_files()}
+    assert not any("vet_fixtures" in f for f in files)
+    assert any(f.endswith("lws_tpu/serving/pipeline.py") for f in files)
+
+
+def test_repo_vet_runs_clean_with_committed_baseline():
+    """The acceptance gate: `python -m tools.vet` (what `make vet` runs)
+    exits 0 on the repo — only baseline-allowed findings, no orphans."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.vet"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_partial_run_keeps_baseline_allowance():
+    """`--only hotpath` must not re-report baselined findings as new —
+    the allowance applies to any full-repo run; only the ORPHAN check
+    needs every pass."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.vet", "--only", "hotpath"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_alias_is_style_only_pass():
+    """`make lint` muscle memory: the style-only invocation still works
+    and the repo is style-clean."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.vet", "--only", "style"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "1 pass(es)" in proc.stderr
+
+
+def test_committed_baseline_has_no_orphans_offline():
+    """The orphan rule, exercised directly against the committed file:
+    every baseline entry (at its full count) must still correspond to
+    real findings."""
+    from tools.vet import collect_findings
+    from tools.vet.core import load_modules
+
+    current, _ = collect_findings(load_modules(iter_source_files()))
+    _, _, orphans = apply_baseline(current, load_baseline())
+    assert orphans == [], orphans

@@ -1,0 +1,47 @@
+"""Fixture: hot-path hygiene cases. hot_root is annotated; helper_sleeps
+and Engine._inner are reachable through the conservative call graph;
+cold() blocks freely because nothing hot reaches it."""
+
+import socket
+import time
+
+import numpy as np
+
+
+def helper_sleeps():
+    time.sleep(0.01)
+
+
+def hot_root():  # hot-path
+    helper_sleeps()
+    time.sleep(0.5)
+    np.asarray([1])
+    np.asarray([2])  # vet: ignore[hotpath-host-sync]: deliberate fence for the fixture
+    conn = socket.create_connection(("example", 1))
+    return conn
+
+
+class Engine:
+    def step(self):  # hot-path
+        return self._inner()
+
+    def _inner(self):
+        return np.asarray([1, 2, 3])
+
+
+def helper_with_closure():
+    def inner():
+        time.sleep(0.2)
+    return inner
+
+
+def hot_root2():  # hot-path
+    return helper_with_closure()
+
+
+def hot_root3():  # hot-path
+    return lambda h: np.asarray(h)
+
+
+def cold():
+    time.sleep(1.0)

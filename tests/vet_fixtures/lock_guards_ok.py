@@ -1,0 +1,59 @@
+"""Fixture: lock-discipline FALSE-POSITIVE GUARDS — every access pattern
+here is legitimate and the pass must stay silent.
+
+Covers: plain `with` blocks, the acquire/try/finally-release idiom,
+re-entrant RLock nesting, the `_locked`-suffix caller-holds convention,
+`# holds-lock:` method annotations (also how lock-acquiring DECORATORS
+are declared — the decorator body is opaque to the lexical pass), and
+nested callbacks (checked at their call site's discipline, not here)."""
+
+import threading
+
+
+def synchronized(fn):
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data = {}  # guarded-by: _lock
+
+    def with_block(self):
+        with self._lock:
+            return dict(self._data)
+
+    def try_finally(self):
+        self._lock.acquire()
+        try:
+            self._data["k"] = 1
+        finally:
+            self._lock.release()
+
+    def after_release(self):
+        self._lock.acquire()
+        self._lock.release()
+        return True  # touching _data HERE would be a finding
+
+    def reentrant(self):
+        with self._lock:
+            with self._lock:
+                self._data.clear()
+
+    def _mutate_locked(self):
+        self._data["x"] = 2
+
+    def annotated(self):  # holds-lock: _lock
+        return len(self._data)
+
+    @synchronized
+    def decorated(self):  # holds-lock: _lock — synchronized() acquires it
+        return self._data.get("k")
+
+    def callback_factory(self):
+        def callback():
+            return self._data
+        return callback

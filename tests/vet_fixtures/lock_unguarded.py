@@ -1,0 +1,44 @@
+"""Fixture: lock-discipline TRUE POSITIVES (never imported, only parsed).
+
+`Guarded` declares `_items`/`count` guarded and touches them without the
+lock in bad_read/bad_write; order_ab/order_ba acquire the class's two
+locks in both orders (the ABBA deadlock shape)."""
+
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other_lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+
+    def bad_read(self):
+        return len(self._items)
+
+    def bad_write(self):
+        self.count += 1
+
+    def good(self):
+        with self._lock:
+            self._items.append(1)
+            self.count += 1
+
+    def bad_after_finally_release(self):
+        self._lock.acquire()
+        try:
+            self._items.append(2)
+        finally:
+            self._lock.release()
+        self.count += 1
+
+    def order_ab(self):
+        with self._lock:
+            with self._other_lock:
+                return None
+
+    def order_ba(self):
+        with self._other_lock:
+            with self._lock:
+                return None

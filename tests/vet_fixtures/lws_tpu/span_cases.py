@@ -1,0 +1,47 @@
+"""Fixture: span/metric hygiene. Lives under a fake lws_tpu/ root (the
+self-tests pass root=tests/vet_fixtures) because the name-literal rules
+are scoped to the catalogue checker's source tree."""
+
+from lws_tpu.core import metrics, trace
+
+NAME = "dyn_metric"
+
+
+def bad_span():
+    orphan = trace.span("never.entered")
+    return orphan is not None
+
+
+def ok_span():
+    with trace.span("ok.span"):
+        return None
+
+
+def ok_assigned_then_entered():
+    dispatch_span = trace.span("ok.assigned")
+    with dispatch_span:
+        return None
+
+
+def bad_metric_name():
+    metrics.inc(NAME)
+
+
+def bad_span_name(suffix):
+    with trace.span("prefix." + suffix):
+        return None
+
+
+def ok_metric():
+    metrics.inc("fixture_total")
+
+
+def bad_span_shared_name():
+    sp = trace.span("leak.shared-name")
+    return sp
+
+
+def ok_other_function_enters_same_name():
+    sp = trace.span("ok.shared-name")
+    with sp:
+        return None

@@ -1,0 +1,63 @@
+"""Fixture: resource-hygiene cases — leaks flagged, every legitimate
+ownership shape (with, finally-close, return, handoff, pytest.raises)
+left alone, plus the constructor error-path leak and its fixed twin."""
+
+import socket
+import urllib.request
+
+import pytest
+
+
+def leaky_local():
+    sock = socket.socket()
+    return None if sock else None
+
+
+def discarded():
+    urllib.request.urlopen("http://example/")
+
+
+def ok_with():
+    with socket.create_connection(("example", 1)) as sock:
+        return sock.recv(1)
+
+
+def ok_closed():
+    sock = socket.socket()
+    try:
+        sock.bind(("", 0))
+    finally:
+        sock.close()
+
+
+def ok_returned():
+    sock = socket.socket()
+    return sock
+
+
+def ok_handoff(registry):
+    sock = socket.socket()
+    registry.append(sock)
+
+
+def ok_expected_raise():
+    with pytest.raises(OSError):
+        urllib.request.urlopen("http://127.0.0.1:1/")
+
+
+class LeakyServer:
+    def __init__(self):
+        self._sock = socket.socket()
+        self._sock.bind(("", 0))
+        self._sock.listen(1)
+
+
+class SafeServer:
+    def __init__(self):
+        self._sock = socket.socket()
+        try:
+            self._sock.bind(("", 0))
+            self._sock.listen(1)
+        except OSError:
+            self._sock.close()
+            raise

@@ -1,0 +1,26 @@
+"""Fixture: style pass cases (the folded-in tools/lint.py checks)."""
+
+import json
+import os  # noqa: intentional — suppressed unused import
+import sys
+
+
+def bad_default(items=[]):
+    return items
+
+
+def bad_compare(x):
+    if x == None:
+        return "f-string with no placeholder: f-literal below"
+    return f"static"
+
+
+def bad_except():
+    try:
+        return json.dumps({})
+    except:
+        return None
+
+
+def uses_sys():
+    return sys.platform

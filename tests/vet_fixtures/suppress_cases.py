@@ -1,0 +1,8 @@
+X = 1  # vet: ignore -- forgot the rule id
+Y = 2  # vet: ignore[style-eq-none]: well-formed marker, nothing to suppress here
+R = 5  # vet: ignore[style-eq-none] missing the colon-reason
+Z = 3   
+
+
+def tabbed():
+	return Y

@@ -1,139 +1,23 @@
 #!/usr/bin/env python
-"""Self-contained linter (no third-party deps; ref parity: the reference
-wires golangci-lint + go vet into its Makefile, Makefile:152-198).
-
-Checks: syntax, unused imports, bare except, mutable default args,
-`== None` comparisons, tabs in indentation, trailing whitespace, and
-f-strings with no placeholders. Run: `make lint` or `python tools/lint.py`.
-"""
+"""Back-compat shim: the linter grew into the `tools/vet` analyzer
+package, and the old checks live on unchanged as its `style` pass
+(tools/vet/style.py). `python tools/lint.py` and `make lint` both run
+exactly `python -m tools.vet --only style`; run `python -m tools.vet`
+for the full suite (lock discipline, hot-path hygiene, resource
+hygiene, span/metric hygiene — see docs/static-analysis.md)."""
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-ROOT = Path(__file__).resolve().parent.parent
-TARGETS = ["lws_tpu", "tests", "benchmarks", "tools", "bench.py", "__graft_entry__.py"]
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-# Names whose import is intentional re-export or side-effect.
-REEXPORT_OK = {"__init__.py", "conftest.py"}
-
-
-class Checker(ast.NodeVisitor):
-    def __init__(self, path: Path, tree: ast.AST):
-        self.path = path
-        self.problems: list[tuple[int, str]] = []
-        self.imported: dict[str, int] = {}
-        self.used: set[str] = set()
-        self.visit(tree)
-
-    def problem(self, lineno: int, msg: str) -> None:
-        self.problems.append((lineno, msg))
-
-    # -- imports -----------------------------------------------------------
-    def visit_Import(self, node: ast.Import) -> None:
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imported.setdefault(name, node.lineno)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module == "__future__":
-            return  # effective by existing, never "used"
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imported.setdefault(a.asname or a.name, node.lineno)
-
-    def visit_Name(self, node: ast.Name) -> None:
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        self.generic_visit(node)
-
-    # -- other checks ------------------------------------------------------
-    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
-        if node.type is None:
-            self.problem(node.lineno, "bare `except:` (catch something specific)")
-        self.generic_visit(node)
-
-    def visit_FunctionDef(self, node):
-        for default in list(node.args.defaults) + list(node.args.kw_defaults):
-            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                self.problem(default.lineno, "mutable default argument")
-        self.generic_visit(node)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Compare(self, node: ast.Compare) -> None:
-        for op, comp in zip(node.ops, node.comparators):
-            if isinstance(op, (ast.Eq, ast.NotEq)) and (
-                (isinstance(comp, ast.Constant) and comp.value is None)
-            ):
-                self.problem(node.lineno, "`== None` (use `is None`)")
-        self.generic_visit(node)
-
-    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
-        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-            self.problem(node.lineno, "f-string without placeholders")
-        self.generic_visit(node)
-
-    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
-        # Visit the value only: a format spec like {x:.1f} parses as a
-        # nested JoinedStr with no placeholders — not a lint problem.
-        self.visit(node.value)
-
-    def unused_imports(self, source: str) -> list[tuple[int, str]]:
-        out = []
-        for name, lineno in self.imported.items():
-            if name in self.used or name == "_":
-                continue
-            # `# noqa` on the import line suppresses (matches existing style).
-            line = source.splitlines()[lineno - 1]
-            if "noqa" in line:
-                continue
-            # __all__ mention counts as use.
-            if f'"{name}"' in source or f"'{name}'" in source:
-                continue
-            out.append((lineno, f"unused import `{name}`"))
-        return out
-
-
-def lint_file(path: Path) -> list[str]:
-    source = path.read_text()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    checker = Checker(path, tree)
-    problems = list(checker.problems)
-    if path.name not in REEXPORT_OK:
-        problems += checker.unused_imports(source)
-    for i, line in enumerate(source.splitlines(), 1):
-        if line.rstrip() != line:
-            problems.append((i, "trailing whitespace"))
-        stripped = line.lstrip("\t ")
-        if "\t" in line[: len(line) - len(stripped)]:
-            problems.append((i, "tab in indentation"))
-    rel = path.relative_to(ROOT)
-    return [f"{rel}:{lineno}: {msg}" for lineno, msg in sorted(problems)]
+from tools.vet import run_vet  # noqa: E402
 
 
 def main() -> int:
-    files: list[Path] = []
-    for target in TARGETS:
-        p = ROOT / target
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.exists():
-            files.append(p)
-    all_problems = []
-    for f in files:
-        all_problems.extend(lint_file(f))
-    for p in all_problems:
-        print(p)
-    print(f"lint: {len(files)} files, {len(all_problems)} problem(s)", file=sys.stderr)
-    return 1 if all_problems else 0
+    return run_vet(only=["style"])
 
 
 if __name__ == "__main__":

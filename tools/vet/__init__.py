@@ -1,0 +1,125 @@
+"""`lws-tpu vet`: project-aware static analysis suite.
+
+Five passes over the repo (see docs/static-analysis.md for the rule
+catalogue): `style` (the old tools/lint.py, folded in), `locks` (guarded
+attributes + lock acquisition order), `hotpath` (no blocking or
+host-sync calls on the decode dispatch path), `resources` (sockets/
+files/executors must be closed, including on error paths), and `spans`
+(spans entered via context manager, metric/span names literal).
+
+Entry points: `make vet`, `python -m tools.vet`, or programmatically
+`run_vet(...)` (the analyzer self-tests drive passes through
+`run_pass`). Findings not in tools/vet/baseline.json fail the run;
+baseline entries that no longer match any finding are orphans and fail
+it too (the file may only shrink).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Optional
+
+from tools.vet import core as _core
+from tools.vet import hotpath, locks, resources, spans, style
+from tools.vet.core import (  # noqa: F401 — re-exported for tests
+    BASELINE_PATH,
+    Finding,
+    Module,
+    apply_baseline,
+    iter_source_files,
+    load_baseline,
+    load_modules,
+    malformed_suppressions,
+    write_baseline,
+)
+
+PASSES = {
+    style.PASS_NAME: style.run,
+    locks.PASS_NAME: locks.run,
+    hotpath.PASS_NAME: hotpath.run,
+    resources.PASS_NAME: resources.run,
+    spans.PASS_NAME: spans.run,
+}
+
+
+def run_pass(name: str, paths: list[Path], root: Optional[Path] = None) -> list[Finding]:
+    """Run ONE pass over explicit files, suppressions applied, no baseline
+    — the shape the analyzer self-tests (tests/test_vet.py) drive."""
+    modules = load_modules(paths, root or _core.ROOT)
+    by_rel = {m.rel: m for m in modules}
+    out = []
+    for f in PASSES[name](modules):
+        mod = by_rel.get(f.path)
+        if mod is not None and mod.suppressed(f):
+            continue
+        out.append(f)
+    return out
+
+
+def collect_findings(
+    modules: list[Module], pass_names: Optional[list[str]] = None
+) -> tuple[list[Finding], int]:
+    """Run passes + the malformed-suppression check over parsed modules,
+    dropping suppressed findings: -> (findings, suppressed_count). The ONE
+    collection loop run_vet, --write-baseline, and the self-tests share."""
+    by_rel = {m.rel: m for m in modules}
+    findings: list[Finding] = []
+    suppressed = 0
+    for name in pass_names or list(PASSES):
+        for f in PASSES[name](modules):
+            mod = by_rel.get(f.path)
+            if mod is not None and mod.suppressed(f):
+                suppressed += 1
+                continue
+            findings.append(f)
+    for mod in modules:
+        findings.extend(malformed_suppressions(mod))
+    return findings, suppressed
+
+
+def run_vet(
+    only: Optional[list[str]] = None,
+    paths: Optional[list[Path]] = None,
+    use_baseline: bool = True,
+    out=sys.stdout,
+) -> int:
+    """Full vet run. Returns the process exit code: 0 clean, 1 findings
+    outside the baseline, 2 orphaned baseline entries (the baseline may
+    only shrink — mirroring check_metrics_catalogue.py's orphan rule)."""
+    pass_names = list(PASSES) if not only else only
+    unknown = [p for p in pass_names if p not in PASSES]
+    if unknown:
+        print(f"vet: unknown pass(es): {', '.join(unknown)}", file=sys.stderr)
+        return 2
+    files = paths if paths is not None else iter_source_files()
+    modules = load_modules(files)
+    findings, suppressed = collect_findings(modules, pass_names)
+
+    # The per-key allowance applies to any full-repo run — `--only
+    # hotpath` must not re-report baselined findings as new. The ORPHAN
+    # check alone needs every pass: a partial run can't distinguish an
+    # orphaned entry from an unexercised pass.
+    baseline = load_baseline() if (use_baseline and paths is None) else {}
+    new, old, orphans = apply_baseline(findings, baseline)
+    if set(pass_names) != set(PASSES):
+        orphans = []
+
+    for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.render(), file=out)
+    for key in orphans:
+        print(
+            f"tools/vet/baseline.json: orphaned entry `{key}` — the finding "
+            "(or its full allowed count) no longer exists; shrink the file "
+            "(python -m tools.vet --write-baseline)", file=out,
+        )
+    print(
+        f"vet: {len(modules)} files, {len(pass_names)} pass(es), "
+        f"{len(new)} finding(s), {len(old)} baselined, "
+        f"{suppressed} suppressed, {len(orphans)} orphaned baseline "
+        "entr(ies)",
+        file=sys.stderr,
+    )
+    if orphans:
+        return 2
+    return 1 if new else 0

@@ -1,0 +1,55 @@
+"""CLI for the vet suite: `python -m tools.vet [--only a,b] [--write-baseline]
+[paths...]`. See tools/vet/__init__.py and docs/static-analysis.md."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.vet import PASSES, collect_findings, run_vet
+from tools.vet.core import (
+    BASELINE_PATH,
+    iter_source_files,
+    load_modules,
+    write_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.vet",
+        description="Project-aware static analysis (docs/static-analysis.md).",
+    )
+    parser.add_argument(
+        "--only",
+        help=f"comma-separated pass subset (of: {', '.join(PASSES)})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring tools/vet/baseline.json",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate tools/vet/baseline.json from the current findings "
+             "(use ONLY to drop fixed entries — the file may not grow)",
+    )
+    parser.add_argument("paths", nargs="*", help="explicit files (default: repo targets)")
+    args = parser.parse_args(argv)
+
+    only = [p.strip() for p in args.only.split(",")] if args.only else None
+    paths = [Path(p).resolve() for p in args.paths] or None
+
+    if args.write_baseline:
+        findings, _ = collect_findings(load_modules(iter_source_files()))
+        keys = [f.key() for f in findings]
+        write_baseline(keys)
+        print(f"vet: wrote {len(set(keys))} entries ({len(keys)} findings) "
+              f"to {BASELINE_PATH}", file=sys.stderr)
+        return 0
+
+    return run_vet(only=only, paths=paths, use_baseline=not args.no_baseline)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,262 @@
+"""Shared infrastructure for the `lws-tpu vet` analyzer passes.
+
+The vet suite is the Python analog of the reference control plane's
+`go vet` + golangci-lint + `-race` toolchain: project-aware AST passes
+over a concurrent codebase, wired into `make check`. This module owns
+everything the passes share:
+
+  * file discovery (same target set as the old tools/lint.py, minus
+    tests/vet_fixtures/ — those files are deliberate rule violations);
+  * the `Finding` model and its stable baseline key (path + enclosing
+    scope + rule + detail, NO line number — line drift must not churn
+    tools/vet/baseline.json);
+  * source-comment annotations: `# guarded-by: <lock>` on attribute
+    initializers, `# hot-path` on def lines, `# holds-lock: <lock>` on
+    methods whose CALLER owns the lock;
+  * inline suppressions: `# vet: ignore[rule-id]: reason` on the finding
+    line. A rule id is mandatory; a bare ignore marker is itself a
+    finding (vet-malformed-suppression) so suppressions stay auditable;
+  * the committed baseline (tools/vet/baseline.json): pre-existing
+    findings burn down without blocking CI, and — mirroring
+    tools/check_metrics_catalogue.py's orphaned-row rule — a baseline
+    entry no current finding matches is an ERROR, so the file can only
+    shrink.
+
+Run: `make vet`, `python -m tools.vet`, or `python -m tools.vet --only
+style,locks`. Rules are catalogued in docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Optional
+
+ROOT = Path(__file__).resolve().parent.parent.parent
+TARGETS = ["lws_tpu", "tests", "benchmarks", "tools", "bench.py", "__graft_entry__.py"]
+# Directories whose files are never vetted: fixture snippets are
+# deliberate violations the analyzer self-tests assert on.
+EXCLUDED_DIRS = {"vet_fixtures", "__pycache__"}
+
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+# A suppression needs BOTH the bracketed rule id(s) AND a `: reason` —
+# ISSUE acceptance: zero inline suppressions without a rule-id and comment.
+SUPPRESS_RE = re.compile(r"#\s*vet:\s*ignore\[([A-Za-z0-9_,\- ]+)\]\s*:\s*\S")
+MALFORMED_SUPPRESS_RE = re.compile(r"#\s*vet:\s*ignore\b")
+# The annotation markers may share a comment (`# hot-path — holds-lock:
+# _lock`), so they match anywhere after the `#`, not only right behind it.
+GUARDED_BY_RE = re.compile(r"#.*?\bguarded-by:\s*([A-Za-z_]\w*)")
+HOT_PATH_RE = re.compile(r"#.*?\bhot-path\b")
+HOLDS_LOCK_RE = re.compile(r"#.*?\bholds-lock:\s*([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    qual: str  # enclosing function/class qualname, or "<module>"
+    detail: str  # stable short detail (attr name, call name, ...)
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity: everything except the line number, which
+        drifts with unrelated edits above the finding."""
+        return f"{self.path}::{self.qual}::{self.rule}::{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """One parsed source file plus the comment-annotation side tables."""
+
+    def __init__(self, path: Path, root: Path = ROOT) -> None:
+        self.path = path
+        try:
+            self.rel = path.relative_to(root).as_posix()
+        except ValueError:
+            self.rel = path.as_posix()
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.source, filename=str(path))
+        except SyntaxError as e:
+            self.syntax_error = e
+        # (start, end, qualname) spans for enclosing-scope lookup, innermost
+        # match wins. Populated lazily — style-only runs never need it.
+        self._scopes: Optional[list[tuple[int, int, str]]] = None
+
+    # ---- lines + annotations ---------------------------------------------
+    def line(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def has_hot_path_mark(self, node: ast.AST) -> bool:
+        """`# hot-path` on the def line or the line directly above it."""
+        lineno = getattr(node, "lineno", 0)
+        return bool(
+            HOT_PATH_RE.search(self.line(lineno))
+            or HOT_PATH_RE.search(self.line(lineno - 1))
+        )
+
+    def holds_locks(self, node: ast.AST) -> set[str]:
+        """Locks a `# holds-lock: a, b` annotation declares held on entry."""
+        lineno = getattr(node, "lineno", 0)
+        for text in (self.line(lineno), self.line(lineno - 1)):
+            m = HOLDS_LOCK_RE.search(text)
+            if m:
+                return {part.strip() for part in m.group(1).split(",")}
+        return set()
+
+    def guarded_by(self, lineno: int) -> Optional[str]:
+        m = GUARDED_BY_RE.search(self.line(lineno))
+        return m.group(1) if m else None
+
+    def suppressed(self, finding: Finding) -> bool:
+        m = SUPPRESS_RE.search(self.line(finding.line))
+        if not m:
+            return False
+        rules = {part.strip() for part in m.group(1).split(",")}
+        return finding.rule in rules
+
+    # ---- scopes -----------------------------------------------------------
+    def qualname_at(self, lineno: int) -> str:
+        if self._scopes is None:
+            self._scopes = []
+            if self.tree is not None:
+                self._collect_scopes(self.tree, "")
+        best = "<module>"
+        best_span = None
+        for start, end, qual in self._scopes:
+            if start <= lineno <= end:
+                if best_span is None or (end - start) < best_span:
+                    best, best_span = qual, end - start
+        return best
+
+    def _collect_scopes(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                end = getattr(child, "end_lineno", child.lineno)
+                assert self._scopes is not None
+                self._scopes.append((child.lineno, end, qual))
+                self._collect_scopes(child, qual)
+            else:
+                self._collect_scopes(child, prefix)
+
+    def finding(self, rule: str, lineno: int, detail: str, message: str) -> Finding:
+        return Finding(rule, self.rel, lineno, self.qualname_at(lineno), detail, message)
+
+
+def dotted_name(node: ast.expr) -> Optional[str]:
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_source_files(root: Path = ROOT, targets: Optional[list[str]] = None) -> list[Path]:
+    files: list[Path] = []
+    for target in targets or TARGETS:
+        p = root / target
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if EXCLUDED_DIRS.isdisjoint(part for part in f.parts):
+                    files.append(f)
+        elif p.exists():
+            files.append(p)
+    return files
+
+
+def load_modules(paths: Iterable[Path], root: Path = ROOT) -> list[Module]:
+    return [Module(p, root) for p in paths]
+
+
+def malformed_suppressions(mod: Module) -> list[Finding]:
+    """A vet-ignore marker without a [rule-id] or without a `: reason` —
+    unauditable, so itself a finding (and it suppresses NOTHING). Applies
+    everywhere, including on otherwise-clean lines."""
+    out = []
+    for i, text in enumerate(mod.lines, 1):
+        if MALFORMED_SUPPRESS_RE.search(text) and not SUPPRESS_RE.search(text):
+            out.append(mod.finding(
+                "vet-malformed-suppression", i, "marker",
+                "suppression without a [rule-id] and `: reason` — write "
+                "`# vet: ignore[rule-id]: reason`",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Baseline: committed findings burn down without blocking CI; orphans error.
+
+
+def load_baseline(path: Path = BASELINE_PATH) -> dict[str, int]:
+    """key -> allowed occurrence count. Counts keep the key line-stable
+    while still bounding it: a baselined key must not silently absorb NEW
+    findings of the same shape."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    entries = data.get("entries", {})
+    if isinstance(entries, list):  # legacy shape: each entry allows one
+        counted: dict[str, int] = {}
+        for key in entries:
+            counted[key] = counted.get(key, 0) + 1
+        return counted
+    return {key: int(n) for key, n in entries.items()}
+
+
+def write_baseline(keys: Iterable[str], path: Path = BASELINE_PATH) -> None:
+    """`keys` is one entry PER FINDING — repetition sets the allowed count."""
+    counts: dict[str, int] = {}
+    for key in keys:
+        counts[key] = counts.get(key, 0) + 1
+    payload = {
+        "_comment": (
+            "Pre-existing vet findings allowed to persist while they burn "
+            "down, as key -> occurrence count. NO new entries and no count "
+            "may grow: fix the finding or suppress inline with a rule-id "
+            "and reason. An entry whose count exceeds the current findings "
+            "is an error (orphan rule, like check_metrics_catalogue.py) — "
+            "regenerate with `python -m tools.vet --write-baseline` only "
+            "when removing fixed entries."
+        ),
+        "entries": dict(sorted(counts.items())),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, int]
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    """-> (new findings, baseline-allowed findings, orphaned entries).
+
+    Per key, the first `count` findings (by file order) are allowed; any
+    beyond that are NEW — a 6th host-sync added to a function whose 5 are
+    baselined fails the run. A key with FEWER current findings than its
+    count is stale and reported as an orphan (the file may only shrink)."""
+    remaining = dict(baseline)
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        if remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    orphans = sorted(key for key, n in remaining.items() if n > 0)
+    return new, old, orphans

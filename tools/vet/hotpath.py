@@ -1,0 +1,225 @@
+"""Hot-path hygiene pass: no blocking or host-syncing calls on the
+decode dispatch path.
+
+Roots are functions whose def line carries `# hot-path` (the dispatch
+bodies of `DecodePipeline` and `PagedBatchEngine.step_n` are annotated
+in source). Reachability closes over the roots through a conservative
+intra-project call graph:
+
+  * `self.m(...)`        -> a method of the same class, when it exists;
+  * `f(...)`             -> a top-level function of the same module;
+  * `alias.f(...)`       -> a top-level function of another lws_tpu
+    module imported as `from lws_tpu.x import alias` / `import
+    lws_tpu.x.alias`;
+  * nested defs of a hot function are hot (pipeline commit callbacks
+    run inside the consume path).
+
+Anything the resolver can't see (callables passed as values, methods on
+other objects) is out of scope by design — the pass must never guess a
+call target into a false positive.
+
+Rules:
+
+  * `hotpath-blocking-call` — `time.sleep`, socket construction or
+    `socket.create_connection`, `urllib.request.urlopen`,
+    `subprocess.*`, builtin `open()`: host latency injected straight
+    into the device dispatch window.
+  * `hotpath-host-sync`     — `np.asarray(...)`, `jax.device_get`,
+    `jax.block_until_ready` or any `.block_until_ready()` method call:
+    a forced device->host fence that serializes the pipeline (exactly
+    what PR 3 removed from `step_n`). Intentional fences — the
+    pipeline's consume is one — carry an inline
+    `# vet: ignore[hotpath-host-sync]: reason`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.vet.core import Finding, Module, dotted_name
+
+PASS_NAME = "hotpath"
+
+BLOCKING_DOTTED = {
+    "time.sleep", "sleep",
+    "socket.socket", "socket.create_connection",
+    "urllib.request.urlopen", "urlopen",
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+}
+# np.asarray is this repo's documented completion fence (engine.host_sync);
+# np.array is NOT banned — building a host array from host lists is host
+# work, not a device sync (e.g. the paged engine's dirty-tracked inputs).
+HOST_SYNC_DOTTED = {
+    "np.asarray", "numpy.asarray",
+    "jax.device_get", "jax.block_until_ready",
+}
+HOST_SYNC_METHODS = {"block_until_ready"}
+
+
+class _FuncInfo:
+    def __init__(self, mod: Module, qual: str, cls: Optional[str],
+                 node: ast.FunctionDef) -> None:
+        self.mod = mod
+        self.qual = qual  # e.g. "DecodePipeline.push" or "beat"
+        self.cls = cls    # enclosing class qualname, if any
+        self.node = node
+        self.hot_mark = mod.has_hot_path_mark(node)
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.mod.rel, self.qual)
+
+
+def _collect_functions(mod: Module) -> list[_FuncInfo]:
+    out: list[_FuncInfo] = []
+
+    def walk(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                out.append(_FuncInfo(mod, qual, cls, child))
+                walk(child, qual, cls)
+            elif isinstance(child, ast.ClassDef):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                walk(child, qual, qual)
+            else:
+                walk(child, prefix, cls)
+
+    if mod.tree is not None:
+        walk(mod.tree, "", None)
+    return out
+
+
+def _module_imports(mod: Module) -> dict[str, str]:
+    """alias -> repo-relative module path, for lws_tpu imports only."""
+    aliases: dict[str, str] = {}
+    if mod.tree is None:
+        return aliases
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.startswith("lws_tpu"):
+            base = node.module.replace(".", "/")
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{base}/{a.name}.py"
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("lws_tpu."):
+                    aliases[a.asname or a.name.split(".")[-1]] = \
+                        a.name.replace(".", "/") + ".py"
+    return aliases
+
+
+def _direct_calls(info: _FuncInfo, funcs_by_key: dict, aliases: dict[str, str]) -> list[tuple[str, str]]:
+    """Resolvable callee keys of one function (excluding nested defs —
+    those are separate graph nodes marked hot by containment)."""
+    out: list[tuple[str, str]] = []
+    mod_rel = info.mod.rel
+
+    def scan(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs resolve via containment edges; lambdas stay inline
+            if isinstance(child, ast.Call):
+                fn = child.func
+                if isinstance(fn, ast.Name):
+                    key = (mod_rel, fn.id)
+                    if key in funcs_by_key:
+                        out.append(key)
+                elif isinstance(fn, ast.Attribute):
+                    if isinstance(fn.value, ast.Name):
+                        if fn.value.id == "self" and info.cls:
+                            key = (mod_rel, f"{info.cls}.{fn.attr}")
+                            if key in funcs_by_key:
+                                out.append(key)
+                        elif fn.value.id in aliases:
+                            key = (aliases[fn.value.id], fn.attr)
+                            if key in funcs_by_key:
+                                out.append(key)
+            scan(child)
+
+    scan(info.node)
+    return out
+
+
+def _banned(call: ast.Call) -> Optional[tuple[str, str, str]]:
+    """-> (rule, detail, description) when the call is banned on a hot path."""
+    fn = call.func
+    dotted = dotted_name(fn)
+    if isinstance(fn, ast.Name) and fn.id == "open":
+        return ("hotpath-blocking-call", "open", "file I/O via open()")
+    if dotted in BLOCKING_DOTTED:
+        return ("hotpath-blocking-call", dotted, f"blocking call {dotted}()")
+    if dotted in HOST_SYNC_DOTTED:
+        return ("hotpath-host-sync", dotted, f"host sync {dotted}()")
+    if isinstance(fn, ast.Attribute) and fn.attr in HOST_SYNC_METHODS:
+        return ("hotpath-host-sync", fn.attr, f".{fn.attr}() device fence")
+    return None
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    funcs: list[_FuncInfo] = []
+    for mod in modules:
+        funcs.extend(_collect_functions(mod))
+    funcs_by_key = {f.key: f for f in funcs}
+    aliases_by_mod = {mod.rel: _module_imports(mod) for mod in modules}
+
+    # Containment: nested defs of a hot function are hot (qualname prefix
+    # == containment here). Applied to every function entering the hot set
+    # — BFS-reached callees included, not just annotated roots — so a
+    # blocking call hidden in a helper's closure is still found.
+    by_mod: dict[str, list[_FuncInfo]] = {}
+    for f in funcs:
+        by_mod.setdefault(f.mod.rel, []).append(f)
+    children: dict[tuple[str, str], list[tuple[str, str]]] = {}
+    for peers in by_mod.values():
+        for f in peers:
+            prefix = f.qual + "."
+            kids = [g.key for g in peers if g.qual.startswith(prefix)]
+            if kids:
+                children[f.key] = kids
+
+    # BFS over the conservative call graph + containment edges.
+    hot: set[tuple[str, str]] = {f.key for f in funcs if f.hot_mark}
+    frontier = list(hot)
+    while frontier:
+        key = frontier.pop()
+        info = funcs_by_key.get(key)
+        if info is None:
+            continue
+        edges = list(children.get(key, ()))
+        edges += _direct_calls(info, funcs_by_key, aliases_by_mod[info.mod.rel])
+        for callee in edges:
+            if callee not in hot:
+                hot.add(callee)
+                frontier.append(callee)
+
+    findings: list[Finding] = []
+    for key in sorted(hot):
+        info = funcs_by_key.get(key)
+        if info is None:
+            continue
+
+        def scan(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # separate hot node (containment edge); scanned on its own
+                # Lambdas are NOT separate nodes — a commit callback like
+                # `lambda h: np.asarray(h)` is scanned as part of its
+                # containing hot function.
+                if isinstance(child, ast.Call):
+                    hit = _banned(child)
+                    if hit is not None:
+                        rule, detail, desc = hit
+                        findings.append(info.mod.finding(
+                            rule, child.lineno, f"{info.qual}:{detail}",
+                            f"{desc} on the hot path (in {info.qual})",
+                        ))
+                scan(child)
+
+        for stmt in info.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            scan(stmt)
+    return findings

@@ -1,0 +1,259 @@
+"""Lock-discipline pass: guarded attributes and lock acquisition order.
+
+`lock-guarded-attr` — an instance attribute whose initializer carries a
+`# guarded-by: _lock` annotation may only be touched (read OR written)
+through `self.<attr>` while `self.<lock>` is held. Held means:
+
+  * lexically inside `with self.<lock>:` (multiple items and nesting
+    compose; re-entrant RLocks are naturally fine — the lock stays in
+    the held set);
+  * after a `self.<lock>.acquire()` statement in the same block, until
+    the matching `.release()` — the try/finally-release idiom keeps the
+    lock held through the try body and handlers;
+  * for the whole method when its def line carries `# holds-lock:
+    <lock>` (the caller owns the lock — the `_locked`-suffix method
+    convention from core/store.py is honored the same way);
+  * `__init__`/`__del__` are exempt (construction and teardown are
+    single-threaded by contract).
+
+Accesses inside nested function defs and lambdas are NOT checked: those
+bodies run later, under whatever discipline their call site owns (the
+engines' pipeline commit callbacks run under the pipeline's consume
+lock, which this pass cannot see lexically).
+
+`lock-order` — for each class, every nested acquisition `A then B` of
+two of its own locks is recorded; observing both `A->B` and `B->A`
+anywhere in the project is a potential deadlock and flags both sites.
+
+The annotations this pass consumes live in core/store.py,
+core/metrics.py, core/flightrecorder.py, core/slo.py,
+serving/pipeline.py, serving/kv_transport.py and runtime/fleet.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.vet.core import Finding, Module
+
+PASS_NAME = "locks"
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+EXEMPT_METHODS = {"__init__", "__del__", "__post_init__"}
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """`self.X` -> "X", else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_call_attr(node: ast.expr, op: str) -> Optional[str]:
+    """`self.X.acquire()` / `.release()` (as an expression) -> "X"."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == op:
+        return _self_attr(node.func.value)
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, mod: Module, qual: str, node: ast.ClassDef) -> None:
+        self.mod = mod
+        self.qual = qual
+        self.node = node
+        self.locks: set[str] = set()
+        self.guarded: dict[str, str] = {}  # attr -> lock name
+        self._collect()
+
+    def _collect(self) -> None:
+        for fn in self.node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    value = stmt.value
+                    if isinstance(value, ast.Call):
+                        ctor = value.func
+                        name = ctor.attr if isinstance(ctor, ast.Attribute) else (
+                            ctor.id if isinstance(ctor, ast.Name) else None
+                        )
+                        if name in LOCK_CTORS:
+                            self.locks.add(attr)
+                    guard = self.mod.guarded_by(stmt.lineno)
+                    if guard:
+                        self.guarded[attr] = guard
+
+
+class _MethodChecker:
+    """Walks one method's statements tracking the set of held self-locks."""
+
+    def __init__(self, cls: _ClassInfo, fn: ast.FunctionDef,
+                 findings: list[Finding], edges: dict) -> None:
+        self.cls = cls
+        self.fn = fn
+        self.findings = findings
+        self.edges = edges  # (class_qual, lockA, lockB) -> first site
+        held = set(cls.mod.holds_locks(fn))
+        if fn.name.endswith("_locked"):
+            # store.py convention: the caller holds every guard lock.
+            held |= set(cls.guarded.values())
+        self.walk_block(fn.body, held)
+
+    # ---- statement walk ---------------------------------------------------
+    def walk_block(self, stmts: list[ast.stmt], held: set[str]) -> set[str]:
+        """Walk statements sequentially; returns the held set at block end
+        (so a release inside a try's finally ends the region for the
+        statements AFTER the try)."""
+        cur = set(held)
+        for stmt in stmts:
+            cur = self.walk_stmt(stmt, cur)
+        return cur
+
+    def walk_stmt(self, stmt: ast.stmt, held: set[str]) -> set[str]:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in stmt.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and self._is_lock(attr):
+                    acquired.append(attr)
+                else:
+                    self.check_expr(item.context_expr, held)
+                if item.optional_vars is not None:
+                    self.check_expr(item.optional_vars, held)
+            for lock in acquired:
+                self._record_order(held, lock, stmt.lineno)
+            self.walk_block(stmt.body, held | set(acquired))
+            return held
+        if isinstance(stmt, ast.Expr):
+            acq = _lock_call_attr(stmt.value, "acquire")
+            if acq is not None and self._is_lock(acq):
+                self._record_order(held, acq, stmt.lineno)
+                return held | {acq}
+            rel = _lock_call_attr(stmt.value, "release")
+            if rel is not None and self._is_lock(rel):
+                return held - {rel}
+            self.check_expr(stmt.value, held)
+            return held
+        if isinstance(stmt, ast.Try):
+            # A lock acquired before the try is held through body and
+            # handlers; a release in the finally ends the region — the
+            # finalbody's resulting held set is what statements AFTER the
+            # try run under.
+            self.walk_block(stmt.body, held)
+            for handler in stmt.handlers:
+                self.walk_block(handler.body, held)
+            self.walk_block(stmt.orelse, held)
+            return self.walk_block(stmt.finalbody, held)
+        if isinstance(stmt, (ast.If,)):
+            self.check_expr(stmt.test, held)
+            self.walk_block(stmt.body, held)
+            self.walk_block(stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.While,)):
+            self.check_expr(stmt.test, held)
+            self.walk_block(stmt.body, held)
+            self.walk_block(stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.check_expr(stmt.iter, held)
+            self.check_expr(stmt.target, held)
+            self.walk_block(stmt.body, held)
+            self.walk_block(stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return held  # nested scope: runs later, not checked here
+        # Leaf statements: scan every expression they contain.
+        for child in ast.iter_child_nodes(stmt):
+            self.check_expr(child, held)
+        return held
+
+    # ---- expression scan --------------------------------------------------
+    def check_expr(self, node: ast.AST, held: set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested scope
+        attr = _self_attr(node) if isinstance(node, ast.Attribute) else None
+        if attr is not None and attr in self.cls.guarded:
+            lock = self.cls.guarded[attr]
+            if lock not in held:
+                self.findings.append(self.cls.mod.finding(
+                    "lock-guarded-attr", node.lineno, f"{self.fn.name}.{attr}",
+                    f"self.{attr} is `# guarded-by: {lock}` but accessed in "
+                    f"{self.cls.qual}.{self.fn.name} without holding "
+                    f"self.{lock}",
+                ))
+        for child in ast.iter_child_nodes(node):
+            self.check_expr(child, held)
+
+    # ---- helpers ----------------------------------------------------------
+    def _is_lock(self, attr: str) -> bool:
+        return attr in self.cls.locks or attr in set(self.cls.guarded.values()) \
+            or attr.endswith(("lock", "mutex", "cond"))
+
+    def _record_order(self, held: set[str], acquired: str, lineno: int) -> None:
+        for outer in held:
+            if outer == acquired:
+                continue  # re-entrant RLock re-acquire: not an order edge
+            # Keyed by (module, class): a class lives in exactly one module,
+            # and two same-named classes in different files must not merge
+            # into one phantom ABBA pair.
+            key = (self.cls.mod.rel, self.cls.qual, outer, acquired)
+            self.edges.setdefault(key, (self.cls.mod, lineno))
+
+
+def _classes(mod: Module) -> list[_ClassInfo]:
+    out: list[_ClassInfo] = []
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                out.append(_ClassInfo(mod, qual, child))
+                walk(child, qual)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, f"{prefix}.{child.name}" if prefix else child.name)
+            else:
+                walk(child, prefix)
+
+    if mod.tree is not None:
+        walk(mod.tree, "")
+    return out
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    edges: dict[tuple[str, str, str, str], tuple[Module, int]] = {}
+    for mod in modules:
+        for cls in _classes(mod):
+            if not cls.guarded and not cls.locks:
+                continue
+            for fn in cls.node.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if fn.name in EXEMPT_METHODS:
+                    continue
+                if cls.guarded or cls.locks:
+                    _MethodChecker(cls, fn, findings, edges)
+    # Inconsistent acquisition order: both A->B and B->A observed for the
+    # same class's locks (the classic ABBA deadlock shape).
+    reported: set[tuple[str, str, str, str]] = set()
+    for (rel, qual, a, b), (mod, lineno) in sorted(
+        edges.items(), key=lambda kv: (kv[1][0].rel, kv[1][1])
+    ):
+        if (rel, qual, b, a) in edges and (rel, qual, b, a) not in reported:
+            reported.add((rel, qual, a, b))
+            other_mod, other_line = edges[(rel, qual, b, a)]
+            findings.append(mod.finding(
+                "lock-order", lineno, f"{qual}:{a}<->{b}",
+                f"inconsistent lock order in {qual}: {a} -> {b} here but "
+                f"{b} -> {a} at {other_mod.rel}:{other_line} (ABBA deadlock)",
+            ))
+    return findings

@@ -1,0 +1,202 @@
+"""Resource-hygiene pass: sockets, files and executors must have an
+owner that closes them.
+
+`resource-unclosed` — a resource constructor (`socket.socket`,
+`socket.create_connection`, `open`, `ThreadPoolExecutor`,
+`urllib.request.urlopen`) whose result is bound to a LOCAL name is fine
+only when the function also does one of: use it as a `with` context,
+call `.close()`/`.shutdown()` on it, return it, yield it, store it on
+`self`/an object (ownership transferred), or pass it to another call
+(ownership escapes). A bare constructor used as an expression statement
+is flagged — nothing can ever close it — unless it sits inside a
+`with pytest.raises(...)` block, where the call is EXPECTED to raise
+before producing a resource (the standard error-path test shape).
+
+`resource-ctor-leak` — the error-path variant the KV transport had: a
+resource stored on `self` in a constructor, followed IN THE SAME
+function by fallible setup calls on it (`bind`/`listen`/`connect`/
+`wrap_socket`) outside any try — if setup raises, the constructor
+aborts and the already-created resource leaks until GC. The fix shape
+is `try: setup() except: res.close(); raise`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.vet.core import Finding, Module, dotted_name
+
+PASS_NAME = "resources"
+
+RESOURCE_CTORS = {
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "open": "file",
+    "ThreadPoolExecutor": "executor",
+    "ProcessPoolExecutor": "executor",
+    "urllib.request.urlopen": "http response",
+    "urlopen": "http response",
+}
+FALLIBLE_SETUP = {"bind", "listen", "connect", "wrap_socket", "connect_ex"}
+CLOSERS = {"close", "shutdown", "detach", "terminate", "kill"}
+
+
+def _ctor_kind(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    dotted = dotted_name(fn)
+    if dotted in RESOURCE_CTORS:
+        return RESOURCE_CTORS[dotted]
+    if isinstance(fn, ast.Name) and fn.id in RESOURCE_CTORS:
+        return RESOURCE_CTORS[fn.id]
+    return None
+
+
+def _functions(mod: Module):
+    if mod.tree is None:
+        return
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _own_nodes(fn: ast.AST) -> list[ast.AST]:
+    """Every AST node of the function EXCLUDING nested def/lambda bodies —
+    those are scanned as their own functions."""
+    out: list[ast.AST] = []
+
+    def collect(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            out.append(child)
+            collect(child)
+
+    collect(fn)
+    return out
+
+
+def _name_escapes(nodes: list[ast.AST], name: str, after_line: int) -> bool:
+    """True when `name` is closed, with-managed, returned/yielded, stored
+    on an object, or passed to a call anywhere later in the function."""
+    for node in nodes:
+        if getattr(node, "lineno", 0) < after_line:
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Name) \
+                        and item.context_expr.id == name:
+                    return True
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == name \
+                    and node.func.attr in CLOSERS:
+                return True
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and isinstance(getattr(node, "value", None), ast.Name) \
+                and node.value.id == name:
+            return True
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == name:
+                    return True  # ownership transferred to an object
+    return False
+
+
+def _in_try(nodes: list[ast.AST], lineno: int) -> bool:
+    for node in nodes:
+        if isinstance(node, ast.Try):
+            # Only the guarded BODY counts: a fallible call sitting in an
+            # except/else/finally of some unrelated try still leaks on
+            # raise — nothing there catches it to close the resource.
+            start = node.body[0].lineno
+            end = getattr(node.body[-1], "end_lineno", node.body[-1].lineno)
+            if start <= lineno <= end:
+                return True
+    return False
+
+
+def _raises_ranges(nodes: list[ast.AST]) -> list[tuple[int, int]]:
+    """Line ranges of `with pytest.raises(...)` bodies — resource ctors
+    there are expected to raise, not to produce a resource."""
+    out = []
+    for node in nodes:
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call) \
+                    and dotted_name(expr.func) in ("pytest.raises", "raises"):
+                out.append((node.lineno, getattr(node, "end_lineno", node.lineno)))
+    return out
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        for fn in _functions(mod):
+            qual = mod.qualname_at(fn.lineno)
+            nodes = _own_nodes(fn)
+            raises_spans = _raises_ranges(nodes)
+            for node in nodes:
+                # Bare constructor as an expression statement: unclosable.
+                if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                    kind = _ctor_kind(node.value)
+                    if kind is not None and not any(
+                        a <= node.lineno <= b for a, b in raises_spans
+                    ):
+                        findings.append(mod.finding(
+                            "resource-unclosed", node.lineno,
+                            f"{qual}:discarded-{kind}",
+                            f"{kind} created and immediately discarded — "
+                            "nothing can ever close it",
+                        ))
+                if not isinstance(node, ast.Assign) \
+                        or not isinstance(node.value, ast.Call):
+                    continue
+                kind = _ctor_kind(node.value)
+                if kind is None:
+                    continue
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    if not _name_escapes(nodes, tgt.id, node.lineno):
+                        findings.append(mod.finding(
+                            "resource-unclosed", node.lineno,
+                            f"{qual}:{tgt.id}",
+                            f"{kind} `{tgt.id}` is never closed, "
+                            "with-managed, or handed off in this function",
+                        ))
+                elif isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    # Error-path leak: fallible setup on the fresh resource,
+                    # in the same function, outside any try.
+                    attr = tgt.attr
+                    for later in nodes:
+                        if getattr(later, "lineno", 0) <= node.lineno:
+                            continue
+                        if isinstance(later, ast.Call) \
+                                and isinstance(later.func, ast.Attribute) \
+                                and later.func.attr in FALLIBLE_SETUP:
+                            recv = later.func.value
+                            if isinstance(recv, ast.Attribute) \
+                                    and isinstance(recv.value, ast.Name) \
+                                    and recv.value.id == "self" \
+                                    and recv.attr == attr \
+                                    and not _in_try(nodes, later.lineno):
+                                findings.append(mod.finding(
+                                    "resource-ctor-leak", later.lineno,
+                                    f"{qual}:{attr}.{later.func.attr}",
+                                    f"self.{attr}.{later.func.attr}() can "
+                                    f"raise and leak the {kind} created at "
+                                    f"line {node.lineno} — wrap setup in "
+                                    "try/except that closes it",
+                                ))
+                                break
+    return findings

@@ -1,0 +1,177 @@
+"""Span/metric hygiene pass: observability that can't leak or lie.
+
+`span-context-manager` — every `*.span(...)` call must be entered: used
+directly as a `with` item, used as a decorator, or assigned to a local
+name that a later `with` in the same function enters (the paged
+engine's `dispatch_span = trace.span(...)` / `with dispatch_span:`
+shape). A span constructed and never entered never closes, skewing
+duration attribution and leaking the thread-local span stack.
+
+`metric-name-literal` / `span-name-literal` — in lws_tpu/ source (the
+catalogue checker's scope), metric and span names must be string
+literals at the emission site: the docs catalogue
+checker (tools/check_metrics_catalogue.py) anchors on literal first
+arguments, so a dynamically-built name silently escapes the catalogue
+contract that dashboards are built against. Forwarding shims whose
+whole job is to pass a caller-supplied name through (core/slo.py's
+`_observe`) carry an inline suppression with the reason.
+
+The registry implementation itself (lws_tpu/core/metrics.py) is exempt
+from `metric-name-literal`: its module-level `inc`/`observe`/`set`
+helpers forward their `name` parameter by design, and every caller-side
+emission is still checked.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.vet.core import Finding, Module
+
+PASS_NAME = "spans"
+
+METRIC_METHODS = {"inc", "observe", "set", "describe"}
+METRIC_EXEMPT_FILES = {"lws_tpu/core/metrics.py"}
+
+
+def _is_metrics_receiver(node: ast.expr) -> bool:
+    """`metrics`, `self.metrics`, `cp.metrics`, `REGISTRY`, `_own_metrics`:
+    a Name or attribute chain whose final segment names a metrics object
+    (same shape the catalogue checker walks for)."""
+    if isinstance(node, ast.Name):
+        return node.id in ("metrics", "metricsmod", "REGISTRY") \
+            or "metrics" in node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("metrics", "REGISTRY") or "metrics" in node.attr
+    return False
+
+
+def _scopes(tree: ast.Module) -> list[list[ast.AST]]:
+    """Split a module into per-scope node lists: the module body and each
+    function, each EXCLUDING nested def/lambda bodies. The entered-span
+    check must match assigned names within ONE scope — a `with sp:` in
+    another function must not launder a leaked span that shares the
+    variable name."""
+    scopes: list[list[ast.AST]] = []
+
+    def collect(root: ast.AST) -> list[ast.AST]:
+        own: list[ast.AST] = []
+
+        def inner(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    # Decorators/defaults evaluate in the ENCLOSING scope.
+                    for dec in getattr(child, "decorator_list", []):
+                        own.append(dec)
+                        inner(dec)
+                    continue
+                own.append(child)
+                inner(child)
+
+        inner(root)
+        return own
+
+    scopes.append(collect(tree))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(collect(node))
+    return scopes
+
+
+def _unentered_spans(scope: list[ast.AST], decorator_ids: set[int]) -> list[ast.Call]:
+    """`.span(...)` calls in one scope that are never entered: not a with
+    item, not a decorator, not assigned to a name a `with` in the SAME
+    scope enters."""
+    with_items: set[int] = set()
+    with_names: set[str] = set()
+    assigned: dict[int, str] = {}  # id(call) -> target name
+    for node in scope:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_items.add(id(item.context_expr))
+                if isinstance(item.context_expr, ast.Name):
+                    with_names.add(item.context_expr.id)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                assigned[id(node.value)] = node.targets[0].id
+    bad = []
+    for node in scope:
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Attribute) and node.func.attr == "span"):
+            continue
+        if id(node) in with_items or id(node) in decorator_ids:
+            continue
+        target = assigned.get(id(node))
+        if target is not None and target in with_names:
+            continue
+        bad.append(node)
+    return bad
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if mod.tree is None:
+            continue
+        # Decorator expressions (`@tracer.trace(...)`-style shapes) are
+        # exempt everywhere: the wrapper enters the span at call time.
+        decorator_ids: set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                for dec in node.decorator_list:
+                    for sub in ast.walk(dec):
+                        decorator_ids.add(id(sub))
+        for scope in _scopes(mod.tree):
+            for node in _unentered_spans(scope, decorator_ids):
+                findings.append(mod.finding(
+                    "span-context-manager", node.lineno,
+                    f"{mod.qualname_at(node.lineno)}:span",
+                    "span created but never entered — use `with ....span(...):`"
+                    " (or enter the assigned name in the same function)",
+                ))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            # Name-literal rules apply to lws_tpu/ only: the catalogue
+            # checker's contract covers shipped source — a test building
+            # span names in a loop can't leak into dashboards.
+            in_catalogue_scope = mod.rel.startswith("lws_tpu/")
+            # Span names: literal first argument.
+            if isinstance(fn, ast.Attribute) and fn.attr == "span":
+                if in_catalogue_scope and node.args and not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    findings.append(mod.finding(
+                        "span-name-literal", node.lineno,
+                        f"{mod.qualname_at(node.lineno)}:span-name",
+                        "span name must be a string literal (the catalogue "
+                        "checker can't see a computed name)",
+                    ))
+                continue
+            # Metric names: literal first argument on metrics receivers.
+            is_describe = (
+                isinstance(fn, ast.Name) and fn.id == "describe"
+            ) or (isinstance(fn, ast.Attribute) and fn.attr == "describe")
+            is_metric_method = (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in METRIC_METHODS
+                and _is_metrics_receiver(fn.value)
+            )
+            if not (is_describe or is_metric_method):
+                continue
+            if mod.rel in METRIC_EXEMPT_FILES or not in_catalogue_scope:
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                findings.append(mod.finding(
+                    "metric-name-literal", node.lineno,
+                    f"{mod.qualname_at(node.lineno)}:metric-name",
+                    "metric name must be a string literal so the docs "
+                    "catalogue checker stays sound",
+                ))
+    return findings

@@ -1,0 +1,143 @@
+"""Style pass: the old tools/lint.py checks, folded in unchanged.
+
+Rules (ids prefixed `style-`): syntax errors, unused imports (suppressed
+by `# noqa` on the import line or an __all__/string mention, exactly as
+before), bare `except:`, mutable default arguments, `== None`
+comparisons, f-strings with no placeholders, trailing whitespace, and
+tabs in indentation. `make lint` now aliases `python -m tools.vet --only
+style`, so existing muscle memory keeps working.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.vet.core import Finding, Module
+
+PASS_NAME = "style"
+
+# Files whose imports are intentional re-exports or side-effects.
+REEXPORT_OK = {"__init__.py", "conftest.py"}
+
+
+class _StyleVisitor(ast.NodeVisitor):
+    def __init__(self, mod: Module) -> None:
+        self.mod = mod
+        self.problems: list[tuple[int, str, str]] = []  # (line, detail, msg)
+        self.imported: dict[str, int] = {}
+        self.used: set[str] = set()
+        assert mod.tree is not None
+        self.visit(mod.tree)
+
+    def problem(self, rule_detail: str, lineno: int, msg: str) -> None:
+        self.problems.append((lineno, rule_detail, msg))
+
+    # -- imports -----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imported.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # effective by existing, never "used"
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported.setdefault(a.asname or a.name, node.lineno)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    # -- other checks ------------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.problem("bare-except", node.lineno,
+                         "bare `except:` (catch something specific)")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        for default in list(node.args.defaults) + list(node.args.kw_defaults):
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.problem("mutable-default", default.lineno,
+                             "mutable default argument")
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                isinstance(comp, ast.Constant) and comp.value is None
+            ):
+                self.problem("eq-none", node.lineno, "`== None` (use `is None`)")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.problem("fstring", node.lineno, "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # Visit the value only: a format spec like {x:.1f} parses as a
+        # nested JoinedStr with no placeholders — not a lint problem.
+        self.visit(node.value)
+
+    def unused_imports(self) -> list[tuple[int, str, str]]:
+        out = []
+        source = self.mod.source
+        for name, lineno in self.imported.items():
+            if name in self.used or name == "_":
+                continue
+            # `# noqa` on the import line suppresses (matches existing style).
+            if "noqa" in self.mod.line(lineno):
+                continue
+            # __all__ mention counts as use.
+            if f'"{name}"' in source or f"'{name}'" in source:
+                continue
+            out.append((lineno, name, f"unused import `{name}`"))
+        return out
+
+
+_RULE_BY_DETAIL = {
+    "bare-except": "style-bare-except",
+    "mutable-default": "style-mutable-default",
+    "eq-none": "style-eq-none",
+    "fstring": "style-fstring",
+}
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in modules:
+        if mod.syntax_error is not None:
+            e = mod.syntax_error
+            out.append(mod.finding(
+                "style-syntax", e.lineno or 1, "syntax",
+                f"syntax error: {e.msg}",
+            ))
+            continue
+        visitor = _StyleVisitor(mod)
+        # Details are line-FREE (the key contract: unrelated edits above a
+        # finding must not churn the baseline); multiple occurrences in
+        # one scope are distinguished by the baseline's occurrence counts.
+        for lineno, detail, msg in visitor.problems:
+            rule = _RULE_BY_DETAIL[detail]
+            out.append(mod.finding(rule, lineno, detail, msg))
+        if mod.path.name not in REEXPORT_OK:
+            for lineno, name, msg in visitor.unused_imports():
+                out.append(mod.finding("style-unused-import", lineno, name, msg))
+        for i, text in enumerate(mod.lines, 1):
+            if text.rstrip() != text:
+                out.append(mod.finding(
+                    "style-trailing-ws", i, "line", "trailing whitespace"
+                ))
+            stripped = text.lstrip("\t ")
+            if "\t" in text[: len(text) - len(stripped)]:
+                out.append(mod.finding(
+                    "style-tab-indent", i, "line", "tab in indentation"
+                ))
+    return out
