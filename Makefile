@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue check native bench bench-trace-overhead bench-decode-overlap clean
+.PHONY: test lint vet metrics-catalogue check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead clean
 
 test:
 	python -m pytest tests/ -q
@@ -15,7 +15,10 @@ metrics-catalogue:  ## every metric/span name in source must be in docs/observab
 bench-decode-overlap:  ## pipelined decode must beat the sync loop's host-blocked fraction (budget json)
 	python benchmarks/decode_overlap_bench.py --check
 
-check: vet metrics-catalogue test bench-decode-overlap  ## what CI would run (vet gates before tests)
+bench-profile-overhead:  ## the stack sampler at default hz must cost <2% decode throughput (budget json)
+	python benchmarks/profile_overhead_bench.py --check
+
+check: vet metrics-catalogue test bench-decode-overlap bench-profile-overhead  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

@@ -153,6 +153,11 @@ def cmd_serve(args) -> int:
         auth = TokenAuth.load(args.token_file)
         print(f"API authentication on ({len(auth.entries)} token(s) from "
               f"{args.token_file}; /healthz and /readyz stay open)")
+    from lws_tpu.core import profile as profmod
+
+    if profmod.start_from_env() is not None:
+        print(f"continuous profiler on at {profmod.PROFILER.hz:g} Hz "
+              "(GET /debug/profile)")
     server = ApiServer(cp, port=args.port, tls=tls, auth=auth)
     dirty = {"flag": True}  # always persist once after boot
     if args.state_file:
@@ -545,6 +550,17 @@ def _top_rows(fams: dict) -> dict:
     fold("serving_inflight_dispatches", "inflight")
     fold("serving_slo_attainment", "slo", reducer=lambda old, v: v)
     fold("serving_decode_dispatch_duration_seconds", "dispatches")
+    fold("serving_prefix_cache_hits_total", "pfx_hits")
+    fold("serving_prefix_cache_misses_total", "pfx_misses")
+
+    # KV-pool occupancy: the state-labelled block gauge folds into per-row
+    # kv_free/kv_live/kv_parked; render_top derives live/(free+live+parked).
+    for name, labels, value, _ in fams.get("serving_kv_pool_blocks", {}).get("samples", []):
+        if name != "serving_kv_pool_blocks":
+            continue
+        r = row(labels)
+        field = f"kv_{labels.get('state', '?')}"
+        r[field] = r.get(field, 0.0) + value
 
     for family, field in (("serving_ttft_seconds", "ttft"),
                           ("serving_itl_seconds", "itl")):
@@ -585,7 +601,8 @@ def render_top(fams: dict, alerts: dict | None = None,
             lines.append(f"  ALERT {name}: {json.dumps(d)}")
     lines.append(
         f"{'INSTANCE':<18}{'ENGINE':<9}{'SLO':>6}{'REQS':>7}{'ACTIVE':>7}"
-        f"{'INFL':>6}{'TTFT_P95':>10}{'ITL_P95':>10}{'DISP/S':>8}"
+        f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{'TTFT_P95':>10}{'ITL_P95':>10}"
+        f"{'DISP/S':>8}"
     )
 
     def fmt(v, pattern="{:.3f}", dash="-"):
@@ -598,12 +615,25 @@ def render_top(fams: dict, alerts: dict | None = None,
         if prev is not None and dt_s:
             before = prev.get((instance, engine), {}).get("dispatches", 0.0)
             rate = max(0.0, r.get("dispatches", 0.0) - before) / dt_s
+        # KV-pool occupancy (live / pool) and prefix-cache hit rate — the
+        # capacity columns: a row pinned near 100% KV with a low hit rate
+        # is the backpressure case paging exists to relieve.
+        kv = None
+        pool = r.get("kv_free", 0.0) + r.get("kv_live", 0.0) + r.get("kv_parked", 0.0)
+        if pool > 0:
+            kv = r.get("kv_live", 0.0) / pool
+        pfx = None
+        lookups = r.get("pfx_hits", 0.0) + r.get("pfx_misses", 0.0)
+        if lookups > 0:
+            pfx = r.get("pfx_hits", 0.0) / lookups
         lines.append(
             f"{instance:<18}{engine:<9}"
             f"{fmt(r.get('slo'), '{:.2f}'):>6}"
             f"{fmt(r.get('requests'), '{:.0f}'):>7}"
             f"{fmt(r.get('active'), '{:.0f}'):>7}"
             f"{fmt(r.get('inflight'), '{:.0f}'):>6}"
+            f"{fmt(kv, '{:.0%}'):>6}"
+            f"{fmt(pfx, '{:.0%}'):>6}"
             f"{fmt(r.get('ttft_p95'), '{:.3f}s'):>10}"
             f"{fmt(r.get('itl_p95'), '{:.4f}s'):>10}"
             f"{fmt(rate, '{:.1f}'):>8}"
@@ -665,6 +695,93 @@ def cmd_top(args) -> int:
         sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
         sys.stdout.flush()
         prev, prev_t = rows, now
+        time.sleep(args.interval)
+
+
+def render_profile(instances: list, top_n: int = 15) -> str:
+    """One frame of `lws-tpu profile`: per-span self-time and top-of-stack
+    tables folded from /debug/profile snapshots. `instances` is
+    [(instance_name, snapshot)] — one entry for a single-process fetch, one
+    per worker for the fleet surface. Pure function of the snapshots so
+    tests drive it from canned stacks."""
+    from lws_tpu.core.profile import fold_by_span, top_frames
+
+    total = sum(s.get("samples", 0) for _, s in instances)
+    sampling = "on" if any(s.get("enabled") for _, s in instances) else "off"
+    lines = [
+        f"PROFILE  instances={len(instances)}  samples={total}  sampling={sampling}",
+        "",
+        f"{'INSTANCE':<18}{'SPAN':<28}{'SAMPLES':>9}{'SELF%':>7}",
+    ]
+    for name, snap in instances:
+        folded = sorted(
+            fold_by_span(snap.get("stacks", [])).items(), key=lambda kv: -kv[1]
+        )
+        denom = sum(c for _, c in folded) or 1  # limit-truncated totals
+        for span_name, count in folded[:top_n]:
+            lines.append(
+                f"{name:<18}{span_name:<28}{count:>9}{count / denom:>7.0%}"
+            )
+    lines.append("")
+    lines.append(f"{'TOP OF STACK':<46}{'SAMPLES':>9}{'SELF%':>7}")
+    merged: dict = {}
+    for _, snap in instances:
+        for frame, count in top_frames(snap.get("stacks", [])).items():
+            merged[frame] = merged.get(frame, 0) + count
+    denom = sum(merged.values()) or 1
+    for frame, count in sorted(merged.items(), key=lambda kv: -kv[1])[:top_n]:
+        lines.append(f"{frame[-46:]:<46}{count:>9}{count / denom:>7.0%}")
+    return "\n".join(lines)
+
+
+def cmd_profile(args) -> int:
+    """Where the time went: fetch `/debug/profile` (or the instance-labelled
+    merge at `/debug/profile/fleet` with --fleet) and render per-span plus
+    top-of-stack self-time tables. --collapsed dumps the raw Brendan-Gregg
+    collapsed stacks instead — pipeable straight into flamegraph.pl."""
+    path = "/debug/profile/fleet" if args.fleet else "/debug/profile"
+    if args.collapsed:
+        if args.watch:
+            raise SystemExit(
+                "error: --collapsed is a one-shot dump for flamegraph "
+                "tooling; drop --watch"
+            )
+        url = (f"{_server_base(args.server)}{path}"
+               f"?format=collapsed&limit={args.limit}")
+        req = urllib.request.Request(url, headers=_auth_headers())
+        try:
+            with urllib.request.urlopen(req, context=_url_context(url)) as resp:
+                sys.stdout.write(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            # Same error surfacing as _http(): the server WAS reached — show
+            # its detail (bad limit, missing token), not "cannot reach".
+            detail = e.read().decode()
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except (ValueError, AttributeError):
+                pass
+            raise SystemExit(f"error: {e.code}: {detail}") from None
+        except urllib.error.URLError as e:
+            raise SystemExit(
+                f"error: cannot reach server {args.server}: {e.reason}"
+            ) from None
+        return 0
+    args.interval = max(args.interval, 1.0)
+    while True:
+        body = _http(args.server, "GET", f"{path}?limit={args.limit}")
+        if args.fleet:
+            instances = [
+                (entry.get("labels", {}).get("instance", "-"), entry["profile"])
+                for entry in body.get("instances", [])
+            ]
+        else:
+            instances = [("-", body)]
+        frame = render_profile(instances, top_n=args.top)
+        if not args.watch:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
         time.sleep(args.interval)
 
 
@@ -810,6 +927,24 @@ def main(argv=None) -> int:
                     help="redraw every --interval seconds (rates need two frames)")
     tp.add_argument("--interval", type=float, default=2.0)
     tp.set_defaults(fn=cmd_top)
+
+    prf = sub.add_parser("profile", help="continuous-profiling view: per-span "
+                         "and top-of-stack self-time (from /debug/profile)")
+    prf.add_argument("--server", default="127.0.0.1:9443")
+    prf.add_argument("--fleet", action="store_true",
+                     help="merge every ready worker's profile "
+                          "(/debug/profile/fleet, instance-labelled)")
+    prf.add_argument("--watch", action="store_true",
+                     help="redraw every --interval seconds")
+    prf.add_argument("--interval", type=float, default=2.0)
+    prf.add_argument("--top", type=int, default=15,
+                     help="rows per table")
+    prf.add_argument("--limit", type=int, default=512,
+                     help="heaviest collapsed stacks to fetch per instance")
+    prf.add_argument("--collapsed", action="store_true",
+                     help="print raw collapsed stacks (flamegraph.pl input) "
+                          "instead of tables")
+    prf.set_defaults(fn=cmd_profile)
 
     ep = sub.add_parser("events", help="controller decision trace (k8s Events)")
     ep.add_argument("name", nargs="?")

@@ -138,7 +138,12 @@ class FlightRecorder:
     def dump(self, reason: str = "manual", registries: tuple = (),
              span_limit: int = 256) -> dict:
         """The diagnostics bundle: everything an operator needs to explain
-        the window that just went wrong, in one JSON-serializable dict."""
+        the window that just went wrong, in one JSON-serializable dict —
+        including the process profile, so a stall alert ships the collapsed
+        stacks of the window that stalled (local import: profile.py is a
+        consumer of this module's surfaces, not a dependency)."""
+        from lws_tpu.core import profile as profmod
+
         exposition = (
             metrics.render_exposition(metrics.REGISTRY, *registries)
             if registries else metrics.REGISTRY.render()
@@ -150,6 +155,7 @@ class FlightRecorder:
             "heartbeats": self.heartbeats(),
             "spans": trace.TRACER.spans(span_limit),
             "metrics": exposition,
+            "profile": profmod.PROFILER.snapshot(limit=128),
         }
 
 

@@ -466,4 +466,13 @@ describe("lws_watchdog_active", "1 while the named watchdog alert is firing, els
 describe("lws_flightrecorder_events_total", "Structured events appended to the flight-recorder ring")
 # --- fleet aggregation (runtime/fleet.py) ----------------------------------
 describe("lws_fleet_instances", "Ready workers the fleet scraper merged on the last pass")
-describe("lws_fleet_scrape_errors_total", "Worker /metrics scrapes that failed, per instance")
+describe("lws_fleet_scrape_errors_total", "Worker telemetry scrapes (/metrics or /debug/profile) that failed, per instance")
+# --- continuous profiling + capacity accounting (core/profile.py) ----------
+describe("lws_profile_samples_total", "Thread samples folded into the collapsed-stack table by the wall-clock sampler")
+describe("lws_profile_stacks_dropped_total", "Samples whose NOVEL stack was dropped by the bounded collapsed-stack table")
+describe("serving_hbm_bytes_in_use", "Device memory in use per local device (jax allocator stats; absent on CPU)")
+describe("serving_hbm_bytes_limit", "Device memory capacity per local device (jax allocator stats; absent on CPU)")
+describe("serving_kv_pool_blocks", "Paged KV pool blocks by state (free / live / parked) — states sum to the pool size minus the null block")
+describe("serving_prefix_cache_hits_total", "Prefix-cache block lookups served from the pool (tokens skipped = hits x block_size)")
+describe("serving_prefix_cache_misses_total", "Shareable prompt blocks that had to be prefilled (no cached prefix)")
+describe("serving_prefix_cache_evictions_total", "LRU-parked prefix blocks evicted to satisfy new allocations")

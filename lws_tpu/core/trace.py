@@ -188,6 +188,14 @@ class Tracer:
         )
         self._export_file = None  # lazily opened append handle
         self._export_lock = threading.Lock()
+        # ident -> that thread's live span stack (the SAME list _TlsState
+        # holds, registered once per thread): the sampling profiler
+        # (core/profile.py) reads other threads' stacks from its sampler
+        # thread to tag samples by semantic phase. CPython dict/list ops
+        # are GIL-atomic; readers copy before iterating and tolerate a
+        # push/pop racing the copy (one sample mis-tagged by one frame).
+        self._thread_stacks: dict[int, list] = {}
+        self._prune_pending: set = set()  # idents absent from ONE live set
 
     # ---- span stack (thread-local: concurrent reconcile workers and
     # serving threads each nest independently) ----------------------------
@@ -202,7 +210,35 @@ class Tracer:
         state = getattr(self._tls, "state", None)
         if state is None:
             state = self._tls.state = Tracer._TlsState()
+            self._thread_stacks[threading.get_ident()] = state.stack
         return state
+
+    def stack_names(self, ident: int) -> list[str]:
+        """Span names live on thread `ident`, outermost first — the
+        profiler's phase tags. Copied so a racing push/pop cannot tear the
+        iteration; an empty/unknown thread reads as untagged."""
+        stack = self._thread_stacks.get(ident)
+        if not stack:
+            return []
+        return [s.name for s in list(stack)]
+
+    def prune_thread_stacks(self, live: set) -> None:
+        """Drop stack registrations for dead threads (idents not in `live`,
+        the sys._current_frames() key set) — without this every short-lived
+        worker thread would pin its stack list forever. Two-pass: an ident
+        is dropped only after being absent from TWO consecutive live sets.
+        A thread that registers between the caller's frame snapshot and
+        this call is missing from the (stale) first set but present in the
+        next one — one-pass pruning would deregister it while alive, and
+        since registration happens only on TLS-state creation, its samples
+        would stay untagged for the thread's whole lifetime."""
+        # list() first: other threads insert registrations concurrently
+        # (first span on a new thread), and iterating the live dict would
+        # raise "dictionary changed size during iteration".
+        doomed = {i for i in list(self._thread_stacks) if i not in live}
+        for ident in doomed & self._prune_pending:
+            self._thread_stacks.pop(ident, None)
+        self._prune_pending = doomed
 
     def _stack(self) -> list:
         return self._tls_state().stack

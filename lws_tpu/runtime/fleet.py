@@ -109,15 +109,22 @@ class FleetCollector:
             out.append((_pod_scrape_labels(pod), endpoint))
         return out
 
+    @staticmethod
+    def _scrape_headers(accept: Optional[str] = None) -> dict:
+        """Shared worker-scrape headers: optional Accept negotiation plus
+        the same-deployment bearer token (one token, CP + workers)."""
+        headers = {"Accept": accept} if accept else {}
+        token = os.environ.get(METRICS_TOKEN_ENV)
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        return headers
+
     def _scrape_one(self, host: str, port: int) -> str:
         # Negotiate OpenMetrics: the merge must carry the workers' trace
         # exemplars (classic text-format responses have them stripped).
-        headers = {"Accept": metrics.OPENMETRICS_CONTENT_TYPE}
-        token = os.environ.get(METRICS_TOKEN_ENV)
-        if token:  # same-deployment convention: one token, CP + workers
-            headers["Authorization"] = f"Bearer {token}"
         req = urllib.request.Request(
-            f"http://{host}:{port}/metrics", headers=headers
+            f"http://{host}:{port}/metrics",
+            headers=self._scrape_headers(metrics.OPENMETRICS_CONTENT_TYPE),
         )
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return resp.read().decode()
@@ -189,6 +196,59 @@ class FleetCollector:
                 {"instance": "control-plane"},
                 metrics.render_exposition(*self.control_registries),
             ))
+        return sources
+
+    # ---- continuous-profiling fan-in (GET /debug/profile/fleet) ----------
+    def _scrape_profile(self, labels: dict, host: str, port: int,
+                        limit: int) -> Optional[dict]:
+        """One worker's /debug/profile snapshot, or None on failure (counted
+        under the same per-instance scrape-error counter as /metrics; no
+        flight-recorder edge event — profile scrapes are operator-driven
+        one-shots, not the periodic refresh whose re-fire flood the
+        _failing edge logic exists to suppress)."""
+        import json
+
+        req = urllib.request.Request(
+            f"http://{host}:{port}/debug/profile?limit={int(limit)}",
+            headers=self._scrape_headers(),
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                snap = json.loads(resp.read().decode())
+            if not isinstance(snap, dict) or "stacks" not in snap:
+                raise ValueError("malformed profile snapshot")
+            return snap
+        except (OSError, ValueError, HTTPException):
+            self._own_metrics.inc(
+                "lws_fleet_scrape_errors_total", {"instance": labels["instance"]},
+            )
+            return None
+
+    def collect_profiles(self, limit: int = 512) -> list[tuple[dict, dict]]:
+        """[(labels, profile snapshot)] over the ready fleet plus this
+        process as instance "control-plane" — the /debug/profile analog of
+        collect(). Operator-driven (no cache: `lws-tpu profile` polls at
+        human rates, and snapshots are cumulative anyway)."""
+        from lws_tpu.core import profile as profmod
+
+        sources: list[tuple[dict, dict]] = [
+            ({"instance": "control-plane"}, profmod.PROFILER.snapshot(limit))
+        ]
+        targets = self.targets()
+        if targets:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with profmod.phase("fleet.profile_scrape"):
+                with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+                    scraped = pool.map(
+                        lambda t: self._scrape_profile(t[0], *t[1], limit),
+                        targets,
+                    )
+                    sources.extend(
+                        (labels, snap)
+                        for (labels, _), snap in zip(targets, scraped)
+                        if snap is not None
+                    )
         return sources
 
     def render_fleet(self, force: bool = False) -> str:

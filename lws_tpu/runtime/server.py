@@ -16,6 +16,13 @@ Endpoints:
   GET  /debug/flightrecorder[?limit=N] flight-recorder snapshot: event ring,
                                       heartbeats, active watchdog alerts,
                                       and the last alert's diagnostics dump
+  GET  /debug/profile[?limit=N&format=json|collapsed]
+                                      this process's collapsed-stack profile
+                                      (core/profile.py; collapsed = raw
+                                      flamegraph.pl input)
+  GET  /debug/profile/fleet           every ready worker's /debug/profile,
+                                      merged with instance/role labels
+                                      (runtime/fleet.py)
   POST /apply                         YAML/JSON manifest (create-or-update)
   GET  /apis/{kind}                   list (JSON manifests)
   GET  /apis/{kind}/{ns}/{name}       get
@@ -197,7 +204,11 @@ class ApiServer:
                     # report into (a live worker embedding both is
                     # inspectable from one scrape).
                     from lws_tpu.core import metrics as metricsmod
+                    from lws_tpu.core import profile as profmod
 
+                    # Device-memory gauges refresh per scrape (CPU-safe
+                    # no-op without allocator stats).
+                    profmod.record_device_memory()
                     regs = (cp.metrics,) if cp.metrics is metricsmod.REGISTRY \
                         else (cp.metrics, metricsmod.REGISTRY)
                     self._send_exposition(metricsmod.render_exposition(*regs))
@@ -240,6 +251,44 @@ class ApiServer:
                     self._json(200, frmod.debug_snapshot(
                         limit, getattr(cp, "watchdog", None)
                     ))
+                elif path in ("/debug/profile", "/debug/profile/fleet"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    from lws_tpu.core import profile as profmod
+                    from lws_tpu.runtime.telemetry import (
+                        parse_limit,
+                        parse_profile_format,
+                    )
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = parse_limit(q, default=512)
+                        fmt = parse_profile_format(q)
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad query: {e}"})
+                        return
+                    if path == "/debug/profile":
+                        if fmt == "collapsed":
+                            self._send(200, profmod.PROFILER.collapsed(limit),
+                                       "text/plain")
+                        else:
+                            self._json(200, profmod.PROFILER.snapshot(limit))
+                        return
+                    # Fleet-merged: every ready worker's /debug/profile,
+                    # instance/role-labelled like /metrics/fleet.
+                    fleet = getattr(cp, "fleet", None)
+                    if fleet is None:
+                        self._json(404, {"error": "fleet collector not wired"})
+                        return
+                    sources = fleet.collect_profiles(limit)
+                    if fmt == "collapsed":
+                        self._send(200, profmod.merge_collapsed(sources),
+                                   "text/plain")
+                    else:
+                        self._json(200, {"instances": [
+                            {"labels": labels, "profile": snap}
+                            for labels, snap in sources
+                        ]})
                 elif len(parts) == 2 and parts[0] == "apis":
                     try:
                         objs = cp.store.list(_kind(parts[1]))

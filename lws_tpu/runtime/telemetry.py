@@ -8,6 +8,9 @@ engine) exposes its process-default registries over one tiny HTTP server:
   GET /debug/traces?limit=N  recent spans from the process trace.TRACER
   GET /debug/flightrecorder  the process flight-recorder snapshot (ring +
                              heartbeats; ?limit=N bounds the event list)
+  GET /debug/profile         the process profiler's collapsed-stack table
+                             (?format=collapsed for raw flamegraph input,
+                             ?limit=N keeps the heaviest N stacks)
   GET /healthz               liveness
 
 Workers declare the port via LWS_TPU_METRICS_PORT in their pod env — the
@@ -44,6 +47,20 @@ def parse_limit(query: dict, default: int = 256) -> int:
     return limit
 
 
+PROFILE_FORMATS = ("json", "collapsed")
+
+
+def parse_profile_format(query: dict) -> str:
+    """Parse a /debug/profile ?format= value; unknown formats raise
+    ValueError (same 400-never-500 contract as parse_limit)."""
+    fmt = query.get("format", ["json"])[0]
+    if fmt not in PROFILE_FORMATS:
+        raise ValueError(
+            f"format must be one of {', '.join(PROFILE_FORMATS)}, got {fmt!r}"
+        )
+    return fmt
+
+
 class TelemetryServer:
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
                  watchdog=None, token: Optional[str] = None) -> None:
@@ -52,6 +69,7 @@ class TelemetryServer:
         path except /healthz behind `Authorization: Bearer <token>`."""
         from lws_tpu.core import flightrecorder as frmod
         from lws_tpu.core import metrics as metricsmod
+        from lws_tpu.core import profile as profmod
         from lws_tpu.core import trace as tracemod
 
         self.watchdog = watchdog
@@ -89,10 +107,28 @@ class TelemetryServer:
                                "application/json")
                     return
                 if path == "/metrics":
+                    # Device-memory gauges are state, not a feed: refresh
+                    # them per scrape (guarded no-op on CPU backends).
+                    profmod.record_device_memory()
                     body, ctype = metricsmod.negotiate_exposition(
                         metricsmod.REGISTRY.render(), self.headers.get("Accept")
                     )
                     self._send(200, body, ctype)
+                elif path == "/debug/profile":
+                    try:
+                        limit = parse_limit(q, default=512)
+                        fmt = parse_profile_format(q)
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": f"bad query: {e}"}),
+                                   "application/json")
+                        return
+                    if fmt == "collapsed":
+                        self._send(200, profmod.PROFILER.collapsed(limit),
+                                   "text/plain")
+                    else:
+                        self._send(200,
+                                   json.dumps(profmod.PROFILER.snapshot(limit)),
+                                   "application/json")
                 elif path == "/debug/traces":
                     try:
                         limit = parse_limit(q)
@@ -135,14 +171,18 @@ def start_from_env() -> Optional[TelemetryServer]:
     """Start the telemetry server on the pod-declared port, with a
     worker-side Watchdog evaluating the default stall/hot-loop/backlog
     rules over this process's heartbeats; None when the env doesn't declare
-    a port (telemetry is opt-in per pod spec)."""
+    a port (telemetry is opt-in per pod spec). Also starts the continuous
+    profiler when LWS_TPU_PROFILE_HZ declares a rate — its /debug/profile
+    surface rides this server."""
     import os
 
+    from lws_tpu.core import profile as profmod
     from lws_tpu.core.flightrecorder import Watchdog
 
     raw = os.environ.get(METRICS_PORT_ENV)
     if not raw:
         return None
+    profmod.start_from_env()
     server = TelemetryServer(
         port=int(raw),
         watchdog=Watchdog(),

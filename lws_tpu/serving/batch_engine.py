@@ -169,6 +169,7 @@ class BatchEngine:
             self._free.append(slot)
         else:
             self._active[slot] = req
+        metrics.set("serving_active_slots", len(self._active), {"engine": "batch"})
         return req.request_id
 
     def step(self) -> None:  # hot-path
@@ -215,6 +216,10 @@ class BatchEngine:
                         if self._active.get(slot) is req:
                             del self._active[slot]
                             self._free.append(slot)
+                            metrics.set(
+                                "serving_active_slots", len(self._active),
+                                {"engine": "batch"},
+                            )
 
             self._pipeline.push(1, self.tokens, commit)
         metrics.observe(

@@ -143,6 +143,21 @@ def host_sync(x) -> None:
     np.asarray(x)  # vet: ignore[hotpath-host-sync]: host_sync IS the named fence — callers invoke it exactly where a sync is the point
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def _occupancy_gauge(engine: str):
+    """serving_active_slots for the request-scoped dense engine: 1 while a
+    generate holds the batch, back to 0 on ANY exit — an exception mid-
+    request must not leave a phantom active slot on the fleet view."""
+    metrics.set("serving_active_slots", 1.0, {"engine": engine})
+    try:
+        yield
+    finally:
+        metrics.set("serving_active_slots", 0.0, {"engine": engine})
+
+
 @dataclass
 class GenerationResult:
     # [B, steps]; host np.ndarray from the pipelined generate() (tokens were
@@ -482,7 +497,7 @@ class Engine:
         with trace.span(
             "serve.request", engine="dense", speculative=True,
             prompt_len=int(prompt.shape[1]), max_new_tokens=max_new_tokens,
-        ) as request_span:
+        ) as request_span, _occupancy_gauge("dense"):
             timeline = slo.request("dense")
             t0 = time.perf_counter()
             with trace.span("serve.prefill", chunked=False,
@@ -577,7 +592,7 @@ class Engine:
             "serve.request", engine="dense", prompt_len=int(prompt.shape[1]),
             max_new_tokens=max_new_tokens,
         )
-        with request_span:
+        with request_span, _occupancy_gauge("dense"):
             timeline = slo.request("dense")
             t0 = time.perf_counter()
             with trace.span("serve.prefill", chunked=False,

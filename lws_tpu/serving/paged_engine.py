@@ -188,6 +188,10 @@ class PagedBatchEngine:
         self._block_refs: dict[int, int] = {}        # shareable-block refs
         self._lru: "dict[int, None]" = {}            # refcount-0, evictable
         self.stats_prefix = {"hit_tokens": 0, "hit_blocks": 0, "evictions": 0}
+        # Request mid-chunked-admission: holds allocated blocks but is not
+        # in _active yet — pool_accounting counts its blocks as live so the
+        # interleaved decode steps' gauge updates stay conserved.
+        self._admitting: Optional[PagedRequest] = None
 
         cfg_static = cfg
         self._cfg_static = cfg
@@ -429,6 +433,7 @@ class PagedBatchEngine:
         # pipeline (two [slots, V] sorts + softmax + cumsum + categorical)
         # would tax every decode step of the benchmarked path for nothing.
         self._step_cache: dict = {}
+        self._update_pool_gauges()  # capacity gauges valid from first scrape
 
     def _get_step_fn(self, sample: bool):
         donate = self._kernel_probed and self._donate_steps
@@ -531,6 +536,35 @@ class PagedBatchEngine:
         # toward the backpressure signal.
         return len(self._free_blocks) + len(self._lru)
 
+    def pool_accounting(self) -> dict[str, int]:
+        """Block-pool state counts. `live` is computed from the blocks
+        requests ACTUALLY hold (not derived as the residual), so the
+        conservation invariant — free + live + parked == num_blocks - 1,
+        block 0 being the never-allocated null block — genuinely detects a
+        leaked or double-counted block instead of hiding it in the residual.
+        Holds at every quiescent point (pinned by
+        tests/test_profile_plane.py); a request mid-chunked-admission counts
+        as live via `_admitting`."""
+        live_blocks: set[int] = set()
+        for req in self._active.values():
+            live_blocks.update(req.blocks)
+        if self._admitting is not None:
+            live_blocks.update(self._admitting.blocks)
+        return {
+            "free": len(self._free_blocks),
+            "parked": len(self._lru),
+            "live": len(live_blocks),
+            "total": self.num_blocks - 1,
+        }
+
+    def _update_pool_gauges(self) -> None:
+        acct = self.pool_accounting()
+        for state in ("free", "live", "parked"):
+            metrics.set(
+                "serving_kv_pool_blocks", acct[state],
+                {"engine": "paged", "state": state},
+            )
+
     # ---- prefix caching ------------------------------------------------
     def _block_digests(self, prompt: np.ndarray, n: int) -> list[bytes]:
         """Position-binding hash chain over the first n full blocks: block
@@ -578,6 +612,9 @@ class PagedBatchEngine:
                     self._prefix_map.pop(digest, None)
                 self._block_refs.pop(blk, None)
                 self.stats_prefix["evictions"] += 1
+                metrics.inc(
+                    "serving_prefix_cache_evictions_total", {"engine": "paged"}
+                )
                 out.append(blk)
                 continue
             self._free_blocks = out + self._free_blocks
@@ -702,6 +739,10 @@ class PagedBatchEngine:
             metrics.set(
                 "serving_active_slots", len(self._active), {"engine": "paged"}
             )
+        # Unconditional: a REFUSED admission may still have flushed the ring
+        # (retiring requests) or evicted parked blocks — the pool gauges
+        # must reflect whatever state the attempt left behind.
+        self._update_pool_gauges()
         return rid
 
     def _submit(
@@ -926,6 +967,20 @@ class PagedBatchEngine:
             req.shared_blocks.append(blk)
         self.stats_prefix["hit_tokens"] += hit_len
         self.stats_prefix["hit_blocks"] += len(hits)
+        # Hit-rate counters (capacity accounting): hits = shareable blocks
+        # served from the pool, misses = shareable blocks this admission had
+        # to prefill. hits/(hits+misses) is the cache hit rate `lws-tpu top`
+        # renders from the fleet scrape.
+        if hits:
+            metrics.inc(
+                "serving_prefix_cache_hits_total", {"engine": "paged"},
+                value=float(len(hits)),
+            )
+        if shareable_n > len(hits):
+            metrics.inc(
+                "serving_prefix_cache_misses_total", {"engine": "paged"},
+                value=float(shareable_n - len(hits)),
+            )
         return self._finish_admission(req, first)
 
     def _get_chunk_cache(self, width: int):
@@ -964,48 +1019,60 @@ class PagedBatchEngine:
         padded = np.zeros((n_chunks * C,), np.int32)
         padded[:s_true] = req.prompt[hit_len:]
         slot = req.slot
-        if dense is None:
-            # Width must fit every append: when max_len caps the bucket to a
-            # non-power-of-two, n_chunks*C can exceed it — and a too-small
-            # cache would silently CLAMP the final dynamic_update_slice,
-            # overwriting earlier rows with wrong-position K/V. The scatter
-            # still takes only the first `bucket` rows.
-            dense = self._get_chunk_cache(max(bucket, n_chunks * C))
-        hidden = None
-        with trace.span(
-            "serve.prefill", chunked=True, chunks=n_chunks,
-            prompt_len=plen, prefix_hit_tokens=hit_len,
-        ):
-            for i in range(n_chunks):
-                chunk = jnp.asarray(padded[i * C:(i + 1) * C])[None, :]
+        # The request owns its blocks but is not in _active yet: register it
+        # so interleaved decode steps' pool-gauge updates count them live.
+        # Cleared in a finally: an exception escaping the prefill body would
+        # otherwise pin a stale registration that double-counts the dead
+        # request's blocks once they are reused — with it cleared, the
+        # abandoned blocks read as a conservation deficit, which is exactly
+        # the leak signal the accounting exists to surface.
+        self._admitting = req
+        try:
+            if dense is None:
+                # Width must fit every append: when max_len caps the bucket
+                # to a non-power-of-two, n_chunks*C can exceed it — and a
+                # too-small cache would silently CLAMP the final
+                # dynamic_update_slice, overwriting earlier rows with
+                # wrong-position K/V. The scatter still takes only the first
+                # `bucket` rows.
+                dense = self._get_chunk_cache(max(bucket, n_chunks * C))
+            hidden = None
+            with trace.span(
+                "serve.prefill", chunked=True, chunks=n_chunks,
+                prompt_len=plen, prefix_hit_tokens=hit_len,
+            ):
+                for i in range(n_chunks):
+                    chunk = jnp.asarray(padded[i * C:(i + 1) * C])[None, :]
+                    with self._mesh_ctx():
+                        hidden, dense = self._chunk_append(
+                            self.params, self._put_rep(chunk), dense
+                        )
+                    if self._active and self.interleave_steps > 0 and i < n_chunks - 1:
+                        executed = self.step_n(self.interleave_steps)
+                        self.stats["interleaved_decode_steps"] = (
+                            self.stats.get("interleaved_decode_steps", 0) + executed
+                        )
                 with self._mesh_ctx():
-                    hidden, dense = self._chunk_append(
-                        self.params, self._put_rep(chunk), dense
+                    logits = self._chunk_logits(
+                        self.params, hidden,
+                        self._put_rep(jnp.asarray((s_true - 1) % C, jnp.int32)),
                     )
-                if self._active and self.interleave_steps > 0 and i < n_chunks - 1:
-                    executed = self.step_n(self.interleave_steps)
-                    self.stats["interleaved_decode_steps"] = (
-                        self.stats.get("interleaved_decode_steps", 0) + executed
+                    first = self._sample_first_token(
+                        logits, req_key, slot, req.temperature, req.top_k, req.top_p
                     )
-            with self._mesh_ctx():
-                logits = self._chunk_logits(
-                    self.params, hidden,
-                    self._put_rep(jnp.asarray((s_true - 1) % C, jnp.int32)),
-                )
-                first = self._sample_first_token(
-                    logits, req_key, slot, req.temperature, req.top_k, req.top_p
-                )
-                # Commit: table row live only now (see docstring).
-                self.table[slot] = 0
-                self.table[slot, : len(blocks)] = blocks
-                self._dirty_table = True
-                prefill_ids = self._put_rep(
-                    jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
-                )
-                self.cache, self.pos_b = self._scatter_dense(
-                    self.cache, dense, prefill_ids, self.pos_b, slot, plen
-                )
-                self.tokens = self._set_at(self.tokens, slot, first)
+                    # Commit: table row live only now (see docstring).
+                    self.table[slot] = 0
+                    self.table[slot, : len(blocks)] = blocks
+                    self._dirty_table = True
+                    prefill_ids = self._put_rep(
+                        jnp.asarray(blocks[: bucket // self.block_size], jnp.int32)
+                    )
+                    self.cache, self.pos_b = self._scatter_dense(
+                        self.cache, dense, prefill_ids, self.pos_b, slot, plen
+                    )
+                    self.tokens = self._set_at(self.tokens, slot, first)
+        finally:
+            self._admitting = None
         self.stats["chunked_admissions"] = self.stats.get("chunked_admissions", 0) + 1
         return first
 
@@ -1029,6 +1096,7 @@ class PagedBatchEngine:
         req.shared_blocks = []
         self._free_slots.append(req.slot)
         metrics.set("serving_active_slots", len(self._active), {"engine": "paged"})
+        self._update_pool_gauges()
 
     def step(self) -> None:
         """One decode step across every active slot."""

@@ -1,0 +1,730 @@
+"""Continuous profiling + capacity accounting plane (ISSUE 7): the
+span-tagged stack sampler, KV-pool/HBM occupancy gauges, the
+`/debug/profile` surfaces (worker + API server + fleet merge), the
+flight-recorder profile embed, `lws-tpu profile`, and the paged-engine
+block-conservation regression.
+
+Sampling is driven deterministically where an assertion depends on WHERE a
+sample lands: `sample_once(frames=...)` takes injected frame dicts, and the
+span-attribution tests park a real thread inside the span being attributed
+before sampling it — no statistical flakes."""
+
+import json
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu.core import metrics, profile, trace
+from lws_tpu.core.profile import (
+    StackSampler,
+    fold_by_span,
+    merge_collapsed,
+    top_frames,
+)
+from tests.test_dns_metrics import parse_exposition
+
+T0 = 1000.0
+
+
+def _parked_thread(body_name: str, setup=None):
+    """Start a thread parked inside `setup()` (a context manager factory,
+    e.g. a span or phase tag) until released; returns (thread, entered,
+    release). The parked frames are what sample_once sees."""
+    import contextlib
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def body():
+        ctx = setup() if setup is not None else contextlib.nullcontext()
+        with ctx:
+            entered.set()
+            release.wait(10)
+
+    t = threading.Thread(target=body, name=body_name, daemon=True)
+    t.start()
+    assert entered.wait(10)
+    return t, entered, release
+
+
+# ---------------------------------------------------------------------------
+# StackSampler unit behavior
+
+
+def test_sampler_folds_thread_stacks_and_excludes_itself():
+    sampler = StackSampler(hz=997.0)
+    t, _, release = _parked_thread("park-plain")
+    try:
+        frames = {t.ident: sys._current_frames()[t.ident]}
+        n = sampler.sample_once(frames=frames)
+        assert n == 1
+        snap = sampler.snapshot()
+        assert snap["samples"] == 1 and snap["hz"] == 997.0
+        (stack, count), = snap["stacks"]
+        assert count == 1
+        assert "threading:" in stack  # the Event.wait frames fold in
+        # The caller's own thread is excluded even when its frame rides in.
+        own = sys._current_frames()[threading.get_ident()]
+        assert sampler.sample_once(frames={threading.get_ident(): own}) == 0
+    finally:
+        release.set()
+        t.join()
+
+
+def test_sampler_tags_samples_with_span_stack():
+    enabled = trace.TRACER.enabled
+    trace.TRACER.enabled = True
+    sampler = StackSampler()
+    t, _, release = _parked_thread(
+        "park-span", setup=lambda: trace.span("serve.decode_consume", steps=1)
+    )
+    try:
+        frames = {t.ident: sys._current_frames()[t.ident]}
+        assert sampler.sample_once(frames=frames) == 1
+        (stack, _), = sampler.snapshot()["stacks"]
+        assert stack.startswith("span:serve.decode_consume;")
+    finally:
+        release.set()
+        t.join()
+        trace.TRACER.enabled = enabled
+
+
+def test_sampler_tags_samples_with_phase_tags():
+    sampler = StackSampler()
+    t, _, release = _parked_thread(
+        "park-phase", setup=lambda: profile.phase("unit.phase")
+    )
+    try:
+        frames = {t.ident: sys._current_frames()[t.ident]}
+        assert sampler.sample_once(frames=frames) == 1
+        (stack, _), = sampler.snapshot()["stacks"]
+        assert stack.startswith("span:unit.phase;")
+        release.set()
+        t.join()
+        # The tag popped with the context: a fresh sample is untagged.
+        assert profile.phase_names(t.ident) == []
+    finally:
+        release.set()
+
+
+def test_sampler_bounded_table_drops_and_counts():
+    sampler = StackSampler(max_stacks=1)
+    t1, _, r1 = _parked_thread("park-a")
+    t2, _, r2 = _parked_thread(
+        "park-b", setup=lambda: profile.phase("unit.bound")
+    )
+    try:
+        before = metrics.REGISTRY.counter_value("lws_profile_stacks_dropped_total")
+        all_frames = sys._current_frames()
+        assert sampler.sample_once(frames={t1.ident: all_frames[t1.ident]}) == 1
+        # A NOVEL stack past the cap is dropped and counted; the known one
+        # keeps counting.
+        sampler.sample_once(frames={t2.ident: all_frames[t2.ident]})
+        sampler.sample_once(frames={t1.ident: all_frames[t1.ident]})
+        snap = sampler.snapshot()
+        assert len(snap["stacks"]) == 1 and snap["dropped_stacks"] == 1
+        assert snap["stacks"][0][1] == 2
+        after = metrics.REGISTRY.counter_value("lws_profile_stacks_dropped_total")
+        assert after == before + 1
+    finally:
+        r1.set(), r2.set()
+        t1.join(), t2.join()
+
+
+def test_sampler_threaded_mode_samples_and_stops():
+    sampler = StackSampler(hz=500.0)
+    t, _, release = _parked_thread("park-live")
+    try:
+        sampler.start()
+        assert sampler.running
+        import time
+
+        deadline = time.monotonic() + 10
+        while sampler.snapshot()["samples"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sampler.snapshot()["samples"] > 0
+    finally:
+        sampler.stop()
+        release.set()
+        t.join()
+    assert not sampler.running
+    # collapsed() is flamegraph.pl input: "frame;frame count" lines.
+    for line in sampler.collapsed().splitlines():
+        stack, count = line.rsplit(" ", 1)
+        assert ";" in stack or ":" in stack
+        assert int(count) > 0
+
+
+def test_fold_helpers_and_merge_collapsed():
+    stacks = [
+        ["span:serve.decode_consume;mod:f;mod:g", 3],
+        ["span:serve.request;span:serve.decode_consume;mod:f;mod:h", 2],
+        ["mod:f;mod:g", 5],
+    ]
+    # Innermost span tag wins (the phase actually executing).
+    assert fold_by_span(stacks) == {"serve.decode_consume": 5, "-": 5}
+    assert top_frames(stacks) == {"mod:g": 8, "mod:h": 2}
+    merged = merge_collapsed([
+        ({"instance": "w0", "role": "decode"}, {"stacks": stacks[:1]}),
+        ({"instance": "cp"}, {"stacks": stacks[2:]}),
+    ])
+    lines = merged.splitlines()
+    assert lines[0] == "instance:w0;role:decode;span:serve.decode_consume;mod:f;mod:g 3"
+    assert lines[1] == "instance:cp;mod:f;mod:g 5"
+
+
+def test_record_device_memory_is_cpu_safe():
+    # On the CPU test backend allocator stats are absent: the refresh must
+    # be a quiet no-op, never a scrape-handler exception.
+    n = profile.record_device_memory()
+    assert n >= 0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder integration: every dump ships a profile snapshot
+
+
+def test_watchdog_dump_embeds_profile_snapshot():
+    from lws_tpu.core.flightrecorder import FlightRecorder, StallRule, Watchdog
+
+    # Ensure the process PROFILER holds at least one stack to embed.
+    t, _, release = _parked_thread("park-dump")
+    try:
+        frames = {t.ident: sys._current_frames()[t.ident]}
+        profile.PROFILER.sample_once(frames=frames)
+    finally:
+        release.set()
+        t.join()
+    fr = FlightRecorder()
+    wd = Watchdog(recorder=fr, rules=[
+        StallRule("decode_ring_stall", "decode_ring:*", stall_after_s=5.0)
+    ])
+    fr.beat("decode_ring:paged", progress=1, depth=2, now=T0)
+    assert "decode_ring_stall" in wd.check_now(now=T0 + 30)
+    dump = wd.last_dump
+    assert dump["profile"]["samples"] > 0
+    assert dump["profile"]["stacks"], "dump carries no collapsed stacks"
+    json.dumps(dump)  # the bundle stays JSON-serializable with the embed
+
+
+# ---------------------------------------------------------------------------
+# Paged engine capacity accounting: gauges + block conservation
+
+
+def _small_engine(**kw):
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return PagedBatchEngine(cfg, params, max_len=64, block_size=16, **kw)
+
+
+def _assert_conserved(engine):
+    """The conservation invariant, computed INDEPENDENTLY of the engine's
+    own accounting: free, parked, and request-held block sets must
+    partition [1, num_blocks) — and the gauges must agree."""
+    free = set(engine._free_blocks)
+    parked = set(engine._lru)
+    live = set()
+    for req in engine._active.values():
+        live |= set(req.blocks)
+    assert not free & parked, "block in free list AND parked LRU"
+    assert not free & live, "block in free list AND held by a request"
+    assert not parked & live, "block parked AND held by a request"
+    assert free | parked | live == set(range(1, engine.num_blocks)), \
+        "pool blocks leaked or double-counted"
+    acct = engine.pool_accounting()
+    assert acct["free"] == len(free) and acct["parked"] == len(parked)
+    assert acct["live"] == len(live)
+    assert acct["free"] + acct["live"] + acct["parked"] == engine.num_blocks - 1
+
+
+def _gauge(state):
+    return metrics.REGISTRY.gauge_value(
+        "serving_kv_pool_blocks", {"engine": "paged", "state": state}
+    )
+
+
+def test_paged_block_accounting_conserved_across_prefix_lifecycle():
+    """The ISSUE's pinned regression: free + live + parked == num_blocks - 1
+    across prefix-cache admission, LRU parking, eviction-under-pressure, and
+    backpressure rollback (the paged_engine.py pin-before-alloc path whose
+    naive pre-check would double-count LRU-parked hit blocks)."""
+    engine = _small_engine(slots=4, num_blocks=10, prefix_cache=True)
+    _assert_conserved(engine)
+    prompt = np.arange(1, 25, dtype=np.int32)  # 24 tokens: 1 shareable block
+
+    # Admission + completion parks the shareable block in the LRU.
+    rid = engine.submit(prompt, 8)
+    assert rid is not None
+    _assert_conserved(engine)
+    engine.run_until_drained()
+    _assert_conserved(engine)
+    assert engine.pool_accounting()["parked"] == 1
+
+    # A second admission HITS the parked block (pin from LRU) — the :805
+    # hazard path: pins must roll into live exactly once.
+    rid2 = engine.submit(prompt, 8)
+    assert rid2 is not None
+    assert engine.stats_prefix["hit_blocks"] == 1
+    _assert_conserved(engine)
+
+    # Backpressure: keep admitting prefix-hitting 4-block requests until the
+    # pool refuses one — the refusal path must roll the hit-block pins back
+    # (the pin-before-alloc shape whose pre-check double-count this pins).
+    refused = engine.submit(np.arange(1, 25, dtype=np.int32), 40)  # 4 blocks
+    while refused is not None:
+        _assert_conserved(engine)
+        refused = engine.submit(np.arange(1, 25, dtype=np.int32), 40)
+    assert refused is None
+    _assert_conserved(engine)
+    engine.run_until_drained()
+    _assert_conserved(engine)
+
+    # Eviction under pressure: distinct prompts park distinct prefix blocks,
+    # then a fill admission forces LRU eviction.
+    for seed in (3, 5, 7):
+        p = np.full((24,), seed, dtype=np.int32)
+        r = engine.submit(p, 8)
+        assert r is not None
+        engine.run_until_drained()
+        _assert_conserved(engine)
+    evictions_before = engine.stats_prefix["evictions"]
+    filled = []
+    r = engine.submit(np.arange(30, 54, dtype=np.int32), 40)
+    while r is not None:
+        filled.append(r)
+        _assert_conserved(engine)
+        r = engine.submit(np.arange(30, 54, dtype=np.int32), 40)
+    assert engine.stats_prefix["evictions"] > evictions_before
+    _assert_conserved(engine)
+    engine.run_until_drained()
+    _assert_conserved(engine)
+
+    # The gauges agree with the final accounting (and sum to the pool).
+    acct = engine.pool_accounting()
+    assert _gauge("free") == acct["free"]
+    assert _gauge("live") == acct["live"]
+    assert _gauge("parked") == acct["parked"]
+    assert _gauge("free") + _gauge("live") + _gauge("parked") == 9.0
+
+
+def test_paged_block_accounting_survives_pipeline_rollback():
+    """discard() abandons in-flight chunks without committing — block
+    ownership must be unaffected (blocks travel with requests, never with
+    chunks), and the drain after the rollback still conserves."""
+    engine = _small_engine(slots=2, pipeline_depth=2)
+    rid = engine.submit(np.arange(1, 25, dtype=np.int32), 8)
+    assert rid is not None
+    engine.step_n(2)  # a chunk rides the ring, unconsumed
+    assert len(engine._pipeline) >= 1
+    engine._pipeline.discard()
+    _assert_conserved(engine)
+    engine.run_until_drained()
+    _assert_conserved(engine)
+    assert engine.pool_accounting()["live"] == 0
+
+
+def test_prefix_cache_hit_miss_evict_counters():
+    reg = metrics.REGISTRY
+    labels = {"engine": "paged"}
+    h0 = reg.counter_value("serving_prefix_cache_hits_total", labels)
+    m0 = reg.counter_value("serving_prefix_cache_misses_total", labels)
+    engine = _small_engine(slots=2, num_blocks=8, prefix_cache=True)
+    prompt = np.arange(1, 25, dtype=np.int32)
+    engine.submit(prompt, 8)
+    engine.run_until_drained()
+    # First sight of the prefix: its one shareable block was a miss.
+    assert reg.counter_value("serving_prefix_cache_misses_total", labels) == m0 + 1
+    engine.submit(prompt, 8)
+    engine.run_until_drained()
+    assert reg.counter_value("serving_prefix_cache_hits_total", labels) == h0 + 1
+    e0 = reg.counter_value("serving_prefix_cache_evictions_total", labels)
+    # Pressure the pool so an allocation must reclaim the parked block:
+    # 7 allocatable, 1 parked. A 4-block fill leaves 2 free; a 3-block
+    # admission then needs the parked block — eviction.
+    assert engine.submit(np.full((24,), 9, dtype=np.int32), 40) is not None
+    assert engine.submit(np.full((24,), 11, dtype=np.int32), 24) is not None
+    assert reg.counter_value(
+        "serving_prefix_cache_evictions_total", labels) == e0 + 1
+    assert engine.stats_prefix["evictions"] == 1
+    engine.run_until_drained()
+
+
+def test_batch_engine_reports_slot_occupancy():
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving.batch_engine import BatchEngine
+
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    engine = BatchEngine(cfg, params, slots=2, max_len=64)
+    engine.submit(np.arange(1, 9, dtype=np.int32), 4)
+    assert metrics.REGISTRY.gauge_value(
+        "serving_active_slots", {"engine": "batch"}) == 1.0
+    engine.run_until_drained()
+    assert metrics.REGISTRY.gauge_value(
+        "serving_active_slots", {"engine": "batch"}) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# /debug/profile HTTP surfaces: validation + auth parity
+
+
+def test_worker_debug_profile_validation_and_formats():
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    profile.PROFILER.clear()
+    t, _, release = _parked_thread("park-http")
+    try:
+        frames = {t.ident: sys._current_frames()[t.ident]}
+        profile.PROFILER.sample_once(frames=frames)
+    finally:
+        release.set()
+        t.join()
+    server = TelemetryServer(port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        for bad in ("?limit=abc", "?limit=-5", "?limit=1.5", "?format=xml",
+                    "?limit=3&format=svg"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{base}/debug/profile{bad}", timeout=10)
+            assert err.value.code == 400, bad
+        with urllib.request.urlopen(f"{base}/debug/profile?limit=8", timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["samples"] >= 1 and body["stacks"]
+        with urllib.request.urlopen(
+            f"{base}/debug/profile?format=collapsed", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+            assert resp.headers.get("Content-Type") == "text/plain"
+        assert text.strip() and text.splitlines()[0].rsplit(" ", 1)[1].isdigit()
+    finally:
+        server.stop()
+
+
+def test_worker_debug_profile_token_parity():
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    server = TelemetryServer(port=0, token="s3cret")
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/profile", timeout=10)
+        assert err.value.code == 401
+        req = urllib.request.Request(
+            f"{base}/debug/profile",
+            headers={"Authorization": "Bearer s3cret"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        server.stop()
+
+
+def test_api_server_debug_profile_validation_and_auth_parity():
+    from lws_tpu.core.auth import TokenAuth, TokenEntry
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    try:
+        for path in ("/debug/profile", "/debug/profile/fleet"):
+            for bad in ("?limit=zz", "?format=flame"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(f"{base}{path}{bad}", timeout=10)
+                assert err.value.code == 400, (path, bad)
+            with urllib.request.urlopen(f"{base}{path}?limit=4", timeout=10) as resp:
+                assert resp.status == 200
+    finally:
+        api.stop()
+    # Same bearer gating as every other /debug/* endpoint.
+    auth = TokenAuth([TokenEntry("tok123", "admin", "admin")])
+    api = ApiServer(cp, port=0, auth=auth)
+    api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/profile", timeout=10)
+        assert err.value.code == 401
+        req = urllib.request.Request(
+            f"{base}/debug/profile",
+            headers={"Authorization": "Bearer tok123"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        api.stop()
+
+
+# ---------------------------------------------------------------------------
+# lws-tpu profile renderer + CLI
+
+
+PROFILE_SNAP = {
+    "enabled": True, "hz": 67.0, "samples": 10, "dropped_stacks": 0,
+    "stacks": [
+        ["span:serve.decode_consume;mod:f;mod:g", 6],
+        ["mod:f;mod:idle", 4],
+    ],
+}
+
+
+def test_render_profile_tables():
+    from lws_tpu.cli import render_profile
+
+    frame = render_profile([("w0", PROFILE_SNAP)], top_n=5)
+    assert "PROFILE  instances=1  samples=10  sampling=on" in frame
+    span_row = next(l for l in frame.splitlines() if "serve.decode_consume" in l)
+    assert span_row.startswith("w0") and "60%" in span_row
+    assert "TOP OF STACK" in frame
+    top_row = next(l for l in frame.splitlines() if "mod:g" in l)
+    assert "6" in top_row and "60%" in top_row
+
+
+def test_cmd_profile_one_shot_and_fleet(capsys):
+    from lws_tpu import cli
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+
+    profile.PROFILER.clear()
+    t, _, release = _parked_thread("park-cli")
+    try:
+        frames = {t.ident: sys._current_frames()[t.ident]}
+        profile.PROFILER.sample_once(frames=frames)
+    finally:
+        release.set()
+        t.join()
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    try:
+        assert cli.main(["profile", "--server", f"127.0.0.1:{api.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "PROFILE" in out and "TOP OF STACK" in out
+        assert "threading:" in out  # the parked thread's frames fold in
+        assert cli.main(
+            ["profile", "--fleet", "--server", f"127.0.0.1:{api.port}"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "control-plane" in out  # the CP's own profile rides the merge
+        assert cli.main(
+            ["profile", "--collapsed", "--server", f"127.0.0.1:{api.port}"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert out.splitlines()[0].rsplit(" ", 1)[1].isdigit()
+    finally:
+        api.stop()
+
+
+def test_top_renders_kv_occupancy_and_prefix_hit_rate():
+    from lws_tpu.cli import _top_rows, render_top
+    from lws_tpu.core.metrics import parse_exposition as parse_prod
+
+    exposition = """\
+# HELP serving_requests_total x
+# TYPE serving_requests_total counter
+serving_requests_total{engine="paged",instance="w0"} 10.0
+# HELP serving_kv_pool_blocks x
+# TYPE serving_kv_pool_blocks gauge
+serving_kv_pool_blocks{engine="paged",instance="w0",state="free"} 2.0
+serving_kv_pool_blocks{engine="paged",instance="w0",state="live"} 6.0
+serving_kv_pool_blocks{engine="paged",instance="w0",state="parked"} 0.0
+# HELP serving_prefix_cache_hits_total x
+# TYPE serving_prefix_cache_hits_total counter
+serving_prefix_cache_hits_total{engine="paged",instance="w0"} 3.0
+# HELP serving_prefix_cache_misses_total x
+# TYPE serving_prefix_cache_misses_total counter
+serving_prefix_cache_misses_total{engine="paged",instance="w0"} 1.0
+"""
+    fams = parse_prod(exposition)
+    rows = _top_rows(fams)
+    r = rows[("w0", "paged")]
+    assert r["kv_live"] == 6.0 and r["kv_free"] == 2.0
+    assert r["pfx_hits"] == 3.0 and r["pfx_misses"] == 1.0
+    frame = render_top(fams)
+    assert "KV%" in frame and "PFX%" in frame
+    row = next(l for l in frame.splitlines() if l.startswith("w0"))
+    assert "75%" in row  # 6 live / 8 pool, and 3/4 prefix hits
+
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: decode workload + sampler + worker /debug/profile + fleet
+# merge + pool-gauge conservation on the merged exposition (the ISSUE's
+# acceptance proof).
+
+
+def _make_worker_pod(name: str, port: int, role: str | None = None):
+    from lws_tpu.api.pod import Container, EnvVar, Pod, PodSpec
+    from lws_tpu.core.store import new_meta
+
+    pod = Pod(
+        meta=new_meta(name),
+        spec=PodSpec(containers=[Container(
+            name="w",
+            command=["sleep", "1"],
+            env=[EnvVar("LWS_TPU_METRICS_PORT", str(port))],
+        )]),
+    )
+    if role is not None:
+        from lws_tpu.api import disagg
+
+        pod.meta.labels[disagg.DS_ROLE_LABEL_KEY] = role
+    return pod
+
+
+def test_profile_plane_end_to_end():
+    from lws_tpu.api.pod import PodPhase
+    from lws_tpu.core.flightrecorder import FlightRecorder, StallRule, Watchdog
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    enabled, rate = trace.TRACER.enabled, trace.TRACER.sample_rate
+    trace.TRACER.enabled, trace.TRACER.sample_rate = True, 1.0
+    profile.PROFILER.clear()
+    profile.PROFILER.hz = 499.0
+    engine = _small_engine(slots=2, num_blocks=9, pipeline_depth=2)
+    worker = TelemetryServer(port=0)
+    worker.start()
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    try:
+        # --- a decode workload with the sampler ON --------------------------
+        profile.PROFILER.start()
+        rid = engine.submit(np.arange(1, 25, dtype=np.int32), 24)
+        assert rid is not None
+        for _ in range(4):
+            engine.step_n(4)
+        # Deterministic serve.decode_consume attribution: a chunk whose
+        # commit parks inside the consume span while we sample it — the
+        # sampler never has to win a race with a microsecond window.
+        entered, release = threading.Event(), threading.Event()
+
+        def slow_commit(host):
+            entered.set()
+            release.wait(10)
+
+        engine._pipeline.push(0, np.zeros((0, engine.slots), np.int32),
+                              slow_commit)
+        flusher = threading.Thread(target=engine._pipeline.flush, daemon=True)
+        flusher.start()
+        assert entered.wait(10)
+        frames = dict(sys._current_frames())
+        # Sample the parked consume repeatedly: its count must rank above
+        # the hz-loop's one-off noise stacks in every limit-truncated view
+        # (the worker endpoint, the fleet merge, the watchdog dump).
+        for _ in range(50):
+            assert profile.PROFILER.sample_once(
+                frames={flusher.ident: frames[flusher.ident]}
+            ) == 1
+        release.set()
+        flusher.join(10)
+        engine.run_until_drained()
+        profile.PROFILER.stop()
+        snap = profile.PROFILER.snapshot()
+        assert snap["samples"] > 0
+        consume_stacks = [
+            s for s, _ in snap["stacks"]
+            if s.split(";")[0] == "span:serve.decode_consume"
+        ]
+        assert consume_stacks, "no stack attributed to serve.decode_consume"
+
+        # --- (a) the worker's /debug/profile serves those stacks ------------
+        base = f"http://127.0.0.1:{worker.port}"
+        with urllib.request.urlopen(f"{base}/debug/profile", timeout=10) as resp:
+            via_worker = json.loads(resp.read().decode())
+        assert any(
+            s.split(";")[0] == "span:serve.decode_consume"
+            for s, _ in via_worker["stacks"]
+        )
+
+        # --- (b) a tripped watchdog's dump embeds the profile ---------------
+        fr = FlightRecorder()
+        wd = Watchdog(recorder=fr, rules=[
+            StallRule("decode_ring_stall", "decode_ring:*", stall_after_s=5.0)
+        ])
+        fr.beat("decode_ring:paged", progress=1, depth=1, now=T0)
+        assert "decode_ring_stall" in wd.check_now(now=T0 + 60)
+        assert any(
+            s.split(";")[0] == "span:serve.decode_consume"
+            for s, _ in wd.last_dump["profile"]["stacks"]
+        ), "the stall dump does not ship the window's profile"
+
+        # --- fleet wiring: pod -> scrape -> merged surfaces ------------------
+        pod = cp.store.create(_make_worker_pod("prof-w0", worker.port,
+                                               role="decode"))
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.ready = True
+        pod.status.address = "127.0.0.1"
+        cp.store.update_status(pod)
+
+        # Keep one request live so the pool gauges show live > 0 on the
+        # merged exposition.
+        rid2 = engine.submit(np.arange(1, 25, dtype=np.int32), 24)
+        assert rid2 is not None
+
+        # (a, fleet) /debug/profile/fleet carries the worker's span-tagged
+        # stacks under its instance label, both JSON and collapsed.
+        api_base = f"http://127.0.0.1:{api.port}"
+        with urllib.request.urlopen(
+            f"{api_base}/debug/profile/fleet", timeout=10
+        ) as resp:
+            fleet_profiles = json.loads(resp.read().decode())
+        by_instance = {
+            e["labels"]["instance"]: e["profile"]
+            for e in fleet_profiles["instances"]
+        }
+        assert {"control-plane", "prof-w0"} <= set(by_instance)
+        assert by_instance["prof-w0"]["samples"] > 0
+        assert any(
+            s.split(";")[0] == "span:serve.decode_consume"
+            for s, _ in by_instance["prof-w0"]["stacks"]
+        )
+        with urllib.request.urlopen(
+            f"{api_base}/debug/profile/fleet?format=collapsed", timeout=10
+        ) as resp:
+            collapsed = resp.read().decode()
+        assert any(
+            line.startswith("instance:prof-w0;role:decode;")
+            for line in collapsed.splitlines()
+        )
+
+        # --- (c) pool-state conservation on the MERGED fleet exposition -----
+        merged = cp.fleet.render_fleet(force=True)
+        fams = parse_exposition(merged)
+        states = {
+            labels["state"]: v
+            for _, labels, v in fams["serving_kv_pool_blocks"]["samples"]
+            if labels.get("instance") == "prof-w0"
+        }
+        assert set(states) == {"free", "live", "parked"}
+        assert sum(states.values()) == engine.num_blocks - 1
+        assert states["live"] > 0
+        engine.run_until_drained()
+    finally:
+        profile.PROFILER.stop()
+        api.stop()
+        worker.stop()
+        trace.TRACER.enabled, trace.TRACER.sample_rate = enabled, rate

@@ -154,6 +154,13 @@ def test_spans_context_and_literal_rules():
     assert "bad_metric_name" in by_rule["metric-name-literal"][0].detail
     assert len(by_rule.get("span-name-literal", [])) == 1
     assert "bad_span_name" in by_rule["span-name-literal"][0].detail
+    # Profiler phase tags carry the same literal-name contract — both the
+    # `profile.phase(...)` and directly-imported bare `phase(...)` shapes;
+    # the ok_phase literal stays silent.
+    phases = by_rule.get("profile-phase-literal", [])
+    assert {f.detail.split(":")[0] for f in phases} == {
+        "bad_phase_name", "bad_phase_name_direct",
+    }, phases
 
 
 def test_spans_name_rules_scoped_to_catalogue_source():
@@ -165,6 +172,7 @@ def test_spans_name_rules_scoped_to_catalogue_source():
     rules = {f.rule for f in found}
     assert "metric-name-literal" not in rules
     assert "span-name-literal" not in rules
+    assert "profile-phase-literal" not in rules
     assert "span-context-manager" in rules
 
 

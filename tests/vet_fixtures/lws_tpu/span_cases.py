@@ -2,7 +2,7 @@
 self-tests pass root=tests/vet_fixtures) because the name-literal rules
 are scoped to the catalogue checker's source tree."""
 
-from lws_tpu.core import metrics, trace
+from lws_tpu.core import metrics, profile, trace
 
 NAME = "dyn_metric"
 
@@ -44,4 +44,21 @@ def bad_span_shared_name():
 def ok_other_function_enters_same_name():
     sp = trace.span("ok.shared-name")
     with sp:
+        return None
+
+
+def bad_phase_name(suffix):
+    with profile.phase("phase." + suffix):
+        return None
+
+
+def bad_phase_name_direct(suffix):
+    from lws_tpu.core.profile import phase
+
+    with phase("phase." + suffix):  # bare-Name call shape must be caught too
+        return None
+
+
+def ok_phase():
+    with profile.phase("ok.phase"):
         return None

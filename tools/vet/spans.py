@@ -16,6 +16,12 @@ contract that dashboards are built against. Forwarding shims whose
 whole job is to pass a caller-supplied name through (core/slo.py's
 `_observe`) carry an inline suppression with the reason.
 
+`profile-phase-literal` — the profiler's explicit phase tags
+(`profile.phase("name")`, core/profile.py) carry the same soundness
+contract: a phase name folded into collapsed stacks must be a string
+literal in lws_tpu/ source, or flamegraphs and the `lws-tpu profile`
+per-span tables fragment across computed names nobody can grep for.
+
 The registry implementation itself (lws_tpu/core/metrics.py) is exempt
 from `metric-name-literal`: its module-level `inc`/`observe`/`set`
 helpers forward their `name` parameter by design, and every caller-side
@@ -43,6 +49,17 @@ def _is_metrics_receiver(node: ast.expr) -> bool:
             or "metrics" in node.id
     if isinstance(node, ast.Attribute):
         return node.attr in ("metrics", "REGISTRY") or "metrics" in node.attr
+    return False
+
+
+def _is_profile_receiver(node: ast.expr) -> bool:
+    """`profile`, `profmod`, `PROFILER`, `self.profiler`: a Name or
+    attribute chain whose final segment names the profiler module/object —
+    the receivers of `.phase(...)` tag calls."""
+    if isinstance(node, ast.Name):
+        return "prof" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "prof" in node.attr.lower()
     return False
 
 
@@ -149,6 +166,26 @@ def run(modules: list[Module]) -> list[Finding]:
                         f"{mod.qualname_at(node.lineno)}:span-name",
                         "span name must be a string literal (the catalogue "
                         "checker can't see a computed name)",
+                    ))
+                continue
+            # Profiler phase tags: literal first argument (same soundness
+            # contract — a computed phase fragments the collapsed stacks).
+            # Both shapes: `profile.phase(...)` and the directly-imported
+            # bare `phase(...)` (mirrors the describe() handling).
+            is_phase = (
+                isinstance(fn, ast.Attribute) and fn.attr == "phase"
+                and _is_profile_receiver(fn.value)
+            ) or (isinstance(fn, ast.Name) and fn.id == "phase")
+            if is_phase:
+                if in_catalogue_scope and node.args and not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    findings.append(mod.finding(
+                        "profile-phase-literal", node.lineno,
+                        f"{mod.qualname_at(node.lineno)}:phase-name",
+                        "profiler phase tag must be a string literal (a "
+                        "computed name fragments the collapsed-stack folds)",
                     ))
                 continue
             # Metric names: literal first argument on metrics receivers.
