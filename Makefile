@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead clean
 
 test:
 	python -m pytest tests/ -q
@@ -12,13 +12,16 @@ lint:  ## alias: the old linter is vet's style pass (tools/vet/style.py)
 metrics-catalogue:  ## every metric/span name in source must be in docs/observability.md
 	python tools/check_metrics_catalogue.py
 
+chaos:  ## the seeded chaos suite, incl. the slow multi-process e2e (docs/robustness.md)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_injection.py tests/test_chaos_serving.py -q
+
 bench-decode-overlap:  ## pipelined decode must beat the sync loop's host-blocked fraction (budget json)
 	python benchmarks/decode_overlap_bench.py --check
 
 bench-profile-overhead:  ## the stack sampler at default hz must cost <2% decode throughput (budget json)
 	python benchmarks/profile_overhead_bench.py --check
 
-check: vet metrics-catalogue test bench-decode-overlap bench-profile-overhead  ## what CI would run (vet gates before tests)
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

@@ -6,6 +6,7 @@
   python -m lws_tpu delete KIND NAMESPACE NAME [--server HOST:PORT]
   python -m lws_tpu scale  NAME REPLICAS [--server HOST:PORT]
   python -m lws_tpu top    [--watch] [--server HOST:PORT]
+  python -m lws_tpu faults [point=spec ...] [--clear] [--drain] [--server HOST:PORT]
   python -m lws_tpu plan-steps --initial 4,4 --target 4,4 [--surge 1,1] [--unavailable 0,0]
 """
 
@@ -41,7 +42,7 @@ def _http(server: str, method: str, path: str, body: bytes | None = None):
     url = f"{_server_base(server)}{path}"
     req = urllib.request.Request(url, data=body, method=method, headers=_auth_headers())
     try:
-        with urllib.request.urlopen(req, context=_url_context(url)) as resp:
+        with urllib.request.urlopen(req, timeout=30, context=_url_context(url)) as resp:
             return json.loads(resp.read().decode())
     except urllib.error.HTTPError as e:
         detail = e.read().decode()
@@ -124,7 +125,7 @@ def cmd_serve(args) -> int:
                 time.sleep(2.0)
                 try:
                     backend.poll_all()
-                except Exception:  # noqa: BLE001
+                except Exception:  # vet: ignore[hazard-exception-swallow]: the exit-poll loop must outlive one bad poll (BLE001 intended)
                     pass
 
         threading.Thread(target=_poll_exits, daemon=True).start()
@@ -227,7 +228,7 @@ def cmd_logs(args) -> int:
     url = f"{_server_base(args.server)}/logs/{args.namespace}/{args.name}"
     req = urllib.request.Request(url, headers=_auth_headers())
     try:
-        with urllib.request.urlopen(req, context=_url_context(url)) as resp:
+        with urllib.request.urlopen(req, timeout=30, context=_url_context(url)) as resp:
             sys.stdout.write(resp.read().decode(errors="replace"))
         return 0
     except urllib.error.HTTPError as e:
@@ -650,7 +651,7 @@ def _fetch_top_state(server: str) -> tuple[dict, dict]:
 
     url = f"{_server_base(server)}/metrics/fleet"
     req = urllib.request.Request(url, headers=_auth_headers())
-    with urllib.request.urlopen(req, context=_url_context(url)) as resp:
+    with urllib.request.urlopen(req, timeout=30, context=_url_context(url)) as resp:
         fams = parse_exposition(resp.read().decode())
     alerts = {}
     for name, labels, value, _ in fams.get("lws_watchdog_active", {}).get("samples", []):
@@ -750,7 +751,7 @@ def cmd_profile(args) -> int:
                f"?format=collapsed&limit={args.limit}")
         req = urllib.request.Request(url, headers=_auth_headers())
         try:
-            with urllib.request.urlopen(req, context=_url_context(url)) as resp:
+            with urllib.request.urlopen(req, timeout=30, context=_url_context(url)) as resp:
                 sys.stdout.write(resp.read().decode())
         except urllib.error.HTTPError as e:
             # Same error surfacing as _http(): the server WAS reached — show
@@ -783,6 +784,38 @@ def cmd_profile(args) -> int:
         sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
         sys.stdout.flush()
         time.sleep(args.interval)
+
+
+def cmd_faults(args) -> int:
+    """Chaos controls against a live server's /debug/faults surface (API
+    server or a worker's telemetry port): list the armed fault points, arm
+    `point=spec` schedules (core/faults.py grammar, e.g.
+    `kv.ack=drop:1`), disarm/clear them, or request a graceful drain
+    (`--drain` posts /debug/drain — worker telemetry servers only)."""
+    if args.drain:
+        out = _http(args.server, "POST", "/debug/drain", b"{}")
+        print(json.dumps(out, indent=1))
+        return 0
+    payload: dict = {}
+    if args.clear:
+        payload["clear"] = True
+    arm = {}
+    for spec in args.points:
+        point, sep, schedule = spec.partition("=")
+        if not sep or not point or not schedule:
+            print(f"error: bad fault spec {spec!r}; expected point=spec "
+                  "(e.g. kv.ack=drop:1)", file=sys.stderr)
+            return 2
+        arm[point] = schedule
+    if arm:
+        payload["arm"] = arm
+    if payload:
+        out = _http(args.server, "POST", "/debug/faults",
+                    json.dumps(payload).encode())
+    else:
+        out = _http(args.server, "GET", "/debug/faults")
+    print(json.dumps(out, indent=1))
+    return 0
 
 
 def cmd_plan_steps(args) -> int:
@@ -945,6 +978,19 @@ def main(argv=None) -> int:
                      help="print raw collapsed stacks (flamegraph.pl input) "
                           "instead of tables")
     prf.set_defaults(fn=cmd_profile)
+
+    fp = sub.add_parser("faults", help="chaos controls: list/arm/disarm fault "
+                        "schedules on a server's /debug/faults; --drain for "
+                        "graceful worker drain")
+    fp.add_argument("points", nargs="*", metavar="point=spec",
+                    help="fault schedules to arm (docs/robustness.md grammar)")
+    fp.add_argument("--server", default="127.0.0.1:9443",
+                    help="API server or worker telemetry host:port")
+    fp.add_argument("--clear", action="store_true",
+                    help="disarm every fault point first")
+    fp.add_argument("--drain", action="store_true",
+                    help="POST /debug/drain instead (graceful worker drain)")
+    fp.set_defaults(fn=cmd_faults)
 
     ep = sub.add_parser("events", help="controller decision trace (k8s Events)")
     ep.add_argument("name", nargs="?")

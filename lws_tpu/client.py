@@ -112,7 +112,12 @@ class RemoteClient:
             headers=headers,
         )
         try:
-            with urllib.request.urlopen(req, context=self._context) as resp:
+            # Sized above the server's 60s max /watch long-poll window: a
+            # partitioned API server fails the call instead of hanging the
+            # informer forever, but a healthy long poll never trips it.
+            with urllib.request.urlopen(
+                req, timeout=90, context=self._context
+            ) as resp:
                 return _json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
             detail = e.read().decode()

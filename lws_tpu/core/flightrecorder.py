@@ -207,6 +207,27 @@ class HotLoopRule:
 
 
 @dataclass(frozen=True)
+class TripRule:
+    """A counter that ADVANCED within `window_s` = a recent trip burst.
+    The deadline feed (`deadline_trips:{site}`, core/resilience.py) beats
+    progress on every expiration: the rule fires while trips are fresh and
+    clears once the burst goes quiet — so the Watchdog's edge logic yields
+    exactly one alert (and one diagnostics dump) per burst."""
+
+    name: str
+    pattern: str
+    window_s: float = 5.0
+
+    def firing(self, beats: dict[str, Heartbeat], now: float) -> list[dict]:
+        return [
+            {"source": src, "trips": hb.progress}
+            for src, hb in beats.items()
+            if fnmatch.fnmatch(src, self.pattern) and hb.progress > 0
+            and now - hb.last_advance <= self.window_s
+        ]
+
+
+@dataclass(frozen=True)
 class BacklogRule:
     """Sustained queue depth at/over the threshold = a backlog."""
 
@@ -233,8 +254,9 @@ class BacklogRule:
 
 
 def default_rules() -> list:
-    """The three fleet failure modes the tentpole names: a non-advancing
-    decode dispatch ring, a reconcile hot loop, KV-handoff backlog. The
+    """The fleet failure modes the watchdog ships with: a non-advancing
+    decode dispatch ring, a reconcile hot loop, KV-handoff backlog, an
+    open circuit breaker, a deadline-expiration burst. The
     ring's progress counter cannot distinguish one legitimately long device
     dispatch from a wedge, so the default stall window is generous (30s —
     far past any sane dispatch, short enough to catch a real wedge) and
@@ -247,6 +269,14 @@ def default_rules() -> list:
         BacklogRule("kv_handoff_backlog", "kv_backlog:*",
                     depth_threshold=_env_float("LWS_TPU_WATCHDOG_DEPTH", 8.0),
                     sustain_s=_env_float("LWS_TPU_WATCHDOG_SUSTAIN_S", 5.0)),
+        # Resilience-plane rules (core/resilience.py feeds): an OPEN
+        # circuit breaker (depth 1 on `breaker:{endpoint}`, progress
+        # pinned so sustain runs) and a recent deadline-expiration burst
+        # each produce one edge-triggered alert with a diagnostics dump.
+        BacklogRule("circuit_open", "breaker:*",
+                    depth_threshold=1.0, sustain_s=0.0),
+        TripRule("deadline_tripped", "deadline_trips:*",
+                 window_s=_env_float("LWS_TPU_WATCHDOG_TRIP_WINDOW_S", 5.0)),
     ]
 
 
@@ -328,7 +358,7 @@ class Watchdog:
             while not self._stop.wait(interval_s):
                 try:
                     self.check_now()
-                except Exception:  # noqa: BLE001 — the watchdog must outlive bad beats
+                except Exception:  # vet: ignore[hazard-exception-swallow]: the watchdog must outlive bad beats (BLE001 intended)
                     pass
 
         self._thread = threading.Thread(target=loop, daemon=True)

@@ -476,3 +476,14 @@ describe("serving_kv_pool_blocks", "Paged KV pool blocks by state (free / live /
 describe("serving_prefix_cache_hits_total", "Prefix-cache block lookups served from the pool (tokens skipped = hits x block_size)")
 describe("serving_prefix_cache_misses_total", "Shareable prompt blocks that had to be prefilled (no cached prefix)")
 describe("serving_prefix_cache_evictions_total", "LRU-parked prefix blocks evicted to satisfy new allocations")
+# --- resilience + fault injection (core/resilience.py, core/faults.py) -----
+describe("serving_retries_total", "Retry events per call site and outcome (retry / recovered / exhausted / budget_exhausted)")
+describe("serving_deadline_expirations_total", "Calls aborted (or work dropped) at a blocking point because the request deadline had expired, per site")
+describe("serving_circuit_state", "Circuit-breaker state per endpoint (0 closed, 1 half-open, 2 open)")
+describe("serving_circuit_transitions_total", "Circuit-breaker state transitions per endpoint, labeled with the state entered")
+describe("serving_draining", "1 while this process is draining (admitting nothing new, finishing in-flight work)")
+describe("serving_replays_deduped_total", "Replayed at-least-once deliveries skipped by the bounded seen-id dedup guard")
+describe("serving_kv_connection_errors_total", "KV handoff connections that died mid-request (client retries cover them)")
+describe("lws_fault_trips_total", "Injected-fault firings per fault point and mode (chaos runs only; zero in production)")
+describe("lws_fault_points_armed", "Fault points currently armed in this process")
+describe("lws_fleet_scrape_skipped_total", "Fleet scrapes skipped because the instance is in failure backoff")

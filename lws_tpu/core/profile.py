@@ -197,7 +197,7 @@ class StackSampler:
             while not self._stop.wait(period):
                 try:
                     self.sample_once()
-                except Exception:  # noqa: BLE001 — the sampler must outlive odd frames
+                except Exception:  # vet: ignore[hazard-exception-swallow]: the sampler must outlive odd frames (BLE001 intended)
                     pass
 
         self._thread = threading.Thread(

@@ -501,6 +501,15 @@ class Store:
         return stored
 
     def _update_locked(self, obj: TypedObject, status_only: bool) -> TypedObject:
+        # Chaos hook for the optimistic-concurrency paths: an armed
+        # `store.conflict` schedule forces this update to LOSE its race —
+        # the cooperative hit() (not fire()) because the typed failure is
+        # the store's own ConflictError, which every retry loop
+        # (_retry_conflicts, controller requeues) must absorb.
+        from lws_tpu.core import faults
+
+        if faults.hit("store.conflict") is not None:
+            raise ConflictError(f"{obj.key()}: injected optimistic-concurrency loss")
         with self._lock:
             key = obj.key()
             current = self._objects.get(key)

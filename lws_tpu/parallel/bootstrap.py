@@ -62,7 +62,7 @@ def assert_platform_from_env(env: Optional[dict[str, str]] = None) -> None:
     if platforms:
         try:
             jax.config.update("jax_platforms", platforms)
-        except Exception:  # noqa: BLE001 — best effort; backend may be fixed
+        except Exception:  # vet: ignore[hazard-exception-swallow]: best-effort platform pin; backend may already be fixed (BLE001 intended)
             pass
 
 

@@ -70,15 +70,22 @@ class FleetCollector:
         cache_ttl_s: float = 1.0,
         max_label_sets: int = 512,
         metrics_registry=None,
+        backoff_base_s: float = 2.0,
+        backoff_cap_s: float = 60.0,
     ) -> None:
         """`control_registries` join the merge as instance "control-plane";
         `metrics_registry` receives the collector's own health metrics
-        (defaults to the first control registry, else the process one)."""
+        (defaults to the first control registry, else the process one).
+        `backoff_base_s`/`backoff_cap_s` shape the per-instance scrape
+        backoff: a failing instance doubles its skip window per consecutive
+        miss up to the cap — the collector's circuit-breaker-lite."""
         self.store = store
         self.control_registries = control_registries
         self.timeout_s = timeout_s
         self.cache_ttl_s = cache_ttl_s
         self.max_label_sets = max_label_sets
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
         self._own_metrics = (
             metrics_registry if metrics_registry is not None
             else (control_registries[0] if control_registries else metrics.REGISTRY)
@@ -87,12 +94,15 @@ class FleetCollector:
         self._refill_lock = threading.Lock()
         self._cached: Optional[str] = None  # guarded-by: _lock
         self._cached_at = 0.0  # guarded-by: _lock
-        # Instances currently failing to scrape: ring events fire on the
-        # healthy->failing edge only (the counter still counts every miss).
-        # Mutated from the scrape pool's threads, so it shares _lock: two
-        # concurrent misses for one instance must produce ONE edge event,
-        # and a lock-free set mutation under churn can corrupt the set.
-        self._failing: set[str] = set()  # guarded-by: _lock
+        # Instances currently failing to scrape, with per-instance backoff
+        # state ({"failures": n, "until": monotonic}): a down worker is
+        # SKIPPED until its backoff expires instead of being re-dialed (and
+        # re-timed-out) on every cache refill. Ring events fire on the
+        # healthy->failing edge only (the counter still counts every real
+        # miss). Mutated from the scrape pool's threads, so it shares
+        # _lock: two concurrent misses for one instance must produce ONE
+        # edge event, and lock-free mutation under churn can corrupt it.
+        self._failing: dict[str, dict] = {}  # guarded-by: _lock
 
     # ---- discovery + scrape ----------------------------------------------
     def targets(self) -> list[tuple[dict, tuple[str, int]]]:
@@ -120,6 +130,9 @@ class FleetCollector:
         return headers
 
     def _scrape_one(self, host: str, port: int) -> str:
+        from lws_tpu.core import faults
+
+        faults.fire("fleet.scrape")
         # Negotiate OpenMetrics: the merge must carry the workers' trace
         # exemplars (classic text-format responses have them stripped).
         req = urllib.request.Request(
@@ -129,8 +142,21 @@ class FleetCollector:
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return resp.read().decode()
 
-    def _scrape_target(self, labels: dict, host: str, port: int) -> Optional[str]:
+    def _backoff_s(self, failures: int) -> float:  # holds-lock: _lock
+        return min(self.backoff_cap_s,
+                   self.backoff_base_s * (2.0 ** max(0, failures - 1)))
+
+    def in_backoff(self, instance: str, now: float) -> bool:
+        with self._lock:
+            state = self._failing.get(instance)
+            return state is not None and now < state["until"]
+
+    def _scrape_target(self, labels: dict, host: str, port: int,
+                       now: Optional[float] = None) -> Optional[str]:
         instance = labels["instance"]
+        if now is None:
+            now = time.monotonic()
+        started = time.perf_counter()
         try:
             text = self._scrape_one(host, port)
             # Validate HERE, inside the per-instance guard: one worker
@@ -139,7 +165,12 @@ class FleetCollector:
             # parses it later.
             metrics.parse_exposition(text)
             with self._lock:
-                self._failing.discard(instance)
+                recovered = self._failing.pop(instance, None) is not None
+            if recovered:
+                from lws_tpu.core import flightrecorder
+
+                flightrecorder.record("fleet_scrape_recovered",
+                                      instance=instance)
             return text
         except (OSError, ValueError, HTTPException) as e:
             self._own_metrics.inc(
@@ -152,10 +183,22 @@ class FleetCollector:
             # set runs under _lock: this method executes on the scrape
             # pool's threads, and two lock-free concurrent misses could
             # both pass the membership test and double-record the edge.
+            # Each consecutive miss doubles the instance's backoff window
+            # (collect() skips it until `until` passes).
+            # Anchor the window at the FAILURE time, not collect-start: a
+            # timing-out scrape otherwise consumes its own backoff window
+            # (timeout_s ~= backoff_base_s) and gets re-dialed every cache
+            # refill anyway. `now` stays the injected base so tests remain
+            # deterministic; the elapsed scrape time rides on top.
+            failed_at = now + (time.perf_counter() - started)
             with self._lock:
-                newly_failing = instance not in self._failing
-                if newly_failing:
-                    self._failing.add(instance)
+                state = self._failing.get(instance)
+                newly_failing = state is None
+                failures = 1 if newly_failing else state["failures"] + 1
+                self._failing[instance] = {
+                    "failures": failures,
+                    "until": failed_at + self._backoff_s(failures),
+                }
             if newly_failing:
                 from lws_tpu.core import flightrecorder
 
@@ -165,22 +208,47 @@ class FleetCollector:
                 )
             return None
 
-    def collect(self) -> list[tuple[dict, str]]:
+    def collect(self, now: Optional[float] = None) -> list[tuple[dict, str]]:
         """One scrape pass over the ready fleet: [(labels, exposition)].
         Control-plane registries ride along as instance "control-plane" so
         the fleet view is genuinely ONE surface. Per-instance failures are
-        counted and skipped — a dead worker must not blank the fleet.
-        Targets are scraped concurrently: a partitioned worker costs one
-        timeout of wall clock, not one per victim."""
+        counted and skipped — a dead worker must not blank the fleet — and
+        a KNOWN-failing instance is not even dialed until its backoff
+        expires (each consecutive miss doubles the window up to the cap),
+        so a dead pod costs one timeout per backoff window, not one per
+        cache refill. `now` (monotonic seconds) is injectable so the
+        backoff regression tests drive time deterministically. Targets are
+        scraped concurrently: a partitioned worker costs one timeout of
+        wall clock, not one per victim."""
+        if now is None:
+            now = time.monotonic()
         sources: list[tuple[dict, str]] = []
-        targets = self.targets()
+        targets = []
+        discovered = self.targets()
+        # Prune backoff state for instances that LEFT the ready set: a pod
+        # that restarted under the same name re-enters with a clean slate
+        # (it went unready in between), and names that never return must
+        # not accumulate in _failing forever.
+        live_names = {labels["instance"] for labels, _ in discovered}
+        with self._lock:
+            for stale in [i for i in self._failing if i not in live_names]:
+                del self._failing[stale]
+        for labels, endpoint in discovered:
+            if self.in_backoff(labels["instance"], now):
+                self._own_metrics.inc(
+                    "lws_fleet_scrape_skipped_total",
+                    {"instance": labels["instance"]},
+                )
+                continue
+            targets.append((labels, endpoint))
         with trace.span("fleet.scrape", instances=len(targets)):
             if targets:
                 from concurrent.futures import ThreadPoolExecutor
 
                 with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
                     scraped = pool.map(
-                        lambda t: self._scrape_target(t[0], *t[1]), targets
+                        lambda t: self._scrape_target(t[0], *t[1], now=now),
+                        targets,
                     )
                     sources = [
                         (labels, text)

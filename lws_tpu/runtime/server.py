@@ -23,6 +23,11 @@ Endpoints:
   GET  /debug/profile/fleet           every ready worker's /debug/profile,
                                       merged with instance/role labels
                                       (runtime/fleet.py)
+  GET  /debug/faults                  armed fault points + hit/trip counters
+  POST /debug/faults                  arm/disarm deterministic fault
+                                      schedules in this process
+                                      (core/faults.py; bearer-gated like
+                                      every other mutating endpoint)
   POST /apply                         YAML/JSON manifest (create-or-update)
   GET  /apis/{kind}                   list (JSON manifests)
   GET  /apis/{kind}/{ns}/{name}       get
@@ -289,6 +294,10 @@ class ApiServer:
                             {"labels": labels, "profile": snap}
                             for labels, snap in sources
                         ]})
+                elif path == "/debug/faults":
+                    from lws_tpu.core import faults as faultsmod
+
+                    self._json(200, faultsmod.INJECTOR.snapshot())
                 elif len(parts) == 2 and parts[0] == "apis":
                     try:
                         objs = cp.store.list(_kind(parts[1]))
@@ -387,6 +396,19 @@ class ApiServer:
                 body = self.rfile.read(length).decode()
                 path = self.path.split("?", 1)[0]
                 parts = [p for p in path.split("/") if p]
+                if path == "/debug/faults":
+                    from lws_tpu.core import faults as faultsmod
+
+                    try:
+                        payload = json.loads(body) if body else {}
+                        result = faultsmod.apply_control(payload)
+                    except ValueError as e:
+                        # 400, never 500: bad specs/JSON are caller errors
+                        # (same contract as the other debug surfaces).
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._json(200, result)
+                    return
                 try:
                     if (len(parts) == 5 and parts[0] == "apis"
                             and parts[4] == "apply"):

@@ -11,6 +11,13 @@ engine) exposes its process-default registries over one tiny HTTP server:
   GET /debug/profile         the process profiler's collapsed-stack table
                              (?format=collapsed for raw flamegraph input,
                              ?limit=N keeps the heaviest N stacks)
+  GET  /debug/faults         armed fault points + hit/trip counters
+  POST /debug/faults         arm/disarm fault schedules in this process
+                             ({"arm": {point: spec}}, {"disarm": [...]},
+                             {"clear": true} — core/faults.py grammar)
+  POST /debug/drain          request graceful drain: the worker loop stops
+                             admitting, finishes in-flight work, exits
+                             clean (core/resilience.py DrainGate)
   GET /healthz               liveness
 
 Workers declare the port via LWS_TPU_METRICS_PORT in their pod env — the
@@ -67,9 +74,11 @@ class TelemetryServer:
         """`watchdog` (a flightrecorder.Watchdog) contributes alerts and the
         last diagnostics dump to /debug/flightrecorder; `token` gates every
         path except /healthz behind `Authorization: Bearer <token>`."""
+        from lws_tpu.core import faults as faultsmod
         from lws_tpu.core import flightrecorder as frmod
         from lws_tpu.core import metrics as metricsmod
         from lws_tpu.core import profile as profmod
+        from lws_tpu.core import resilience as resmod
         from lws_tpu.core import trace as tracemod
 
         self.watchdog = watchdog
@@ -148,6 +157,38 @@ class TelemetryServer:
                         return
                     snapshot = frmod.debug_snapshot(limit, outer.watchdog)
                     self._send(200, json.dumps(snapshot, default=str),
+                               "application/json")
+                elif path == "/debug/faults":
+                    self._send(200, json.dumps(faultsmod.INJECTOR.snapshot()),
+                               "application/json")
+                else:
+                    self._send(404, json.dumps({"error": "unknown path"}),
+                               "application/json")
+
+            def do_POST(self):
+                from urllib.parse import urlparse
+
+                path = urlparse(self.path).path
+                if not self._authorized():
+                    self._send(401, json.dumps({"error": "unauthorized"}),
+                               "application/json")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length).decode() if length else ""
+                if path == "/debug/faults":
+                    try:
+                        payload = json.loads(body) if body else {}
+                        result = faultsmod.apply_control(payload)
+                    except ValueError as e:
+                        # 400, never 500: bad specs/JSON are caller errors,
+                        # same contract as parse_limit.
+                        self._send(400, json.dumps({"error": str(e)}),
+                                   "application/json")
+                        return
+                    self._send(200, json.dumps(result), "application/json")
+                elif path == "/debug/drain":
+                    accepted = resmod.DRAIN.request("debug-endpoint")
+                    self._send(200, json.dumps({"draining": accepted}),
                                "application/json")
                 else:
                     self._send(404, json.dumps({"error": "unknown path"}),

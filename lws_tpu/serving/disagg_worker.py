@@ -141,8 +141,12 @@ def _start_telemetry():
 
 def run_prefill_tcp(once: bool, max_len: int) -> int:
     """Serve prompts-in / KV-bundles-out on LWS_TPU_KV_PORT. With `once`,
-    exit after the first bundle has been pulled AND acked by a peer."""
-    from lws_tpu.core import metrics, slo, trace
+    exit after the first bundle has been pulled AND acked by a peer.
+    SIGTERM (or POST /debug/drain on the telemetry port) drains: stop
+    admitting prompts, finish the in-flight handoff, exit clean — queued
+    prompts stay the router's responsibility (at-least-once: unanswered
+    ids are resubmitted)."""
+    from lws_tpu.core import metrics, resilience, slo, trace
     from lws_tpu.serving import kv_transport as kt
 
     _force_tracing()
@@ -152,6 +156,11 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
     print(f"[prefill {os.environ.get('POD_NAME', '?')}] serving KV on :{server.port}",
           flush=True)
     while True:
+        if resilience.DRAIN.draining:
+            print(f"[prefill] DRAINED ({resilience.DRAIN.reason}): "
+                  f"{server.delivery_counts()[0]} bundles delivered; exiting clean",
+                  flush=True)
+            return 0
         if once and server.delivery_counts()[0] >= 1:
             return 0
         item = server.next_prompt(timeout=0.5)
@@ -159,6 +168,15 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
             continue
         meta, payload = item
         req_id = meta["id"]
+        # Deadline rides the frame meta like trace ctx: an already-expired
+        # prompt is DROPPED (recorded, not prefilled) — burning a prefill
+        # dispatch on a request nobody is waiting for starves live ones.
+        deadline = resilience.Deadline.from_wire(meta.get("deadline_s"))
+        if deadline is not None and deadline.expired():
+            resilience.expire("prefill.admit")
+            print(f"[prefill] DROPPED {req_id}: deadline expired in queue",
+                  flush=True)
+            continue
         prompt = kt.bytes_to_arrays(payload)["prompt"]
         import json as _json
 
@@ -198,13 +216,20 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
         # cost breakdown — and the full span subtree — to the client with
         # the result. The bundle's trace ctx parents decode's subtree under
         # THIS request span, keeping one connected tree across processes.
-        server.offer_bundle(
-            {
-                "id": req_id, "handoff": handoff, "trace": s_req.context,
-                "spans": [s.to_dict() for s in (s_req, s_prefill, s_gather)],
-            },
-            bundle,
-        )
+        # The fault point below is the "prefill dies mid-handoff" chaos
+        # hook: exit mode kills the process after prefill compute but
+        # before the bundle is offered (the request's only copy dies with
+        # it — the router's resubmit is the recovery path).
+        from lws_tpu.core import faults
+
+        faults.fire("disagg.prefill.handoff")
+        bundle_meta = {
+            "id": req_id, "handoff": handoff, "trace": s_req.context,
+            "spans": [s.to_dict() for s in (s_req, s_prefill, s_gather)],
+        }
+        if deadline is not None:
+            bundle_meta["deadline_s"] = deadline.to_wire()
+        server.offer_bundle(bundle_meta, bundle)
         print(f"[prefill] HANDOFF {req_id} {_json.dumps(handoff)}", flush=True)
 
 
@@ -213,18 +238,27 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
     API server), pull KV bundles over TCP, decode, serve results. The pull
     is acked only AFTER the result is posted (end-to-end at-least-once: a
     crash mid-decode re-queues the bundle server-side). With `once`, exit
-    after the first result has been delivered to a peer."""
+    after the first result has been delivered to a peer. SIGTERM / POST
+    /debug/drain drains between pulls: the in-flight bundle finishes and
+    acks, nothing new is admitted, unacked bundles stay queued on prefill
+    for a successor, and the process exits clean."""
     import time as _time
 
     from lws_tpu.api import disagg
     from lws_tpu.client import RemoteClient
-    from lws_tpu.core import trace
+    from lws_tpu.core import faults, resilience, trace
+    from lws_tpu.utils.common import env_float
     from lws_tpu.serving import kv_transport as kt
 
     _force_tracing()
     _start_telemetry()
     engine = build_engine(batch=1, max_len=max_len)
     server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
+    # Replays HAPPEN on this path (ack loss, redelivery after a pull died
+    # mid-processing): the bounded seen-id guard enforces the "decode is
+    # idempotent per id" contract instead of documenting it.
+    seen = resilience.SeenIds(capacity=1024, site="decode")
+    breakers: dict[str, resilience.CircuitBreaker] = {}
     me = os.environ.get("POD_NAME", str(os.getpid()))
     namespace = os.environ.get("POD_NAMESPACE", "default")
     client = RemoteClient(os.environ["LWS_TPU_API"])
@@ -242,6 +276,30 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
     def process(meta, payload):
         import json as _json
 
+        # Chaos hook: exit mode here is "decode crashes mid-processing" —
+        # the connection drops unacked, the bundle re-queues on prefill,
+        # and a successor (or restart) redelivers.
+        faults.fire("disagg.decode.process")
+        if seen.contains(meta["id"]):
+            # A replayed delivery (the ack was lost): the result was
+            # already posted — ack without decoding again, or the replay
+            # would double-spend device time and could double-deliver.
+            # (Ids are recorded only AFTER post_result succeeds — see
+            # below — so a first attempt that died mid-post redelivers
+            # into a real retry, never an ack-with-no-result.)
+            print(f"[decode] REPLAY {meta['id']}: already decoded, "
+                  "acking without re-decode", flush=True)
+            return
+        deadline = resilience.Deadline.from_wire(meta.get("deadline_s"))
+        if deadline is not None and deadline.expired():
+            resilience.expire("decode.admit")
+            server.post_result(
+                meta["id"],
+                {"id": meta["id"], "failed": "deadline exceeded before decode"},
+                b"",
+            )
+            seen.record(meta["id"])
+            return
         # Parent decode's subtree under the prefill-side request span (the
         # bundle meta's trace ctx): one connected tree, client -> prefill ->
         # decode, reassembled client-side from the "spans" records below.
@@ -260,6 +318,7 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
             # every request behind it.
             print(f"[decode] FAILED {meta['id']}: {e!r}", flush=True)
             server.post_result(meta["id"], {"id": meta["id"], "failed": repr(e)[:300]}, b"")
+            seen.record(meta["id"])
             return
         handoff = {**meta.get("handoff", {}), **dstats}
         spans_out = list(meta.get("spans", [])) + dspans + [s_req.to_dict()]
@@ -267,11 +326,21 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
             meta["id"], {"id": meta["id"], "handoff": handoff, "spans": spans_out},
             kt.arrays_to_bytes(tokens=full),
         )
+        # Only NOW is the id complete: recording before the post could turn
+        # a redelivery after a mid-post failure into a silent ack-no-result.
+        seen.record(meta["id"])
         print(f"[decode] HANDOFF {meta['id']} {_json.dumps(handoff)}", flush=True)
         print(f"[decode] finished {meta['id']}: {full[0][:8]}...", flush=True)
 
     endpoint = None
+    breaker = None
     while True:
+        if resilience.DRAIN.draining:
+            print(f"[decode] DRAINED ({resilience.DRAIN.reason}): "
+                  f"{server.delivery_counts()[1]} results delivered; "
+                  "unacked bundles stay queued on prefill; exiting clean",
+                  flush=True)
+            return 0
         if once and server.delivery_counts()[1] >= 1:
             return 0
         if endpoint is None:
@@ -285,16 +354,55 @@ def run_decode_tcp(steps: int, once: bool, max_len: int) -> int:
                 _time.sleep(0.5)
                 continue
             print(f"[decode] prefill endpoint via -prv service: {endpoint}", flush=True)
+            # One breaker per endpoint, kept across rediscoveries: failure
+            # counts must survive the endpoint=None round trips below or
+            # the circuit could never accumulate enough to open. BOUNDED:
+            # every prefill roll mints a fresh ip:port, and a long-lived
+            # decode worker must not leak breakers (or their gauge series)
+            # across weeks of rolls — oldest evicted, its gauge retired.
+            name = f"prefill@{endpoint[0]}:{endpoint[1]}"
+            if name not in breakers:
+                while len(breakers) >= 8:
+                    breakers.pop(next(iter(breakers))).retire()
+                breakers[name] = resilience.CircuitBreaker(
+                    name,
+                    failure_threshold=int(env_float("LWS_TPU_BREAKER_THRESHOLD", 5)),
+                    reset_timeout_s=env_float("LWS_TPU_BREAKER_RESET_S", 5.0),
+                )
+            breaker = breakers[name]
+        if not breaker.allow():
+            # Open circuit: fail fast instead of re-dialing a dead peer
+            # every poll; the half-open probe re-tests after the reset
+            # window (a rolled replica comes back through here).
+            _time.sleep(0.1)
+            continue
         try:
             # process() runs BEFORE the ack goes back (see pull_bundle); the
-            # ack window covers decode + first-call compile.
-            kt.pull_bundle(endpoint, timeout=1.0, process=process, ack_timeout=600.0)
+            # ack window covers decode + first-call compile. One bounded
+            # in-line retry absorbs transient blips (accept-queue hiccups)
+            # without waiting out a full poll interval.
+            resilience.call(
+                lambda: kt.pull_bundle(endpoint, timeout=1.0, process=process,
+                                       ack_timeout=600.0),
+                site="kv.pull_bundle",
+                policy=resilience.RetryPolicy(max_attempts=2, base_s=0.05,
+                                              cap_s=0.25),
+            )
+            breaker.record_success()
         except OSError:
+            breaker.record_failure()
             endpoint = None  # peer rolled/moved: rediscover through the service
             continue
 
 
 def main() -> int:
+    from lws_tpu.core import faults, resilience
+
+    # SIGTERM = the kubelet's stop signal: drain instead of dying mid-
+    # request. Fault schedules arm from the pod env (LWS_TPU_FAULTS) for
+    # chaos runs; POST /debug/faults on the telemetry port can re-arm live.
+    resilience.DRAIN.install_signal_handler()
+    faults.arm_from_env()
     parser = argparse.ArgumentParser()
     parser.add_argument("role", choices=["prefill", "decode"])
     # The directory transport was deleted (round 4); the flag survives so

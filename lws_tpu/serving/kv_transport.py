@@ -26,6 +26,8 @@ import struct
 import threading
 from typing import Optional
 
+from lws_tpu.core import faults, resilience
+
 _FRAME = struct.Struct("!II")
 
 
@@ -42,6 +44,16 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 def send_msg(sock: socket.socket, meta: dict, payload: bytes = b"") -> None:
     header = json.dumps(meta).encode()
     sock.sendall(_FRAME.pack(len(header), len(payload)) + header + payload)
+
+
+def _send_partial(sock: socket.socket, meta: dict, payload: bytes,
+                  nbytes: int) -> None:
+    """Cooperative `partial_write` fault: ship only the first `nbytes` of
+    the frame, leaving the peer with a truncated read — the mid-frame
+    death the re-queue/re-insert paths must survive."""
+    header = json.dumps(meta).encode()
+    frame = _FRAME.pack(len(header), len(payload)) + header + payload
+    sock.sendall(frame[: max(0, nbytes)])
 
 
 def recv_msg(sock: socket.socket) -> tuple[Optional[dict], bytes]:
@@ -201,9 +213,24 @@ class KVServer:
             import time as _time
 
             meta["queue_wait_s"] = max(0.0, _time.time() - enq)
+            # The deadline budget pays for queue time too: deduct the
+            # measured wait so a 2s-budget prompt that queued 30s dequeues
+            # EXPIRED, not with a fresh 2s (the wire carries remaining
+            # seconds; the clock only ticks while someone holds it).
+            if "deadline_s" in meta:
+                meta["deadline_s"] = max(
+                    0.0, float(meta["deadline_s"]) - meta["queue_wait_s"]
+                )
         return meta, payload
 
     def offer_bundle(self, meta: dict, payload: bytes) -> None:
+        if "deadline_s" in meta:
+            # Anchor the bundle's remaining budget at ENQUEUE: time spent
+            # waiting for a decode pull is charged against the deadline
+            # when the bundle ships (see the pull_bundle leg).
+            import time as _time
+
+            meta["_offered_t"] = _time.monotonic()
         self._bundles.put((meta, payload))
         self._backlog_beat()
 
@@ -247,66 +274,105 @@ class KVServer:
             threading.Thread(target=self._serve_one, args=(conn,), daemon=True).start()
 
     def _serve_one(self, conn: socket.socket) -> None:
-        with conn:
-            meta, payload = recv_msg(conn)
-            if meta is None:
-                return
-            if self._token and not hmac.compare_digest(
-                str(meta.get("token", "")).encode(), self._token.encode()
-            ):
-                send_msg(conn, {"error": "unauthorized"})
-                return
-            op = meta.get("op")
-            if op == "submit_prompt":
-                import time as _time
+        # Connection-level failures (peer died mid-frame, injected partial
+        # writes, resets) must not kill the handler thread with a stack
+        # trace: the protocol is one-shot, the peer's retry covers it, and
+        # the bundle/result re-queue paths below already ran.
+        try:
+            with conn:
+                self._handle_one(conn)
+        except OSError:
+            from lws_tpu.core import metrics
 
-                meta["_enq_t"] = _time.time()  # queue-wait stamp (same host)
-                self._prompts.put((meta, payload))
-                send_msg(conn, {"ok": True})
-            elif op == "pull_bundle":
-                try:
-                    bmeta, bpayload = self._bundles.get(timeout=meta.get("timeout", 1.0))
-                except queue.Empty:
-                    send_msg(conn, {"none": True})
-                    return
-                # At-least-once END TO END: the bundle is only discarded once
-                # the puller acks on this connection, and the puller acks only
-                # after it has PROCESSED the bundle (result posted) — a decode
-                # crash mid-processing drops the connection, the bundle
-                # re-queues, and another pull redelivers (decode is idempotent
-                # per id, so replays are harmless). The ack window covers
-                # decode + first-call compile.
-                try:
-                    send_msg(conn, bmeta, bpayload)
-                    conn.settimeout(float(meta.get("ack_timeout", 120.0)))
-                    ack, _ = recv_msg(conn)
-                    if not (ack or {}).get("ack"):
-                        raise OSError("no ack")
-                    with self._counts_lock:
-                        self.bundles_delivered += 1
-                    self._backlog_beat()  # progress advanced: backlog drains
-                except OSError:
-                    self._bundles.put((bmeta, bpayload))
-                    self._backlog_beat()
-            elif op == "pull_result":
-                # Pop under the lock BEFORE sending: two concurrent pulls for
-                # the same id must not both deliver (results_served drives
-                # --once exit); re-insert on send failure so a retry works.
-                with self._results_lock:
-                    entry = self._results.pop(meta.get("id", ""), None)
-                if entry is None:
-                    send_msg(conn, {"none": True})
-                    return
-                try:
-                    send_msg(conn, entry[0], entry[1])
-                except OSError:
-                    with self._results_lock:
-                        self._results.setdefault(meta.get("id", ""), entry)
-                    return
+            metrics.inc("serving_kv_connection_errors_total")
+
+    def _handle_one(self, conn: socket.socket) -> None:
+        faults.fire("kv.server.recv")
+        meta, payload = recv_msg(conn)
+        if meta is None:
+            return
+        if self._token and not hmac.compare_digest(
+            str(meta.get("token", "")).encode(), self._token.encode()
+        ):
+            send_msg(conn, {"error": "unauthorized"})
+            return
+        op = meta.get("op")
+        if op == "submit_prompt":
+            import time as _time
+
+            meta["_enq_t"] = _time.time()  # queue-wait stamp (same host)
+            self._prompts.put((meta, payload))
+            send_msg(conn, {"ok": True})
+        elif op == "pull_bundle":
+            try:
+                bmeta, bpayload = self._bundles.get(timeout=meta.get("timeout", 1.0))
+            except queue.Empty:
+                send_msg(conn, {"none": True})
+                return
+            import time as _time
+
+            offered = bmeta.pop("_offered_t", None)
+            pop_t = _time.monotonic()
+            if offered is not None and "deadline_s" in bmeta:
+                # Charge the bundle-queue wait against the deadline (the
+                # internal anchor never crosses the wire).
+                bmeta["deadline_s"] = max(
+                    0.0, float(bmeta["deadline_s"]) - (pop_t - offered)
+                )
+            # At-least-once END TO END: the bundle is only discarded once
+            # the puller acks on this connection, and the puller acks only
+            # after it has PROCESSED the bundle (result posted) — a decode
+            # crash mid-processing drops the connection, the bundle
+            # re-queues, and another pull redelivers (decode is idempotent
+            # per id, so replays are harmless). The ack window covers
+            # decode + first-call compile.
+            try:
+                fault = faults.fire("kv.server.send_bundle")
+                if fault is not None and fault.mode == "partial_write":
+                    _send_partial(conn, bmeta, bpayload, int(fault.arg))
+                    raise OSError("injected partial bundle write")
+                send_msg(conn, bmeta, bpayload)
+                conn.settimeout(float(meta.get("ack_timeout", 120.0)))
+                ack, _ = recv_msg(conn)
+                if not (ack or {}).get("ack"):
+                    raise OSError("no ack")
                 with self._counts_lock:
-                    self.results_served += 1
-            else:
-                send_msg(conn, {"error": f"unknown op {op!r}"})
+                    self.bundles_delivered += 1
+                self._backlog_beat()  # progress advanced: backlog drains
+            except OSError:
+                if "deadline_s" in bmeta:
+                    # The failed delivery window (pop -> here) burned real
+                    # budget too; deduct it and re-anchor for redelivery.
+                    now = _time.monotonic()
+                    bmeta["deadline_s"] = max(
+                        0.0, float(bmeta["deadline_s"]) - (now - pop_t)
+                    )
+                    bmeta["_offered_t"] = now
+                self._bundles.put((bmeta, bpayload))
+                self._backlog_beat()
+        elif op == "pull_result":
+            # Pop under the lock BEFORE sending: two concurrent pulls for
+            # the same id must not both deliver (results_served drives
+            # --once exit); re-insert on send failure so a retry works.
+            with self._results_lock:
+                entry = self._results.pop(meta.get("id", ""), None)
+            if entry is None:
+                send_msg(conn, {"none": True})
+                return
+            try:
+                fault = faults.fire("kv.server.send_result")
+                if fault is not None and fault.mode == "partial_write":
+                    _send_partial(conn, entry[0], entry[1], int(fault.arg))
+                    raise OSError("injected partial result write")
+                send_msg(conn, entry[0], entry[1])
+            except OSError:
+                with self._results_lock:
+                    self._results.setdefault(meta.get("id", ""), entry)
+                return
+            with self._counts_lock:
+                self.results_served += 1
+        else:
+            send_msg(conn, {"error": f"unknown op {op!r}"})
 
 
 def _auth(meta: dict) -> dict:
@@ -320,21 +386,40 @@ def _auth(meta: dict) -> dict:
 
 def _one_shot(endpoint: tuple[str, int], meta: dict, payload: bytes = b"",
               timeout: float = 10.0) -> tuple[Optional[dict], bytes]:
-    with socket.create_connection(endpoint, timeout=timeout) as sock:
+    # Every blocking point checks the bound deadline BEFORE waiting and
+    # clamps its socket timeout to the remaining budget: a dead peer costs
+    # what the request had left, never the full transport timeout.
+    resilience.check("kv.connect")
+    faults.fire("kv.client.connect")
+    with socket.create_connection(
+        endpoint, timeout=resilience.clamp_timeout(timeout)
+    ) as sock:
         send_msg(sock, _auth(meta), payload)
+        faults.fire("kv.client.recv")
         return recv_msg(sock)
+
+
+def _deadline_meta(meta: dict) -> dict:
+    """Attach the caller's bound deadline to the frame meta — remaining
+    seconds, re-anchored by the peer — exactly like the trace ctx rides."""
+    deadline = resilience.current()
+    if deadline is not None:
+        meta["deadline_s"] = deadline.to_wire()
+    return meta
 
 
 def submit_prompt(endpoint, req_id: str, prompt_bytes: bytes,
                   trace_ctx: Optional[dict] = None) -> None:
     """`trace_ctx` (default: the caller's current span context) rides the
     frame meta so the prefill worker's span subtree grafts onto the
-    caller's trace — the cross-process leg of the trace spine."""
+    caller's trace — the cross-process leg of the trace spine. The bound
+    `resilience.Deadline` (if any) rides the same way: the prefill worker
+    drops expired prompts instead of burning prefill on them."""
     if trace_ctx is None:
         from lws_tpu.core import trace
 
         trace_ctx = trace.current_context()
-    meta = {"op": "submit_prompt", "id": req_id}
+    meta = _deadline_meta({"op": "submit_prompt", "id": req_id})
     if trace_ctx:
         meta["trace"] = trace_ctx
     meta, _ = _one_shot(endpoint, meta, prompt_bytes)
@@ -356,10 +441,15 @@ def pull_bundle(endpoint, timeout: float = 1.0, process=None,
     to the server as its ack-wait window — size it for the callback's worst
     case (decode + first-call jit compile), or the server re-queues and
     redelivers while the puller is still working."""
-    with socket.create_connection(endpoint, timeout=timeout + 9.0) as sock:
+    resilience.check("kv.pull_bundle")
+    faults.fire("kv.client.connect")
+    with socket.create_connection(
+        endpoint, timeout=resilience.clamp_timeout(timeout + 9.0)
+    ) as sock:
         send_msg(sock, _auth({
             "op": "pull_bundle", "timeout": timeout, "ack_timeout": ack_timeout,
         }))
+        faults.fire("kv.client.recv")
         meta, payload = recv_msg(sock)
         if meta is None:
             raise OSError("truncated pull_bundle reply")
@@ -368,19 +458,32 @@ def pull_bundle(endpoint, timeout: float = 1.0, process=None,
         if meta.get("none"):
             return None
         if process is None:
-            send_msg(sock, {"ack": True})
+            _send_ack(sock)
             return meta, payload
         result = process(meta, payload)  # raise => no ack => server re-queues
-        send_msg(sock, {"ack": True})
+        _send_ack(sock)
         return result
 
 
-def pull_result(endpoint, req_id: str):
+def _send_ack(sock: socket.socket) -> None:
+    fault = faults.fire("kv.ack")
+    if fault is not None and fault.mode == "drop":
+        # Injected ack loss: the connection closes unacked, the server
+        # re-queues, and the next pull REPLAYS the bundle — the decode
+        # worker's seen-id dedup guard must absorb it.
+        return
+    send_msg(sock, {"ack": True})
+
+
+def pull_result(endpoint, req_id: str, timeout: float = 10.0):
     """None = not ready yet. Raises on protocol-level rejection (e.g. auth)
     instead of handing the error reply back as if it were a result. A
     delivered result whose meta carries "failed" is the DECODE's verdict on
-    a poison request — returned to the caller, who must check it."""
-    meta, payload = _one_shot(endpoint, {"op": "pull_result", "id": req_id})
+    a poison request — returned to the caller, who must check it.
+    `timeout` bounds the socket (further clamped to any bound deadline)."""
+    meta, payload = _one_shot(
+        endpoint, {"op": "pull_result", "id": req_id}, timeout=timeout
+    )
     if meta is None or meta.get("none"):
         return None
     if meta.get("error"):

@@ -49,7 +49,7 @@ from typing import Callable
 
 import numpy as np
 
-from lws_tpu.core import flightrecorder, metrics, trace
+from lws_tpu.core import faults, flightrecorder, metrics, trace
 
 
 def remaining_steps(req, max_len: int) -> int:
@@ -133,6 +133,11 @@ class DecodePipeline:
         return _HostSection(self)
 
     def push(self, steps: int, payload, commit: Callable) -> None:  # hot-path
+        # Disarmed this is one flag read (faults.py's no-op fast path); the
+        # decode-overlap budget in make check holds the line. Armed `delay`
+        # schedules inject dispatch-side slowness — the deterministic way
+        # to rehearse a wedged ring against the stall watchdog.
+        faults.fire("pipeline.dispatch")  # vet: ignore[hotpath-blocking-call]: delay-mode faults sleep BY DESIGN — armed only in chaos runs, disarmed cost is one flag read
         with self._lock:
             self._ring.append((steps, payload, commit))
             self.stats["dispatched"] += 1

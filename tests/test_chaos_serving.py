@@ -1,0 +1,794 @@
+"""Chaos suite: the serving data plane under injected faults (ISSUE 8).
+
+Every scenario drives REAL transport (KVServer sockets over localhost)
+through deterministic fault schedules — no monkeypatching the code under
+test, no lucky interleavings. Clocks are injected where windows matter
+(breaker reset, backoff); the only waits are injected `delay` faults and
+bounded sub-second socket timeouts.
+
+Mutation proof: each resilience mechanism (deadline, retry, breaker,
+drain, dedup) has a paired test that env-disables it
+(LWS_TPU_RESILIENCE_DISABLE) and asserts the failure it exists to close
+RE-OPENS — a mechanism whose removal changes nothing is decoration, not
+resilience.
+
+The multi-process e2e (prefill killed mid-handoff + ack loss, byte-
+identical replay) is `slow`-marked: `make chaos` runs it, the tier-1
+sweep skips it like the other subprocess e2es."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from lws_tpu.core import faults, flightrecorder, metrics, resilience
+from lws_tpu.core.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    DrainGate,
+    RetryBudget,
+    RetryPolicy,
+    SeenIds,
+)
+from lws_tpu.serving import kv_transport as kt
+
+
+@pytest.fixture
+def armed():
+    """Arm schedules on the process injector (what the wired fault points
+    read); ALWAYS disarmed after — a leaked schedule poisons later tests."""
+
+    def arm(point: str, spec: str) -> None:
+        faults.INJECTOR.arm(point, spec)
+
+    yield arm
+    faults.INJECTOR.disarm()
+
+
+@pytest.fixture
+def server():
+    s = kt.KVServer(port=0, host="127.0.0.1")
+    yield s
+    s.close()
+
+
+def ep(server):
+    return ("127.0.0.1", server.port)
+
+
+def no_sleep(_s: float) -> None:
+    """Injected retry sleeper: chaos runs never wait wall-clock backoff."""
+
+
+# ---------------------------------------------------------------------------
+# Retry
+
+
+def test_retry_recovers_from_transient_connect_failures(armed, server):
+    server.post_result("r1", {"id": "r1"}, b"out")
+    armed("kv.client.connect", "fail_n_times:2:ConnectionError")
+    before = metrics.REGISTRY.counter_value(
+        "serving_retries_total", {"site": "chaos.pull", "outcome": "retry"})
+    got = resilience.call(
+        lambda: kt.pull_result(ep(server), "r1"),
+        site="chaos.pull",
+        policy=RetryPolicy(max_attempts=4, base_s=0.0, cap_s=0.0),
+        sleeper=no_sleep,
+    )
+    assert got is not None and got[1] == b"out"
+    after = metrics.REGISTRY.counter_value(
+        "serving_retries_total", {"site": "chaos.pull", "outcome": "retry"})
+    assert after == before + 2  # exactly the two injected failures
+
+
+def test_retry_disabled_fails_on_first_transient(armed, server, monkeypatch):
+    """Mutation proof: with retry off, the same two-blip schedule that the
+    test above absorbs kills the call on blip one."""
+    monkeypatch.setenv(resilience.DISABLE_ENV, "retry")
+    server.post_result("r2", {"id": "r2"}, b"out")
+    armed("kv.client.connect", "fail_n_times:2:ConnectionError")
+    with pytest.raises(ConnectionError):
+        resilience.call(
+            lambda: kt.pull_result(ep(server), "r2"),
+            site="chaos.pull",
+            policy=RetryPolicy(max_attempts=4, base_s=0.0, cap_s=0.0),
+            sleeper=no_sleep,
+        )
+
+
+def test_retry_exhaustion_and_budget():
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        resilience.call(always_fails, site="chaos.exhaust",
+                        policy=RetryPolicy(max_attempts=3, base_s=0.0),
+                        sleeper=no_sleep)
+    assert calls["n"] == 3
+    assert metrics.REGISTRY.counter_value(
+        "serving_retries_total",
+        {"site": "chaos.exhaust", "outcome": "exhausted"}) >= 1.0
+    # A dry budget stops the storm after the FIRST failure.
+    budget = RetryBudget(capacity=0.0)
+    calls["n"] = 0
+    with pytest.raises(OSError):
+        resilience.call(always_fails, site="chaos.budget",
+                        policy=RetryPolicy(max_attempts=5, base_s=0.0),
+                        budget=budget, sleeper=no_sleep)
+    assert calls["n"] == 1
+    assert metrics.REGISTRY.counter_value(
+        "serving_retries_total",
+        {"site": "chaos.budget", "outcome": "budget_exhausted"}) >= 1.0
+
+
+def test_retry_backoff_is_decorrelated_jitter_and_seedable():
+    import random
+
+    sleeps: list[float] = []
+
+    def failing():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        resilience.call(failing, site="chaos.jitter",
+                        policy=RetryPolicy(max_attempts=4, base_s=0.05,
+                                           cap_s=1.0),
+                        sleeper=sleeps.append, rng=random.Random(7))
+    sleeps2: list[float] = []
+    with pytest.raises(OSError):
+        resilience.call(failing, site="chaos.jitter",
+                        policy=RetryPolicy(max_attempts=4, base_s=0.05,
+                                           cap_s=1.0),
+                        sleeper=sleeps2.append, rng=random.Random(7))
+    assert sleeps == sleeps2 and len(sleeps) == 3  # seeded = reproducible
+    assert all(0.05 <= s <= 1.0 for s in sleeps)
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+
+
+@pytest.fixture
+def black_hole():
+    """A peer that accepts and then says nothing — the hang shape."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(4)
+    yield ("127.0.0.1", sock.getsockname()[1])
+    sock.close()
+
+
+def test_deadline_trips_instead_of_hanging(black_hole):
+    """A dead-silent peer costs the request its REMAINING BUDGET, not the
+    transport's 10s default: the clamped socket timeout fails the attempt
+    fast and the next blocking point raises DeadlineExceeded."""
+    before = metrics.REGISTRY.counter_value(
+        "serving_deadline_expirations_total", {"site": "chaos.deadline"})
+    t0 = time.perf_counter()
+    with resilience.bind(Deadline(0.08)):
+        with pytest.raises(DeadlineExceeded):
+            resilience.call(
+                lambda: kt.pull_result(black_hole, "nope"),
+                site="chaos.deadline",
+                policy=RetryPolicy(max_attempts=3, base_s=0.0),
+                sleeper=no_sleep,
+            )
+    assert time.perf_counter() - t0 < 1.0  # budget-bounded, not 10s-bounded
+    after = metrics.REGISTRY.counter_value(
+        "serving_deadline_expirations_total", {"site": "chaos.deadline"})
+    assert after >= before + 1
+
+
+def test_deadline_disabled_waits_full_socket_timeout(black_hole, monkeypatch):
+    """Mutation proof: deadline off = the call blocks for the transport
+    timeout (bounded to 0.3s here only because the test passes one) and
+    surfaces a socket error, never DeadlineExceeded."""
+    monkeypatch.setenv(resilience.DISABLE_ENV, "deadline")
+    t0 = time.perf_counter()
+    with resilience.bind(Deadline(0.05)):
+        with pytest.raises(OSError) as err:
+            kt.pull_result(black_hole, "nope", timeout=0.3)
+    assert not isinstance(err.value, DeadlineExceeded)
+    assert time.perf_counter() - t0 >= 0.25  # waited PAST the dead budget
+
+
+def test_deadline_rides_frame_meta_to_the_worker(server):
+    """The wire leg: submit with a bound deadline, and the meta the worker
+    dequeues carries the remaining budget (re-anchored on its own clock)."""
+    with resilience.bind(Deadline(5.0)):
+        kt.submit_prompt(ep(server), "dl1", b"prompt")
+    meta, _ = server.next_prompt(timeout=2.0)
+    assert 0.0 < float(meta["deadline_s"]) <= 5.0
+    restored = Deadline.from_wire(meta["deadline_s"])
+    assert restored is not None and not restored.expired()
+
+
+def test_injected_delay_makes_slow_network_trip_deadline(armed, server):
+    """The 'slow network' chaos shape from the issue: a delay fault on the
+    server's recv leg makes the peer slow, the deadline-clamped socket
+    timeout fails the attempt, and the retry loop's deadline check turns
+    the would-be hang into a typed, recorded failure."""
+    server.post_result("slow1", {"id": "slow1"}, b"out")
+    armed("kv.server.recv", "delay:0.1")
+    with resilience.bind(Deadline(0.05)):
+        with pytest.raises(DeadlineExceeded):
+            resilience.call(
+                lambda: kt.pull_result(ep(server), "slow1"),
+                site="chaos.slow", policy=RetryPolicy(max_attempts=2,
+                                                      base_s=0.0),
+                sleeper=no_sleep,
+            )
+    faults.INJECTOR.disarm()
+    # The result was never consumed (the slow server found a dead client
+    # socket and never popped the entry): a fresh pull still serves it.
+    assert kt.pull_result(ep(server), "slow1") is not None
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+
+
+def test_breaker_opens_half_opens_and_recovers():
+    fake = {"t": 0.0}
+    breaker = CircuitBreaker("chaos@peer", failure_threshold=2,
+                             reset_timeout_s=5.0, clock=lambda: fake["t"])
+    flightrecorder.RECORDER.clear()
+    assert breaker.allow() and breaker.state == "closed"
+    breaker.record_failure()
+    assert breaker.state == "closed"  # below threshold
+    breaker.record_failure()
+    assert breaker.state == "open"
+    assert not breaker.allow()  # fail fast: no dial at the dead peer
+    assert metrics.REGISTRY.gauge_value(
+        "serving_circuit_state", {"endpoint": "chaos@peer"}) == 2.0
+    fake["t"] = 6.0
+    assert breaker.allow()  # half-open: ONE probe
+    assert breaker.state == "half_open"
+    assert not breaker.allow()  # second caller blocked while probing
+    breaker.record_failure()  # probe failed: straight back to open
+    assert breaker.state == "open" and not breaker.allow()
+    fake["t"] = 12.0
+    assert breaker.allow()
+    breaker.record_success()
+    assert breaker.state == "closed" and breaker.allow()
+    kinds = [
+        (e["from_state"], e["to_state"])
+        for e in flightrecorder.RECORDER.events()
+        if e["kind"] == "circuit_breaker" and e["endpoint"] == "chaos@peer"
+    ]
+    assert kinds == [
+        ("closed", "open"), ("open", "half_open"), ("half_open", "open"),
+        ("open", "half_open"), ("half_open", "closed"),
+    ]
+
+
+def test_breaker_fails_fast_on_dead_endpoint():
+    """Wire-level: after the circuit opens against a connection-refused
+    endpoint, calls fail in microseconds WITHOUT dialing (the refused
+    connect itself costs a syscall; CircuitOpenError costs nothing)."""
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        dead = ("127.0.0.1", probe.getsockname()[1])
+    # Port now closed: connects are refused instantly.
+    breaker = CircuitBreaker("chaos@dead", failure_threshold=1,
+                             reset_timeout_s=60.0)
+    with pytest.raises(OSError):
+        breaker.call(lambda: kt.pull_result(dead, "x"))
+    assert breaker.state == "open"
+    t0 = time.perf_counter()
+    with pytest.raises(CircuitOpenError):
+        breaker.call(lambda: kt.pull_result(dead, "x"))
+    assert time.perf_counter() - t0 < 0.05  # failed fast, no dial
+    breaker.record_success()  # close: no open-breaker heartbeat outlives us
+
+
+def test_breaker_disabled_keeps_dialing(monkeypatch):
+    """Mutation proof: breaker off = every call hits the dead peer."""
+    monkeypatch.setenv(resilience.DISABLE_ENV, "breaker")
+    breaker = CircuitBreaker("chaos@disabled", failure_threshold=1,
+                             reset_timeout_s=60.0)
+    calls = {"n": 0}
+
+    def dial():
+        calls["n"] += 1
+        raise OSError("refused")
+
+    for _ in range(3):
+        with pytest.raises(OSError):
+            breaker.call(dial)
+    assert calls["n"] == 3  # never failed fast
+    assert breaker.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# At-least-once replay + dedup (satellite: idempotency ENFORCED)
+
+
+def test_ack_loss_replays_bundle_and_dedup_decodes_once(armed, server):
+    """The issue's headline replay scenario, in-process: the first ack is
+    dropped (injected), the server re-queues, the second pull REPLAYS the
+    same bundle — and the seen-id guard decodes it exactly once."""
+    server.offer_bundle({"id": "req1"}, b"kvbytes")
+    armed("kv.ack", "drop:1")
+    seen = SeenIds(site="chaos")
+    decodes = {"n": 0}
+
+    def process(meta, payload):
+        if seen.seen(meta["id"]):
+            return
+        decodes["n"] += 1
+        assert payload == b"kvbytes"
+
+    # First delivery: processed, ack DROPPED -> server re-queues.
+    kt.pull_bundle(ep(server), timeout=1.0, process=process)
+    assert server.delivery_counts()[0] == 0  # unacked
+    # Redelivery: replay detected, acked WITHOUT re-decoding.
+    kt.pull_bundle(ep(server), timeout=1.0, process=process)
+    assert decodes["n"] == 1
+
+    def wait_for(predicate, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline and not predicate():
+            time.sleep(0.02)
+        return predicate()
+
+    assert wait_for(lambda: server.delivery_counts()[0] == 1)
+    assert kt.pull_bundle(ep(server), timeout=0.2) is None  # consumed
+    assert metrics.REGISTRY.counter_value(
+        "serving_replays_deduped_total", {"site": "chaos"}) >= 1.0
+
+
+def test_dedup_disabled_decodes_replay_twice(armed, server, monkeypatch):
+    """Mutation proof: dedup off = the replayed bundle burns a second
+    decode (the double-work/double-deliver hazard the guard closes)."""
+    monkeypatch.setenv(resilience.DISABLE_ENV, "dedup")
+    server.offer_bundle({"id": "req2"}, b"kv")
+    armed("kv.ack", "drop:1")
+    seen = SeenIds(site="chaos")
+    decodes = {"n": 0}
+
+    def process(meta, payload):
+        if seen.seen(meta["id"]):
+            return
+        decodes["n"] += 1
+
+    kt.pull_bundle(ep(server), timeout=1.0, process=process)
+    kt.pull_bundle(ep(server), timeout=1.0, process=process)
+    assert decodes["n"] == 2
+
+
+def test_deadline_budget_pays_for_queue_time(server):
+    """Queue wait is charged against the wire deadline on BOTH legs: a
+    prompt (or bundle) that waited out its whole budget dequeues expired,
+    never with a fresh re-anchored budget."""
+    with resilience.bind(Deadline(0.05)):
+        kt.submit_prompt(ep(server), "qw1", b"p")
+    time.sleep(0.1)  # the prompt queues past its entire budget
+    meta, _ = server.next_prompt(timeout=2.0)
+    assert float(meta["deadline_s"]) == 0.0, meta
+    server.offer_bundle({"id": "qw2", "deadline_s": 0.05}, b"b")
+    time.sleep(0.1)  # the bundle parks past its budget too
+    bmeta, _ = kt.pull_bundle(ep(server), timeout=1.0)
+    assert float(bmeta["deadline_s"]) == 0.0, bmeta
+    assert "_offered_t" not in bmeta  # internal anchor never hits the wire
+
+
+def test_two_phase_dedup_failed_first_attempt_retries_for_real(server):
+    """The record-after-post contract: a first attempt that dies BEFORE
+    posting its result must not poison the id — the redelivery is a real
+    retry, not an ack-with-no-result."""
+    server.offer_bundle({"id": "tp1"}, b"x")
+    seen = SeenIds(site="chaos")
+    attempts = []
+
+    def process(meta, payload):
+        if seen.contains(meta["id"]):
+            return
+        attempts.append(meta["id"])
+        if len(attempts) == 1:
+            raise OSError("died before post_result")
+        seen.record(meta["id"])  # the worker records only after posting
+
+    with pytest.raises(OSError):
+        kt.pull_bundle(ep(server), timeout=1.0, process=process)
+    kt.pull_bundle(ep(server), timeout=2.0, process=process)
+    assert attempts == ["tp1", "tp1"]  # the redelivery really re-ran
+    assert seen.contains("tp1")  # only NOW is a further replay deduped
+
+
+def test_seen_ids_bound_evicts_oldest():
+    seen = SeenIds(capacity=3, site="chaos")
+    for rid in ("a", "b", "c"):
+        assert not seen.seen(rid)
+    assert not seen.seen("d")  # evicts "a"
+    assert len(seen) == 3
+    assert not seen.seen("a")  # "a" fell out of the window: not a replay
+    assert seen.seen("c")
+
+
+def test_decode_crash_mid_process_requeues_bundle(armed, server):
+    """Injected decode death (exit mode) mid-processing: the connection
+    drops unacked and the bundle survives server-side for a successor."""
+    server.offer_bundle({"id": "crash1"}, b"payload")
+    armed("disagg.decode.process", "exit:1")
+
+    def process(meta, payload):
+        faults.fire("disagg.decode.process")  # the worker's chaos hook
+
+    with pytest.raises(SystemExit):
+        kt.pull_bundle(ep(server), timeout=1.0, process=process)
+    got = kt.pull_bundle(ep(server), timeout=2.0)  # successor pulls
+    assert got is not None and got[0]["id"] == "crash1" and got[1] == b"payload"
+
+
+# ---------------------------------------------------------------------------
+# partial_write: the mid-frame death paths (satellite: KVServer re-insert)
+
+
+def test_partial_write_requeues_bundle_intact(armed, server):
+    """A bundle send that dies mid-frame (injected partial write) must
+    re-queue the bundle server-side, and the next pull receives it INTACT
+    — not truncated, not lost."""
+    payload = bytes(range(256)) * 4
+    server.offer_bundle({"id": "pw1"}, payload)
+    armed("kv.server.send_bundle", "partial_write:6:1")
+    with pytest.raises(OSError):  # truncated reply surfaces to the puller
+        kt.pull_bundle(ep(server), timeout=1.0)
+    got = kt.pull_bundle(ep(server), timeout=2.0)
+    assert got is not None and got[0]["id"] == "pw1" and got[1] == payload
+
+
+def test_partial_write_reinserts_result_for_retry(armed, server):
+    """kv_transport's re-insert-on-send-failure path (pull_result): a send
+    that dies mid-frame re-inserts the entry and a retry delivers it."""
+    server.post_result("pw2", {"id": "pw2"}, b"result-bytes")
+    armed("kv.server.send_result", "partial_write:4:1")
+    assert kt.pull_result(ep(server), "pw2") is None  # truncated = not ready
+    got = kt.pull_result(ep(server), "pw2")  # re-inserted: retry succeeds
+    assert got is not None and got[1] == b"result-bytes"
+    assert server.results_served == 1  # the failed send never counted
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain
+
+
+def _drain_worker(gate, server, hold, done, processed):
+    """A decode-worker-shaped loop: pull/process until drained."""
+
+    def process(meta, payload):
+        processed.append(meta["id"])
+        hold.wait(timeout=10)  # in-flight work the drain must NOT cut short
+
+    while not gate.draining:
+        try:
+            if kt.pull_bundle(ep(server), timeout=0.2, process=process) is None:
+                continue
+        except OSError:
+            break
+    done.set()
+
+
+def test_drain_finishes_in_flight_and_parks_the_rest(server):
+    """Drain mid-bundle: the in-flight item finishes AND acks, nothing new
+    is admitted, the parked items stay queued for a successor."""
+    for i in range(3):
+        server.offer_bundle({"id": f"d{i}"}, b"x")
+    gate = DrainGate()
+    hold, done = threading.Event(), threading.Event()
+    processed: list = []
+    worker = threading.Thread(
+        target=_drain_worker, args=(gate, server, hold, done, processed),
+        daemon=True,
+    )
+    worker.start()
+    deadline = time.time() + 5
+    while not processed and time.time() < deadline:
+        time.sleep(0.01)
+    assert processed == ["d0"]  # one bundle in flight
+    assert gate.request("test")  # drain arrives MID-processing
+    hold.set()  # in-flight work completes...
+    assert done.wait(timeout=5)  # ...and the loop exits clean
+    deadline = time.time() + 5
+    while server.delivery_counts()[0] < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert server.delivery_counts()[0] == 1  # the in-flight item WAS acked
+    assert processed == ["d0"]  # nothing new admitted after the drain
+    # Parked work survives for a successor: both remaining bundles pull.
+    survivors = {kt.pull_bundle(ep(server), timeout=1.0)[0]["id"]
+                 for _ in range(2)}
+    assert survivors == {"d1", "d2"}
+
+
+def test_drain_disabled_keeps_admitting(server, monkeypatch):
+    """Mutation proof: drain off = the request is refused (False) and the
+    loop keeps pulling new work past it."""
+    monkeypatch.setenv(resilience.DISABLE_ENV, "drain")
+    for i in range(3):
+        server.offer_bundle({"id": f"nd{i}"}, b"x")
+    gate = DrainGate()
+    hold, done = threading.Event(), threading.Event()
+    hold.set()  # processing never blocks
+    processed: list = []
+    worker = threading.Thread(
+        target=_drain_worker, args=(gate, server, hold, done, processed),
+        daemon=True,
+    )
+    worker.start()
+    assert gate.request("test") is False  # refused: mechanism disabled
+    deadline = time.time() + 5
+    while len(processed) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(processed) == 3  # kept admitting straight past the drain
+    monkeypatch.delenv(resilience.DISABLE_ENV)
+    gate.request("cleanup")  # now it latches: the loop exits
+    assert done.wait(timeout=5)
+    gate.reset()
+
+
+def test_drain_endpoint_flips_the_process_gate():
+    """POST /debug/drain on the worker telemetry server drives the module
+    DRAIN gate (what the disagg workers poll) and sets the gauge."""
+    import json
+    import urllib.request
+
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    server = TelemetryServer(port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/debug/drain",
+            data=b"{}", method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read().decode())["draining"] is True
+        assert resilience.DRAIN.draining
+        assert resilience.DRAIN.reason == "debug-endpoint"
+        assert metrics.REGISTRY.gauge_value("serving_draining") == 1.0
+    finally:
+        resilience.DRAIN.reset()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet scrape under injected faults
+
+
+def test_fleet_scrape_fault_point_degrades_and_backs_off(armed):
+    from lws_tpu.api.pod import PodPhase
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.telemetry import TelemetryServer
+    from tests.test_telemetry_plane import _make_worker_pod
+
+    worker = TelemetryServer(port=0)
+    worker.start()
+    cp = ControlPlane()
+    try:
+        pod = cp.store.create(_make_worker_pod("chaos-w0", worker.port))
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.ready = True
+        pod.status.address = "127.0.0.1"
+        cp.store.update_status(pod)
+        armed("fleet.scrape", "fail_n_times:1:ConnectionError")
+        assert cp.fleet.collect(now=100.0) is not None
+        assert cp.metrics.counter_value(
+            "lws_fleet_scrape_errors_total", {"instance": "chaos-w0"}) == 1.0
+        # Inside the backoff window the worker is not even dialed...
+        cp.fleet.collect(now=100.5)
+        assert cp.metrics.counter_value(
+            "lws_fleet_scrape_errors_total", {"instance": "chaos-w0"}) == 1.0
+        # ...and after it expires the (now fault-free) scrape recovers.
+        sources = cp.fleet.collect(now=1000.0)
+        assert any(labels.get("instance") == "chaos-w0"
+                   for labels, _ in sources)
+        assert [e for e in flightrecorder.RECORDER.events()
+                if e["kind"] == "fleet_scrape_recovered"
+                and e.get("instance") == "chaos-w0"]
+    finally:
+        worker.stop()
+
+
+# ---------------------------------------------------------------------------
+# The multi-process e2e: prefill killed mid-handoff + ack loss -> replay,
+# byte-identical. `slow` like the other subprocess e2es; `make chaos` runs it.
+
+
+@pytest.mark.slow
+def test_e2e_disagg_prefill_death_and_ack_loss_replay(tmp_path):
+    """ISSUE 8 acceptance: a fault schedule kills the prefill worker mid-
+    handoff (armed via POST /debug/faults on ITS telemetry server — the
+    restarted replacement comes up clean) and drops the decode worker's
+    first ack. The request still completes via replay — the restart policy
+    recreates prefill, the router resubmits (its retry), the re-queued
+    bundle replays into the dedup guard — and the token stream is byte-
+    identical to the fault-free oracle. Retry/breaker/fault metrics are
+    visible on the merged fleet exposition."""
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    from lws_tpu.client import RemoteClient
+    from lws_tpu.core import trace
+    from lws_tpu.core.store import new_meta
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+    from lws_tpu.api.disagg import DisaggregatedSet, DisaggregatedSetSpec
+    from tests.test_dns_metrics import parse_exposition
+    from tests.test_e2e_disagg import DECODE_STEPS, free_port, role_spec
+    from tests.test_e2e_local import make_backend
+
+    trace.TRACER.enabled = True
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    api_url = f"http://127.0.0.1:{api.port}"
+    prefill_port, decode_port = free_port(), free_port()
+    prefill_metrics, decode_metrics = free_port(), free_port()
+    from lws_tpu.api.pod import EnvVar
+
+    ds = DisaggregatedSet(
+        meta=new_meta("llmd-chaos"),
+        spec=DisaggregatedSetSpec(roles=[
+            role_spec("prefill", prefill_port, api_url,
+                      metrics_port=prefill_metrics),
+            role_spec("decode", decode_port, api_url,
+                      # Fast breaker recovery: prefill WILL die and return.
+                      extra_env=[EnvVar("LWS_TPU_BREAKER_RESET_S", "1.0")],
+                      metrics_port=decode_metrics),
+        ]),
+    )
+    backend = make_backend(cp, tmp_path)
+    cp.manager.register(backend, {"Pod": lambda o: [o.key()]})
+    client = RemoteClient(api_url)
+
+    def post_faults(port: int, payload: dict) -> None:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/faults",
+            data=json.dumps(payload).encode(), method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+
+    try:
+        cp.create(ds)
+        cp.run_until_stable()
+        deadline = time.time() + 240
+
+        # Arm the chaos BEFORE the request flows, via the live /debug/faults
+        # control surface: prefill dies mid-handoff ONCE (its restarted
+        # replacement is unarmed — fault state is per-process); decode
+        # drops its first ack.
+        for port, payload in (
+            (prefill_metrics, {"arm": {"disagg.prefill.handoff": "exit:1"}}),
+            (decode_metrics, {"arm": {"kv.ack": "drop:1"}}),
+        ):
+            while time.time() < deadline:
+                try:
+                    post_faults(port, payload)
+                    break
+                except OSError:
+                    backend.poll_all()
+                    cp.run_until_stable()
+                    time.sleep(0.5)
+            else:
+                pytest.fail(f"telemetry port {port} never came up")
+
+        prompt = np.array([5, 9, 2, 11, 7], dtype=np.int32)
+        prompt_bytes = kt.arrays_to_bytes(prompt=prompt)
+
+        def submit():
+            endpoint = kt.discover_role_endpoint(
+                client, "default", "llmd-chaos", "prefill")
+            if endpoint is None:
+                raise OSError("prefill endpoint not published yet")
+            kt.submit_prompt(endpoint, "chaos-req", prompt_bytes)
+
+        # First submission: retried until the (first) prefill accepts.
+        while time.time() < deadline:
+            try:
+                submit()
+                break
+            except (OSError, RuntimeError):
+                backend.poll_all()
+                cp.run_until_stable()
+                time.sleep(0.5)
+        else:
+            pytest.fail("prefill never accepted the prompt")
+
+        # The armed prefill DIES mid-handoff: the prompt's only copy dies
+        # with it. The router-shaped recovery is resubmission (decode is
+        # idempotent per id, so over-submitting is safe) — through the
+        # resilience retry helper so the attempts land in
+        # serving_retries_total on the control-plane instance.
+        result = None
+        last_resubmit = time.time()
+        while time.time() < deadline and result is None:
+            backend.poll_all()
+            cp.run_until_stable()
+            decode_ep = kt.discover_role_endpoint(
+                client, "default", "llmd-chaos", "decode")
+            if decode_ep is not None:
+                try:
+                    got = kt.pull_result(decode_ep, "chaos-req")
+                except (OSError, RuntimeError):
+                    got = None
+                if got is not None:
+                    assert "failed" not in got[0], got[0]
+                    result = kt.bytes_to_arrays(got[1])["tokens"]
+                    break
+            if time.time() - last_resubmit > 10.0:
+                last_resubmit = time.time()
+                try:
+                    resilience.call(
+                        submit, site="router.submit",
+                        policy=RetryPolicy(max_attempts=3, base_s=0.1,
+                                           cap_s=0.5,
+                                           retry_on=(OSError, RuntimeError)),
+                    )
+                except (OSError, RuntimeError):
+                    pass  # prefill still restarting: next lap resubmits
+            time.sleep(0.5)
+        assert result is not None, "request never completed via replay"
+
+        # Byte-identical to the fault-free oracle: replay + dedup changed
+        # NOTHING about the tokens.
+        from lws_tpu.serving.disagg_worker import build_engine
+
+        engine = build_engine(batch=1, max_len=32)
+        oracle = engine.generate(
+            np.asarray(prompt).reshape(1, -1), max_new_tokens=DECODE_STEPS + 1
+        )
+        np.testing.assert_array_equal(result[0], np.asarray(oracle.tokens)[0])
+
+        # The resilience plane is VISIBLE on the merged fleet surface:
+        # retry counters (control-plane resubmit + decode pull retries),
+        # breaker state from the decode worker, and the injected-fault
+        # trip counters from both workers.
+        fams = None
+        needed = {"serving_retries_total", "serving_circuit_state",
+                  "lws_fault_trips_total"}
+        while time.time() < deadline:
+            with urllib.request.urlopen(f"{api_url}/metrics/fleet",
+                                        timeout=10) as resp:
+                fams = parse_exposition(resp.read().decode())
+            if needed <= set(fams):
+                break
+            time.sleep(1.1)  # collector cache TTL
+        assert needed <= set(fams), sorted(needed - set(fams))
+        # The decode worker retried its pulls against the dead prefill:
+        # those attempts are visible, instance-labelled, on the fleet view.
+        assert any(
+            labels.get("site") == "kv.pull_bundle"
+            and labels.get("role") == "decode"
+            for _, labels, _ in fams["serving_retries_total"]["samples"]
+        ), fams["serving_retries_total"]["samples"]
+        assert any(
+            labels.get("role") == "decode"
+            and labels.get("endpoint", "").startswith("prefill@")
+            for _, labels, _ in fams["serving_circuit_state"]["samples"]
+        ), fams["serving_circuit_state"]["samples"]
+        # Only the SURVIVING worker's trip counter can ride the fleet: the
+        # prefill's `disagg.prefill.handoff` trip died with the process it
+        # killed. Its evidence is the group-atomic restart the control
+        # plane recorded — the two halves of the chaos story, each on the
+        # surface that survived it.
+        trips = {
+            labels.get("point"): value
+            for _, labels, value in fams["lws_fault_trips_total"]["samples"]
+        }
+        assert trips.get("kv.ack") == 1.0, trips
+        restarts = [e for e in list(cp.recorder.events)
+                    if e.reason == "RecreateGroup"
+                    and "prefill" in e.message]
+        assert restarts, "prefill death never tripped a group recreation"
+    finally:
+        backend.shutdown()
+        api.stop()

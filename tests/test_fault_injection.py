@@ -1,0 +1,309 @@
+"""Fault-injection substrate (ISSUE 8 tentpole): deterministic schedule
+semantics, the LWS_TPU_FAULTS grammar, the /debug/faults control surface on
+both servers, the store conflict hook, and the disarmed fast path.
+
+Everything here is seeded/counter-driven — the same schedule fires the same
+way every run; the only sleeps are injected `delay` faults ≤ 0.05s."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lws_tpu.core import faults, metrics
+from lws_tpu.core.faults import Fault, FaultInjector, parse
+
+
+@pytest.fixture
+def injector():
+    return FaultInjector(env="")
+
+
+@pytest.fixture
+def global_faults():
+    """Arm the PROCESS injector (what the wired fault points read) with
+    guaranteed disarm-after: a leaked schedule would poison later tests."""
+    yield faults.INJECTOR
+    faults.INJECTOR.disarm()
+
+
+# ---------------------------------------------------------------------------
+# Grammar + schedules
+
+
+def test_parse_grammar():
+    specs = parse("kv.ack=drop:1, disagg.prefill.handoff=exit:1;"
+                  "kv.client.connect=fail_n_times:2:ConnectionError")
+    assert specs == {
+        "kv.ack": "drop:1",
+        "disagg.prefill.handoff": "exit:1",
+        "kv.client.connect": "fail_n_times:2:ConnectionError",
+    }
+
+
+@pytest.mark.parametrize("bad", [
+    "pointonly", "=spec", "point=", "p=unknown_mode:1",
+    "p=fail_n_times:x", "p=fail_n_times:1:NotAnException",
+    "p=every_k:0", "p=prob:0.5",  # prob requires a seed
+])
+def test_bad_specs_rejected(bad, injector):
+    with pytest.raises(ValueError):
+        injector.arm_many(parse(bad))
+
+
+def test_fail_n_times_fires_then_passes(injector):
+    injector.arm("kv.client.connect", "fail_n_times:2:ConnectionError")
+    for _ in range(2):
+        with pytest.raises(ConnectionError, match="injected fault"):
+            injector.fire("kv.client.connect")
+    assert injector.fire("kv.client.connect") is None  # budget spent
+    snap = injector.snapshot()
+    assert snap["trips"]["kv.client.connect"] == 2
+    assert snap["hits"]["kv.client.connect"] == 3
+
+
+def test_every_k_fires_periodically(injector):
+    injector.arm("store.conflict", "every_k:3")
+    fired = [injector.hit("store.conflict") is not None for _ in range(9)]
+    assert fired == [False, False, True] * 3  # deterministic period
+
+
+def test_delay_sleeps_then_stops(injector):
+    import time
+
+    injector.arm("kv.client.recv", "delay:0.03:1")
+    t0 = time.perf_counter()
+    assert injector.fire("kv.client.recv") is None  # slept, no error
+    assert time.perf_counter() - t0 >= 0.03
+    t0 = time.perf_counter()
+    assert injector.fire("kv.client.recv") is None  # budget spent: no sleep
+    assert time.perf_counter() - t0 < 0.02
+
+
+def test_drop_and_partial_write_are_cooperative(injector):
+    injector.arm("kv.ack", "drop:1")
+    injector.arm("kv.server.send_bundle", "partial_write:6:1")
+    fault = injector.fire("kv.ack")
+    assert isinstance(fault, Fault) and fault.mode == "drop"
+    assert injector.fire("kv.ack") is None
+    fault = injector.fire("kv.server.send_bundle")
+    assert fault.mode == "partial_write" and fault.arg == 6.0
+
+
+def test_exit_mode_raises_systemexit(injector):
+    injector.arm("disagg.prefill.handoff", "exit:1")
+    with pytest.raises(SystemExit):
+        injector.fire("disagg.prefill.handoff")
+    assert injector.fire("disagg.prefill.handoff") is None
+
+
+def test_prob_is_seed_deterministic():
+    a, b = FaultInjector(env=""), FaultInjector(env="")
+    for injector in (a, b):
+        injector.arm("fleet.scrape", "prob:0.5:42")
+    pattern_a = [injector_hit(a) for _ in range(32)]
+    pattern_b = [injector_hit(b) for _ in range(32)]
+    assert pattern_a == pattern_b  # same seed, same schedule
+    assert any(pattern_a) and not all(pattern_a)
+
+
+def injector_hit(injector):
+    return injector.hit("fleet.scrape") is not None
+
+
+def test_env_arming():
+    injector = FaultInjector(env="kv.ack=drop:1,store.conflict=every_k:2")
+    assert injector.armed
+    assert set(injector.snapshot()["armed"]) == {"kv.ack", "store.conflict"}
+
+
+def test_disarmed_fast_path(injector):
+    assert not injector.armed
+    assert injector.fire("kv.ack") is None
+    assert injector.hit("anything") is None
+    injector.arm("kv.ack", "drop")
+    injector.disarm("kv.ack")
+    assert not injector.armed  # flag drops back with the last point
+
+
+def test_trip_counter_metric(global_faults):
+    before = metrics.REGISTRY.counter_value(
+        "lws_fault_trips_total", {"point": "kv.ack", "mode": "drop"})
+    global_faults.arm("kv.ack", "drop:2")
+    assert faults.fire("kv.ack").mode == "drop"
+    assert faults.hit("kv.ack").mode == "drop"
+    after = metrics.REGISTRY.counter_value(
+        "lws_fault_trips_total", {"point": "kv.ack", "mode": "drop"})
+    assert after == before + 2
+
+
+def test_apply_control_arm_disarm_clear(global_faults):
+    out = faults.apply_control({"arm": {"kv.ack": "drop:1"}})
+    assert out["armed"] == {"kv.ack": "drop:1"}
+    out = faults.apply_control({"disarm": ["kv.ack"]})
+    assert out["armed"] == {}
+    faults.apply_control({"arm": {"a": "fail_n_times:1", "b": "delay:0.01"}})
+    out = faults.apply_control({"clear": True, "arm": {"c": "exit:1"}})
+    assert set(out["armed"]) == {"c"}  # clear applies first
+    with pytest.raises(ValueError):
+        faults.apply_control({"arm": {"p": "bogus_mode"}})
+    with pytest.raises(ValueError):
+        faults.apply_control({"frobnicate": True})
+    faults.apply_control({"clear": True})
+
+
+def test_cooperative_modes_rejected_on_non_cooperative_points(injector):
+    """drop/partial_write only make sense where the call site implements
+    the loss — arming them on a bare fire() point would count trips that
+    injected nothing, so the arm is refused up front."""
+    for point in ("kv.client.connect", "fleet.scrape", "made.up.point"):
+        with pytest.raises(ValueError, match="cooperative"):
+            injector.arm(point, "drop:1")
+    injector.arm("kv.ack", "drop:1")  # a cooperative point still arms
+    assert injector.snapshot()["armed"] == {"kv.ack": "drop:1"}
+
+
+# ---------------------------------------------------------------------------
+# Control surfaces
+
+
+def test_debug_faults_on_worker_telemetry_server(global_faults):
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    server = TelemetryServer(port=0, token="s3cret")
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    auth = {"Authorization": "Bearer s3cret"}
+    try:
+        # Bearer-gated, both verbs: the debug surface can KILL processes.
+        for method, body in (("GET", None), ("POST", b"{}")):
+            req = urllib.request.Request(f"{base}/debug/faults", data=body,
+                                         method=method)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 401
+        req = urllib.request.Request(
+            f"{base}/debug/faults", method="POST", headers=auth,
+            data=json.dumps({"arm": {"kv.ack": "drop:1"}}).encode(),
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["armed"] == {"kv.ack": "drop:1"}
+        assert faults.INJECTOR.snapshot()["armed"] == {"kv.ack": "drop:1"}
+        req = urllib.request.Request(f"{base}/debug/faults", headers=auth)
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read().decode())["trips"] == {"kv.ack": 0}
+        # Bad specs are a 400, never a 500.
+        req = urllib.request.Request(
+            f"{base}/debug/faults", method="POST", headers=auth,
+            data=json.dumps({"arm": {"p": "warp_core_breach"}}).encode(),
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_debug_faults_on_api_server(global_faults):
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/debug/faults", method="POST",
+            data=json.dumps({"arm": {"store.conflict": "every_k:2"}}).encode(),
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read().decode())["armed"] == {
+                "store.conflict": "every_k:2"
+            }
+        with urllib.request.urlopen(f"{base}/debug/faults", timeout=10) as resp:
+            assert "store.conflict" in json.loads(resp.read().decode())["armed"]
+    finally:
+        api.stop()
+
+
+def test_cli_faults_subcommand(global_faults, capsys):
+    from lws_tpu import cli
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    server = TelemetryServer(port=0)
+    server.start()
+    try:
+        rc = cli.main(["faults", "--server", f"127.0.0.1:{server.port}",
+                       "kv.ack=drop:1"])
+        assert rc == 0
+        assert '"kv.ack": "drop:1"' in capsys.readouterr().out
+        rc = cli.main(["faults", "--server", f"127.0.0.1:{server.port}",
+                       "--clear"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["armed"] == {}
+        rc = cli.main(["faults", "--server", f"127.0.0.1:{server.port}",
+                       "not-a-spec"])
+        assert rc == 2
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Store conflict hook
+
+
+def test_store_conflict_fault_exercises_retry_loops(global_faults):
+    from lws_tpu.core.store import ConflictError, Store, new_meta
+    from lws_tpu.api.node import CLUSTER_NAMESPACE, Node
+
+    store = Store()
+    store.create(Node(meta=new_meta("chaos-node", namespace=CLUSTER_NAMESPACE)))
+    global_faults.arm("store.conflict", "every_k:2")
+    # Every 2nd update loses an injected optimistic-concurrency race.
+    node = store.get("Node", CLUSTER_NAMESPACE, "chaos-node")
+    store.update(node)  # hit 1: passes
+    node = store.get("Node", CLUSTER_NAMESPACE, "chaos-node")
+    with pytest.raises(ConflictError, match="injected"):
+        store.update(node)  # hit 2: injected loss
+    store.update(node)  # retry with the SAME rv converges (hit 3 passes)
+
+
+def test_api_server_retry_loops_absorb_injected_conflicts(global_faults):
+    """The /scale path's _retry_conflicts must converge through an armed
+    conflict schedule — the fault proves the retry loop is load-bearing."""
+    import urllib.request as _rq
+
+    from lws_tpu.api.types import (
+        LeaderWorkerSet, LeaderWorkerSetSpec, LeaderWorkerTemplate,
+    )
+    from lws_tpu.api.pod import PodTemplateSpec
+    from lws_tpu.core.store import new_meta
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+
+    cp = ControlPlane()
+    cp.store.create(LeaderWorkerSet(
+        meta=new_meta("scale-chaos"),
+        spec=LeaderWorkerSetSpec(
+            replicas=1,
+            leader_worker_template=LeaderWorkerTemplate(
+                size=1, worker_template=PodTemplateSpec()),
+        ),
+    ))
+    api = ApiServer(cp, port=0)
+    api.start()
+    try:
+        global_faults.arm("store.conflict", "every_k:2")
+        req = _rq.Request(
+            f"http://127.0.0.1:{api.port}/scale/default/scale-chaos",
+            data=json.dumps({"replicas": 3}).encode(), method="POST",
+        )
+        with _rq.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read().decode())["replicas"] == 3
+        global_faults.disarm()
+        assert cp.store.get("LeaderWorkerSet", "default", "scale-chaos").spec.replicas == 3
+    finally:
+        api.stop()

@@ -660,7 +660,127 @@ def test_worker_telemetry_server_surfaces(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# lws-tpu top
+# Resilience-plane watchdog rules (ISSUE 8): an open breaker and a tripped
+# deadline each produce EXACTLY ONE edge-triggered alert with a dump.
+
+
+def test_open_circuit_breaker_alerts_once_with_dump():
+    from lws_tpu.core.flightrecorder import BacklogRule
+    from lws_tpu.core.resilience import CircuitBreaker
+
+    fake = {"t": 0.0}
+    breaker = CircuitBreaker("wd@peer", failure_threshold=1,
+                             reset_timeout_s=60.0, clock=lambda: fake["t"])
+    wd = Watchdog(rules=[BacklogRule("circuit_open", "breaker:wd@*",
+                                     depth_threshold=1.0, sustain_s=0.0)])
+    now = time.monotonic()
+    assert "circuit_open" not in wd.check_now(now=now)  # closed: quiet
+    before = metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "circuit_open"})
+    breaker.record_failure()  # threshold 1: opens, beats depth 1
+    firing = wd.check_now(now=time.monotonic() + 0.001)
+    assert firing["circuit_open"][0]["source"] == "breaker:wd@peer"
+    after = metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "circuit_open"})
+    assert after == before + 1
+    # Steady-open does NOT re-alert (edge-triggered)...
+    wd.check_now(now=time.monotonic() + 0.002)
+    assert metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "circuit_open"}) == after
+    # ...and the trip captured a diagnostics dump naming the alert.
+    dump = wd.last_dump
+    assert dump["reason"] == "watchdog:circuit_open"
+    assert dump["heartbeats"]["breaker:wd@peer"]["depth"] == 1.0
+    assert any(e["kind"] == "circuit_breaker" for e in dump["events"])
+    # Recovery clears the alert.
+    fake["t"] = 100.0
+    assert breaker.allow()  # half-open probe
+    breaker.record_success()  # closed: beat depth 0
+    assert "circuit_open" not in wd.check_now(now=time.monotonic() + 1)
+    assert metrics.REGISTRY.gauge_value(
+        "lws_watchdog_active", {"watchdog": "circuit_open"}) == 0.0
+
+
+def test_tripped_deadline_alerts_once_with_dump():
+    from lws_tpu.core.flightrecorder import TripRule
+    from lws_tpu.core.resilience import Deadline, DeadlineExceeded
+
+    wd = Watchdog(rules=[TripRule("deadline_tripped", "deadline_trips:wd.*",
+                                  window_s=5.0)])
+    before = metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "deadline_tripped"})
+    deadline = Deadline(0.0)  # born expired
+    with pytest.raises(DeadlineExceeded):
+        deadline.check("wd.site")
+    firing = wd.check_now(now=time.monotonic())
+    assert firing["deadline_tripped"][0]["source"] == "deadline_trips:wd.site"
+    after = metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "deadline_tripped"})
+    assert after == before + 1
+    # Steady within the window: still firing but NOT re-counted.
+    wd.check_now(now=time.monotonic() + 1.0)
+    assert metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "deadline_tripped"}) == after
+    dump = wd.last_dump
+    assert dump["reason"] == "watchdog:deadline_tripped"
+    assert any(e["kind"] == "deadline_exceeded" and e["site"] == "wd.site"
+               for e in dump["events"])
+    # The burst going quiet (window passes with no new trips) clears it.
+    assert "deadline_tripped" not in wd.check_now(now=time.monotonic() + 60.0)
+    assert metrics.REGISTRY.gauge_value(
+        "lws_watchdog_active", {"watchdog": "deadline_tripped"}) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Fleet scrape backoff (ISSUE 8 satellite): a down instance is SKIPPED
+# until its backoff expires, with deterministic `now=` injection.
+
+
+def test_fleet_scrape_backoff_skips_down_instance_until_expiry():
+    from lws_tpu.api.pod import PodPhase
+    from lws_tpu.runtime import ControlPlane
+
+    cp = ControlPlane()
+    pod = cp.store.create(_make_worker_pod("backoff-dead", 1))  # nothing listens
+    pod.status.phase = PodPhase.RUNNING
+    pod.status.ready = True
+    pod.status.address = "127.0.0.1"
+    cp.store.update_status(pod)
+    cp.fleet.timeout_s = 0.2
+    errors = lambda: cp.metrics.counter_value(  # noqa: E731
+        "lws_fleet_scrape_errors_total", {"instance": "backoff-dead"})
+    skips = lambda: cp.metrics.counter_value(  # noqa: E731
+        "lws_fleet_scrape_skipped_total", {"instance": "backoff-dead"})
+    cp.fleet.collect(now=100.0)
+    assert errors() == 1.0 and skips() == 0.0
+    # Inside the first backoff window (base 2s): not even dialed.
+    cp.fleet.collect(now=100.5)
+    cp.fleet.collect(now=101.9)
+    assert errors() == 1.0 and skips() == 2.0
+    # Window expired: dialed again (fails again — window doubles to 4s).
+    # The window anchors at the FAILURE time (injected now + the scrape's
+    # own elapsed), so the re-dial points leave sub-second slack.
+    cp.fleet.collect(now=103.0)
+    assert errors() == 2.0
+    cp.fleet.collect(now=105.0)  # ~103 + 4 > 105: still backed off
+    assert errors() == 2.0 and skips() == 3.0
+    cp.fleet.collect(now=108.0)
+    assert errors() == 3.0
+    # The merged view stays parser-valid throughout.
+    parse_exposition(cp.fleet.render_fleet(force=True))
+
+
+def test_fleet_backoff_caps_and_recovers():
+    """The window doubles only to the cap, and one success clears ALL
+    backoff state (plus records the recovery edge event)."""
+    from lws_tpu.runtime.fleet import FleetCollector
+
+    fc = FleetCollector(store=None, metrics_registry=MetricsRegistry(),
+                        backoff_base_s=1.0, backoff_cap_s=4.0)
+    assert [fc._backoff_s(n) for n in (1, 2, 3, 4, 9)] == \
+        [1.0, 2.0, 4.0, 4.0, 4.0]
+    fc._failing["w0"] = {"failures": 3, "until": 200.0}
+    assert fc.in_backoff("w0", 199.0) and not fc.in_backoff("w0", 200.0)
 
 
 TOP_EXPOSITION = """\

@@ -297,6 +297,36 @@ def test_lint_alias_is_style_only_pass():
     assert "1 pass(es)" in proc.stderr
 
 
+def test_hazards_swallows_and_timeouts():
+    found = run_pass(
+        "hazards", [FIXTURES / "lws_tpu" / "hazard_cases.py"], root=FIXTURES
+    )
+    by_detail = {f.detail: f.rule for f in found}
+    # True positives: broad swallows (direct + tuple member) and the two
+    # timeout-less network calls.
+    assert by_detail.get("except-Exception-pass") == "hazard-exception-swallow"
+    assert by_detail.get("except-BaseException-pass") == "hazard-exception-swallow"
+    assert by_detail.get("socket.create_connection") == "hazard-no-timeout"
+    assert by_detail.get("urllib.request.urlopen") == "hazard-no-timeout"
+    quals = {f.qual for f in found}
+    # False-positive guards: narrow swallow, handled broad except, keyword
+    # and positional timeouts, and the suppressed swallow stay silent.
+    for clean in ("narrow_swallow_ok", "broad_but_handled_ok",
+                  "dial_kw_timeout_ok", "dial_positional_timeout_ok",
+                  "fetch_timeout_ok", "swallow_suppressed"):
+        assert clean not in quals, found
+
+
+def test_hazards_scoped_to_lws_tpu_paths():
+    """The same fixture rooted so its rel path is NOT under lws_tpu/
+    produces nothing — tests and tools may swallow and block."""
+    found = run_pass(
+        "hazards", [FIXTURES / "lws_tpu" / "hazard_cases.py"],
+        root=FIXTURES / "lws_tpu",
+    )
+    assert found == []
+
+
 def test_committed_baseline_has_no_orphans_offline():
     """The orphan rule, exercised directly against the committed file:
     every baseline entry (at its full count) must still correspond to

@@ -1,0 +1,68 @@
+"""Hazard-pass fixtures: true positives AND false-positive guards. Lives
+under vet_fixtures/lws_tpu/ because the pass is scoped to lws_tpu/ paths.
+Never imported — only parsed by the analyzer self-tests."""
+
+import socket
+import urllib.request
+
+
+def swallow_broad():
+    try:
+        risky()
+    except Exception:  # true positive: hazard-exception-swallow
+        pass
+
+
+def swallow_base():
+    try:
+        risky()
+    except (ValueError, BaseException):  # true positive: tuple with a broad member
+        pass
+
+
+def swallow_suppressed():
+    try:
+        risky()
+    except Exception:  # vet: ignore[hazard-exception-swallow]: fixture keep-alive loop
+        pass
+
+
+def narrow_swallow_ok():
+    try:
+        risky()
+    except ValueError:  # narrow handler: NOT flagged
+        pass
+
+
+def broad_but_handled_ok():
+    try:
+        risky()
+    except Exception as e:  # broad but handled: NOT flagged
+        print(e)
+
+
+def dial_no_timeout():
+    sock = socket.create_connection(("h", 1))  # true positive: hazard-no-timeout
+    sock.close()
+
+
+def fetch_no_timeout():
+    return urllib.request.urlopen("http://h/metrics")  # true positive
+
+
+def dial_kw_timeout_ok():
+    sock = socket.create_connection(("h", 1), timeout=2.0)
+    sock.close()
+
+
+def dial_positional_timeout_ok():
+    sock = socket.create_connection(("h", 1), 2.0)
+    sock.close()
+
+
+def fetch_timeout_ok():
+    return urllib.request.urlopen("http://h/metrics", None, 5.0)
+
+
+def risky():
+    raise ValueError("boom")

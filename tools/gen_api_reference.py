@@ -93,6 +93,13 @@ def _type_str(t) -> str:
     s = typing.get_type_hints  # noqa: F841 — resolved below, fall back to raw
     if isinstance(t, str):
         return t
+    # Parameterized generics BEFORE the bare-type branch: on Python 3.10
+    # `isinstance(dict[str, str], type)` is True (fixed in 3.11), and the
+    # __name__ path would strip the parameters — the generated reference
+    # must not depend on which interpreter regenerated it.
+    if typing.get_origin(t) is not None:
+        return str(t).replace("typing.", "").replace(
+            "lws_tpu.api.", "").replace("lws_tpu.", "")
     if isinstance(t, type):
         return t.__name__
     return str(t).replace("typing.", "").replace("lws_tpu.api.", "").replace(
@@ -124,6 +131,11 @@ def _real_doc(cls) -> str | None:
     str-enum would render `str.__doc__` builtin noise into the reference.
     """
     doc = cls.__dict__.get("__doc__")
+    # "An enumeration." is Python <=3.10's synthesized enum docstring
+    # (removed in 3.11) — boilerplate, and interpreter-version-dependent
+    # output would churn the generated files on every regeneration.
+    if doc and doc.strip() == "An enumeration.":
+        return None
     if doc and not doc.startswith(cls.__name__ + "("):
         return inspect.cleandoc(doc)
     return None

@@ -1,11 +1,13 @@
 """`lws-tpu vet`: project-aware static analysis suite.
 
-Five passes over the repo (see docs/static-analysis.md for the rule
+Six passes over the repo (see docs/static-analysis.md for the rule
 catalogue): `style` (the old tools/lint.py, folded in), `locks` (guarded
 attributes + lock acquisition order), `hotpath` (no blocking or
 host-sync calls on the decode dispatch path), `resources` (sockets/
-files/executors must be closed, including on error paths), and `spans`
-(spans entered via context manager, metric/span names literal).
+files/executors must be closed, including on error paths), `spans`
+(spans entered via context manager, metric/span names literal), and
+`hazards` (no silent `except Exception: pass` swallows, no socket or
+urlopen calls without an explicit timeout in lws_tpu/).
 
 Entry points: `make vet`, `python -m tools.vet`, or programmatically
 `run_vet(...)` (the analyzer self-tests drive passes through
@@ -21,7 +23,7 @@ from pathlib import Path
 from typing import Optional
 
 from tools.vet import core as _core
-from tools.vet import hotpath, locks, resources, spans, style
+from tools.vet import hazards, hotpath, locks, resources, spans, style
 from tools.vet.core import (  # noqa: F401 — re-exported for tests
     BASELINE_PATH,
     Finding,
@@ -40,6 +42,7 @@ PASSES = {
     hotpath.PASS_NAME: hotpath.run,
     resources.PASS_NAME: resources.run,
     spans.PASS_NAME: spans.run,
+    hazards.PASS_NAME: hazards.run,
 }
 
 

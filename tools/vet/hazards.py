@@ -1,0 +1,97 @@
+"""Hazard pass: failure-handling hygiene in lws_tpu/ source.
+
+Two rules, both scoped to `lws_tpu/` (tests and tools legitimately
+swallow and block):
+
+`hazard-exception-swallow` — an `except Exception:` (or BaseException,
+or a tuple containing either) whose entire body is `pass`: the failure
+vanishes — no log line, no metric, no ring event — which is exactly how
+a partially-broken fleet degrades silently instead of visibly. Narrow
+handlers (`except queue.Empty: pass`) are fine; broad ones must handle,
+count, or at least log. Keep-alive loops that genuinely must outlive
+anything carry `# vet: ignore[hazard-exception-swallow]: reason`.
+
+`hazard-no-timeout` — a `socket.create_connection(...)` or
+`urllib.request.urlopen(...)` call without an explicit timeout: the
+OS-default is effectively infinite, so one dead peer hangs the caller
+forever — the hang class the resilience layer (deadlines, breakers)
+exists to eliminate cannot be allowed back in at the socket layer.
+Positional timeouts count (`create_connection(addr, 5.0)`,
+`urlopen(url, data, 5.0)`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.vet.core import Finding, Module, dotted_name
+
+PASS_NAME = "hazards"
+
+BROAD_EXCEPTIONS = {"Exception", "BaseException"}
+
+# dotted call name -> index of the positional timeout argument.
+_TIMEOUT_CALLS = {
+    "socket.create_connection": 1,
+    "urllib.request.urlopen": 2,
+    "request.urlopen": 2,
+    "urlopen": 2,
+}
+
+
+def _names_in(node: ast.expr) -> set[str]:
+    """Exception-class names a handler type mentions (Name, dotted tail,
+    or any member of a tuple)."""
+    if isinstance(node, ast.Tuple):
+        out: set[str] = set()
+        for elt in node.elts:
+            out |= _names_in(elt)
+        return out
+    if isinstance(node, ast.Name):
+        return {node.id}
+    if isinstance(node, ast.Attribute):
+        return {node.attr}
+    return set()
+
+
+def _body_is_pass(body: list[ast.stmt]) -> bool:
+    return bool(body) and all(isinstance(stmt, ast.Pass) for stmt in body)
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in modules:
+        if not mod.rel.startswith("lws_tpu/") or mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler):
+                # Bare `except:` is the style pass's problem; here only the
+                # explicitly-broad swallow shape.
+                if node.type is not None \
+                        and _names_in(node.type) & BROAD_EXCEPTIONS \
+                        and _body_is_pass(node.body):
+                    broad = sorted(_names_in(node.type) & BROAD_EXCEPTIONS)[0]
+                    findings.append(mod.finding(
+                        "hazard-exception-swallow", node.lineno,
+                        f"except-{broad}-pass",
+                        f"`except {broad}: pass` swallows every failure "
+                        "silently — handle, count, or log it (or suppress "
+                        "with a reason if the loop truly must outlive "
+                        "anything)",
+                    ))
+            elif isinstance(node, ast.Call):
+                dotted = dotted_name(node.func)
+                if dotted not in _TIMEOUT_CALLS:
+                    continue
+                timeout_idx = _TIMEOUT_CALLS[dotted]
+                has_timeout = (
+                    any(kw.arg == "timeout" for kw in node.keywords)
+                    or len(node.args) > timeout_idx
+                )
+                if not has_timeout:
+                    findings.append(mod.finding(
+                        "hazard-no-timeout", node.lineno, dotted,
+                        f"{dotted}() without an explicit timeout hangs "
+                        "forever on a dead peer — pass timeout=",
+                    ))
+    return findings
