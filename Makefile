@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode clean
 
 test:
 	python -m pytest tests/ -q
@@ -21,7 +21,10 @@ bench-decode-overlap:  ## pipelined decode must beat the sync loop's host-blocke
 bench-profile-overhead:  ## the stack sampler at default hz must cost <2% decode throughput (budget json)
 	python benchmarks/profile_overhead_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead  ## what CI would run (vet gates before tests)
+bench-spec-decode:  ## device-resident speculative loop must beat the host-loop oracle's host-blocked fraction (budget json)
+	python benchmarks/spec_decode_bench.py --check
+
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

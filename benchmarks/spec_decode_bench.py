@@ -1,0 +1,208 @@
+"""Speculative decode bench: device-resident vs host-loop spec drain (ISSUE 9).
+
+Measures the paged engine's SPECULATIVE drain on the CPU backend in two
+configurations:
+
+  * sync      — `step_speculative_sync`: the PR-8 host loop kept as the
+                oracle. Drafts on host from token history, blocks on the
+                verify logits (`np.asarray(greedy)`), computes acceptance on
+                host, and re-uploads pos/tokens — every dispatch pays the
+                full host round trip with the device idle.
+  * pipelined — `step_speculative` at ring depth 2: drafting, acceptance,
+                and the commit all run in-kernel; dispatches ride the
+                in-flight ring and the host only unpacks each chunk's packed
+                accepted tokens while the next chunk verifies.
+
+Three numbers per mode:
+
+  * host_blocked_fraction — fraction of the drain's wall time the host spent
+    scheduling (drafting, acceptance, commits, dispatch) with NO device work
+    in flight (`serving_host_blocked_seconds` accounting, instrumented
+    identically in both loops). The tentpole win: the spec inner loop leaves
+    the host.
+  * tokens_per_dispatch — decode tokens per device dispatch (spec +
+    fallback). Device drafting must hold parity with host drafting: the
+    history ring covers the full context at this scale, so the drafts —
+    hence acceptance — are identical.
+  * tok_s — decode tokens/s over the drain.
+
+Greedy token streams must be BYTE-IDENTICAL between the modes — acceptance
+only ever keeps tokens equal to the model's own argmax chain, so moving the
+loop on-device cannot change the stream. Checked every run.
+
+Run:    python benchmarks/spec_decode_bench.py           # report only
+CI:     python benchmarks/spec_decode_bench.py --check   # enforce budget
+The budget lives in benchmarks/spec_decode_budget.json; --check fails if the
+host-blocked-fraction reduction or the tokens/dispatch ratio regresses, or
+the streams diverge. Repetitive prompts (the content class n-gram drafting
+exists for) keep acceptance — and therefore the dispatch schedule —
+deterministic across repeats.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+import bench  # noqa: E402
+
+bench.force_cpu_if_dev()  # axon plugin overrides JAX_PLATFORMS; see helper
+
+import jax.numpy as jnp  # noqa: E402
+
+from lws_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from lws_tpu.serving.paged_engine import PagedBatchEngine  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "spec_decode_budget.json")
+
+SLOTS = 4
+MAX_NEW = 48
+GAMMA = 4
+NGRAM = 3
+REPEATS = 3  # median fraction per mode — one OS scheduling blip in a ~us
+             # host section must not decide a CI verdict
+
+
+def build_model():
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=512, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return cfg, params
+
+
+def make_prompts():
+    # Repetitive prompts: n-gram drafting's content class. A random prompt
+    # would accept ~nothing and the bench would measure the fallback path.
+    r = np.random.RandomState(0)
+    out = []
+    for i in range(SLOTS):
+        pat = r.randint(1, 255, size=8).astype(np.int32)
+        out.append(np.tile(pat, 5))  # 40 tokens
+    return out
+
+
+def _timed_drain(engine, prompts, sync: bool) -> dict:
+    ids = [engine.submit(p, max_new_tokens=MAX_NEW) for p in prompts]
+    assert all(i is not None for i in ids)
+    stats = engine._pipeline.stats
+    for k in ("host_blocked_s", "device_wait_s"):
+        stats[k] = 0.0
+    for k in ("spec_dispatches", "spec_fallback_dispatches"):
+        engine.stats[k] = 0
+    t0 = time.perf_counter()
+    engine.run_until_drained_speculative(gamma=GAMMA, ngram=NGRAM, sync=sync)
+    wall = time.perf_counter() - t0
+    results = [engine.result(i) for i in ids]
+    dispatches = (engine.stats["spec_dispatches"]
+                  + engine.stats["spec_fallback_dispatches"])
+    decode_tokens = sum(len(t) for t in results) - len(results)  # first token
+    return {                                                     # came at admit
+        "wall_s": wall,
+        "host_blocked_s": stats["host_blocked_s"],
+        "host_blocked_fraction": stats["host_blocked_s"] / wall,
+        "dispatches": dispatches,
+        "tokens_per_dispatch": decode_tokens / max(dispatches, 1),
+        "tok_s": decode_tokens / wall,
+        "flushes": stats["flushes"],
+        "results": results,
+    }
+
+
+def run_mode(cfg, params, prompts, sync: bool) -> dict:
+    # donate_steps=False for BOTH modes: on CPU a donating dispatch executes
+    # synchronously inside the call, which would dump the sync oracle's
+    # device compute into its host-blocked window and make the budget
+    # trivially passable (same fairness note as decode_overlap_bench).
+    engine = PagedBatchEngine(
+        cfg, params, slots=SLOTS, max_len=512, block_size=16,
+        pipeline_depth=0 if sync else 2, donate_steps=False,
+    )
+    # Warm pass: compiles prefill and the spec/verify/fallback executables
+    # outside the timed window.
+    for p in prompts:
+        assert engine.submit(p, max_new_tokens=MAX_NEW) is not None
+    engine.run_until_drained_speculative(gamma=GAMMA, ngram=NGRAM, sync=sync)
+
+    runs = [_timed_drain(engine, prompts, sync) for _ in range(REPEATS)]
+    for r in runs[1:]:  # determinism: every repeat emits the same streams
+        assert r["results"] == runs[0]["results"], "nondeterministic streams"
+    med = sorted(runs, key=lambda r: r["host_blocked_fraction"])[REPEATS // 2]
+    return {
+        "mode": "sync" if sync else "pipelined",
+        "repeats": REPEATS,
+        "wall_s": round(med["wall_s"], 4),
+        "host_blocked_s": round(med["host_blocked_s"], 4),
+        "host_blocked_fraction": round(med["host_blocked_fraction"], 5),
+        "dispatches": med["dispatches"],
+        "tokens_per_dispatch": round(med["tokens_per_dispatch"], 2),
+        "tok_s": round(med["tok_s"], 1),
+        "flushes": med["flushes"],
+        "_results": runs[0]["results"],
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true",
+                        help="enforce spec_decode_budget.json (CI mode)")
+    args = parser.parse_args()
+
+    cfg, params = build_model()
+    prompts = make_prompts()
+    sync = run_mode(cfg, params, prompts, sync=True)
+    pipelined = run_mode(cfg, params, prompts, sync=False)
+
+    identical = sync.pop("_results") == pipelined.pop("_results")
+    f_sync = sync["host_blocked_fraction"]
+    f_pipe = pipelined["host_blocked_fraction"]
+    reduction = 1.0 - (f_pipe / f_sync) if f_sync > 0 else 0.0
+    tpd_ratio = (pipelined["tokens_per_dispatch"]
+                 / max(sync["tokens_per_dispatch"], 1e-9))
+
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    ok = (identical
+          and reduction >= budget["min_host_blocked_reduction"]
+          and tpd_ratio >= budget["min_tokens_per_dispatch_ratio"])
+    record = {
+        "metric": "paged speculative-drain host-blocked fraction, "
+                  f"device-resident vs host loop ({jax.default_backend()})",
+        "sync": sync,
+        "pipelined": pipelined,
+        "host_blocked_reduction": round(reduction, 4),
+        "tokens_per_dispatch_ratio": round(tpd_ratio, 4),
+        "tokens_identical": identical,
+        "budget": budget,
+        "ok": ok,
+    }
+    print(json.dumps(record), flush=True)
+    if args.check and not ok:
+        print(
+            f"[spec-decode] FAIL: reduction {reduction:.2%} < budget "
+            f"{budget['min_host_blocked_reduction']:.0%}, or t/d ratio "
+            f"{tpd_ratio:.3f} < {budget['min_tokens_per_dispatch_ratio']}, "
+            f"or streams diverged (identical={identical})",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -563,6 +563,16 @@ def _top_rows(fams: dict) -> dict:
         field = f"kv_{labels.get('state', '?')}"
         r[field] = r.get(field, 0.0) + value
 
+    # Speculation: the kind-labelled token counter folds into per-row
+    # spec_drafted/spec_accepted; render_top derives the accept rate
+    # (SPEC%) — the knob-tuning signal for gamma/ngram.
+    for name, labels, value, _ in fams.get("serving_spec_tokens_total", {}).get("samples", []):
+        if name != "serving_spec_tokens_total":
+            continue
+        r = row(labels)
+        field = f"spec_{labels.get('kind', '?')}"
+        r[field] = r.get(field, 0.0) + value
+
     for family, field in (("serving_ttft_seconds", "ttft"),
                           ("serving_itl_seconds", "itl")):
         per_key: dict = {}
@@ -602,8 +612,8 @@ def render_top(fams: dict, alerts: dict | None = None,
             lines.append(f"  ALERT {name}: {json.dumps(d)}")
     lines.append(
         f"{'INSTANCE':<18}{'ENGINE':<9}{'SLO':>6}{'REQS':>7}{'ACTIVE':>7}"
-        f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{'TTFT_P95':>10}{'ITL_P95':>10}"
-        f"{'DISP/S':>8}"
+        f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{'SPEC%':>7}{'TTFT_P95':>10}"
+        f"{'ITL_P95':>10}{'DISP/S':>8}"
     )
 
     def fmt(v, pattern="{:.3f}", dash="-"):
@@ -627,6 +637,12 @@ def render_top(fams: dict, alerts: dict | None = None,
         lookups = r.get("pfx_hits", 0.0) + r.get("pfx_misses", 0.0)
         if lookups > 0:
             pfx = r.get("pfx_hits", 0.0) / lookups
+        # Speculation accept rate: accepted/drafted draft tokens. Low SPEC%
+        # with speculation on means gamma is burning verify width for
+        # nothing on this traffic (docs/tasks/speculative-decoding.md).
+        spec = None
+        if r.get("spec_drafted", 0.0) > 0:
+            spec = r.get("spec_accepted", 0.0) / r["spec_drafted"]
         lines.append(
             f"{instance:<18}{engine:<9}"
             f"{fmt(r.get('slo'), '{:.2f}'):>6}"
@@ -635,6 +651,7 @@ def render_top(fams: dict, alerts: dict | None = None,
             f"{fmt(r.get('inflight'), '{:.0f}'):>6}"
             f"{fmt(kv, '{:.0%}'):>6}"
             f"{fmt(pfx, '{:.0%}'):>6}"
+            f"{fmt(spec, '{:.0%}'):>7}"
             f"{fmt(r.get('ttft_p95'), '{:.3f}s'):>10}"
             f"{fmt(r.get('itl_p95'), '{:.4f}s'):>10}"
             f"{fmt(rate, '{:.1f}'):>8}"

@@ -432,6 +432,8 @@ describe("serving_requests_total", "Requests admitted per engine")
 describe("serving_admission_duration_seconds", "Admission (prefill-to-slot) latency per engine")
 describe("serving_decode_dispatch_duration_seconds", "Decode dispatch latency per engine")
 describe("serving_spec_verify_duration_seconds", "Speculative verify dispatch latency")
+describe("serving_spec_tokens_total",
+         "Speculative draft tokens verified (kind=drafted) vs model-accepted (kind=accepted), per engine")
 describe("serving_active_slots", "Active decode slots per engine")
 describe("serving_inflight_dispatches", "Dispatched-but-unconsumed decode chunks in the engine's pipeline ring")
 describe("serving_host_blocked_seconds", "Seconds the serving loop spent on host-side scheduling with no device work in flight")

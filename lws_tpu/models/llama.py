@@ -1076,3 +1076,60 @@ def forward_verify_paged(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [B, S, V]
     return logits, cache
+
+
+# ---- device-resident speculative decoding primitives (ISSUE 9) ------------
+# Drafting and acceptance run INSIDE the jitted spec step so the engines
+# never need host token truth on the speculative hot path: no host drafting
+# loop, no np.asarray on the verify logits, no host-rewind round trip.
+
+
+def ngram_draft(
+    hist: jax.Array, hist_len: jax.Array, ngram: int, gamma: int
+) -> jax.Array:
+    """Device twin of Engine._draft_ngram over a bounded token-history ring.
+    `hist` [H] i32 stores global token t at index t % H; `hist_len` [] i32 is
+    the total tokens ever recorded, so the live window is the last
+    min(hist_len, H) tokens. Matches the host algorithm exactly on windows
+    that hold the full context (H >= context length): the LATEST earlier
+    occurrence of the trailing `ngram` wins, the `gamma` tokens after it are
+    the draft, short/absent candidates pad with the last token. vmap over a
+    slot axis for batched engines."""
+    H = hist.shape[0]
+    W = jnp.minimum(hist_len, H)                       # live window length
+    start = hist_len - W                               # global idx of window[0]
+    j = jnp.arange(H)
+    lin = hist[(start + j) % H]                        # lin[j] valid for j < W
+    last = lin[jnp.clip(W - 1, 0, H - 1)]
+    k = jnp.arange(ngram)
+    tail = lin[jnp.clip(W - ngram + k, 0, H - 1)]      # trailing n-gram
+    cand = lin[jnp.clip(j[:, None] + k[None, :], 0, H - 1)]   # [H, ngram]
+    # A candidate start i must be a strictly EARLIER occurrence (host scans
+    # i from len(context)-ngram-1 down); too-short windows match nothing.
+    ok = jnp.all(cand == tail[None, :], axis=1) & (j <= W - ngram - 1)
+    best = jnp.max(jnp.where(ok, j, -1))
+    g = jnp.arange(gamma)
+    idx = best + ngram + g
+    in_window = (best >= 0) & (idx < W)
+    return jnp.where(in_window, lin[jnp.clip(idx, 0, H - 1)], last)
+
+
+def speculative_accept(
+    drafts: jax.Array, greedy: jax.Array, rem: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Packed acceptance for a verify pass: drafts [B, gamma], greedy
+    [B, gamma+1] (argmax over the verify logits), rem [B] remaining token
+    budgets. Longest-accepted-prefix via cumprod-of-matches; returns
+    (take [B], out [B, gamma+1]) where out[b, :take[b]] are the tokens the
+    slot produced this dispatch — the accepted draft prefix plus the model's
+    own next token, budget-clamped exactly like the host loop's
+    `([*d[:a], greedy[a]])[:remaining]`."""
+    gamma = drafts.shape[1]
+    matches = (drafts == greedy[:, :gamma]).astype(jnp.int32)
+    a = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # [B] accepted prefix
+    pos = jnp.arange(gamma + 1)[None, :]
+    ext = jnp.concatenate([drafts, drafts[:, -1:]], axis=1)      # [B, gamma+1]
+    bonus = jnp.take_along_axis(greedy, a[:, None], axis=1)      # [B, 1]
+    out = jnp.where(pos == a[:, None], bonus, ext)
+    take = jnp.minimum(a + 1, rem)
+    return take, out

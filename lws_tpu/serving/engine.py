@@ -422,45 +422,201 @@ class Engine:
             cand.append(context[-1])
         return cand
 
-    def _get_verify(self):
-        """One jitted verify fn — jax.jit already specializes per draft-run
-        shape, so no per-gamma bookkeeping is needed."""
-        if getattr(self, "_verify", None) is None:
+    def _get_spec_step_dense(self, gamma: int, ngram: int):
+        """Device-resident speculative step (ISSUE 9), B=1: draft from the
+        on-device history ring, verify the draft run in one forward pass,
+        compute the longest-accepted-prefix, and commit pos/history/budget
+        in-kernel. Returns the packed [gamma+2] result (take, then produced
+        tokens) — the only thing the host ever transfers. The pre-ISSUE-9
+        loop drafted on host and blocked on the verify logits every dispatch
+        (the vet baseline's five hotpath-host-sync findings); this kernel is
+        what burned that baseline to zero."""
+        cache_key = ("spec", gamma, ngram)
+        store = getattr(self, "_spec_steps", None)
+        if store is None:
+            store = self._spec_steps = {}
+        if cache_key not in store:
+            import dataclasses as _dc
+
             cfg_static = self.cfg
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as _P
 
-            @partial(jax.jit, donate_argnums=(2,),
-                     **({"out_shardings": (None, self._cache_shardings)}
-                        if self.mesh is not None else {}))
-            def _verify(params, tokens, cache):
-                return forward_with_cache(
-                    params, tokens, cache, cfg_static, all_logits=True
+                _rep = NamedSharding(self.mesh, _P())
+                sh = {"out_shardings": (
+                    self._cache_shardings, _rep, _rep, _rep, _rep, _rep
+                )}
+            else:
+                sh = {}
+
+            @partial(jax.jit, donate_argnums=(1,), **sh)
+            def _spec(params, cache, token, hist, hist_len, rem):
+                from lws_tpu.models.llama import ngram_draft, speculative_accept
+
+                drafts = ngram_draft(hist, hist_len, ngram=ngram, gamma=gamma)
+                tokens_in = jnp.concatenate([token, drafts])[None, :]  # [1, S]
+                pos0 = cache.pos
+                all_logits, cache = forward_with_cache(
+                    params, tokens_in, cache, cfg_static, all_logits=True
                 )
+                greedy = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
+                take, out = speculative_accept(drafts[None, :], greedy, rem[None])
+                take0, row = take[0], out[0]
+                # pos IS the rewind: rejected draft rows sit past it, masked
+                # out of attention until later appends overwrite them.
+                cache = _dc.replace(
+                    cache, pos=(pos0 + take0).astype(cache.pos.dtype)
+                )
+                rem = rem - take0
+                token = row[jnp.maximum(take0 - 1, 0)][None]
+                H = hist.shape[0]
+                i = jnp.arange(gamma + 1)
+                idx = (hist_len + i) % H
+                hist = hist.at[idx].set(jnp.where(i < take0, row, hist[idx]))
+                hist_len = hist_len + take0
+                packed = jnp.concatenate([take, row])  # [S+1]
+                return cache, token, hist, hist_len, rem, packed
 
-            self._verify = _verify
-        return self._verify
+            store[cache_key] = _spec
+        return store[cache_key]
 
-    def _warm_verify(self, gamma: int) -> None:
-        """AOT-compile the verify executable (and the single-step fallback)
-        outside the timed window — same discipline as _warm_decode, so
-        spec-vs-plain comparisons measure steady state on both sides."""
-        warmed = getattr(self, "_warmed_verify", set())
-        self._warmed_verify = warmed
-        if gamma in warmed:
-            return
-        tokens_s = jax.ShapeDtypeStruct((1, gamma + 1), jnp.int32)
-        cache_s = jax.eval_shape(self.new_cache)
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as _P
+    def _seed_spec_history(self, context, token):
+        """Device history ring for a fresh speculative run: `context`
+        (optional [plen] int array — the prompt, normally) followed by the
+        running token. Sized to max_len, so the drafting window always holds
+        the full context — device drafts match Engine._draft_ngram exactly."""
+        hist = jnp.zeros((self.max_len,), jnp.int32)
+        n = 0
+        if context is not None:
+            context = jnp.asarray(context, jnp.int32).reshape(-1)
+            n = context.shape[0]
+            hist = jax.lax.dynamic_update_slice(hist, context, (0,))
+        hist = hist.at[n].set(token[0].astype(jnp.int32))
+        return hist, jnp.asarray(n + 1, jnp.int32)
 
-            rep = NamedSharding(self.mesh, _P())
-            tokens_s = jax.ShapeDtypeStruct(tokens_s.shape, tokens_s.dtype, sharding=rep)
-            cache_s = jax.tree.map(
-                lambda st, sh: jax.ShapeDtypeStruct(st.shape, st.dtype, sharding=sh),
-                cache_s, self._cache_shardings,
+    def _speculate_loop(  # hot-path
+        self, cache, token, needed: int, gamma: int, ngram: int,
+        pos_start: int, context, engine_label: str,
+    ):
+        """Pipelined device-resident speculative drain: produce exactly
+        `needed` greedy tokens after `token`. Spec dispatches ride a bounded
+        in-flight ring — the host consumes chunk N's packed tokens while
+        chunk N+1 verifies — and acceptance/commit happen in-kernel, so the
+        steady-state path has NO host drafting, NO logits transfer, and NO
+        pos re-upload. The budget lives on device (the kernel clamps `take`
+        by it), so overlapped dispatches can never overshoot; near max_len
+        the loop flushes and finishes with pipelined single steps, exactly
+        like the host loop it replaced. Returns (tokens list, cache, last
+        token, stats dict)."""
+        S = gamma + 1
+        fn = self._get_spec_step_dense(gamma, ngram)
+        hist, hist_len = self._seed_spec_history(context, token)
+        rem = jnp.asarray(needed, jnp.int32)
+        pipe = DecodePipeline(depth=self.pipeline_depth, engine=engine_label)
+        out: list[int] = []
+        acct = {"dispatches": 0, "drafted": 0, "accepted": 0}
+
+        def commit(host_packed):
+            with trace.span(
+                "serve.spec_verify", engine=engine_label, gamma=gamma,
+            ) as sp:
+                t = int(host_packed[0])
+                if t > 0:
+                    out.extend(int(x) for x in host_packed[1:1 + t])
+                    acct["dispatches"] += 1
+                    acct["drafted"] += gamma
+                    acct["accepted"] += t - 1
+                sp.set(accepted=max(t - 1, 0))
+            metrics.inc(
+                "serving_spec_tokens_total",
+                {"engine": engine_label, "kind": "drafted"},
+                value=float(gamma if t > 0 else 0),
             )
-        self._get_verify().lower(self.params, tokens_s, cache_s).compile()
-        self._warm_decode(chunked=False, single=True)
-        warmed.add(gamma)
+            metrics.inc(
+                "serving_spec_tokens_total",
+                {"engine": engine_label, "kind": "accepted"},
+                value=float(max(t - 1, 0)),
+            )
+
+        guard = 0
+        while len(out) < needed:
+            guard += 1
+            if guard > 4 * needed + 16:
+                raise RuntimeError("speculative loop did not converge")
+            if pipe and len(out) + pipe.inflight_steps() >= needed:
+                # Step-weighted gate (the paged engine's discipline): when
+                # the in-flight chunks' POTENTIAL already covers the budget,
+                # consume instead of dispatching — which also guarantees
+                # every dispatched chunk still has device budget (take >= 1),
+                # so acct's consume-side counters see every real dispatch.
+                pipe.flush()
+                continue
+            if pos_start + len(out) + pipe.inflight_steps() + S > self.max_len:
+                # Worst-case in-flight commits could push the verify writes
+                # past max_len: sync to exact truth, then re-check.
+                pipe.flush()
+                if pos_start + len(out) + S > self.max_len:
+                    break  # genuine tail — single steps below
+                continue
+            t0 = time.perf_counter()
+            with trace.span(
+                "serve.decode_dispatch", engine=engine_label, steps=S,
+                speculative=True, inflight=len(pipe),
+            ):
+                with pipe.host_section():
+                    cache, token, hist, hist_len, rem, packed = fn(
+                        self.params, cache, token, hist, hist_len, rem
+                    )
+                pipe.push(S, packed, commit)
+            metrics.observe(
+                "serving_spec_verify_duration_seconds",
+                time.perf_counter() - t0,
+            )
+        pipe.flush()
+        # Tail: no room for a full verify run — pipelined single steps.
+        # FIXED count, computed while host truth is exact (the ring just
+        # flushed): each dispatch produces exactly one token, and counting
+        # `len(out)` inside the loop would lag the in-flight pushes —
+        # over-dispatching past `needed` (and appending K/V past max_len).
+        tail = min(needed - len(out), self.max_len - pos_start - len(out))
+        for _ in range(max(0, tail)):
+            with trace.span(
+                "serve.decode_dispatch", engine=engine_label, steps=1,
+            ):
+                with pipe.host_section():
+                    token, cache = self._decode(
+                        self.params, token, cache, self._next_key()
+                    )
+                pipe.push(1, token, lambda h: out.append(int(h[0])))
+            acct["dispatches"] += 1
+        pipe.flush()
+        return out, cache, token, acct
+
+    def decode_speculative(
+        self, token, cache: KVCache, steps: int, gamma: int = 4,
+        ngram: int = 3, pos: Optional[int] = None, context=None,
+        engine_label: str = "dense",
+    ):
+        """Speculative counterpart of decode_n: produce exactly `steps`
+        greedy tokens continuing `cache` — byte-identical to decode_n
+        (acceptance only keeps tokens equal to the model's own argmax
+        chain), in fewer dispatches on repetitive content. `pos` is the
+        cache's current length as a host int (callers that deserialized the
+        cache know it; passing it avoids a device round trip); `context`
+        optionally seeds the drafting history (the prompt, when available —
+        without it drafting warms up from generated tokens only). Returns
+        (last token [1], cache, tokens [1, steps] host array). This is the
+        disagg decode leg's speculation primitive (disagg_worker)."""
+        if self._sampling.temperature > 0:
+            raise NotImplementedError("speculative decoding is greedy-only")
+        if self.batch_size != 1:
+            raise ValueError("speculative decoding is single-sequence (B=1)")
+        if pos is None:
+            pos = int(cache.pos)
+        out, cache, token, _ = self._speculate_loop(
+            cache, token, steps, gamma, ngram, pos, context, engine_label
+        )
+        return token, cache, np.asarray(out, np.int32)[None, :]  # vet: ignore[hotpath-host-sync]: out is a host list — this is packaging, not a device fence
 
     def generate_speculative(  # hot-path
         self, prompt: jax.Array, max_new_tokens: int,
@@ -475,14 +631,19 @@ class Engine:
         (stale K/V masked, later overwritten — the prefill_chunked trick).
         B=1, greedy only (sampling would need rejection resampling).
 
+        Device-resident since ISSUE 9: drafting, acceptance, and the cache
+        rewind all run inside the jitted spec step, and dispatches ride a
+        bounded in-flight ring — the host's only per-chunk work is unpacking
+        the accepted tokens (no per-dispatch logits transfer or host
+        drafting loop; the vet hotpath baseline this function carried is
+        gone).
+
         Exactness: equal to generate() up to floating-point argmax ties —
         the verify pass computes logits at [1, gamma+1] and single-step
         decode at [1, 1], and XLA may tile/reduce the two shapes in
         different orders, so a near-tied top-2 can flip (the standard
         speculative-decoding caveat; bitwise-equal in this repo's f32
         test suite)."""
-        import dataclasses as _dc
-
         if self.batch_size != 1 or prompt.shape[0] != 1:
             raise ValueError("speculative decoding is single-sequence (B=1)")
         if self._sampling.temperature > 0:
@@ -491,8 +652,7 @@ class Engine:
             # Same contract as the batch engines: the output shape is always
             # [1, max_new_tokens], never silently short.
             raise ValueError("prompt + max_new_tokens exceeds max_len")
-        verify = self._get_verify()
-        self._warm_verify(gamma)
+        self._warm_spec(gamma, ngram)
 
         with trace.span(
             "serve.request", engine="dense", speculative=True,
@@ -508,44 +668,12 @@ class Engine:
             timeline.first_token(ttft)
 
             t1 = time.perf_counter()
-            context = [int(t) for t in np.asarray(prompt)[0]] + [int(np.asarray(token)[0])]
-            out = [int(np.asarray(token)[0])]
-            # pos is host-derivable (prompt length, then += accepted+1 per
-            # dispatch): int(cache.pos) would be a blocking device round trip
-            # per dispatch on exactly the links this engine optimizes for.
-            pos = prompt.shape[1]
-            dispatches = drafted = accepted_total = 0
-            while len(out) < max_new_tokens:
-                if pos + gamma + 1 > self.max_len:
-                    # No room for a full verify run: finish with single steps.
-                    tok = jnp.asarray([out[-1]], jnp.int32)
-                    while len(out) < max_new_tokens and pos < self.max_len:
-                        with trace.span("serve.decode_dispatch",
-                                        engine="dense", steps=1):
-                            tok, cache = self.decode(tok, cache)
-                            out.append(int(np.asarray(tok)[0]))
-                        pos += 1
-                        dispatches += 1
-                    break
-                drafts = self._draft_ngram(context, ngram, gamma)
-                tokens_in = jnp.asarray([[out[-1]] + drafts], jnp.int32)
-                with trace.span("serve.spec_verify", engine="dense", gamma=gamma):
-                    all_logits, cache = verify(self.params, tokens_in, cache)
-                    greedy = np.asarray(jnp.argmax(all_logits, axis=-1))[0]  # [gamma+1]
-                a = 0
-                while a < gamma and drafts[a] == int(greedy[a]):
-                    a += 1
-                new_tokens = [int(t) for t in drafts[:a]] + [int(greedy[a])]
-                # Rewind past the rejected draft rows: only positions
-                # [0, pos + a + 1) are real; stale rows get overwritten.
-                pos = pos + a + 1
-                cache = _dc.replace(cache, pos=jnp.asarray(pos, cache.pos.dtype))
-                out.extend(new_tokens)
-                context.extend(new_tokens)
-                dispatches += 1
-                drafted += gamma
-                accepted_total += a
-            out = out[: max(1, max_new_tokens)]  # generate(p, 0) also returns [1, 1]
+            first = int(np.asarray(token)[0])  # vet: ignore[hotpath-host-sync]: first token already fenced for TTFT — this transfer is free
+            new, cache, _, acct = self._speculate_loop(
+                cache, token, max(0, max_new_tokens - 1), gamma, ngram,
+                int(prompt.shape[1]), prompt[0], "dense",
+            )
+            out = ([first] + new)[: max(1, max_new_tokens)]
             dt = time.perf_counter() - t1
             steps = len(out) - 1
             if steps:
@@ -553,24 +681,59 @@ class Engine:
             timeline.finish()
             request_span.set(
                 ttft_s=round(ttft, 6), decode_s=round(dt, 6),
-                dispatches=dispatches, accepted=accepted_total,
+                dispatches=acct["dispatches"], accepted=acct["accepted"],
             )
         metrics.inc("serving_requests_total", {"engine": "dense"})
         return GenerationResult(
             tokens=jnp.asarray([out], jnp.int32),
             ttft_s=ttft,
             decode_s=dt,
-            decode_steps=dispatches,
+            decode_steps=acct["dispatches"],
             decode_tokens_per_s=steps / dt if steps else 0.0,
             spec_stats={
-                "dispatches": dispatches,
-                "drafted": drafted,          # draft slots verified
-                "accepted": accepted_total,  # model-accepted draft tokens
+                "dispatches": acct["dispatches"],
+                "drafted": acct["drafted"],    # draft slots verified
+                "accepted": acct["accepted"],  # model-accepted draft tokens
                 # Decode tokens only — the prefill-produced first token is
                 # not a dispatch's output.
-                "tokens_per_dispatch": round(steps / max(dispatches, 1), 2),
+                "tokens_per_dispatch": round(
+                    steps / max(acct["dispatches"], 1), 2
+                ),
             },
         )
+
+    def _warm_spec(self, gamma: int, ngram: int) -> None:
+        """AOT-compile the speculative step (and the single-step tail)
+        outside the timed window — same discipline as _warm_decode, so
+        spec-vs-plain comparisons measure steady state on both sides."""
+        warmed = getattr(self, "_warmed_spec", set())
+        self._warmed_spec = warmed
+        if (gamma, ngram) in warmed:
+            return
+        token_s = jax.ShapeDtypeStruct((1,), jnp.int32)
+        hist_s = jax.ShapeDtypeStruct((self.max_len,), jnp.int32)
+        scalar_s = jax.ShapeDtypeStruct((), jnp.int32)
+        cache_s = jax.eval_shape(self.new_cache)
+        if self.mesh is not None:
+            # Same discipline as _warm_decode: the avals must carry the REAL
+            # shardings (replicated small inputs, cache on its mesh
+            # shardings) or this compiles a different executable than the
+            # runtime call and the warm is wasted.
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            rep = NamedSharding(self.mesh, _P())
+            token_s = jax.ShapeDtypeStruct(token_s.shape, token_s.dtype, sharding=rep)
+            hist_s = jax.ShapeDtypeStruct(hist_s.shape, hist_s.dtype, sharding=rep)
+            scalar_s = jax.ShapeDtypeStruct(scalar_s.shape, scalar_s.dtype, sharding=rep)
+            cache_s = jax.tree.map(
+                lambda st, sh: jax.ShapeDtypeStruct(st.shape, st.dtype, sharding=sh),
+                cache_s, self._cache_shardings,
+            )
+        self._get_spec_step_dense(gamma, ngram).lower(
+            self.params, cache_s, token_s, hist_s, scalar_s, scalar_s
+        ).compile()
+        self._warm_decode(chunked=False, single=True)
+        warmed.add((gamma, ngram))
 
     def generate(self, prompt: jax.Array, max_new_tokens: int) -> GenerationResult:  # hot-path
         """Generation under the engine's SamplingParams (greedy by default),

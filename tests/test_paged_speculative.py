@@ -1,17 +1,23 @@
 """Speculative decoding composed with paged continuous batching (VERDICT r4
-#4: the r4 engine had spec decode only on the plain Engine at B=1; the
-production engine had none).
+#4; device-resident with ring-riding dispatches since ISSUE 9).
 
-step_speculative verifies every greedy slot's n-gram draft run in ONE
-batched dispatch (models/llama.py forward_verify_paged); sampled slots ride
-the same dispatch advancing one token from their own PRNG stream. Pinned:
+step_speculative drafts each greedy slot's n-gram run ON DEVICE from a
+per-slot history ring, verifies it in one batched dispatch
+(models/llama.py forward_verify_paged), and commits acceptance in-kernel;
+sampled slots ride the same dispatch advancing one token from their own
+PRNG stream. Dispatches ride the same in-flight ring as step_n. Pinned:
 
   * token-exactness vs the non-speculative paged engine — all-greedy and
     MIXED (sampled+greedy) batches, int8 KV, tp=2 mesh;
+  * byte-exactness vs the retained PR-8 host-loop oracle
+    (step_speculative_sync), including under ring depth 2;
   * acceptance actually happens on repetitive content and the drain takes
     FEWER dispatches than sequential decode (the tokens/dispatch gain);
   * acceptance stats are recorded in engine.stats;
-  * the near-max_len guard falls back instead of overrunning.
+  * the near-max_len guard falls back instead of overrunning;
+  * device n-gram drafting matches the host oracle token-for-token;
+  * the steady-state spec loop never flushes the ring; an injected
+    dispatch fault rolls back cleanly (discard + host-truth restore).
 """
 
 import dataclasses
@@ -112,3 +118,243 @@ def test_near_max_len_falls_back(setup):
     rid2 = eng2.submit(prompt, max_new_tokens=14)
     eng2.run_until_drained()
     assert got == eng2.result(rid2)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: device-resident spec loop, ring-riding dispatches
+
+
+def test_ngram_draft_device_matches_host(setup):
+    """The in-kernel drafting must be token-for-token the host oracle
+    (Engine._draft_ngram) whenever the history ring holds the full context
+    — that parity is what keeps tokens/dispatch at sync levels."""
+    from lws_tpu.models.llama import ngram_draft
+    from lws_tpu.serving.engine import Engine
+
+    H = 64
+    rng = np.random.RandomState(3)
+    contexts = []
+    for n in (5, 9, 17, 40, 63):
+        pat = rng.randint(1, 60, size=max(2, n // 4)).astype(int)
+        ctx = list(np.tile(pat, 8))[:n]  # repetitive: matches exist
+        contexts.append(ctx)
+        contexts.append(list(rng.randint(1, 60, size=n)))  # random: mostly none
+    for ngram in (2, 3):
+        for gamma in (1, 4):
+            fn = jax.jit(
+                lambda h, l, ng=ngram, g=gamma: ngram_draft(h, l, ng, g)
+            )
+            for ctx in contexts:
+                want = Engine._draft_ngram(list(ctx), ngram, gamma)
+                hist = np.zeros((H,), np.int32)
+                hist[: len(ctx)] = ctx
+                got = [int(t) for t in fn(jnp.asarray(hist), jnp.int32(len(ctx)))]
+                assert got == [int(t) for t in want], (ctx, ngram, gamma)
+
+
+def run_ring(cfg, params, sync, depth=2, sampled_second=False, **eng_kw):
+    eng = PagedBatchEngine(cfg, params, slots=4, max_len=256, block_size=16,
+                           pipeline_depth=depth, donate_steps=False, **eng_kw)
+    p1, p2 = prompts()
+    kw = dict(temperature=0.8, seed=7, top_k=10) if sampled_second else {}
+    rids = [eng.submit(p1, max_new_tokens=24), eng.submit(p2, max_new_tokens=16, **kw)]
+    eng.run_until_drained_speculative(gamma=4, ngram=3, sync=sync)
+    return [eng.result(r) for r in rids], eng
+
+
+def test_sync_oracle_byte_identical(setup):
+    """The device-resident ring-riding loop must emit byte-identical greedy
+    streams to the PR-8 host-loop oracle — the ISSUE-9 correctness bar —
+    at matching tokens/dispatch (device drafts == host drafts when the ring
+    covers the context)."""
+    cfg, params = setup
+    want, eng_sync = run_ring(cfg, params, sync=True, depth=0)
+    got, eng_pipe = run_ring(cfg, params, sync=False, depth=2)
+    assert want == got
+    s, p = eng_sync.stats, eng_pipe.stats
+    assert p["spec_accepted"] == s["spec_accepted"]
+    assert (p["spec_dispatches"] + p.get("spec_fallback_dispatches", 0)
+            <= s["spec_dispatches"] + s.get("spec_fallback_dispatches", 0))
+
+
+def test_mixed_batch_under_ring_matches_oracle(setup):
+    """Mixed greedy+sampled batch at ring depth 2: the per-slot key schedule
+    (one split per produced token) must survive pipelining — sampled streams
+    stay byte-identical to the sync oracle's."""
+    cfg, params = setup
+    want, _ = run_ring(cfg, params, sync=True, depth=0, sampled_second=True)
+    got, _ = run_ring(cfg, params, sync=False, depth=2, sampled_second=True)
+    assert want == got
+
+
+def test_no_steady_state_flushes(setup):
+    """Acceptance criterion: NO ring flush on the speculative steady-state
+    path. Ten ring-riding spec dispatches against deep budgets must leave
+    the flush counter untouched (flushes remain only at spec-mode entry —
+    which finds an empty ring and does not count — budget/tail boundaries,
+    and rollback)."""
+    cfg, params = setup
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16,
+                           pipeline_depth=2, donate_steps=False)
+    p1, _ = prompts()
+    assert eng.submit(p1, max_new_tokens=128) is not None
+    dispatched = 0
+    for _ in range(10):
+        assert eng.step_speculative(gamma=4, ngram=3) is True
+        dispatched += 1
+    stats = eng._pipeline.stats
+    assert dispatched == 10
+    assert stats["flushes"] == 0, stats
+    assert stats["max_inflight"] == 2, stats
+    eng.run_until_drained_speculative(gamma=4, ngram=3)
+
+
+def test_mid_stream_admission_during_inflight_spec(setup):
+    """Admission while spec chunks are in flight must seed the new slot's
+    device history/budget WITHOUT a flush, and every stream must match the
+    plain step_n oracle (greedy streams are schedule-independent)."""
+    cfg, params = setup
+    eng = PagedBatchEngine(cfg, params, slots=4, max_len=256, block_size=16,
+                           pipeline_depth=2, donate_steps=False)
+    p1, p2 = prompts()
+    r1 = eng.submit(p1, max_new_tokens=32)
+    for _ in range(3):
+        assert eng.step_speculative(gamma=4, ngram=3) is True
+    flushes_before = eng._pipeline.stats["flushes"]
+    r2 = eng.submit(p2, max_new_tokens=16)  # admitted mid-flight
+    assert eng._pipeline.stats["flushes"] == flushes_before
+    assert eng.step_speculative(gamma=4, ngram=3) is True
+    eng.run_until_drained_speculative(gamma=4, ngram=3)
+
+    oracle = PagedBatchEngine(cfg, params, slots=4, max_len=256, block_size=16)
+    o1 = oracle.submit(p1, max_new_tokens=32)
+    o2 = oracle.submit(p2, max_new_tokens=16)
+    oracle.run_until_drained()
+    assert eng.result(r1) == oracle.result(o1)
+    assert eng.result(r2) == oracle.result(o2)
+
+
+def test_early_retire_and_eviction_during_inflight_spec(setup):
+    """Uneven budgets retire requests inside in-flight chunks; a tight
+    prefix-cache pool forces LRU eviction (whose allocator flushes the
+    ring). Every stream must still match the plain oracle."""
+    cfg, params = setup
+    kw = dict(slots=3, max_len=128, block_size=16, prefix_cache=True,
+              num_blocks=17)  # 16 usable blocks: admissions contend
+    eng = PagedBatchEngine(cfg, params, pipeline_depth=2, donate_steps=False,
+                           **kw)
+    p1, p2 = prompts()
+    r1 = eng.submit(p1, max_new_tokens=40)   # long
+    r2 = eng.submit(p2, max_new_tokens=6)    # retires early, mid-ring
+    for _ in range(4):
+        eng.step_speculative(gamma=4, ngram=3)
+    # Allocation pressure: this admission evicts LRU-parked prefix blocks.
+    p3 = np.tile(np.arange(1, 9, dtype=np.int32), 6)
+    r3 = eng.submit(p3, max_new_tokens=12)
+    assert r3 is not None
+    eng.run_until_drained_speculative(gamma=4, ngram=3)
+
+    oracle = PagedBatchEngine(cfg, params, **kw)
+    o1 = oracle.submit(p1, max_new_tokens=40)
+    o2 = oracle.submit(p2, max_new_tokens=6)
+    oracle.run_until_drained()
+    o3 = oracle.submit(p3, max_new_tokens=12)
+    oracle.run_until_drained()
+    assert eng.result(r1) == oracle.result(o1)
+    assert eng.result(r2) == oracle.result(o2)
+    assert eng.result(r3) == oracle.result(o3)
+
+
+def test_interleaved_step_n_refresh(setup):
+    """Alternating plain step_n and spec dispatches must stay exact: step_n
+    stales the device history/budget, and the next spec entry rebuilds it
+    from host truth."""
+    cfg, params = setup
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16,
+                           pipeline_depth=2, donate_steps=False)
+    p1, _ = prompts()
+    rid = eng.submit(p1, max_new_tokens=30)
+    eng.step_speculative(gamma=4, ngram=3)
+    eng.step_n(2)          # stales spec state
+    eng.step_speculative(gamma=4, ngram=3)  # refresh path
+    eng.run_until_drained_speculative(gamma=4, ngram=3)
+    oracle = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16)
+    oid = oracle.submit(p1, max_new_tokens=30)
+    oracle.run_until_drained()
+    assert eng.result(rid) == oracle.result(oid)
+
+
+def test_push_fault_rollback(setup):
+    """Chaos: a `pipeline.dispatch` fault injected during a spec chunk must
+    roll back cleanly — in-flight chunks discarded, pos_b/tokens restored
+    from host truth — and the subsequent drain must still emit the oracle
+    stream."""
+    from lws_tpu.core import faults
+
+    cfg, params = setup
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16,
+                           pipeline_depth=2, donate_steps=False)
+    p1, _ = prompts()
+    rid = eng.submit(p1, max_new_tokens=24)
+    assert eng.step_speculative(gamma=4, ngram=3) is True  # one chunk in flight
+    faults.INJECTOR.arm("pipeline.dispatch", "fail_n_times:1:RuntimeError")
+    try:
+        with pytest.raises(RuntimeError):
+            eng.step_speculative(gamma=4, ngram=3)
+    finally:
+        faults.INJECTOR.disarm()
+    assert len(eng._pipeline) == 0  # everything in flight was discarded
+    assert eng._pipeline.stats["discarded"] >= 1
+    eng.run_until_drained_speculative(gamma=4, ngram=3)
+    oracle = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16)
+    oid = oracle.submit(p1, max_new_tokens=24)
+    oracle.run_until_drained()
+    assert eng.result(rid) == oracle.result(oid)
+
+
+def test_sampled_rows_never_extend_acceptance(setup):
+    """Satellite contract: sampled slots ride the gamma+1-wide verify (the
+    dispatch is static-shaped) but advance EXACTLY one token per dispatch —
+    their filler draft rows are masked out of acceptance in-kernel even on
+    maximally repetitive content."""
+    cfg, params = setup
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16)
+    pat = np.tile(np.arange(1, 9, dtype=np.int32), 6)
+    rg = eng.submit(pat, max_new_tokens=40)
+    rs = eng.submit(pat, max_new_tokens=40, temperature=0.8, seed=3)
+    by_id = {r.request_id: r for r in eng._active.values()}
+    dispatches = 0
+    while dispatches < 10 and len(eng._active) > eng._sampled_active:
+        before = len(by_id[rs].tokens)
+        assert eng.step_speculative(gamma=4, ngram=3) is True
+        eng._pipeline.flush()
+        dispatches += 1
+        # EXACTLY one token per dispatch, even with a maximally repetitive
+        # history that would draft perfect matches if the filler mask broke.
+        assert len(by_id[rs].tokens) - before == 1, "sampled slot overran"
+    assert dispatches == 10
+    eng.run_until_drained_speculative(gamma=4, ngram=3)
+    # Greedy output self-repeats under a greedy loop: drafting accepted.
+    assert eng.stats["spec_accepted"] > 0
+    assert len(by_id[rg].tokens) == 40 and len(by_id[rs].tokens) == 40
+    # All-sampled batches refuse the wide verify outright.
+    eng2 = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16)
+    eng2.submit(pat, max_new_tokens=8, temperature=0.8, seed=1)
+    assert eng2.step_speculative(gamma=4) is False
+
+
+def test_ring_wrap_spec_history(setup):
+    """A spec_history window smaller than the context still drains exactly
+    (drafting is match-only; acceptance protects the stream) and keeps
+    accepting on content whose period fits the window."""
+    cfg, params = setup
+    eng = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16,
+                           spec_history=16)
+    pat = np.tile(np.arange(1, 9, dtype=np.int32), 6)  # period 8 < H=16
+    rid = eng.submit(pat, max_new_tokens=24)
+    eng.run_until_drained_speculative(gamma=4, ngram=3)
+    oracle = PagedBatchEngine(cfg, params, slots=2, max_len=256, block_size=16)
+    oid = oracle.submit(pat, max_new_tokens=24)
+    oracle.run_until_drained()
+    assert eng.result(rid) == oracle.result(oid)
+    assert eng.stats["spec_accepted"] > 0

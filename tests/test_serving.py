@@ -225,6 +225,28 @@ def test_bundle_bytes_scale_with_prompt_length():
     assert tok2.shape == (1,)
 
 
+def test_decode_bundle_speculative_leg_exact():
+    """The disagg decode worker's speculative leg (--gamma / env
+    LWS_TPU_SPEC_GAMMA): _decode_bundle with gamma > 0 must return the SAME
+    [B, steps+1] token matrix as the plain decode_n leg — drafting seeds
+    from the bundle's running token only (the wire ships K/V, not prompt
+    text), and greedy acceptance protects the stream regardless."""
+    from lws_tpu.serving.disagg_worker import _decode_bundle
+    from lws_tpu.serving.kv_transport import cache_to_bundle
+
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, batch_size=1, max_len=64)
+    prompt = jnp.asarray([[5, 9, 2, 11] * 4], jnp.int32)
+    token, cache = engine.prefill(prompt)
+    payload = cache_to_bundle(cache, token)
+
+    want, _, _ = _decode_bundle(engine, payload, steps=20)
+    got, stats, _ = _decode_bundle(engine, payload, steps=20, gamma=4, ngram=3)
+    np.testing.assert_array_equal(got, want)
+    assert stats["spec_gamma"] == 4
+
+
 def test_bundle_rejects_too_small_decode_budget():
     import pytest
 
@@ -309,3 +331,63 @@ def test_speculative_decoding_near_max_len():
     want = engine.generate(prompt, max_new_tokens=16)
     got = engine.generate_speculative(prompt, max_new_tokens=16, gamma=8, ngram=3)
     np.testing.assert_array_equal(np.asarray(got.tokens), np.asarray(want.tokens))
+
+
+def test_speculative_decoding_sync_loop_exact():
+    """pipeline_depth=0 (the strictly synchronous ring) must emit the same
+    stream as the default overlapped loop — pipelining reorders host
+    consumption, never device math."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.asarray([[5, 9, 2, 11] * 4], jnp.int32)
+    e_sync = Engine(cfg, params, batch_size=1, max_len=64, pipeline_depth=0)
+    e_pipe = Engine(cfg, params, batch_size=1, max_len=64, pipeline_depth=2)
+    want = e_sync.generate_speculative(prompt, max_new_tokens=24, gamma=6)
+    got = e_pipe.generate_speculative(prompt, max_new_tokens=24, gamma=6)
+    np.testing.assert_array_equal(np.asarray(got.tokens), np.asarray(want.tokens))
+    assert got.spec_stats["accepted"] == want.spec_stats["accepted"]
+
+
+def test_decode_speculative_matches_decode_n():
+    """The disagg decode leg's primitive: decode_speculative must continue a
+    prefilled cache byte-identically to decode_n (greedy acceptance keeps
+    only the model's own argmax chain), with and without a prompt context
+    seeding the drafting history."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, batch_size=1, max_len=64)
+    prompt = jnp.asarray([[5, 9, 2, 11] * 4], jnp.int32)  # 16 tokens
+    steps = 24
+
+    token, cache = engine.prefill(prompt)
+    _, _, want = engine.decode_n(token, cache, steps)
+    want = np.asarray(want)
+
+    for context in (prompt[0], None):
+        token2, cache2 = engine.prefill(prompt)
+        _, _, got = engine.decode_speculative(
+            token2, cache2, steps, gamma=4, ngram=3,
+            pos=int(prompt.shape[1]), context=context,
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_decode_speculative_near_max_len_exact_count():
+    """Regression: the pipelined single-step tail must produce EXACTLY
+    `steps` tokens and never append K/V past max_len — an in-flight-blind
+    tail loop over-dispatched by up to pipeline_depth steps (returning 9
+    tokens for steps=7 with cache.pos past max_len)."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    engine = Engine(cfg, params, batch_size=1, max_len=32, pipeline_depth=2)
+    prompt = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6] * 3], jnp.int32)  # 24 tokens
+    token, cache = engine.prefill(prompt)
+    _, _, want = engine.decode_n(token, cache, 7)
+
+    token2, cache2 = engine.prefill(prompt)
+    _, cache2, got = engine.decode_speculative(
+        token2, cache2, 7, gamma=4, ngram=3, pos=24, context=prompt[0],
+    )
+    assert got.shape == (1, 7), got.shape
+    assert int(cache2.pos) <= 32, int(cache2.pos)
+    np.testing.assert_array_equal(got, np.asarray(want))

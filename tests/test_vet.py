@@ -337,3 +337,14 @@ def test_committed_baseline_has_no_orphans_offline():
     current, _ = collect_findings(load_modules(iter_source_files()))
     _, _, orphans = apply_baseline(current, load_baseline())
     assert orphans == [], orphans
+
+
+def test_committed_baseline_is_empty():
+    """ISSUE 9 burned the last baseline entry (generate_speculative's host
+    syncs) to zero. The file must STAY empty: any new hot-path host sync is
+    fixed or suppressed inline with a rule id and reason — never
+    re-baselined."""
+    assert load_baseline() == {}, (
+        "tools/vet/baseline.json grew an entry — fix the finding or "
+        "suppress inline with `# vet: ignore[rule]: reason`"
+    )
