@@ -547,6 +547,10 @@ def _top_rows(fams: dict) -> dict:
             r[field] = reducer(r.get(field, start), value)
 
     fold("serving_requests_total", "requests")
+    # KV-transfer wire bytes (streamed + monolithic handoffs). The metric
+    # carries no engine label, so it folds into the instance's `-` row;
+    # render_top rates it per instance as the KV MB/s column.
+    fold("serving_kv_transfer_bytes_total", "kv_bytes")
     fold("serving_active_slots", "active")
     fold("serving_inflight_dispatches", "inflight")
     fold("serving_slo_attainment", "slo", reducer=lambda old, v: v)
@@ -613,7 +617,7 @@ def render_top(fams: dict, alerts: dict | None = None,
     lines.append(
         f"{'INSTANCE':<18}{'ENGINE':<9}{'SLO':>6}{'REQS':>7}{'ACTIVE':>7}"
         f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{'SPEC%':>7}{'TTFT_P95':>10}"
-        f"{'ITL_P95':>10}{'DISP/S':>8}"
+        f"{'ITL_P95':>10}{'DISP/S':>8}{'KV_MB/S':>9}"
     )
 
     def fmt(v, pattern="{:.3f}", dash="-"):
@@ -626,6 +630,14 @@ def render_top(fams: dict, alerts: dict | None = None,
         if prev is not None and dt_s:
             before = prev.get((instance, engine), {}).get("dispatches", 0.0)
             rate = max(0.0, r.get("dispatches", 0.0) - before) / dt_s
+        # KV handoff wire throughput: the transfer counter is engine-less
+        # (it lives in the transport), so it rides the instance's `-` row.
+        kv_rate = None
+        kv_now = r.get("kv_bytes", rows.get((instance, "-"), {}).get("kv_bytes"))
+        if prev is not None and dt_s and kv_now is not None:
+            kv_prev = prev.get((instance, engine), {}).get(
+                "kv_bytes", prev.get((instance, "-"), {}).get("kv_bytes", 0.0))
+            kv_rate = max(0.0, kv_now - kv_prev) / dt_s / 1e6
         # KV-pool occupancy (live / pool) and prefix-cache hit rate — the
         # capacity columns: a row pinned near 100% KV with a low hit rate
         # is the backpressure case paging exists to relieve.
@@ -655,6 +667,7 @@ def render_top(fams: dict, alerts: dict | None = None,
             f"{fmt(r.get('ttft_p95'), '{:.3f}s'):>10}"
             f"{fmt(r.get('itl_p95'), '{:.4f}s'):>10}"
             f"{fmt(rate, '{:.1f}'):>8}"
+            f"{fmt(kv_rate, '{:.1f}'):>9}"
         )
     return "\n".join(lines)
 

@@ -25,6 +25,10 @@ Schedules (the `spec` grammar, also the `LWS_TPU_FAULTS` env grammar):
                          true process-death chaos
   prob:P:SEED[:Exc]      seeded Bernoulli(P) failure — deterministic for a
                          given seed (`random.Random(SEED)`)
+  pace:MBPS              cooperative: return Fault("pace", MBPS) — the
+                         send site sleeps nbytes/(MBPS*1e6), emulating a
+                         bandwidth-limited (DCN-like) link per-byte-fairly
+                         across monolithic and streamed KV deliveries
 
 Arm via `LWS_TPU_FAULTS="point=spec,point=spec"` in the worker env (read at
 process start), the injector API (tests), or `POST /debug/faults` on the
@@ -63,19 +67,26 @@ _EXCEPTIONS = {
 }
 
 MODES = ("fail_n_times", "every_k", "delay", "drop", "partial_write",
-         "exit", "prob")
+         "exit", "prob", "pace")
 # Modes fire() enacts by raising/sleeping; the rest are cooperative — the
 # call site reads the returned Fault and implements the behavior.
 _RAISING_MODES = ("fail_n_times", "every_k", "exit", "prob")
-_COOPERATIVE_MODES = ("drop", "partial_write")
-# Points whose call sites HONOR the cooperative modes. Arming drop /
-# partial_write anywhere else is rejected at arm time: a bare fire() site
-# would count the trip (and ring-event it) while injecting NOTHING, and a
-# chaos run reasoning from trips that never happened proves the wrong
-# thing. Extend this set when a new site implements the cooperation.
-COOPERATIVE_POINTS = frozenset({
-    "kv.ack", "kv.server.send_bundle", "kv.server.send_result",
-})
+_COOPERATIVE_MODES = ("drop", "partial_write", "pace")
+# Cooperative modes each point's call site actually HONORS. Arming a
+# cooperative mode anywhere (or any mode) the site does not implement is
+# rejected at arm time: a bare fire() site would count the trip (and
+# ring-event it) while injecting NOTHING, and a chaos run reasoning from
+# trips that never happened proves the wrong thing. The map is
+# (point, mode)-granular for the same reason — `kv.ack` implements drop
+# but not partial_write or pace. Extend an entry when a site implements a
+# new cooperation.
+COOPERATIVE_POINTS = {
+    "kv.ack": frozenset({"drop"}),
+    "kv.server.send_bundle": frozenset({"partial_write", "pace"}),
+    "kv.server.send_result": frozenset({"partial_write"}),
+    "kv.stream.send_chunk": frozenset({"partial_write", "pace"}),
+    "kv.stream.recv_chunk": frozenset({"drop", "partial_write"}),
+}
 
 
 @dataclass(frozen=True)
@@ -130,6 +141,10 @@ class _Schedule:
                 self.n = int(parts[2]) if len(parts) > 2 else 0
             elif self.mode == "exit":
                 self.n = int(parts[1]) if len(parts) > 1 else 1
+            elif self.mode == "pace":
+                self.arg = float(parts[1])  # MB/s the link is clamped to
+                if self.arg <= 0:
+                    raise ValueError("pace MB/s must be > 0")
             elif self.mode == "prob":
                 import random
 
@@ -199,11 +214,14 @@ class FaultInjector:
     def arm(self, point: str, spec: str) -> None:
         schedule = _Schedule(point, spec)  # validate BEFORE mutating state
         if schedule.mode in _COOPERATIVE_MODES \
-                and point not in COOPERATIVE_POINTS:
+                and schedule.mode not in COOPERATIVE_POINTS.get(point, frozenset()):
+            honoring = ", ".join(sorted(
+                p for p, modes in COOPERATIVE_POINTS.items()
+                if schedule.mode in modes
+            )) or "none"
             raise ValueError(
                 f"point {point!r} does not honor cooperative mode "
-                f"{schedule.mode!r}; cooperative points: "
-                f"{', '.join(sorted(COOPERATIVE_POINTS))}"
+                f"{schedule.mode!r}; points honoring it: {honoring}"
             )
         with self._lock:
             self._points[point] = schedule

@@ -439,6 +439,19 @@ describe("serving_inflight_dispatches", "Dispatched-but-unconsumed decode chunks
 describe("serving_host_blocked_seconds", "Seconds the serving loop spent on host-side scheduling with no device work in flight")
 describe("serving_kv_handoff_bytes_total", "KV bundle bytes shipped prefill -> decode")
 describe("serving_kv_handoffs_total", "KV bundles handed off prefill -> decode")
+# --- streamed KV handoff wire accounting (serving/kv_transport.py) ---------
+describe("serving_kv_transfer_bytes_total",
+         "KV handoff payload bytes moved over the wire, per transfer leg "
+         "(role=prefill send / role=decode receive)")
+describe("serving_kv_transfer_seconds",
+         "Wall-clock of one KV handoff transfer (monolithic send, or "
+         "stream BEGIN through END), per leg")
+describe("serving_kv_stream_inflight_chunks",
+         "Stream chunks produced by prefill compute but not yet acked by "
+         "a decode puller")
+describe("serving_kv_copy_bytes_total",
+         "Payload bytes that paid an extra host copy (the arrays_to_bytes "
+         "join); the streamed KV path is budgeted to keep this flat")
 # --- per-request SLO telemetry (core/slo.py) -------------------------------
 # Declared bucket ladders are the whole point of describe(..., buckets=...):
 # ITL distributions live sub-millisecond, queue waits can hit minutes.

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import os
+from typing import Optional
 
 import numpy as np
 
@@ -64,29 +65,53 @@ def build_engine(batch: int, max_len: int):
 
 
 def _decode_bundle(
-    engine, payload: bytes, steps: int, gamma: int = 0, ngram: int = 3,
+    engine, payload, steps: int, gamma: int = 0, ngram: int = 3,
 ) -> tuple[np.ndarray, dict, list]:  # hot-path
-    """Bundle bytes -> ([B, steps+1] tokens, per-handoff stats, span
+    """Bundle (monolithic payload bytes, or a finished streamed
+    `CacheAssembler`) -> ([B, steps+1] tokens, per-handoff stats, span
     records). The pos-truncated wire prefix is padded to DECODE's own
     max_len and, when the decode engine is mesh-sharded, placed onto its
     cache shardings. Each real cost of the handoff (VERDICT r4 #5) runs in
     its own span — deserialize, reshard onto this side's mesh, decode — and
     the legacy stats dict is DERIVED from the span durations (the spans
-    subsume the old ad-hoc timers; same keys on the wire). With gamma > 0
-    the decode leg runs device-resident speculative decoding
-    (Engine.decode_speculative): byte-identical greedy tokens in fewer
-    dispatches on repetitive content — drafting warms up from generated
-    tokens (the bundle ships K/V, not prompt text)."""
+    subsume the old ad-hoc timers; same keys on the wire). For a STREAMED
+    handoff the deserialize/upload work already happened chunk-by-chunk
+    while the wire was still moving (kv.deserialize then times only the
+    residual take()), and the first decode step dispatches as soon as END
+    landed. With gamma > 0 the decode leg runs device-resident speculative
+    decoding (Engine.decode_speculative): byte-identical greedy tokens in
+    fewer dispatches on repetitive content — a streamed handoff ships the
+    prompt token ids alongside the KV rows, so drafting seeds from the
+    REAL prompt instead of warming up from generated tokens only."""
     import jax
 
     from lws_tpu.core import slo, trace
-    from lws_tpu.serving.kv_transport import bundle_to_cache
+    from lws_tpu.serving.kv_transport import (
+        CacheAssembler,
+        PoisonPayload,
+        bundle_to_cache,
+    )
     from lws_tpu.serving.pipeline import DecodePipeline
 
-    with trace.span("kv.deserialize", bundle_bytes=len(payload)) as s_deser:
-        cache, token = bundle_to_cache(payload, max_len=engine.max_len)
-        pos = int(cache.pos)  # still host-built here: free, and the spec
-        # path needs the cache length without a post-placement round trip
+    if isinstance(payload, PoisonPayload):
+        # Streamed content this engine rejected (receiver error — e.g.
+        # more KV rows than our max_len): surface it HERE so the worker's
+        # poison-message guard consumes the request with a failed result,
+        # exactly like a poison monolithic bundle.
+        raise payload.error
+    streamed = isinstance(payload, CacheAssembler)
+    bundle_bytes = payload.payload_bytes if streamed else len(payload)
+    context = None
+    with trace.span(
+        "kv.deserialize", bundle_bytes=bundle_bytes, streamed=streamed,
+        chunks=payload.chunks if streamed else 0,
+    ) as s_deser:
+        if streamed:
+            cache, token, pos, context = payload.take()
+        else:
+            cache, token = bundle_to_cache(payload, max_len=engine.max_len)
+            pos = int(cache.pos)  # still host-built here: free, and the spec
+            # path needs the cache length without a post-placement round trip
     with trace.span("kv.reshard", tp_sharded=engine.mesh is not None) as s_reshard:
         if engine.mesh is not None:
             cache = jax.device_put(cache, engine._cache_shardings)
@@ -102,10 +127,13 @@ def _decode_bundle(
     with trace.span("serve.decode_dispatch", engine="disagg", steps=steps) as s_decode:
         if gamma > 0:
             # Speculative leg: decode_speculative runs its own in-flight
-            # ring (engine-labelled "disagg") and returns host tokens.
+            # ring (engine-labelled "disagg") and returns host tokens. A
+            # streamed handoff seeds the drafting history from the REAL
+            # prompt tokens it shipped — no extra flush, no warm-up-from-
+            # generated-tokens penalty.
             _, _, toks_spec = engine.decode_speculative(
                 token, cache, steps, gamma=gamma, ngram=ngram, pos=pos,
-                engine_label="disagg",
+                context=context, engine_label="disagg",
             )
             out["toks"] = toks_spec
             spec_stats = {"spec_gamma": gamma}
@@ -124,10 +152,11 @@ def _decode_bundle(
     timeline.tokens(steps, s_decode.duration_s)
     timeline.finish()
     stats = {
-        "bundle_bytes": len(payload),
+        "bundle_bytes": bundle_bytes,
         "deserialize_s": round(s_deser.duration_s, 4),
         "reshard_s": round(s_reshard.duration_s, 4),
         "decode_s": round(s_decode.duration_s, 4),
+        **({"streamed": True, "chunks": payload.chunks} if streamed else {}),
         **spec_stats,
     }
     spans = [s.to_dict() for s in (s_deser, s_reshard, s_decode)]
@@ -160,13 +189,130 @@ def _start_telemetry():
     return server
 
 
+def kv_chunk_tokens() -> int:
+    """The streamed-handoff chunk size knob (`LWS_TPU_KV_CHUNK`, position
+    rows per stream chunk; default 256). 0 selects the monolithic
+    single-shot path — the oracle the streamed path is budgeted against."""
+    return int(os.environ.get("LWS_TPU_KV_CHUNK", "256") or 0)
+
+
+def use_streaming(prompt_len: int, chunk_tokens: int,
+                  max_len: Optional[int] = None) -> bool:
+    """Stream only when the prompt spans MULTIPLE chunks: a single-chunk
+    stream is the single-shot transfer with extra frames — short prompts
+    keep today's monolithic path. With `max_len`, also require the
+    chunk-PADDED prompt to fit the engine's budget: chunked prefill pads
+    to a whole number of chunks, so a 270-token prompt under
+    chunk=256/max_len=300 must fall back to single-shot (which serves it
+    fine) instead of raising in the engine and crash-looping the worker
+    on a prompt the monolithic path accepts."""
+    if chunk_tokens <= 0 or prompt_len <= chunk_tokens:
+        return False
+    if max_len is not None:
+        padded = prompt_len + ((-prompt_len) % chunk_tokens)
+        if padded > max_len:
+            return False
+    return True
+
+
+def _prefill_streamed(
+    engine, server, kt, meta: dict, req_id: str, prompt, chunk_tokens: int,
+    deadline,
+) -> None:
+    """One STREAMED handoff (ISSUE 10): offer the KVStream FIRST (so a
+    decode puller attaches while chunks are still being produced), then run
+    the chunked prefill whose emit callback lands each position range into
+    the stream — gather/serialize/send of chunk N overlapping compute of
+    chunk N+1 on the engine's bounded sender ring. The END frame carries
+    the first token + pos tail, the handoff record, and the span subtree
+    (exactly what the monolithic bundle meta carried). A producer-side
+    failure fails the stream (the server tells the puller and DROPS it —
+    the router's resubmit recovers, same as prefill death pre-offer)."""
+    import json as _json
+
+    from lws_tpu.core import faults, metrics, slo, trace
+
+    # Death-mid-handoff chaos hook, streamed placement: BEFORE the offer,
+    # so an armed exit still kills the request's only copy (the router's
+    # resubmit is the recovery path either way).
+    faults.fire("disagg.prefill.handoff")
+    stream = kt.KVStream(chunk_tokens)
+    s_req = trace.span(
+        "serve.request", parent=meta.get("trace"),
+        role="prefill", request_id=req_id,
+    )
+    bundle_meta = {"id": req_id, "trace": s_req.context}
+    if deadline is not None:
+        bundle_meta["deadline_s"] = deadline.to_wire()
+    server.offer_stream(bundle_meta, stream)
+    try:
+        with s_req:
+            timeline = slo.request("disagg")
+            wait = float(meta.get("queue_wait_s", 0.0))
+            timeline.queue_wait(wait)
+            # kv.gather parents serve.prefill here: the two phases overlap
+            # by construction (that IS the streamed win), so the gather
+            # span covers the whole streaming window and carries the
+            # accumulated per-chunk gather fence time as an attribute.
+            with trace.span(
+                "kv.gather", streamed=True,
+                tp_gathered=engine.mesh is not None,
+            ) as s_gather:
+                with trace.span(
+                    "serve.prefill", chunked=True,
+                    prompt_len=int(prompt.size),
+                ) as s_prefill:
+                    token, cache, pstats = engine.prefill_chunked_stream(
+                        prompt.reshape(1, -1), chunk_tokens,
+                        emit=stream.put_chunk,
+                    )
+                s_gather.set(
+                    pos=int(cache.pos), bundle_bytes=stream.payload_bytes,
+                    chunks=pstats["chunks"],
+                    gather_s=round(pstats["gather_s"], 4),
+                )
+            timeline.first_token(wait + s_prefill.duration_s)
+            timeline.finish()
+    except Exception:
+        stream.fail()  # wake the puller with a terminal verdict
+        raise
+    handoff = {
+        "pos": int(cache.pos),
+        "bundle_bytes": stream.payload_bytes,
+        "prefill_s": round(s_prefill.duration_s, 4),
+        "gather_s": round(pstats["gather_s"], 4),
+        "tp_gathered": engine.mesh is not None,
+        "streamed": True,
+        "chunks": pstats["chunks"],
+    }
+    metrics.inc("serving_kv_handoffs_total")
+    metrics.inc("serving_kv_handoff_bytes_total", value=stream.payload_bytes)
+    import numpy as _np
+
+    stream.finish(
+        {
+            "handoff": handoff,
+            "spans": [s.to_dict() for s in (s_req, s_prefill, s_gather)],
+        },
+        {"token": _np.asarray(token), "pos": _np.asarray(int(cache.pos), _np.int32)},
+    )
+    print(f"[prefill] HANDOFF {req_id} {_json.dumps(handoff)}", flush=True)
+
+
 def run_prefill_tcp(once: bool, max_len: int) -> int:
     """Serve prompts-in / KV-bundles-out on LWS_TPU_KV_PORT. With `once`,
     exit after the first bundle has been pulled AND acked by a peer.
     SIGTERM (or POST /debug/drain on the telemetry port) drains: stop
     admitting prompts, finish the in-flight handoff, exit clean — queued
     prompts stay the router's responsibility (at-least-once: unanswered
-    ids are resubmitted)."""
+    ids are resubmitted).
+
+    Long prompts (past `LWS_TPU_KV_CHUNK` rows) hand off STREAMED: the
+    KVStream is offered BEFORE prefill computes, and each chunk's KV is
+    gathered and shipped while the next chunk is still computing
+    (Engine.prefill_chunked_stream) — decode starts uploading rows while
+    prefill is mid-prompt, so the handoff costs ~max(compute, wire)
+    instead of their sum."""
     from lws_tpu.core import metrics, resilience, slo, trace
     from lws_tpu.serving import kv_transport as kt
 
@@ -174,8 +320,9 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
     _start_telemetry()
     engine = build_engine(batch=1, max_len=max_len)
     server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
-    print(f"[prefill {os.environ.get('POD_NAME', '?')}] serving KV on :{server.port}",
-          flush=True)
+    chunk_tokens = kv_chunk_tokens()
+    print(f"[prefill {os.environ.get('POD_NAME', '?')}] serving KV on :{server.port}"
+          f" (kv_chunk={chunk_tokens})", flush=True)
     while True:
         if resilience.DRAIN.draining:
             print(f"[prefill] DRAINED ({resilience.DRAIN.reason}): "
@@ -200,6 +347,13 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
             continue
         prompt = kt.bytes_to_arrays(payload)["prompt"]
         import json as _json
+
+        if use_streaming(int(prompt.size), chunk_tokens, engine.max_len):
+            _prefill_streamed(
+                engine, server, kt, meta, req_id, prompt, chunk_tokens,
+                deadline,
+            )
+            continue
 
         # The request's span subtree grafts onto the submitting client's
         # trace (meta["trace"]) and replaces the old ad-hoc timers: the
@@ -405,10 +559,17 @@ def run_decode_tcp(
             # process() runs BEFORE the ack goes back (see pull_bundle); the
             # ack window covers decode + first-call compile. One bounded
             # in-line retry absorbs transient blips (accept-queue hiccups)
-            # without waiting out a full poll interval.
+            # without waiting out a full poll interval. Streamed replies
+            # assemble through a CacheAssembler: each chunk device-uploads
+            # into its position slice ON ARRIVAL (host assembly under a
+            # mesh — the reshard leg keeps the single sharded device_put),
+            # so the first decode step dispatches the moment END lands.
             resilience.call(
                 lambda: kt.pull_bundle(endpoint, timeout=1.0, process=process,
-                                       ack_timeout=600.0),
+                                       ack_timeout=600.0,
+                                       receiver_factory=lambda m: kt.CacheAssembler(
+                                           max_len=engine.max_len,
+                                           device=engine.mesh is None)),
                 site="kv.pull_bundle",
                 policy=resilience.RetryPolicy(max_attempts=2, base_s=0.05,
                                               cap_s=0.25),

@@ -357,6 +357,89 @@ class Engine:
         # masking out (then overwriting) the padded tail's K/V.
         return token, _dc.replace(cache, pos=jnp.asarray(S, cache.pos.dtype))
 
+    def prefill_chunked_stream(
+        self, tokens: jax.Array, chunk_size: int, emit,
+        ring_depth: int = 1,
+    ) -> tuple[jax.Array, KVCache, dict]:
+        """Chunked prefill whose per-chunk KV leaves the device AS IT LANDS
+        (ISSUE 10, the streamed-handoff producer): after dispatching chunk
+        N+1's compute, chunk N's position range is sliced from the cache
+        (a cheap on-device op, dispatched BEFORE the next chunk donates the
+        cache buffers), host-gathered, and handed to
+        `emit(lo, hi, arrays)` — so gather/serialize/send of chunk N
+        overlaps compute of chunk N+1 instead of waiting for the whole
+        prompt. A bounded sender ring (DecodePipeline's discipline) caps
+        how far the gather may trail the compute frontier; depth 1 is the
+        default because the drain runs synchronously in this thread — the
+        gather IS the fence, so trailing by one chunk buys the full
+        overlap and any deeper ring only delays the FIRST chunk onto the
+        wire (first-chunk latency is exactly what streaming exists to
+        cut). `ring_depth=0` degenerates to the serial gather-after-
+        compute loop.
+
+        `arrays` per emit: {"k", "v", (+"k_scale"/"v_scale" for kv_quant),
+        "tokens"} — each truncated to the TRUE prompt rows (the padded tail
+        never ships), "tokens" being the [B, width] prompt slice so the
+        decode side can seed its speculative drafting history for free.
+
+        Returns (first token [B], cache, stats) with stats =
+        {"chunks", "gather_s"}. Semantically identical to
+        prefill_chunked(): same first token, same cache contents."""
+        import dataclasses as _dc
+        from collections import deque
+
+        B, S = tokens.shape
+        if S <= 0:
+            raise ValueError("empty prompt")
+        pad = (-S) % chunk_size
+        if S + pad > self.max_len:
+            raise ValueError(
+                f"padded prompt {S + pad} exceeds max_len {self.max_len}; "
+                f"use a chunk_size dividing max_len or a shorter prompt"
+            )
+        padded = jnp.pad(tokens, ((0, 0), (0, pad))) if pad else tokens
+        tokens_host = np.asarray(tokens)
+        cache = self.new_cache()
+        hidden = None
+        stats = {"chunks": 0, "gather_s": 0.0}
+        pending: "deque[tuple[int, int, dict]]" = deque()
+
+        def drain_one() -> None:
+            lo, hi, slices = pending.popleft()
+            t0 = time.perf_counter()
+            host = {name: np.asarray(x) for name, x in slices.items()}  # vet: ignore[hotpath-host-sync]: the per-chunk gather fence — scheduled while the NEXT chunk computes, which is the point
+            stats["gather_s"] += time.perf_counter() - t0
+            host["tokens"] = tokens_host[:, lo:hi]
+            emit(lo, hi, host)
+            stats["chunks"] += 1
+
+        for i in range(0, S + pad, chunk_size):
+            hidden, cache = self._prefill_chunk(
+                self.params, padded[:, i: i + chunk_size], cache
+            )
+            # Slice THIS chunk's true rows now — the ops dispatch against
+            # the current cache value before the next chunk donates it.
+            lo, hi = i, min(i + chunk_size, S)
+            slices = {
+                "k": cache.k[:, :, lo:hi], "v": cache.v[:, :, lo:hi],
+            }
+            if cache.k_scale is not None:
+                slices["k_scale"] = cache.k_scale[:, :, lo:hi]
+                slices["v_scale"] = cache.v_scale[:, :, lo:hi]
+            pending.append((lo, hi, slices))
+            while len(pending) > max(0, ring_depth):
+                drain_one()
+        while pending:
+            drain_one()
+        token, cache = self._finish_chunked(
+            self.params, cache, hidden, (S - 1) % chunk_size, self._next_key()
+        )
+        return (
+            token,
+            _dc.replace(cache, pos=jnp.asarray(S, cache.pos.dtype)),
+            stats,
+        )
+
     def decode(self, tokens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
         """tokens [B] -> (next token [B], cache)."""
         return self._decode(self.params, tokens, cache, self._next_key())

@@ -13,22 +13,74 @@ One request per connection: dial, send one op frame, read one reply frame,
 close — the bundles are MB-scale, so connection setup is noise, and
 stateless requests keep replica failover trivial (any endpoint of the
 service can answer).
+
+Payload format (ISSUE 10 — the npz path is gone): a payload is a
+self-describing pack of raw array buffers,
+
+    !I(spec_len) + spec_json + buf0 + buf1 + ...
+    spec_json = {"arrays": [{"name", "dtype", "shape"}, ...]}
+
+sent with scatter-gather (`socket.sendmsg` over memoryviews straight off
+the source arrays — zero host copies on the send path; `np.savez` copied
+every payload twice through a BytesIO) and decoded with `np.frombuffer`
+views (zero copies on the receive path). Every KV-transport socket runs
+`TCP_NODELAY` with an SO_SNDBUF/SO_RCVBUF floor so small ack frames never
+ride Nagle under MB-scale payloads.
+
+Streamed handoff (`kv_stream`): a bundle may be offered as a `KVStream`
+instead of one monolithic payload. The server then answers `pull_bundle`
+with a multi-frame reply on the same connection —
+
+    BEGIN {.., "stream": true}
+    CHUNK {"chunk": seq, "pos_range": [lo, hi)} + packed arrays   (per-chunk ack)
+    END   {"end": true, "chunks": n, "checksum": crc32, ...} + packed tail
+
+— chunks leaving the prefill worker WHILE later prefill chunks still
+compute. Per-chunk acks ride the same deadline/retry/fault machinery as
+everything else; any torn leg (partial write, dropped ack, checksum or
+order mismatch) re-queues the WHOLE stream for redelivery from chunk 0, so
+a mid-stream death can never deliver a torn cache. `LWS_TPU_KV_CHUNK=0`
+keeps the monolithic single-shot path (the oracle).
 """
 
 from __future__ import annotations
 
 import hmac
-import io
 import json
 import queue
 import socket
 import struct
 import threading
-from typing import Optional
+import zlib
+from typing import Optional, Sequence, Union
 
-from lws_tpu.core import faults, resilience
+from lws_tpu.core import faults, metrics, resilience
 
 _FRAME = struct.Struct("!II")
+_SPEC = struct.Struct("!I")
+
+# Socket buffer floor: small ack frames must never sit behind Nagle, and
+# MB-scale bundle frames should not drain through default-sized kernel
+# buffers (the floor is a request — the kernel may clamp to its rmem/wmem
+# ceilings, which is fine).
+_SOCK_BUF_FLOOR = 1 << 20
+
+Payload = Union[bytes, bytearray, memoryview, Sequence]
+
+
+def tune_socket(sock: socket.socket) -> None:
+    """TCP_NODELAY + SO_SNDBUF/SO_RCVBUF floor on every KV-transport socket
+    (client dials AND the server's listen/accept path)."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # non-TCP test doubles
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            if sock.getsockopt(socket.SOL_SOCKET, opt) < _SOCK_BUF_FLOOR:
+                sock.setsockopt(socket.SOL_SOCKET, opt, _SOCK_BUF_FLOOR)
+        except OSError:
+            pass
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -41,18 +93,50 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-def send_msg(sock: socket.socket, meta: dict, payload: bytes = b"") -> None:
+def _as_views(payload: Payload) -> list:
+    """Normalize a payload (bytes | buffer | sequence of buffers) to a flat
+    list of byte views WITHOUT copying any of them."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return [memoryview(payload).cast("B")] if len(payload) else []
+    return [v if isinstance(v, memoryview) else memoryview(v) for v in payload]
+
+
+def _sendall_vectored(sock: socket.socket, views: list) -> None:
+    """sendall over a scatter-gather buffer list: the frame header and every
+    array buffer go to the kernel straight from where they live — no
+    intermediate join copy. Falls back to per-buffer sendall where sendmsg
+    is unavailable."""
+    bufs = [v for v in views if v.nbytes]
+    if not hasattr(sock, "sendmsg"):
+        for v in bufs:
+            sock.sendall(v)
+        return
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        while bufs and sent >= bufs[0].nbytes:
+            sent -= bufs[0].nbytes
+            bufs.pop(0)
+        if sent and bufs:
+            bufs[0] = bufs[0][sent:]
+
+
+def send_msg(sock: socket.socket, meta: dict, payload: Payload = b"") -> None:
+    views = _as_views(payload)
     header = json.dumps(meta).encode()
-    sock.sendall(_FRAME.pack(len(header), len(payload)) + header + payload)
+    plen = sum(v.nbytes for v in views)
+    frame = _FRAME.pack(len(header), plen) + header
+    _sendall_vectored(sock, [memoryview(frame)] + views)
 
 
-def _send_partial(sock: socket.socket, meta: dict, payload: bytes,
+def _send_partial(sock: socket.socket, meta: dict, payload: Payload,
                   nbytes: int) -> None:
     """Cooperative `partial_write` fault: ship only the first `nbytes` of
     the frame, leaving the peer with a truncated read — the mid-frame
-    death the re-queue/re-insert paths must survive."""
+    death the re-queue/re-insert paths must survive. (Test-only path: the
+    join copy here is deliberate and irrelevant.)"""
     header = json.dumps(meta).encode()
-    frame = _FRAME.pack(len(header), len(payload)) + header + payload
+    body = b"".join(bytes(v) for v in _as_views(payload))
+    frame = _FRAME.pack(len(header), len(body)) + header + body
     sock.sendall(frame[: max(0, nbytes)])
 
 
@@ -60,6 +144,14 @@ def recv_msg(sock: socket.socket) -> tuple[Optional[dict], bytes]:
     raw = _recv_exact(sock, _FRAME.size)
     if raw is None:
         return None, b""
+    return _recv_msg_body(sock, raw)
+
+
+def _recv_msg_body(sock: socket.socket, raw: bytes) -> tuple[Optional[dict], bytes]:
+    """Finish reading a frame whose !II prefix (`raw`) already arrived —
+    split out so pull_bundle can open its transfer clock AT the first
+    frame byte (the long-poll wait for the server's queue pop must not
+    pollute `serving_kv_transfer_seconds`)."""
     hlen, plen = _FRAME.unpack(raw)
     header = _recv_exact(sock, hlen)
     if header is None:
@@ -68,24 +160,92 @@ def recv_msg(sock: socket.socket) -> tuple[Optional[dict], bytes]:
     return json.loads(header), payload or b""
 
 
+# ---------------------------------------------------------------------------
+# Raw-buffer array packing (the one wire serialization — npz is deleted).
+
+
+def _resolve_dtype(name: str):
+    """np.dtype by name, including the ml_dtypes extension types a bf16
+    serving cache ships (registered by the jax import in any worker)."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_payload(arrays: dict) -> tuple[list, int]:
+    """dict of arrays -> ([spec_header_bytes, raw buffer views...], payload
+    nbytes). ZERO-COPY: each C-contiguous array contributes its own buffer
+    view (the views keep their arrays alive); `np.asarray` on a jax/sharded
+    array is the host gather the caller intends."""
+    import numpy as np
+
+    spec = []
+    views: list = []
+    nbytes = 0
+    for name, value in arrays.items():
+        arr = np.asarray(value)
+        if not arr.flags["C_CONTIGUOUS"]:
+            # The one copy a non-contiguous source costs (sliced host
+            # views); device gathers and packed chunks arrive contiguous.
+            arr = np.ascontiguousarray(arr)
+        spec.append({"name": name, "dtype": arr.dtype.name,
+                     "shape": list(arr.shape)})
+        if arr.nbytes:
+            # uint8 reinterpret, not memoryview.cast: ml_dtypes extension
+            # types (bfloat16) have no buffer-protocol format code.
+            view = memoryview(arr.reshape(-1).view(np.uint8))
+            views.append(view)
+            nbytes += view.nbytes
+    head = json.dumps({"arrays": spec}).encode()
+    return [_SPEC.pack(len(head)) + head] + views, nbytes
+
+
 def arrays_to_bytes(**arrays) -> bytes:
-    """npz-serialize a dict of arrays (the KV bundle wire format)."""
+    """Pack arrays into ONE contiguous payload. This is the convenience
+    path for small payloads (prompts, token results, tests) — the join is
+    the single host copy it costs, accounted in
+    `serving_kv_copy_bytes_total` so perf budgets can pin the hot KV path
+    to zero copies (it streams via `pack_payload` views instead)."""
+    bufs, nbytes = pack_payload(arrays)
+    if nbytes:
+        metrics.inc("serving_kv_copy_bytes_total",
+                    {"site": "arrays_to_bytes"}, value=float(nbytes))
+    return b"".join(bytes(v) if isinstance(v, memoryview) else v
+                    for v in bufs)
+
+
+def bytes_to_arrays(data) -> dict:
+    """Payload bytes -> dict of arrays, ZERO-COPY: every array is an
+    `np.frombuffer` view into `data` (read-only when `data` is bytes)."""
     import numpy as np
 
-    bio = io.BytesIO()
-    np.savez(bio, **{k: np.asarray(v) for k, v in arrays.items()})
-    return bio.getvalue()
+    view = memoryview(data)
+    (hlen,) = _SPEC.unpack(view[: _SPEC.size])
+    spec = json.loads(bytes(view[_SPEC.size: _SPEC.size + hlen]))
+    off = _SPEC.size + hlen
+    out = {}
+    for entry in spec["arrays"]:
+        dt = _resolve_dtype(entry["dtype"])
+        shape = tuple(entry["shape"])
+        count = 1
+        for dim in shape:
+            count *= int(dim)
+        nbytes = count * dt.itemsize
+        arr = np.frombuffer(view[off: off + nbytes], dtype=dt, count=count)
+        out[entry["name"]] = arr.reshape(shape)
+        off += nbytes
+    return out
 
 
-def bytes_to_arrays(data: bytes) -> dict:
-    import numpy as np
-
-    return dict(np.load(io.BytesIO(data)))
-
-
-def cache_to_bundle(cache, token) -> bytes:
-    """KVCache + first token -> wire bundle. The ONE place the bundle schema
-    lives (both roles go through here).
+def cache_arrays(cache, token) -> dict:
+    """KVCache + first token -> the wire array dict (pos-truncated). The
+    ONE place the bundle schema lives (both roles and both transfer shapes
+    go through here).
 
     Bundle bytes are ∝ PROMPT LENGTH, not the prefill engine's allocation:
     the sequence dim is truncated to `pos` (only rows [0, pos) hold prompt
@@ -93,8 +253,8 @@ def cache_to_bundle(cache, token) -> bytes:
     prompt in a 2k-slot allocation ships half the bytes; production prompts
     in 70B-scale caches ship orders less than the reservation (VERDICT r3
     next #3). For a tp-sharded cache np.asarray performs an explicit host
-    gather — the recorded len() of the result is the true wire cost; the
-    decode side re-shards onto ITS mesh (see disagg_worker)."""
+    gather — the recorded byte count of the result is the true wire cost;
+    the decode side re-shards onto ITS mesh (see disagg_worker)."""
     import numpy as np
 
     p = int(np.asarray(cache.pos))
@@ -109,11 +269,19 @@ def cache_to_bundle(cache, token) -> bytes:
             k_scale=np.asarray(cache.k_scale)[:, :, :p],
             v_scale=np.asarray(cache.v_scale)[:, :, :p],
         )
-    return arrays_to_bytes(**arrays)
+    return arrays
 
 
-def bundle_to_cache(data: bytes, max_len: Optional[int] = None):
-    """Wire bundle -> (KVCache, first token [B]).
+def cache_to_bundle(cache, token) -> bytes:
+    """KVCache + first token -> one monolithic wire bundle (the single-shot
+    path; the streamed path ships `cache_arrays` position ranges through a
+    `KVStream` without this join copy)."""
+    return arrays_to_bytes(**cache_arrays(cache, token))
+
+
+def bundle_to_cache(data, max_len: Optional[int] = None):
+    """Wire bundle (payload bytes, or an already-unpacked array dict from a
+    stream's `HostAssembler`) -> (KVCache, first token [B]).
 
     `max_len` is the DECODE side's sequence budget: the pos-truncated prefix
     from the wire is pasted into a zeroed [*, max_len, *] allocation with
@@ -125,7 +293,7 @@ def bundle_to_cache(data: bytes, max_len: Optional[int] = None):
 
     from lws_tpu.models.llama import KVCache
 
-    bundle = bytes_to_arrays(data)
+    bundle = data if isinstance(data, dict) else bytes_to_arrays(data)
 
     def fit(a):
         if max_len is None or a.shape[2] == max_len:
@@ -147,6 +315,317 @@ def bundle_to_cache(data: bytes, max_len: Optional[int] = None):
     return cache, jnp.asarray(bundle["token"])
 
 
+# ---------------------------------------------------------------------------
+# Streamed handoff: server-side stream record + client-side assemblers.
+
+# Axis each per-position array chunks along ("tokens" is the [B, width]
+# prompt slice the stream ships so decode can seed its speculative drafting
+# history — 4 bytes/token, noise next to the KV rows).
+_CHUNK_AXES = {"k": 2, "v": 2, "k_scale": 2, "v_scale": 2, "tokens": 1}
+
+# serving_kv_stream_inflight_chunks: chunks produced by prefill compute but
+# not yet acked by a decode puller, summed over this process's live streams.
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT_CHUNKS = 0  # guarded-by: _INFLIGHT_LOCK
+
+
+def _inflight_delta(delta: int) -> None:
+    global _INFLIGHT_CHUNKS
+    with _INFLIGHT_LOCK:
+        _INFLIGHT_CHUNKS = max(0, _INFLIGHT_CHUNKS + delta)
+        value = _INFLIGHT_CHUNKS
+    metrics.set("serving_kv_stream_inflight_chunks", float(value))
+
+
+class _StreamFailed(Exception):
+    """Producer-side failure: the stream is dead, do NOT requeue (the
+    router's resubmit is the recovery path, exactly like prefill death)."""
+
+
+class PoisonPayload:
+    """A streamed delivery whose RECEIVER rejected the content (e.g. more
+    KV rows than the decode budget) while the WIRE completed cleanly. The
+    stream is drained and acked per protocol — re-queueing cannot heal a
+    content mismatch, it would crash-loop every successor — and the error
+    surfaces where the monolithic path's would: inside `process()`, whose
+    poison-message guard consumes the request with a failed result."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException) -> None:
+        self.error = error
+
+
+class KVStream:
+    """Server-side record of ONE streamed KV handoff.
+
+    The prefill loop `put_chunk`s position ranges as their KV lands (each
+    chunk packed zero-copy at produce time) and `finish`es with the tail
+    payload (first token, pos) plus the END metadata; connection threads
+    `read` it — possibly MULTIPLE times, because chunks stay buffered until
+    the final ack so a torn delivery replays from chunk 0 (the same
+    at-least-once contract the monolithic bundle queue gives). Memory cost
+    equals the monolithic path's queued bundle. The running crc32 computed
+    at produce time is the END frame's torn-cache check."""
+
+    def __init__(self, chunk_tokens: int = 0) -> None:
+        self._cond = threading.Condition()
+        self.chunk_tokens = int(chunk_tokens)
+        self._chunks: list[tuple[dict, list, int]] = []  # guarded-by: _cond
+        self._end: Optional[tuple[dict, list]] = None    # guarded-by: _cond
+        self._failed = False                             # guarded-by: _cond
+        self.checksum = 0                                # guarded-by: _cond
+        self.payload_bytes = 0                           # guarded-by: _cond
+        self._acked_hw = 0                               # guarded-by: _cond
+
+    @property
+    def failed(self) -> bool:
+        with self._cond:
+            return self._failed
+
+    @property
+    def chunks(self) -> int:
+        with self._cond:
+            return len(self._chunks)
+
+    def put_chunk(self, lo: int, hi: int, arrays: dict) -> None:
+        """Buffer one position range [lo, hi) for delivery. Called by the
+        prefill loop while LATER chunks still compute — a blocked puller
+        never blocks the producer."""
+        bufs, _ = pack_payload(arrays)
+        wire_len = _payload_len(bufs)  # incl. the spec header, like len(payload)
+        # Gauge BEFORE the chunk becomes visible: a connection thread can
+        # deliver and ack the chunk the moment notify lands, and its -1
+        # racing ahead of this +1 would be eaten by the gauge's zero clamp
+        # (drifting the counter permanently high).
+        _inflight_delta(+1)
+        try:
+            with self._cond:
+                if self._end is not None or self._failed:
+                    raise RuntimeError("put_chunk on a finished KVStream")
+                for view in bufs:
+                    self.checksum = zlib.crc32(view, self.checksum)
+                meta = {"chunk": len(self._chunks), "pos_range": [int(lo), int(hi)]}
+                self._chunks.append((meta, bufs, wire_len))
+                self.payload_bytes += wire_len
+                self._cond.notify_all()
+        except BaseException:
+            _inflight_delta(-1)  # the chunk never became visible
+            raise
+
+    def finish(self, end_meta: dict, end_arrays: Optional[dict] = None) -> None:
+        bufs, _ = pack_payload(end_arrays or {})
+        with self._cond:
+            self._end = (dict(end_meta), bufs)
+            self._cond.notify_all()
+
+    def fail(self) -> None:
+        """Producer died/raised: wake pullers with a terminal verdict."""
+        with self._cond:
+            self._failed = True
+            pending = len(self._chunks) - self._acked_hw
+            # Advance the high-water mark so an ack already in flight on a
+            # connection thread becomes a no-op in chunk_acked() — without
+            # this, fail() and the late ack would BOTH decrement the
+            # process-wide gauge for the same chunk, eating another live
+            # stream's contribution.
+            self._acked_hw = len(self._chunks)
+            self._cond.notify_all()
+        if pending > 0:
+            _inflight_delta(-pending)
+
+    def chunk_acked(self, idx: int) -> None:
+        """First-time ack bookkeeping for the in-flight gauge (redeliveries
+        re-send already-acked chunks without double-decrementing)."""
+        delta = 0
+        with self._cond:
+            if idx + 1 > self._acked_hw:
+                delta = idx + 1 - self._acked_hw
+                self._acked_hw = idx + 1
+        if delta:
+            _inflight_delta(-delta)
+
+    def read(self, idx: int, timeout: float):
+        """Next item for a delivery at position `idx`: ("chunk", meta,
+        bufs), ("end", meta, bufs), ("failed", None, None), or ("timeout",
+        None, None) when the producer stalls past `timeout`."""
+        import time as _time
+
+        deadline_t = _time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._failed:
+                    return "failed", None, None
+                if idx < len(self._chunks):
+                    meta, bufs, _ = self._chunks[idx]
+                    return "chunk", meta, bufs
+                if self._end is not None:
+                    end_meta, bufs = self._end
+                    meta = {
+                        **end_meta, "end": True,
+                        "chunks": len(self._chunks),
+                        "checksum": self.checksum,
+                        "payload_bytes": self.payload_bytes,
+                    }
+                    return "end", meta, bufs
+                remaining = deadline_t - _time.monotonic()
+                if remaining <= 0:
+                    return "timeout", None, None
+                self._cond.wait(remaining)
+
+
+def _payload_len(bufs: list) -> int:
+    # Spec header included: this is the wire payload length a receiver's
+    # per-chunk `len(payload)` sees, so both ends account identical bytes.
+    return sum(memoryview(v).nbytes for v in bufs)
+
+
+class HostAssembler:
+    """Default stream receiver: reassemble the chunked per-position arrays
+    into the monolithic bundle dict `bytes_to_arrays` would have returned
+    (plus the streamed-only "tokens" prompt array)."""
+
+    def __init__(self, begin_meta: Optional[dict] = None) -> None:
+        self._parts: dict[str, list] = {}
+        self.chunks = 0
+
+    def chunk(self, cmeta: dict, arrays: dict) -> None:
+        for name, arr in arrays.items():
+            self._parts.setdefault(name, []).append(arr)
+        self.chunks += 1
+
+    def finish(self, end_meta: dict, end_arrays: dict):
+        import numpy as np
+
+        out = {
+            name: np.concatenate(parts, axis=_CHUNK_AXES.get(name, 0))
+            for name, parts in self._parts.items()
+        }
+        out.update(end_arrays)
+        return out
+
+
+# One jitted donating insert shared by every CacheAssembler: compiled per
+# (chunk shape, dtype) — two shapes per stream (the fixed chunk width and
+# the ragged tail), reused across requests.
+_DEVICE_INSERT = None
+_DEVICE_INSERT_LOCK = threading.Lock()
+
+
+def _device_insert(buf, chunk, lo: int):
+    global _DEVICE_INSERT
+    import jax
+    import jax.numpy as jnp
+
+    with _DEVICE_INSERT_LOCK:
+        if _DEVICE_INSERT is None:
+            _DEVICE_INSERT = jax.jit(
+                lambda b, c, i: jax.lax.dynamic_update_slice_in_dim(
+                    b, c, i, axis=2
+                ),
+                donate_argnums=(0,),
+            )
+        fn = _DEVICE_INSERT
+    return fn(buf, jnp.asarray(chunk), jnp.asarray(lo, jnp.int32))
+
+
+class CacheAssembler:
+    """Decode-side incremental `bundle_to_cache`: every streamed chunk is
+    uploaded into its position slice of a zeroed [*, max_len, *] device
+    buffer ON ARRIVAL (a donated `dynamic_update_slice` dispatch — async,
+    so the upload overlaps the next chunk's wire transfer), and the
+    finished cache is ready the moment END lands — the first decode step
+    dispatches immediately, no deserialize/upload tail.
+
+    `device=False` (mesh-sharded decode) assembles on HOST instead: a
+    per-position-slice sharded insert would reshard every chunk, so the
+    mesh path keeps the single `device_put` onto the engine's cache
+    shardings at the end, still overlapping host assembly with the wire."""
+
+    def __init__(self, max_len: int, device: bool = True) -> None:
+        self.max_len = int(max_len)
+        self.device = device
+        self._bufs: dict = {}
+        self._token_parts: list = []
+        self.chunks = 0
+        self.payload_bytes = 0
+        self.array_bytes: dict[str, int] = {}
+        self._token = None
+        self._pos: Optional[int] = None
+
+    def chunk(self, cmeta: dict, arrays: dict) -> None:
+        lo, hi = (int(x) for x in cmeta["pos_range"])
+        for name in ("k", "v", "k_scale", "v_scale"):
+            arr = arrays.get(name)
+            if arr is None:
+                continue
+            if lo + arr.shape[2] > self.max_len:
+                raise ValueError(
+                    f"stream chunk ends at {lo + arr.shape[2]} KV rows but "
+                    f"decode max_len={self.max_len}"
+                )
+            self._insert(name, arr, lo)
+            self.array_bytes[name] = self.array_bytes.get(name, 0) + arr.nbytes
+        if "tokens" in arrays:
+            self._token_parts.append(arrays["tokens"])
+        self.chunks += 1
+
+    def _insert(self, name: str, arr, lo: int) -> None:
+        import numpy as np
+
+        buf = self._bufs.get(name)
+        if buf is None:
+            shape = list(arr.shape)
+            shape[2] = self.max_len
+            if self.device:
+                import jax.numpy as jnp
+
+                buf = jnp.zeros(tuple(shape), arr.dtype)
+            else:
+                buf = np.zeros(tuple(shape), arr.dtype)
+        if self.device:
+            buf = _device_insert(buf, arr, lo)
+        else:
+            buf[:, :, lo: lo + arr.shape[2]] = arr
+        self._bufs[name] = buf
+
+    def finish(self, end_meta: dict, end_arrays: dict):
+        if "token" not in end_arrays or "pos" not in end_arrays:
+            raise OSError("kv stream END frame missing token/pos tail")
+        self._token = end_arrays["token"]
+        self._pos = int(end_arrays["pos"])
+        if self._pos > self.max_len:
+            raise ValueError(
+                f"stream holds {self._pos} KV rows but decode max_len={self.max_len}"
+            )
+        return self
+
+    def take(self):
+        """-> (KVCache, first token [B], pos, context tokens [B, pos]|None).
+        Device path: the cache IS the assembled device buffers (decode can
+        dispatch on it immediately); host path: np arrays the caller
+        device_puts onto its own shardings (the monolithic reshard leg)."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from lws_tpu.models.llama import KVCache
+
+        if self._pos is None:
+            raise RuntimeError("take() before the stream END landed")
+        cache = KVCache(
+            k=self._bufs["k"], v=self._bufs["v"],
+            pos=jnp.asarray(self._pos, jnp.int32),
+            k_scale=self._bufs.get("k_scale"),
+            v_scale=self._bufs.get("v_scale"),
+        )
+        context = (
+            np.concatenate(self._token_parts, axis=1)
+            if self._token_parts else None
+        )
+        return cache, jnp.asarray(self._token), self._pos, context
+
+
 class KVServer:
     """Per-worker handoff server. The owning worker enqueues/dequeues
     locally; remote peers drive the queues through one-shot TCP ops:
@@ -154,9 +633,11 @@ class KVServer:
       submit_prompt  (router/client -> prefill)   meta {id}, payload bytes
       pull_prompt    (unused remotely; prefill drains its own queue)
       pull_bundle    (decode -> prefill)          reply meta {id}|{none};
-                     the puller ACKS on the same connection — unacked
-                     bundles are re-queued (at-least-once; decode is
-                     idempotent per id, so replays are harmless)
+                     monolithic payload or a BEGIN/CHUNK/END stream; the
+                     puller ACKS on the same connection (per-chunk acks for
+                     streams, plus the final process ack) — unacked
+                     bundles/streams are re-queued (at-least-once; decode
+                     is idempotent per id, so replays are harmless)
       pull_result    (router/client -> decode)    meta {id}; the entry is
                      evicted on delivery (no unbounded growth)
 
@@ -174,7 +655,7 @@ class KVServer:
 
         self._token = token if token is not None else os.environ.get("LWS_TPU_KV_TOKEN")
         self._prompts: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
-        self._bundles: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
+        self._bundles: "queue.Queue[tuple[dict, object]]" = queue.Queue()
         self._results: dict[str, tuple[dict, bytes]] = {}  # guarded-by: _results_lock
         self._results_lock = threading.Lock()
         # Delivery counters are bumped from per-connection threads — every
@@ -187,6 +668,7 @@ class KVServer:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
             self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            tune_socket(self._sock)  # buf floors inherit into accepted conns
             self._sock.bind((host, port))
             self._sock.listen(16)
         except OSError:
@@ -234,6 +716,12 @@ class KVServer:
         self._bundles.put((meta, payload))
         self._backlog_beat()
 
+    def offer_stream(self, meta: dict, stream: KVStream) -> None:
+        """Offer a STREAMED handoff: called BEFORE prefill computes, so a
+        puller attaches while chunks are still being produced — the wire
+        leg overlaps prefill compute instead of waiting for it."""
+        self.offer_bundle(meta, stream)
+
     def _backlog_beat(self) -> None:
         # KV-handoff backlog feed for the watchdog: progress = bundles the
         # decode side has pulled AND acked, depth = bundles still waiting.
@@ -280,10 +768,9 @@ class KVServer:
         # the bundle/result re-queue paths below already ran.
         try:
             with conn:
+                tune_socket(conn)
                 self._handle_one(conn)
         except OSError:
-            from lws_tpu.core import metrics
-
             metrics.inc("serving_kv_connection_errors_total")
 
     def _handle_one(self, conn: socket.socket) -> None:
@@ -325,20 +812,39 @@ class KVServer:
             # crash mid-processing drops the connection, the bundle
             # re-queues, and another pull redelivers (decode is idempotent
             # per id, so replays are harmless). The ack window covers
-            # decode + first-call compile.
+            # decode + first-call compile. Streams re-queue WHOLE: every
+            # redelivery replays from chunk 0, never a torn suffix.
+            ack_timeout = float(meta.get("ack_timeout", 120.0))
             try:
-                fault = faults.fire("kv.server.send_bundle")
-                if fault is not None and fault.mode == "partial_write":
-                    _send_partial(conn, bmeta, bpayload, int(fault.arg))
-                    raise OSError("injected partial bundle write")
-                send_msg(conn, bmeta, bpayload)
-                conn.settimeout(float(meta.get("ack_timeout", 120.0)))
+                if isinstance(bpayload, KVStream):
+                    self._send_stream(conn, bmeta, bpayload, ack_timeout)
+                else:
+                    t0 = _time.perf_counter()
+                    fault = faults.fire("kv.server.send_bundle")
+                    if fault is not None and fault.mode == "partial_write":
+                        _send_partial(conn, bmeta, bpayload, int(fault.arg))
+                        raise OSError("injected partial bundle write")
+                    if fault is not None and fault.mode == "pace":
+                        _pace_sleep(fault, len(bpayload))
+                    send_msg(conn, bmeta, bpayload)
+                    metrics.inc("serving_kv_transfer_bytes_total",
+                                {"role": "prefill"}, value=float(len(bpayload)))
+                    metrics.observe("serving_kv_transfer_seconds",
+                                    _time.perf_counter() - t0,
+                                    {"role": "prefill"})
+                conn.settimeout(ack_timeout)
                 ack, _ = recv_msg(conn)
                 if not (ack or {}).get("ack"):
                     raise OSError("no ack")
                 with self._counts_lock:
                     self.bundles_delivered += 1
                 self._backlog_beat()  # progress advanced: backlog drains
+            except _StreamFailed:
+                # Producer-side death: the stream can never complete, so a
+                # re-queue would head-of-line block the queue forever. The
+                # router's resubmit is the recovery path (same contract as
+                # prefill dying pre-offer).
+                return
             except OSError:
                 if "deadline_s" in bmeta:
                     # The failed delivery window (pop -> here) burned real
@@ -374,6 +880,61 @@ class KVServer:
         else:
             send_msg(conn, {"error": f"unknown op {op!r}"})
 
+    def _send_stream(self, conn: socket.socket, bmeta: dict,
+                     stream: KVStream, ack_timeout: float) -> None:
+        """One streamed delivery attempt: BEGIN, then chunk/ack pairs as
+        the producer lands them, then END. Raises OSError on any torn leg
+        (caller re-queues the stream) or _StreamFailed when the producer
+        died (caller drops it)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        send_msg(conn, {**bmeta, "stream": True})
+        idx = 0
+        while True:
+            kind, cmeta, bufs = stream.read(idx, timeout=ack_timeout)
+            if kind == "timeout":
+                raise OSError("kv stream producer stalled")
+            if kind == "failed":
+                try:
+                    send_msg(conn, {"stream_failed": True})
+                except OSError:
+                    pass
+                raise _StreamFailed(bmeta.get("id", "?"))
+            if kind == "chunk":
+                fault = faults.fire("kv.stream.send_chunk")
+                if fault is not None and fault.mode == "partial_write":
+                    _send_partial(conn, cmeta, bufs, int(fault.arg))
+                    raise OSError("injected partial stream chunk write")
+                if fault is not None and fault.mode == "pace":
+                    _pace_sleep(fault, _payload_len(bufs))
+                send_msg(conn, cmeta, bufs)
+                conn.settimeout(ack_timeout)
+                ack, _ = recv_msg(conn)
+                if ack is None or ack.get("ack_chunk") != cmeta["chunk"]:
+                    raise OSError("kv stream chunk unacked")
+                stream.chunk_acked(idx)
+                idx += 1
+                continue
+            # END
+            send_msg(conn, cmeta, bufs)
+            metrics.inc("serving_kv_transfer_bytes_total",
+                        {"role": "prefill"},
+                        value=float(stream.payload_bytes))
+            metrics.observe("serving_kv_transfer_seconds",
+                            _time.perf_counter() - t0, {"role": "prefill"})
+            return
+
+
+def _pace_sleep(fault, nbytes: int) -> None:
+    """Cooperative `pace:MBPS` fault: emulate a bandwidth-limited link by
+    sleeping this frame's byte count at the armed MB/s — per-byte-fair
+    across monolithic and streamed deliveries (the kv_handoff bench's
+    DCN-like link; see docs/robustness.md)."""
+    import time as _time
+
+    _time.sleep(nbytes / (max(float(fault.arg), 1e-6) * 1e6))
+
 
 def _auth(meta: dict) -> dict:
     import os
@@ -394,6 +955,7 @@ def _one_shot(endpoint: tuple[str, int], meta: dict, payload: bytes = b"",
     with socket.create_connection(
         endpoint, timeout=resilience.clamp_timeout(timeout)
     ) as sock:
+        tune_socket(sock)
         send_msg(sock, _auth(meta), payload)
         faults.fire("kv.client.recv")
         return recv_msg(sock)
@@ -427,8 +989,81 @@ def submit_prompt(endpoint, req_id: str, prompt_bytes: bytes,
         raise RuntimeError(f"submit_prompt failed: {meta}")
 
 
+def _recv_stream(sock: socket.socket, begin_meta: dict, receiver,
+                 ack_timeout: float) -> tuple[dict, object, int]:
+    """Client half of the kv_stream protocol: consume CHUNK frames into
+    `receiver` (per-chunk acked) until END, verify the checksum/count, and
+    return (merged meta, receiver.finish(...) result, payload bytes). Any
+    mismatch raises OSError — no final ack, the server re-queues, the
+    redelivery replays from chunk 0: a torn cache is impossible."""
+    crc = 0
+    n = 0
+    nbytes = 0
+    poison: Optional[BaseException] = None
+    while True:
+        resilience.check("kv.stream.recv")
+        fault = faults.fire("kv.stream.recv_chunk")
+        if fault is not None and fault.mode in ("drop", "partial_write"):
+            # Cooperative receive-side loss: the connection is abandoned
+            # mid-stream exactly as if the read tore.
+            raise OSError(f"injected kv stream recv loss at chunk {n}")
+        sock.settimeout(resilience.clamp_timeout(ack_timeout))
+        cmeta, payload = recv_msg(sock)
+        if cmeta is None:
+            raise OSError("kv stream truncated mid-frame")
+        if cmeta.get("stream_failed"):
+            raise OSError("kv stream failed at the sender")
+        if cmeta.get("end"):
+            if int(cmeta.get("chunks", -1)) != n or \
+                    int(cmeta.get("checksum", -1)) != crc:
+                raise OSError("torn kv stream: checksum/chunk-count mismatch")
+            end_arrays = bytes_to_arrays(payload) if payload else {}
+            merged = {k: v for k, v in {**begin_meta, **cmeta}.items()
+                      if k not in ("end", "checksum", "stream")}
+            merged["streamed"] = True
+            merged["payload_bytes"] = nbytes
+            try:
+                receiver.payload_bytes = nbytes  # wire accounting for stats
+            except AttributeError:
+                pass
+            if poison is None:
+                try:
+                    result = receiver.finish(cmeta, end_arrays)
+                except Exception as e:  # noqa: BLE001 — content verdict, see below
+                    poison = e
+            if poison is not None:
+                merged["receiver_error"] = repr(poison)[:200]
+                return merged, PoisonPayload(poison), nbytes
+            return merged, result, nbytes
+        if int(cmeta.get("chunk", -1)) != n:
+            raise OSError("out-of-order kv stream chunk")
+        crc = zlib.crc32(payload, crc)
+        # Ack on RECEIPT, then insert: the per-chunk ack is flow control
+        # (it keeps the sender's window moving while this side uploads);
+        # durability is the END checksum + the final process ack — a death
+        # after a chunk ack still re-queues the WHOLE stream. Inserting
+        # after the ack overlaps this chunk's device upload with the
+        # sender's next transmission instead of serializing them.
+        send_msg(sock, {"ack_chunk": n})
+        if poison is None:
+            try:
+                receiver.chunk(cmeta, bytes_to_arrays(payload))
+            except Exception as e:  # noqa: BLE001
+                # A RECEIVER rejection is a CONTENT verdict, not a wire
+                # failure: re-queueing cannot heal it (every successor
+                # would re-pull and re-die — a head-of-line crash loop).
+                # Keep draining/acking so the protocol completes, then
+                # hand the error to process() as a PoisonPayload — the
+                # same consume-with-failed-result path a poison
+                # monolithic bundle takes. Wire errors (OSError from the
+                # socket reads above) still propagate and re-queue.
+                poison = e
+        n += 1
+        nbytes += len(payload)
+
+
 def pull_bundle(endpoint, timeout: float = 1.0, process=None,
-                ack_timeout: float = 120.0):
+                ack_timeout: float = 120.0, receiver_factory=None):
     """Returns (meta, payload) — or `process(meta, payload)`'s result when a
     callback is given — or None when the peer has nothing pending.
 
@@ -440,25 +1075,61 @@ def pull_bundle(endpoint, timeout: float = 1.0, process=None,
     must be idempotent per id — replays happen). `ack_timeout` is forwarded
     to the server as its ack-wait window — size it for the callback's worst
     case (decode + first-call jit compile), or the server re-queues and
-    redelivers while the puller is still working."""
+    redelivers while the puller is still working.
+
+    STREAMED replies (the server offered a `KVStream`): chunks are fed to
+    `receiver_factory(begin_meta)` as they arrive — the decode worker
+    passes a `CacheAssembler` so each chunk device-uploads while the next
+    is still on the wire — and `payload` is the receiver's `finish()`
+    result (without a factory, a `HostAssembler`'s monolithic array dict).
+    The per-chunk acks and the END checksum ride inside this call; `meta`
+    gains `streamed`/`chunks`/`payload_bytes`. A RECEIVER exception (the
+    content doesn't fit this side — e.g. more KV rows than max_len) does
+    NOT re-queue: the stream drains per protocol and `payload` arrives as
+    a `PoisonPayload` for `process()`'s poison guard to consume with a
+    failed result (without `process`, the error re-raises after the
+    wire-level ack)."""
     resilience.check("kv.pull_bundle")
     faults.fire("kv.client.connect")
+    import time as _time
+
     with socket.create_connection(
         endpoint, timeout=resilience.clamp_timeout(timeout + 9.0)
     ) as sock:
+        tune_socket(sock)
         send_msg(sock, _auth({
             "op": "pull_bundle", "timeout": timeout, "ack_timeout": ack_timeout,
         }))
         faults.fire("kv.client.recv")
-        meta, payload = recv_msg(sock)
+        # Transfer clock opens at the FIRST frame byte: the blocking wait
+        # before it is the server's long-poll queue wait, not wire time.
+        raw = _recv_exact(sock, _FRAME.size)
+        t0 = _time.perf_counter()
+        meta, payload = (None, b"") if raw is None else _recv_msg_body(sock, raw)
         if meta is None:
             raise OSError("truncated pull_bundle reply")
         if meta.get("error"):
             raise RuntimeError(f"pull_bundle rejected: {meta}")
         if meta.get("none"):
             return None
+        if meta.get("stream"):
+            receiver = (receiver_factory(meta) if receiver_factory
+                        else HostAssembler(meta))
+            meta, payload, rx_bytes = _recv_stream(
+                sock, meta, receiver, ack_timeout
+            )
+        else:
+            rx_bytes = len(payload)
+        metrics.inc("serving_kv_transfer_bytes_total", {"role": "decode"},
+                    value=float(rx_bytes))
+        metrics.observe("serving_kv_transfer_seconds",
+                        _time.perf_counter() - t0, {"role": "decode"})
         if process is None:
             _send_ack(sock)
+            if isinstance(payload, PoisonPayload):
+                # Content the receiver rejected: consumed at wire level
+                # (same as any acked no-process pull), error to the caller.
+                raise payload.error
             return meta, payload
         result = process(meta, payload)  # raise => no ack => server re-queues
         _send_ack(sock)

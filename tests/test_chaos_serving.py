@@ -596,6 +596,158 @@ def test_fleet_scrape_fault_point_degrades_and_backs_off(armed):
 
 
 # ---------------------------------------------------------------------------
+# Streamed KV handoff under injected faults (ISSUE 10): armed faults at the
+# new stream points (`kv.stream.send_chunk`, `kv.stream.recv_chunk`) must
+# NEVER deliver a torn cache — every scenario resumes or requeues and ends
+# with token streams byte-identical to the fault-free oracle.
+
+
+@pytest.fixture(scope="module")
+def stream_rig():
+    """Tiny real engines + the fault-free oracle tokens, shared across the
+    stream-chaos scenarios (prefill produces once per test; decode engines
+    are minted per pull because decode_n donates its cache)."""
+    from types import SimpleNamespace
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving.disagg_worker import _decode_bundle
+    from lws_tpu.serving.engine import Engine
+
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+
+    def engine():
+        return Engine(cfg, params, batch_size=1, max_len=32)
+
+    prompt = np.asarray(
+        jax.random.randint(jax.random.key(5), (13,), 0, 64), np.int32)
+    pre = engine()
+    token, cache = pre.prefill(jnp.asarray(prompt).reshape(1, -1))
+    want, _, _ = _decode_bundle(
+        engine(), kt.cache_to_bundle(cache, token), steps=5)
+    return SimpleNamespace(
+        engine=engine, prefill_engine=pre, prompt=prompt, want=want,
+        decode=_decode_bundle,
+    )
+
+
+def _produce_stream(rig, server, req_id: str) -> None:
+    from lws_tpu.serving.disagg_worker import _prefill_streamed
+
+    _prefill_streamed(rig.prefill_engine, server, kt, {"id": req_id},
+                      req_id, rig.prompt, 4, None)
+
+
+def _pull_assembled(rig, server, **kw):
+    return kt.pull_bundle(
+        ep(server), timeout=2.0, ack_timeout=30.0,
+        receiver_factory=lambda m: kt.CacheAssembler(max_len=32, device=True),
+        **kw,
+    )
+
+
+def test_stream_partial_write_requeues_and_replays_byte_identical(
+        armed, server, stream_rig):
+    """A chunk send that dies mid-frame (injected partial write): the first
+    delivery tears, the WHOLE stream re-queues, the redelivery replays from
+    chunk 0, and the decoded tokens equal the fault-free oracle."""
+    import numpy as np
+
+    _produce_stream(stream_rig, server, "pw-stream")
+    armed("kv.stream.send_chunk", "partial_write:6:1")
+    with pytest.raises(OSError):
+        _pull_assembled(stream_rig, server)
+    assert server.delivery_counts()[0] == 0
+    meta, payload = _pull_assembled(stream_rig, server)
+    assert meta["chunks"] == 4  # 13 rows / 4-row chunks, replayed whole
+    got, stats, _ = stream_rig.decode(stream_rig.engine(), payload, steps=5)
+    np.testing.assert_array_equal(got, stream_rig.want)
+    assert stats["streamed"]
+
+
+def test_stream_recv_drop_requeues_and_replays_byte_identical(
+        armed, server, stream_rig):
+    """Receive-side loss (injected drop at kv.stream.recv_chunk): the
+    puller abandons mid-stream, the server re-queues on the missing chunk
+    ack, and the replay is byte-identical."""
+    import numpy as np
+
+    _produce_stream(stream_rig, server, "drop-stream")
+    armed("kv.stream.recv_chunk", "drop:1")
+    with pytest.raises(OSError, match="injected kv stream recv loss"):
+        _pull_assembled(stream_rig, server)
+    faults.INJECTOR.disarm()
+    meta, payload = _pull_assembled(stream_rig, server)
+    got, _, _ = stream_rig.decode(stream_rig.engine(), payload, steps=5)
+    np.testing.assert_array_equal(got, stream_rig.want)
+
+
+def test_stream_decode_death_mid_stream_requeue_then_replay_dedup(
+        armed, server, stream_rig):
+    """The full ISSUE-10 chaos chain: decode DIES mid-stream (exit fault on
+    the recv leg) -> stream re-queues; the successor decodes and posts, but
+    its ack is DROPPED -> redelivery replays into the seen-id guard, which
+    acks WITHOUT a second decode. One decode total, tokens byte-identical."""
+    import numpy as np
+
+    _produce_stream(stream_rig, server, "death-stream")
+    armed("kv.stream.recv_chunk", "exit:1")
+    armed("kv.ack", "drop:1")
+    seen = SeenIds(site="chaos-stream")
+    decodes = []
+
+    def process(meta, payload):
+        if seen.contains(meta["id"]):
+            return  # replay: ack without re-decoding
+        got, _, _ = stream_rig.decode(stream_rig.engine(), payload, steps=5)
+        decodes.append(got)
+        seen.record(meta["id"])
+
+    with pytest.raises(SystemExit):  # decode death mid-stream
+        _pull_assembled(stream_rig, server, process=process)
+    assert server.delivery_counts()[0] == 0 and not decodes
+    # Successor: decodes for real, ack dropped -> server re-queues.
+    _pull_assembled(stream_rig, server, process=process)
+    assert len(decodes) == 1
+    # Replay: deduped, acked, consumed.
+    _pull_assembled(stream_rig, server, process=process)
+    assert len(decodes) == 1  # never decoded twice
+    np.testing.assert_array_equal(decodes[0], stream_rig.want)
+
+    def wait_for(predicate, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline and not predicate():
+            time.sleep(0.02)
+        return predicate()
+
+    assert wait_for(lambda: server.delivery_counts()[0] == 1)
+    assert kt.pull_bundle(ep(server), timeout=0.2) is None  # consumed
+    assert metrics.REGISTRY.counter_value(
+        "serving_replays_deduped_total", {"site": "chaos-stream"}) >= 1.0
+
+
+def test_pace_fault_emulates_bandwidth_on_both_paths(armed, server):
+    """`pace:MBPS` (the kv_handoff bench's DCN-like link): cooperative at
+    both send points, per-byte-fair — a paced monolithic send sleeps the
+    same total as the equivalent paced stream."""
+    payload = bytes(200_000)
+    server.offer_bundle({"id": "paced"}, payload)
+    armed("kv.server.send_bundle", "pace:10")  # 10 MB/s -> ~20ms for 200kB
+    t0 = time.perf_counter()
+    got = kt.pull_bundle(ep(server), timeout=2.0)
+    assert got is not None and got[1] == payload
+    assert time.perf_counter() - t0 >= 0.015  # the link really throttled
+
+
+# ---------------------------------------------------------------------------
 # The multi-process e2e: prefill killed mid-handoff + ack loss -> replay,
 # byte-identical. `slow` like the other subprocess e2es; `make chaos` runs it.
 

@@ -79,7 +79,8 @@ def role_spec(role: str, kv_port: int, api_url: str, extra_env: list | None = No
 
 
 def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
-                    backend_env: dict | None = None):
+                    backend_env: dict | None = None,
+                    expect_streamed: bool = False):
     from lws_tpu.core import trace as _trace
 
     _trace.TRACER.enabled = True
@@ -193,6 +194,14 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
 
         cfg = flagship_config("smoke", max_seq_len=32)
         assert handoff["bundle_bytes"] >= len(prompt) * kv_row_bytes(cfg), handoff
+        if expect_streamed:
+            # The streamed path really ran: chunk count matches the knob
+            # (ceil(5 / 2) chunks for the 5-token prompt) on both the
+            # prefill-side record and the decode-side stats merge.
+            assert handoff.get("streamed") is True, handoff
+            assert handoff.get("chunks") == 3, handoff
+        else:
+            assert "streamed" not in handoff, handoff
 
         # One CONNECTED span tree across three processes: controller
         # reconcile (control plane) -> client request -> prefill admission +
@@ -342,8 +351,18 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
         api.stop()
 
 
-def test_disaggregated_prefill_decode_over_tcp(tmp_path):
-    _run_disagg_e2e(tmp_path)
+def test_disaggregated_prefill_decode_over_tcp_streamed(tmp_path):
+    """The primary e2e now rides the STREAMED handoff (ISSUE 10):
+    LWS_TPU_KV_CHUNK=2 chunks the 5-token prompt into 3 position ranges
+    that ship while prefill still computes; tokens must stay byte-identical
+    to the single-engine oracle. (The tp e2e below keeps the monolithic
+    single-shot path covered — LWS_TPU_KV_CHUNK=0 — so BOTH transfer
+    shapes run end to end across real processes.)"""
+    _run_disagg_e2e(
+        tmp_path,
+        extra_env=[EnvVar("LWS_TPU_KV_CHUNK", "2")],
+        expect_streamed=True,
+    )
 
 
 def test_disaggregated_tp_sharded_over_tcp(tmp_path):
@@ -353,7 +372,8 @@ def test_disaggregated_tp_sharded_over_tcp(tmp_path):
     decode mesh — tokens identical to the single-device oracle."""
     _run_disagg_e2e(
         tmp_path,
-        extra_env=[EnvVar("LWS_TPU_TP", "2")],
+        # LWS_TPU_KV_CHUNK=0 pins the monolithic single-shot oracle path.
+        extra_env=[EnvVar("LWS_TPU_TP", "2"), EnvVar("LWS_TPU_KV_CHUNK", "0")],
         # The harness's env_overrides win over pod-declared env (it forces
         # JAX_PLATFORMS=cpu the same way), so the device count rides there.
         backend_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},

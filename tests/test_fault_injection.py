@@ -91,6 +91,21 @@ def test_drop_and_partial_write_are_cooperative(injector):
     assert fault.mode == "partial_write" and fault.arg == 6.0
 
 
+def test_pace_mode_is_cooperative_and_always_fires(injector):
+    """pace:MBPS (ISSUE 10's DCN-link emulation): cooperative Fault with
+    the MB/s as arg, firing on EVERY call (a link has no trip budget), and
+    armable only on the send points that implement the pacing."""
+    injector.arm("kv.stream.send_chunk", "pace:150")
+    for _ in range(3):
+        fault = injector.fire("kv.stream.send_chunk")
+        assert isinstance(fault, Fault) and fault.mode == "pace"
+        assert fault.arg == 150.0
+    with pytest.raises(ValueError, match="cooperative"):
+        injector.arm("kv.client.connect", "pace:10")
+    with pytest.raises(ValueError, match="MB/s"):
+        injector.arm("kv.stream.send_chunk", "pace:0")
+
+
 def test_exit_mode_raises_systemexit(injector):
     injector.arm("disagg.prefill.handoff", "exit:1")
     with pytest.raises(SystemExit):

@@ -1,0 +1,305 @@
+"""Streamed KV handoff (ISSUE 10): chunk-granular transfer with real
+engines over real sockets, in one process. The contract under test is the
+one the benchmark budgets and the e2e proves across processes:
+
+  * BYTE-IDENTICAL greedy token streams, streamed vs the monolithic
+    single-shot oracle (streaming reorders WHEN bytes move, never what the
+    decode math sees);
+  * the incremental CacheAssembler builds exactly the cache
+    bundle_to_cache builds (device and host/mesh assembly paths);
+  * speculative decode seeds its drafting history from the streamed prompt
+    tokens without changing the token stream;
+  * short prompts fall back to the single-shot path (a one-chunk stream is
+    the monolithic transfer with extra frames).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu.models.llama import LlamaConfig, init_params
+from lws_tpu.serving import kv_transport as kt
+from lws_tpu.serving.disagg_worker import (
+    _decode_bundle,
+    _prefill_streamed,
+    kv_chunk_tokens,
+    use_streaming,
+)
+from lws_tpu.serving.engine import Engine
+
+MAX_LEN = 48
+STEPS = 6
+
+
+def tiny_cfg(**kw):
+    return LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=64, dtype=jnp.float32, remat=False, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return np.asarray(
+        jax.random.randint(jax.random.key(3), (21,), 0, 128), np.int32
+    )
+
+
+def make_engine(model):
+    cfg, params = model
+    return Engine(cfg, params, batch_size=1, max_len=MAX_LEN)
+
+
+def pull_streamed(server, engine, device=True):
+    """One decode-side pull with the worker's CacheAssembler shape, run in
+    a thread (the server delivers from a connection thread while the
+    caller produces)."""
+    out = {}
+
+    def puller():
+        out["got"] = kt.pull_bundle(
+            ("127.0.0.1", server.port), timeout=15.0, ack_timeout=60.0,
+            receiver_factory=lambda m: kt.CacheAssembler(
+                max_len=engine.max_len, device=device),
+        )
+
+    t = threading.Thread(target=puller, daemon=True)
+    t.start()
+    return t, out
+
+
+def test_streamed_handoff_byte_identical_to_monolithic_oracle(model, prompt):
+    pre = make_engine(model)
+    dec_mono, dec_stream = make_engine(model), make_engine(model)
+    server = kt.KVServer(port=0, host="127.0.0.1")
+    try:
+        # Oracle: the retained single-shot path, same engines end to end.
+        token, cache = pre.prefill(jnp.asarray(prompt).reshape(1, -1))
+        bundle = kt.cache_to_bundle(cache, token)
+        want, mono_stats, _ = _decode_bundle(dec_mono, bundle, steps=STEPS)
+        assert "streamed" not in mono_stats
+
+        thread, out = pull_streamed(server, dec_stream)
+        _prefill_streamed(pre, server, kt, {"id": "r1"}, "r1", prompt, 8, None)
+        thread.join(timeout=60)
+        meta, payload = out["got"]
+        got, stats, _ = _decode_bundle(dec_stream, payload, steps=STEPS)
+
+        np.testing.assert_array_equal(got, want)  # the whole point
+        assert stats["streamed"] and stats["chunks"] == 3
+        # Wire accounting agrees end to end: prefill's reported bundle
+        # bytes == the receiver's counted payload bytes == decode's stats.
+        assert meta["handoff"]["bundle_bytes"] == meta["payload_bytes"]
+        assert stats["bundle_bytes"] == meta["payload_bytes"]
+        assert meta["handoff"]["streamed"] and meta["handoff"]["chunks"] == 3
+    finally:
+        server.close()
+
+
+def test_cache_assembler_matches_bundle_to_cache(model, prompt):
+    """Feed prefill_chunked_stream's chunks straight into a CacheAssembler
+    (no sockets): the assembled device cache is BIT-IDENTICAL to
+    bundle_to_cache of the same chunked prefill's monolithic bundle."""
+    pre, pre2 = make_engine(model), make_engine(model)
+    tokens = jnp.asarray(prompt).reshape(1, -1)
+
+    asm = kt.CacheAssembler(max_len=MAX_LEN, device=True)
+    token_s, cache_s, stats = pre.prefill_chunked_stream(
+        tokens, 8, emit=lambda lo, hi, a: asm.chunk(
+            {"pos_range": [lo, hi]}, a),
+    )
+    asm.finish({}, {"token": np.asarray(token_s),
+                    "pos": np.asarray(int(cache_s.pos), np.int32)})
+    cache_a, token_a, pos, context = asm.take()
+
+    token_c, cache_c = pre2.prefill_chunked(tokens, chunk_size=8)
+    ref_cache, ref_token = kt.bundle_to_cache(
+        kt.cache_to_bundle(cache_c, token_c), max_len=MAX_LEN)
+
+    assert pos == len(prompt) == int(ref_cache.pos)
+    np.testing.assert_array_equal(np.asarray(cache_a.k), np.asarray(ref_cache.k))
+    np.testing.assert_array_equal(np.asarray(cache_a.v), np.asarray(ref_cache.v))
+    np.testing.assert_array_equal(np.asarray(token_a), np.asarray(ref_token))
+    np.testing.assert_array_equal(context[0], prompt)  # spec seeding input
+    assert stats["chunks"] == asm.chunks == 3
+
+
+def test_host_assembly_path_matches_device_path(model, prompt):
+    """The mesh-decode shape (device=False): host-assembled np buffers ==
+    the device path's arrays — the single device_put reshard leg sees the
+    same cache either way."""
+    pre, pre2 = make_engine(model), make_engine(model)
+    tokens = jnp.asarray(prompt).reshape(1, -1)
+
+    def run(engine, device):
+        asm = kt.CacheAssembler(max_len=MAX_LEN, device=device)
+        token, cache, _ = engine.prefill_chunked_stream(
+            tokens, 8, emit=lambda lo, hi, a: asm.chunk(
+                {"pos_range": [lo, hi]}, a),
+        )
+        asm.finish({}, {"token": np.asarray(token),
+                        "pos": np.asarray(int(cache.pos), np.int32)})
+        return asm.take()
+
+    cache_d, _, _, _ = run(pre, True)
+    cache_h, _, _, _ = run(pre2, False)
+    assert isinstance(cache_h.k, np.ndarray)
+    np.testing.assert_array_equal(np.asarray(cache_d.k), cache_h.k)
+    np.testing.assert_array_equal(np.asarray(cache_d.v), cache_h.v)
+
+
+def test_streamed_spec_leg_seeds_history_and_stays_byte_identical(model, prompt):
+    """gamma > 0 over a streamed handoff: the drafting history seeds from
+    the streamed prompt tokens (context is not None reaches
+    decode_speculative) and the greedy stream is STILL byte-identical —
+    acceptance only ever keeps the model's own argmax chain."""
+    pre = make_engine(model)
+    dec_plain, dec_spec = make_engine(model), make_engine(model)
+    server = kt.KVServer(port=0, host="127.0.0.1")
+    try:
+        token, cache = pre.prefill(jnp.asarray(prompt).reshape(1, -1))
+        want, _, _ = _decode_bundle(
+            dec_plain, kt.cache_to_bundle(cache, token), steps=STEPS)
+
+        thread, out = pull_streamed(server, dec_spec)
+        _prefill_streamed(pre, server, kt, {"id": "r2"}, "r2", prompt, 8, None)
+        thread.join(timeout=60)
+        _, payload = out["got"]
+        assert payload._token_parts, "stream did not ship prompt tokens"
+        got, stats, _ = _decode_bundle(
+            dec_spec, payload, steps=STEPS, gamma=3, ngram=2)
+        np.testing.assert_array_equal(got, want)
+        assert stats["spec_gamma"] == 3 and stats["streamed"]
+    finally:
+        server.close()
+
+
+def test_short_prompts_fall_back_to_single_shot():
+    assert not use_streaming(prompt_len=5, chunk_tokens=256)
+    assert not use_streaming(prompt_len=256, chunk_tokens=256)  # one chunk
+    assert use_streaming(prompt_len=257, chunk_tokens=256)
+    assert not use_streaming(prompt_len=10_000, chunk_tokens=0)  # oracle knob
+    # Chunk padding must FIT the engine budget: a 270-token prompt under
+    # chunk=256/max_len=300 pads to 512 — single-shot serves it fine, so
+    # it must fall back instead of raising in the engine (crash loop).
+    assert not use_streaming(prompt_len=270, chunk_tokens=256, max_len=300)
+    assert use_streaming(prompt_len=270, chunk_tokens=256, max_len=512)
+    assert use_streaming(prompt_len=21, chunk_tokens=8, max_len=24)  # pad 3 fits
+
+
+def test_stream_fail_after_acks_keeps_gauge_consistent():
+    """fail() racing an in-flight chunk ack must not double-decrement the
+    process-wide inflight gauge (it would eat another live stream's
+    contribution): fail() advances the ack high-water mark so a late
+    chunk_acked() is a no-op."""
+    import lws_tpu.serving.kv_transport as ktmod
+
+    base = ktmod._INFLIGHT_CHUNKS
+    stream = kt.KVStream(4)
+    for lo in (0, 4, 8):
+        stream.put_chunk(lo, lo + 4, {"k": np.zeros((1, 1, 4, 1, 1), np.float32)})
+    stream.chunk_acked(0)
+    assert ktmod._INFLIGHT_CHUNKS == base + 2
+    stream.fail()  # clears the stream's remaining contribution...
+    assert ktmod._INFLIGHT_CHUNKS == base
+    stream.chunk_acked(1)  # ...and a LATE ack is a no-op, not a decrement
+    stream.chunk_acked(2)
+    assert ktmod._INFLIGHT_CHUNKS == base
+
+
+def test_kv_chunk_env_knob(monkeypatch):
+    monkeypatch.setenv("LWS_TPU_KV_CHUNK", "0")
+    assert kv_chunk_tokens() == 0
+    monkeypatch.setenv("LWS_TPU_KV_CHUNK", "64")
+    assert kv_chunk_tokens() == 64
+    monkeypatch.delenv("LWS_TPU_KV_CHUNK")
+    assert kv_chunk_tokens() == 256  # streaming-by-default for long prompts
+
+
+def test_prefill_chunked_stream_serial_ring_matches(model, prompt):
+    """ring_depth=0 (fully serial gather) must emit the same chunks and
+    first token as the overlapped default — the ring only schedules WHEN
+    gathers happen, never what they contain."""
+    pre_a, pre_b = make_engine(model), make_engine(model)
+    tokens = jnp.asarray(prompt).reshape(1, -1)
+    a, b = [], []
+    tok_a, _, _ = pre_a.prefill_chunked_stream(
+        tokens, 8, emit=lambda lo, hi, ar: a.append((lo, hi, ar)))
+    tok_b, _, _ = pre_b.prefill_chunked_stream(
+        tokens, 8, emit=lambda lo, hi, ar: b.append((lo, hi, ar)),
+        ring_depth=0)
+    assert [x[:2] for x in a] == [x[:2] for x in b] == [(0, 8), (8, 16), (16, 21)]
+    assert int(tok_a[0]) == int(tok_b[0])
+    for (_, _, ar_a), (_, _, ar_b) in zip(a, b):
+        np.testing.assert_array_equal(ar_a["k"], ar_b["k"])
+
+
+def test_assembler_rejects_rows_past_decode_budget(model, prompt):
+    """The decode-budget contract bundle_to_cache enforces holds for
+    streams too: a chunk (or final pos) past max_len is refused."""
+    asm = kt.CacheAssembler(max_len=4, device=True)
+    with pytest.raises(ValueError, match="max_len"):
+        asm.chunk({"pos_range": [0, 8]},
+                  {"k": np.zeros((2, 1, 8, 2, 3), np.float32)})
+
+
+def test_streamed_poison_bundle_fails_request_not_worker(model, prompt):
+    """Prefill budget larger than decode budget over a STREAMED handoff
+    (the poison shape the monolithic guard already covers): the assembler's
+    rejection must flow into the worker's process() as a PoisonPayload —
+    consumed with a failed result and acked — never crash the pull loop
+    (an un-consumed poison stream would re-queue and crash every
+    successor: a head-of-line crash loop)."""
+    cfg, params = model
+    pre = Engine(cfg, params, batch_size=1, max_len=MAX_LEN)
+    small_budget = 8  # decode max_len < the 21-row prompt
+    server = kt.KVServer(port=0, host="127.0.0.1")
+    try:
+        results = {}
+
+        def process(meta, payload):
+            # The decode worker's poison-guard shape (run_decode_tcp).
+            try:
+                _decode_bundle(None, payload, steps=STEPS)
+            except Exception as e:  # noqa: BLE001 — the worker's guard
+                results[meta["id"]] = f"failed: {e!r}"
+                return
+            results[meta["id"]] = "decoded"
+
+        out = {}
+
+        def puller():
+            out["r"] = kt.pull_bundle(
+                ("127.0.0.1", server.port), timeout=15.0, ack_timeout=60.0,
+                receiver_factory=lambda m: kt.CacheAssembler(
+                    max_len=small_budget, device=True),
+                process=process,
+            )
+
+        t = threading.Thread(target=puller, daemon=True)
+        t.start()
+        _prefill_streamed(pre, server, kt, {"id": "poison"}, "poison",
+                          prompt, 8, None)
+        t.join(timeout=60)
+        assert results.get("poison", "").startswith("failed:"), results
+        assert "max_len" in results["poison"]
+        # Consumed, not re-queued: no successor can crash on it.
+        import time as _time
+        deadline = _time.time() + 5
+        while server.delivery_counts()[0] < 1 and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert server.delivery_counts()[0] == 1
+        assert kt.pull_bundle(("127.0.0.1", server.port), timeout=0.3) is None
+    finally:
+        server.close()

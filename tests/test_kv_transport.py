@@ -109,6 +109,199 @@ def test_pull_result_concurrent_single_delivery(server):
     assert len(hits) == 1 and server.results_served == 1
 
 
+def test_pack_roundtrip_preserves_shapes_and_is_zero_copy():
+    """The raw wire format (ISSUE 10): dtype/shape round-trip including
+    0-d scalars, and the decoded arrays are frombuffer VIEWS into the
+    payload (no receive-side copy), ml_dtypes extension types included."""
+    import numpy as np
+
+    arrays = {
+        "k": np.arange(48, dtype=np.float32).reshape(2, 1, 4, 2, 3),
+        "pos": np.asarray(7, np.int32),
+        "token": np.asarray([3], np.int32),
+        "empty": np.zeros((2, 0, 4), np.float32),
+    }
+    data = kt.arrays_to_bytes(**arrays)
+    out = kt.bytes_to_arrays(data)
+    assert set(out) == set(arrays)
+    for name, want in arrays.items():
+        assert out[name].dtype == want.dtype and out[name].shape == want.shape
+        np.testing.assert_array_equal(out[name], want)
+    assert out["pos"].ndim == 0 and int(out["pos"]) == 7
+    assert out["k"].base is not None, "decode copied instead of viewing"
+
+    import ml_dtypes
+
+    bf = np.arange(6, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(2, 3)
+    back = kt.bytes_to_arrays(kt.arrays_to_bytes(x=bf))["x"]
+    assert back.dtype == bf.dtype
+    np.testing.assert_array_equal(back, bf)
+
+
+def test_vectored_send_survives_large_multi_buffer_payloads(server):
+    """Scatter-gather framing: a payload made of MANY separate buffers,
+    larger than any socket buffer, arrives byte-exact (the partial-sendmsg
+    continuation loop)."""
+    import numpy as np
+
+    parts = [np.random.RandomState(i).bytes(257 * 1024) for i in range(9)]
+    # Drive send_msg directly over a connected socket via the submit op:
+    # the prompt payload rides the same vectored path.
+    payload = [memoryview(p) for p in parts]
+    with kt.socket.create_connection(ep(server)) as sock:
+        kt.tune_socket(sock)
+        kt.send_msg(sock, {"op": "submit_prompt", "id": "vec"}, payload)
+        reply, _ = kt.recv_msg(sock)
+    assert reply == {"ok": True}
+    meta, got = server.next_prompt(timeout=2.0)
+    assert meta["id"] == "vec" and got == b"".join(parts)
+
+
+def test_kv_sockets_run_nodelay(server):
+    """Satellite: every KV-transport socket disables Nagle (small ack
+    frames must not queue behind MB-scale payload segments)."""
+    with kt.socket.create_connection(ep(server)) as sock:
+        kt.tune_socket(sock)
+        assert sock.getsockopt(kt.socket.IPPROTO_TCP, kt.socket.TCP_NODELAY) != 0
+    assert server._sock.getsockopt(
+        kt.socket.IPPROTO_TCP, kt.socket.TCP_NODELAY) != 0
+
+
+def stream_of(chunks, end_arrays, chunk_tokens=4):
+    import numpy as np
+
+    stream = kt.KVStream(chunk_tokens)
+    lo = 0
+    for width in chunks:
+        arrays = {
+            "k": np.full((2, 1, width, 2, 3), float(lo), np.float32),
+            "v": np.full((2, 1, width, 2, 3), float(lo + 1), np.float32),
+            "tokens": np.arange(lo, lo + width, dtype=np.int32)[None, :],
+        }
+        stream.put_chunk(lo, lo + width, arrays)
+        lo += width
+    stream.finish({"handoff": {"streamed": True}}, end_arrays)
+    return stream, lo
+
+
+def test_streamed_pull_default_receiver_reassembles(server):
+    """BEGIN/CHUNK/END over a real socket: the default HostAssembler hands
+    back the monolithic array dict, per-chunk acked, checksum verified,
+    and the final ack counts ONE delivery."""
+    import numpy as np
+
+    end = {"token": np.asarray([9], np.int32), "pos": np.asarray(12, np.int32)}
+    stream, total = stream_of([4, 4, 4], end)
+    server.offer_stream({"id": "s1"}, stream)
+    meta, arrays = kt.pull_bundle(ep(server), timeout=2.0, ack_timeout=10.0)
+    assert meta["id"] == "s1" and meta["streamed"] and meta["chunks"] == 3
+    assert meta["payload_bytes"] == stream.payload_bytes
+    assert arrays["k"].shape[2] == total
+    np.testing.assert_array_equal(
+        arrays["tokens"][0], np.arange(total, dtype=np.int32))
+    assert int(arrays["pos"]) == 12 and arrays["token"][0] == 9
+    # Chunk boundaries landed in the right rows.
+    assert arrays["k"][0, 0, 0, 0, 0] == 0.0 and arrays["k"][0, 0, 4, 0, 0] == 4.0
+    import time as _time
+    deadline = _time.time() + 5
+    while server.delivery_counts()[0] < 1 and _time.time() < deadline:
+        _time.sleep(0.02)
+    assert server.delivery_counts()[0] == 1
+    assert kt.pull_bundle(ep(server), timeout=0.2) is None  # consumed
+
+
+def test_stream_receiver_rejection_is_poison_not_requeue(server):
+    """A RECEIVER exception mid-stream is a CONTENT verdict, not a wire
+    failure: re-queueing could never heal it (every successor would
+    re-pull and re-die — a head-of-line crash loop), so the stream drains
+    per protocol and the error surfaces as a poison delivery — exactly
+    the consume-with-failed-result path a poison monolithic bundle takes
+    through the decode worker's guard."""
+    import numpy as np
+
+    end = {"token": np.asarray([1], np.int32), "pos": np.asarray(8, np.int32)}
+    stream, _ = stream_of([4, 4], end)
+    server.offer_stream({"id": "s2"}, stream)
+
+    class RejectsContent(kt.HostAssembler):
+        def chunk(self, cmeta, arrays):
+            raise ValueError("rows past this side's budget")
+
+    # Worker shape: process() sees the PoisonPayload, consumes it (posts a
+    # failed result in the real worker), and the delivery ACKS.
+    seen = {}
+
+    def process(meta, payload):
+        assert isinstance(payload, kt.PoisonPayload)
+        with pytest.raises(ValueError, match="budget"):
+            raise payload.error
+        seen["meta"] = meta
+
+    kt.pull_bundle(ep(server), timeout=2.0, ack_timeout=10.0,
+                   receiver_factory=lambda m: RejectsContent(m),
+                   process=process)
+    assert "receiver_error" in seen["meta"]
+
+    def consumed():
+        return server.delivery_counts()[0] == 1
+    assert wait_for(consumed)
+    assert kt.pull_bundle(ep(server), timeout=0.2) is None  # consumed, no loop
+
+    # No-process shape: the error re-raises to the caller after the
+    # wire-level ack (same consumed-on-ack contract as any bare pull).
+    stream2, _ = stream_of([4], end)
+    server.offer_stream({"id": "s2b"}, stream2)
+    with pytest.raises(ValueError, match="budget"):
+        kt.pull_bundle(ep(server), timeout=2.0, ack_timeout=10.0,
+                       receiver_factory=lambda m: RejectsContent(m))
+    assert wait_for(lambda: server.delivery_counts()[0] == 2)
+
+
+def test_stream_checksum_mismatch_refused():
+    """A server whose END frame advertises the wrong checksum (bit rot,
+    torn buffers) is REFUSED: OSError, no ack — never a silent torn cache."""
+    import threading
+
+    import numpy as np
+
+    lis = kt.socket.socket()
+    lis.bind(("127.0.0.1", 0))
+    lis.listen(1)
+    port = lis.getsockname()[1]
+
+    def evil_server():
+        conn, _ = lis.accept()
+        with conn:
+            kt.recv_msg(conn)  # the pull op frame
+            kt.send_msg(conn, {"id": "x", "stream": True})
+            bufs, _ = kt.pack_payload(
+                {"k": np.zeros((1, 1, 2, 1, 1), np.float32)})
+            kt.send_msg(conn, {"chunk": 0, "pos_range": [0, 2]}, bufs)
+            kt.recv_msg(conn)  # chunk ack
+            kt.send_msg(conn, {"end": True, "chunks": 1, "checksum": 12345})
+
+    t = threading.Thread(target=evil_server, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(OSError, match="torn kv stream"):
+            kt.pull_bundle(("127.0.0.1", port), timeout=2.0, ack_timeout=5.0)
+    finally:
+        lis.close()
+        t.join(timeout=5)
+
+
+def test_stream_producer_failure_drops_not_requeues(server):
+    """stream.fail() (prefill raised mid-produce): the puller gets a
+    terminal error and the stream is DROPPED, never re-queued — a dead
+    stream must not head-of-line block the bundle queue forever."""
+    stream = kt.KVStream(4)
+    server.offer_stream({"id": "s3"}, stream)
+    stream.fail()
+    with pytest.raises(OSError, match="failed at the sender"):
+        kt.pull_bundle(ep(server), timeout=2.0, ack_timeout=10.0)
+    assert kt.pull_bundle(ep(server), timeout=0.3) is None  # dropped, not queued
+
+
 def test_bind_failure_closes_socket(server):
     """Error-path resource hygiene (vet: resource-ctor-leak): a KVServer
     that fails to bind — port already owned by the fixture's server — must

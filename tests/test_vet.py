@@ -116,6 +116,36 @@ def test_hotpath_roots_reachability_and_suppression():
     assert not any(f.detail.startswith("cold:") for f in found), found
 
 
+def test_hotpath_flags_buffered_serialization_in_serving():
+    """hotpath-serialize-copy (ISSUE 10): np.savez / io.BytesIO anywhere
+    under lws_tpu/serving/ — lexical, no hot-root reachability needed (the
+    npz double copy this rule guards against was never on a hot root)."""
+    found = run_pass(
+        "hotpath",
+        [FIXTURES / "lws_tpu" / "serving" / "serialize_cases.py"],
+        root=FIXTURES,
+    )
+    rules = {(f.rule, f.detail) for f in found}
+    assert ("hotpath-serialize-copy", "npz_round_trip:io.BytesIO") in rules
+    assert ("hotpath-serialize-copy", "npz_round_trip:np.savez") in rules
+    assert ("hotpath-serialize-copy",
+            "compressed_variant:np.savez_compressed") in rules
+    # The suppressed buffered dump and the sanctioned raw-framing /
+    # bytes-join shapes produce nothing.
+    assert not any(d.startswith(("suppressed_copy", "raw_framing_ok",
+                                 "bytes_join_ok"))
+                   for r, d in rules if r == "hotpath-serialize-copy"), rules
+    # Scope: the SAME shapes outside lws_tpu/serving/ are not this rule's
+    # business (root=FIXTURES/"lws_tpu"/"serving" puts the file OUTSIDE the
+    # scoped prefix).
+    outside = run_pass(
+        "hotpath",
+        [FIXTURES / "lws_tpu" / "serving" / "serialize_cases.py"],
+        root=FIXTURES / "lws_tpu" / "serving",
+    )
+    assert not any(f.rule == "hotpath-serialize-copy" for f in outside)
+
+
 # ---------------------------------------------------------------------------
 # resources pass
 

@@ -1,0 +1,34 @@
+"""hotpath-serialize-copy fixtures: true positives AND false-positive
+guards. Lives under vet_fixtures/lws_tpu/serving/ because the rule is
+scoped to lws_tpu/serving/ paths (lexically — no hot-root reachability
+required). Never imported — only parsed by the analyzer self-tests."""
+
+import io
+
+import numpy as np
+
+
+def npz_round_trip(arrays):
+    bio = io.BytesIO()  # true positive: hotpath-serialize-copy
+    np.savez(bio, **arrays)  # true positive: hotpath-serialize-copy
+    return bio.getvalue()
+
+
+def compressed_variant(bio, arrays):
+    np.savez_compressed(bio, **arrays)  # true positive
+
+
+def suppressed_copy():
+    return io.BytesIO()  # vet: ignore[hotpath-serialize-copy]: fixture — a deliberate buffered debug dump
+
+
+def raw_framing_ok(arrays):
+    # The sanctioned shape: raw contiguous views, no intermediate buffer.
+    return [memoryview(np.asarray(v).reshape(-1)).cast("B")
+            for v in arrays.values()]
+
+
+def bytes_join_ok(views):
+    # b"".join is not BytesIO — the single-copy convenience path is
+    # accounted by metrics, not banned by the analyzer.
+    return b"".join(bytes(v) for v in views)

@@ -30,6 +30,13 @@ Rules:
     what PR 3 removed from `step_n`). Intentional fences — the
     pipeline's consume is one — carry an inline
     `# vet: ignore[hotpath-host-sync]: reason`.
+  * `hotpath-serialize-copy` — `np.savez*` / `io.BytesIO` ANYWHERE in
+    `lws_tpu/serving/` (lexical, no reachability needed): the npz round
+    trip copies every payload at least twice on the KV wire path, which
+    ISSUE 10 replaced with zero-copy raw-buffer framing
+    (`kv_transport.pack_payload`). A serving-side serialization that
+    genuinely needs a buffered copy carries a
+    `# vet: ignore[hotpath-serialize-copy]: reason`.
 """
 
 from __future__ import annotations
@@ -56,6 +63,14 @@ HOST_SYNC_DOTTED = {
     "jax.device_get", "jax.block_until_ready",
 }
 HOST_SYNC_METHODS = {"block_until_ready"}
+# Buffered-serialization shapes banned across lws_tpu/serving/ (lexically —
+# a copy-heavy serializer is a hazard anywhere near the KV wire, reachable
+# from a hot root or not): the npz/BytesIO round trip ISSUE 10 deleted.
+SERIALIZE_COPY_DOTTED = {
+    "np.savez", "np.savez_compressed", "numpy.savez",
+    "numpy.savez_compressed", "io.BytesIO", "BytesIO",
+}
+SERVING_PREFIX = "lws_tpu/serving/"
 
 
 class _FuncInfo:
@@ -222,4 +237,23 @@ def run(modules: list[Module]) -> list[Finding]:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             scan(stmt)
+
+    # Serving-wide serialization-copy sweep: lexical, independent of the
+    # hot-root reachability above — `np.savez`/`BytesIO` in
+    # lws_tpu/serving/ is a finding wherever it hides.
+    for mod in modules:
+        if not mod.rel.startswith(SERVING_PREFIX) or mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            if dotted in SERIALIZE_COPY_DOTTED:
+                findings.append(mod.finding(
+                    "hotpath-serialize-copy", node.lineno,
+                    f"{mod.qualname_at(node.lineno)}:{dotted}",
+                    f"buffered serialization {dotted}() in lws_tpu/serving/ "
+                    "— use kv_transport's zero-copy raw framing "
+                    "(pack_payload/bytes_to_arrays)",
+                ))
     return findings
