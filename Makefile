@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios clean
 
 test:
 	python -m pytest tests/ -q
@@ -27,7 +27,10 @@ bench-spec-decode:  ## device-resident speculative loop must beat the host-loop 
 bench-kv-handoff:  ## streamed KV handoff must beat the monolithic oracle's wall-clock by >=30% at >=4 chunks, byte-identical, zero extra copies (budget json)
 	python benchmarks/kv_handoff_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff  ## what CI would run (vet gates before tests)
+bench-scenarios:  ## committed loadgen scenarios must stay above their attainment/goodput/completion floors (budget json)
+	python benchmarks/scenario_bench.py --check
+
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

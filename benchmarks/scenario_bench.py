@@ -1,0 +1,175 @@
+"""Scenario capacity bench: CPU-sized traffic scenarios as a CI gate.
+
+Runs the committed loadgen scenarios (steady Poisson, burst, shared-prefix
+mix) open-loop against an in-process paged engine — the same harness
+`lws-tpu loadgen` drives — and enforces the floors in
+serving_scenarios_budget.json:
+
+  * min_completed_fraction — the engine kept up with the offered load
+    (open-loop: falling behind leaves requests unfinished at the wall
+    bound, it does not slow the arrivals down);
+  * min_attainment — the fraction of requests meeting their class's SLO
+    targets (CPU-loose targets: the gate catches the engine or the
+    harness collapsing, not a laptop missing production latency);
+  * min_goodput_fraction — tokens delivered within their per-token
+    deadline / tokens delivered (core/slo.py `token_deadline_s`);
+  * min_prefix_hit_rate (shared_prefix only) — the pooled-prefix mix
+    really exercised the prefix cache (block hits / lookups from the
+    process metrics registry).
+
+Determinism is asserted every run: the (spec, seed) schedule must compile
+to the same digest twice — if the traffic itself drifts, every other
+number is noise (tests/test_loadgen.py pins the cross-run half of the
+contract).
+
+Run:    python benchmarks/scenario_bench.py           # report only
+CI:     python benchmarks/scenario_bench.py --check   # enforce budget
+Same shape as decode_overlap/spec_decode/kv_handoff budgets; wired into
+`make check` as bench-scenarios.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import bench  # noqa: E402
+
+bench.force_cpu_if_dev()  # axon plugin overrides JAX_PLATFORMS; see helper
+
+import numpy as np  # noqa: E402
+
+from lws_tpu import loadgen  # noqa: E402
+from lws_tpu.core import metrics  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "serving_scenarios_budget.json")
+MAX_WALL_S = 60.0
+
+
+def warm_target(target: loadgen.EngineTarget, spec: dict) -> None:
+    """Absorb XLA compile time before the measured run: submit one prompt
+    per power-of-two length bucket the scenario can produce and drain, so
+    the open-loop clock measures serving, not first-call compilation."""
+    max_len = int(spec.get("max_len", 64))
+    lens = sorted({
+        min(n, max_len - 2) for n in (5, 9, 17, 33) if n < max_len
+    })
+    rng = np.random.RandomState(0)
+    for plen in lens:
+        prompt = rng.randint(1, int(spec.get("vocab", 256)),
+                             size=plen).astype(np.int32)
+        rid = target.engine.submit(prompt, 2)
+        if rid is None:
+            target.engine.run_until_drained()
+            target.engine.submit(prompt, 2)
+    target.engine.run_until_drained()
+
+
+def run_scenario(name: str, seed: int) -> dict:
+    spec = loadgen.load_scenario(name)
+    schedule = loadgen.build_schedule(spec, seed)
+    redo = loadgen.build_schedule(spec, seed)
+    digest = loadgen.schedule_digest(schedule)
+    if digest != loadgen.schedule_digest(redo):
+        raise AssertionError(
+            f"{name}: schedule not reproducible from seed {seed}"
+        )
+    targets = loadgen.install_class_targets(spec)
+    target = loadgen.build_local_target("paged", spec)
+    warm_target(target, spec)
+    pfx_before = (
+        metrics.REGISTRY.counter_value(
+            "serving_prefix_cache_hits_total", {"engine": "paged"}),
+        metrics.REGISTRY.counter_value(
+            "serving_prefix_cache_misses_total", {"engine": "paged"}),
+    )
+    result = loadgen.run_schedule(schedule, target, max_wall_s=MAX_WALL_S)
+    report = loadgen.summarize(
+        result, targets, float(spec["horizon_s"]), name, seed
+    )
+    hits = metrics.REGISTRY.counter_value(
+        "serving_prefix_cache_hits_total", {"engine": "paged"}) - pfx_before[0]
+    misses = metrics.REGISTRY.counter_value(
+        "serving_prefix_cache_misses_total", {"engine": "paged"}) - pfx_before[1]
+    total = report["all"]
+    return {
+        "scenario": name,
+        "seed": seed,
+        "schedule_digest": digest,
+        "requests": total["count"],
+        "completed_fraction": (
+            total["completed"] / total["count"] if total["count"] else None
+        ),
+        "attainment": total["attainment"],
+        "goodput_fraction": total["goodput_fraction"],
+        "offered_rps": report["offered_rps"],
+        "achieved_rps": report["achieved_rps"],
+        "ttft_p95_s": total["ttft_p95"],
+        "prefix_hit_rate": (
+            hits / (hits + misses) if (hits + misses) > 0 else None
+        ),
+    }
+
+
+def check(results: dict[str, dict], budget: dict) -> list[str]:
+    failures: list[str] = []
+    for name, floors in budget["scenarios"].items():
+        r = results.get(name)
+        if r is None:
+            failures.append(f"{name}: scenario did not run")
+            continue
+        checks = [
+            ("completed_fraction", floors.get("min_completed_fraction")),
+            ("attainment", floors.get("min_attainment")),
+            ("goodput_fraction", floors.get("min_goodput_fraction")),
+            ("prefix_hit_rate", floors.get("min_prefix_hit_rate")),
+        ]
+        for field, floor in checks:
+            if floor is None:
+                continue
+            value = r.get(field)
+            if value is None or value < floor:
+                failures.append(
+                    f"{name}: {field} {value if value is not None else 'n/a'}"
+                    f" below budget floor {floor}"
+                )
+    return failures
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true",
+                        help="enforce serving_scenarios_budget.json")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="override the budget's committed seed")
+    args = parser.parse_args()
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    seed = args.seed if args.seed is not None else int(budget["seed"])
+    results = {}
+    for name in budget["scenarios"]:
+        results[name] = run_scenario(name, seed)
+        print(json.dumps(results[name], indent=1))
+    if not args.check:
+        return 0
+    failures = check(results, budget)
+    if failures:
+        print("SCENARIO BUDGET FAIL:")
+        for f in failures:
+            print(f"  {f}")
+        return 1
+    print(f"scenario budget ok: {len(results)} scenarios within floors "
+          f"(seed {seed})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

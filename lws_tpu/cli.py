@@ -529,13 +529,18 @@ def _histogram_quantile(buckets: list[tuple[float, float]], q: float):
     return buckets[-1][0]
 
 
-def _top_rows(fams: dict) -> dict:
-    """Fold parsed fleet families into {(instance, engine): row}. Pure
-    function of the exposition so tests drive it from canned text."""
+def _top_rows(fams: dict, by_class: bool = False) -> dict:
+    """Fold parsed fleet families into {(instance, engine): row} — or, with
+    `by_class`, {(instance, engine, klass): row}, splitting every
+    class-labelled series into its own row (class-free series keep a `-`
+    class). Pure function of the exposition so tests drive it from canned
+    text."""
     rows: dict = {}
 
     def row(labels):
         key = (labels.get("instance", "-"), labels.get("engine", "-"))
+        if by_class:
+            key += (labels.get("klass", "-") or "-",)
         return rows.setdefault(key, {})
 
     def fold(family, field, reducer=lambda old, v: old + v, start=0.0):
@@ -557,6 +562,11 @@ def _top_rows(fams: dict) -> dict:
     fold("serving_decode_dispatch_duration_seconds", "dispatches")
     fold("serving_prefix_cache_hits_total", "pfx_hits")
     fold("serving_prefix_cache_misses_total", "pfx_misses")
+    # Goodput ledger (core/slo.py): delivered vs delivered-on-time tokens.
+    # Without --by-class the per-class series of one engine sum into its
+    # row, so GOODPUT% is the engine's overall on-time fraction.
+    fold("serving_tokens_total", "tokens")
+    fold("serving_goodput_tokens_total", "good_tokens")
 
     # KV-pool occupancy: the state-labelled block gauge folds into per-row
     # kv_free/kv_live/kv_parked; render_top derives live/(free+live+parked).
@@ -586,6 +596,8 @@ def _top_rows(fams: dict) -> dict:
             le = labels.get("le", "+Inf")
             le_f = float("inf") if le == "+Inf" else float(le)
             key = (labels.get("instance", "-"), labels.get("engine", "-"))
+            if by_class:
+                key += (labels.get("klass", "-") or "-",)
             per_key.setdefault(key, []).append((le_f, value))
         for key, buckets in per_key.items():
             r = rows.setdefault(key, {})
@@ -595,13 +607,16 @@ def _top_rows(fams: dict) -> dict:
 
 def render_top(fams: dict, alerts: dict | None = None,
                prev: dict | None = None, dt_s: float | None = None,
-               rows: dict | None = None) -> str:
+               rows: dict | None = None, by_class: bool = False) -> str:
     """One frame of `lws-tpu top`. `prev`/`dt_s` (a previous _top_rows fold
     and the seconds since it) turn cumulative counters into rates in
     --watch mode; one-shot renders totals. `rows` takes a precomputed
-    _top_rows fold so --watch folds each frame once, not twice."""
+    _top_rows fold so --watch folds each frame once, not twice. With
+    `by_class` (`--by-class`), class-labelled series split into one row
+    per (instance, engine, klass) — `rows`/`prev` must then be by-class
+    folds too."""
     if rows is None:
-        rows = _top_rows(fams)
+        rows = _top_rows(fams, by_class=by_class)
     instances = None
     for name, _labels, value, _ in fams.get("lws_fleet_instances", {}).get("samples", []):
         if name == "lws_fleet_instances":
@@ -614,29 +629,36 @@ def render_top(fams: dict, alerts: dict | None = None,
     for name, details in sorted((alerts or {}).items()):
         for d in details:
             lines.append(f"  ALERT {name}: {json.dumps(d)}")
+    klass_col = f"{'CLASS':<9}" if by_class else ""
     lines.append(
-        f"{'INSTANCE':<18}{'ENGINE':<9}{'SLO':>6}{'REQS':>7}{'ACTIVE':>7}"
-        f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{'SPEC%':>7}{'TTFT_P95':>10}"
+        f"{'INSTANCE':<18}{'ENGINE':<9}{klass_col}{'SLO':>6}{'REQS':>7}{'ACTIVE':>7}"
+        f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{'SPEC%':>7}{'GOOD%':>7}{'TTFT_P95':>10}"
         f"{'ITL_P95':>10}{'DISP/S':>8}{'KV_MB/S':>9}"
     )
 
     def fmt(v, pattern="{:.3f}", dash="-"):
         return pattern.format(v) if v is not None else dash
 
-    for (instance, engine), r in sorted(rows.items()):
+    blank_key = (lambda i: (i, "-", "-")) if by_class else (lambda i: (i, "-"))
+    for key, r in sorted(rows.items()):
+        if by_class:
+            instance, engine, klass = key
+        else:
+            instance, engine = key
+            klass = None
         if engine == "-" and "requests" not in r and "slo" not in r:
             continue  # fleet-plumbing rows without serving data
         rate = None
         if prev is not None and dt_s:
-            before = prev.get((instance, engine), {}).get("dispatches", 0.0)
+            before = prev.get(key, {}).get("dispatches", 0.0)
             rate = max(0.0, r.get("dispatches", 0.0) - before) / dt_s
         # KV handoff wire throughput: the transfer counter is engine-less
         # (it lives in the transport), so it rides the instance's `-` row.
         kv_rate = None
-        kv_now = r.get("kv_bytes", rows.get((instance, "-"), {}).get("kv_bytes"))
+        kv_now = r.get("kv_bytes", rows.get(blank_key(instance), {}).get("kv_bytes"))
         if prev is not None and dt_s and kv_now is not None:
-            kv_prev = prev.get((instance, engine), {}).get(
-                "kv_bytes", prev.get((instance, "-"), {}).get("kv_bytes", 0.0))
+            kv_prev = prev.get(key, {}).get(
+                "kv_bytes", prev.get(blank_key(instance), {}).get("kv_bytes", 0.0))
             kv_rate = max(0.0, kv_now - kv_prev) / dt_s / 1e6
         # KV-pool occupancy (live / pool) and prefix-cache hit rate — the
         # capacity columns: a row pinned near 100% KV with a low hit rate
@@ -655,8 +677,16 @@ def render_top(fams: dict, alerts: dict | None = None,
         spec = None
         if r.get("spec_drafted", 0.0) > 0:
             spec = r.get("spec_accepted", 0.0) / r["spec_drafted"]
+        # Goodput fraction: tokens delivered within their deadline / tokens
+        # delivered (core/slo.py ledger). A row serving fast-but-late work
+        # shows high DISP/S with a sagging GOOD% — throughput that isn't
+        # helping anyone.
+        good = None
+        if r.get("tokens", 0.0) > 0:
+            good = r.get("good_tokens", 0.0) / r["tokens"]
+        klass_cell = f"{klass:<9}" if by_class else ""
         lines.append(
-            f"{instance:<18}{engine:<9}"
+            f"{instance:<18}{engine:<9}{klass_cell}"
             f"{fmt(r.get('slo'), '{:.2f}'):>6}"
             f"{fmt(r.get('requests'), '{:.0f}'):>7}"
             f"{fmt(r.get('active'), '{:.0f}'):>7}"
@@ -664,6 +694,7 @@ def render_top(fams: dict, alerts: dict | None = None,
             f"{fmt(kv, '{:.0%}'):>6}"
             f"{fmt(pfx, '{:.0%}'):>6}"
             f"{fmt(spec, '{:.0%}'):>7}"
+            f"{fmt(good, '{:.0%}'):>7}"
             f"{fmt(r.get('ttft_p95'), '{:.3f}s'):>10}"
             f"{fmt(r.get('itl_p95'), '{:.4f}s'):>10}"
             f"{fmt(rate, '{:.1f}'):>8}"
@@ -714,11 +745,12 @@ def cmd_top(args) -> int:
                 f"error: cannot reach server {args.server}: {e.reason}"
             ) from None
         now = time.monotonic()
-        rows = _top_rows(fams)
+        by_class = getattr(args, "by_class", False)
+        rows = _top_rows(fams, by_class=by_class)
         frame = render_top(
             fams, alerts, prev=prev,
             dt_s=(now - prev_t) if prev_t is not None else None,
-            rows=rows,
+            rows=rows, by_class=by_class,
         )
         if not args.watch:
             print(frame)
@@ -814,6 +846,75 @@ def cmd_profile(args) -> int:
         sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
         sys.stdout.flush()
         time.sleep(args.interval)
+
+
+def _parse_endpoint(value: str) -> tuple[str, int]:
+    host, sep, port = value.rpartition(":")
+    if not sep or not port.isdigit():
+        raise SystemExit(f"error: bad endpoint {value!r}; expected HOST:PORT")
+    return (host or "127.0.0.1", int(port))
+
+
+def cmd_loadgen(args) -> int:
+    """Run a named traffic scenario (lws_tpu/loadgen/) against a target and
+    render the goodput report: seeded open-loop arrivals + workload mix ->
+    per-class TTFT/ITL quantiles, SLO attainment, and the goodput fraction
+    (tokens on time / tokens delivered). Targets: an in-process engine
+    (--target dense|batch|paged, the default) or a LIVE disagg pair over
+    the existing client path (--prefill/--decode KV endpoints). With
+    --server, the report's fleet block folds GOODPUT%/PFX%/SPEC%/KV% out
+    of that API server's /metrics/fleet surface."""
+    from lws_tpu import loadgen
+
+    if args.list:
+        for name in loadgen.scenario_names():
+            print(loadgen.describe_scenario(loadgen.load_scenario(name)))
+        return 0
+    if not args.scenario and not args.spec:
+        print("error: a scenario name (or --spec FILE) is required; "
+              "--list shows the built-ins", file=sys.stderr)
+        return 2
+    spec = loadgen.load_scenario(args.spec or args.scenario)
+    schedule = loadgen.build_schedule(spec, args.seed)
+    targets = loadgen.install_class_targets(spec)
+    digest = loadgen.schedule_digest(schedule)
+    print(f"# {loadgen.describe_scenario(spec, schedule)} "
+          f"(seed {args.seed}, schedule {digest[:12]})")
+    if bool(args.prefill) != bool(args.decode):
+        print("error: --prefill and --decode must be given together",
+              file=sys.stderr)
+        return 2
+    if args.prefill:
+        target = loadgen.DisaggTarget(
+            _parse_endpoint(args.prefill), _parse_endpoint(args.decode)
+        )
+    else:
+        target = loadgen.build_local_target(args.target, spec)
+    result = loadgen.run_schedule(
+        schedule, target, time_scale=args.time_scale, max_wall_s=args.max_wall
+    )
+    report = loadgen.summarize(
+        result, targets, float(spec.get("horizon_s", 1.0)),
+        spec.get("name", args.scenario or "-"), args.seed,
+    )
+    fleet = None
+    if args.server:
+        from lws_tpu.core.metrics import parse_exposition
+
+        url = f"{_server_base(args.server)}/metrics/fleet"
+        req = urllib.request.Request(url, headers=_auth_headers())
+        try:
+            with urllib.request.urlopen(req, timeout=30,
+                                        context=_url_context(url)) as resp:
+                fleet = parse_exposition(resp.read().decode())
+        except (urllib.error.URLError, ValueError) as e:
+            print(f"warning: fleet metrics unavailable from {args.server}: {e}",
+                  file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(loadgen.render_report(report, fleet))
+    return 0
 
 
 def cmd_faults(args) -> int:
@@ -989,6 +1090,10 @@ def main(argv=None) -> int:
     tp.add_argument("--watch", action="store_true",
                     help="redraw every --interval seconds (rates need two frames)")
     tp.add_argument("--interval", type=float, default=2.0)
+    tp.add_argument("--by-class", action="store_true", dest="by_class",
+                    help="split class-labelled series into one row per "
+                         "(instance, engine, class) — SLO/GOOD% per "
+                         "workload class")
     tp.set_defaults(fn=cmd_top)
 
     prf = sub.add_parser("profile", help="continuous-profiling view: per-span "
@@ -1008,6 +1113,38 @@ def main(argv=None) -> int:
                      help="print raw collapsed stacks (flamegraph.pl input) "
                           "instead of tables")
     prf.set_defaults(fn=cmd_profile)
+
+    lg = sub.add_parser("loadgen", help="run a traffic scenario (seeded "
+                        "open-loop arrivals + workload mix) against an "
+                        "in-process engine or a live disagg pair; render "
+                        "the per-class goodput report")
+    lg.add_argument("scenario", nargs="?",
+                    help="built-in scenario name (see --list)")
+    lg.add_argument("--spec", default=None, metavar="FILE",
+                    help="JSON scenario spec file (overrides the name)")
+    lg.add_argument("--seed", type=int, default=1234,
+                    help="schedule seed: same seed -> byte-identical traffic")
+    lg.add_argument("--target", default="paged",
+                    choices=("dense", "batch", "paged"),
+                    help="in-process engine target (default paged)")
+    lg.add_argument("--prefill", default=None, metavar="HOST:PORT",
+                    help="prefill worker KV endpoint (with --decode: drive "
+                         "a live disagg pair instead of an in-process engine)")
+    lg.add_argument("--decode", default=None, metavar="HOST:PORT",
+                    help="decode worker KV endpoint")
+    lg.add_argument("--time-scale", type=float, default=1.0, dest="time_scale",
+                    help="wall seconds per scenario second (2.0 = half speed)")
+    lg.add_argument("--max-wall", type=float, default=120.0, dest="max_wall",
+                    help="abort the drain after this many wall seconds "
+                         "(unfinished requests report as incomplete)")
+    lg.add_argument("--server", default=None,
+                    help="API server to pull /metrics/fleet from for the "
+                         "report's GOODPUT%%/PFX%%/SPEC%%/KV%% fleet block")
+    lg.add_argument("--list", action="store_true",
+                    help="list built-in scenarios and exit")
+    lg.add_argument("--json", action="store_true",
+                    help="emit the raw report dict as JSON")
+    lg.set_defaults(fn=cmd_loadgen)
 
     fp = sub.add_parser("faults", help="chaos controls: list/arm/disarm fault "
                         "schedules on a server's /debug/faults; --drain for "

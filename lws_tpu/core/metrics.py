@@ -151,17 +151,24 @@ class MetricsRegistry:
             if self._admit(name, lk):
                 self._gauges[(name, lk)] = float(value)
 
-    def clear_gauge(self, name: str, labels_subset: dict[str, str]) -> None:
+    def clear_gauge(self, name: str, labels_subset: dict[str, str],
+                    exact: bool = False) -> None:
         """Drop every gauge series of `name` whose labels contain
         `labels_subset`, freeing their cardinality slots. Gauge series keyed
         by a churning label (rollout revisions) must retire when superseded
         — otherwise stale series report forever and eventually exhaust the
-        label-set cap for live ones."""
+        label-set cap for live ones. With `exact`, only the series whose
+        label set EQUALS `labels_subset` retires — the caller that wants to
+        drop `{engine}` without taking every `{engine, klass}` sibling with
+        it (core/slo.py refresh)."""
         wanted = tuple(sorted(labels_subset.items()))
         with self._lock:
             doomed = [
                 key for key in self._gauges
-                if key[0] == name and all(item in key[1] for item in wanted)
+                if key[0] == name and (
+                    key[1] == wanted if exact
+                    else all(item in key[1] for item in wanted)
+                )
             ]
             seen = self._label_sets.get(name)
             for key in doomed:
@@ -473,7 +480,20 @@ describe(
 )
 describe(
     "serving_slo_attainment",
-    "Fraction of the trailing request window meeting every SLO target, per engine",
+    "Fraction of the trailing request window meeting every SLO target, per engine (and per workload class when klass labels ride)",
+)
+describe(
+    "serving_slo_window_age_seconds",
+    "Seconds since the newest entry in the attainment window — discount (or ignore) attainment from a window that stopped filling",
+)
+# --- goodput ledger (core/slo.py; consumed by lws_tpu/loadgen/) ------------
+describe(
+    "serving_tokens_total",
+    "Tokens delivered to requests (first token + decode chunks), per engine and workload class",
+)
+describe(
+    "serving_goodput_tokens_total",
+    "Tokens delivered WITHIN their per-token deadline (ttft target + (i-1) x itl target) — goodput/total is the fraction of throughput that met its SLO",
 )
 # --- stall watchdogs + flight recorder (core/flightrecorder.py) ------------
 describe("lws_watchdog_alerts_total", "Watchdog alert transitions (inactive -> firing)")

@@ -1,0 +1,95 @@
+"""Scenario load generation + goodput measurement (ROADMAP item 4's sensor
+half): seeded open-loop arrival processes, composable workload mixes,
+deterministic scenario schedules, an open-loop driver for in-process
+engines or a live disagg pair, and pure report rendering over the
+class-granular SLO/goodput plane in core/slo.py.
+
+    from lws_tpu import loadgen
+    spec = loadgen.load_scenario("steady_poisson")
+    schedule = loadgen.build_schedule(spec, seed=1234)   # byte-reproducible
+    result = loadgen.run_schedule(schedule, loadgen.EngineTarget(engine, "paged"))
+    report = loadgen.summarize(result, loadgen.class_targets(spec),
+                               spec["horizon_s"], spec["name"], 1234)
+    print(loadgen.render_report(report))
+
+CLI: `lws-tpu loadgen SCENARIO` (docs/tasks/load-testing.md); CI:
+benchmarks/scenario_bench.py + serving_scenarios_budget.json in
+`make check`.
+"""
+
+from lws_tpu.loadgen.arrivals import (
+    BurstProcess,
+    FlashCrowdProcess,
+    GammaProcess,
+    PoissonProcess,
+    TraceReplayProcess,
+    arrival_times,
+    make_process,
+    piecewise_poisson,
+)
+from lws_tpu.loadgen.report import fold_fleet, render_report
+from lws_tpu.loadgen.runner import (
+    DisaggTarget,
+    EngineTarget,
+    RequestOutcome,
+    RunResult,
+    attained,
+    build_local_target,
+    goodput_tokens,
+    run_schedule,
+    summarize,
+)
+from lws_tpu.loadgen.scenario import (
+    SCENARIOS,
+    build_schedule,
+    class_targets,
+    describe_scenario,
+    install_class_targets,
+    load_scenario,
+    offered_load_rps,
+    scenario_names,
+    schedule_digest,
+)
+from lws_tpu.loadgen.workload import (
+    LengthDist,
+    ScheduledRequest,
+    WorkloadClass,
+    build_prompt,
+    pick_class,
+)
+
+__all__ = [
+    "SCENARIOS",
+    "BurstProcess",
+    "DisaggTarget",
+    "EngineTarget",
+    "FlashCrowdProcess",
+    "GammaProcess",
+    "LengthDist",
+    "PoissonProcess",
+    "RequestOutcome",
+    "RunResult",
+    "ScheduledRequest",
+    "TraceReplayProcess",
+    "WorkloadClass",
+    "arrival_times",
+    "attained",
+    "build_local_target",
+    "build_prompt",
+    "build_schedule",
+    "class_targets",
+    "describe_scenario",
+    "fold_fleet",
+    "goodput_tokens",
+    "install_class_targets",
+    "load_scenario",
+    "make_process",
+    "offered_load_rps",
+    "pick_class",
+    "piecewise_poisson",
+    "render_report",
+    "run_schedule",
+    "scenario_names",
+    "schedule_digest",
+    "summarize",
+]

@@ -1,0 +1,102 @@
+"""Scenario report rendering — pure functions of the summary (and an
+optional parsed fleet exposition), so tests drive them from canned data.
+
+The report answers the capacity questions in the order an operator asks
+them: did the service keep up (offered vs achieved load), did it keep its
+promises (per-class attainment + latency quantiles), and did the work it
+did count (goodput fraction — tokens on time / tokens delivered). The
+optional fleet block folds the server-side capacity columns `lws-tpu top`
+shows (PFX% / SPEC% / KV% / GOODPUT%) out of the same /metrics/fleet
+surface, so the client-side and server-side views sit in one frame.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def fold_fleet(fams: dict) -> dict:
+    """Parsed fleet families (core.metrics.parse_exposition shape) ->
+    {pfx, spec, kv, goodput} fractions (None where the feeding series are
+    absent). The same folds `lws-tpu top` derives its columns from."""
+
+    def total(family: str, want: Optional[dict] = None) -> float:
+        acc = 0.0
+        for name, labels, value, _ in fams.get(family, {}).get("samples", []):
+            if name != family:
+                continue
+            if want and any(labels.get(k) != v for k, v in want.items()):
+                continue
+            acc += value
+        return acc
+
+    out: dict = {}
+    hits = total("serving_prefix_cache_hits_total")
+    misses = total("serving_prefix_cache_misses_total")
+    out["pfx"] = hits / (hits + misses) if (hits + misses) > 0 else None
+    drafted = total("serving_spec_tokens_total", {"kind": "drafted"})
+    accepted = total("serving_spec_tokens_total", {"kind": "accepted"})
+    out["spec"] = accepted / drafted if drafted > 0 else None
+    live = total("serving_kv_pool_blocks", {"state": "live"})
+    pool = live + total("serving_kv_pool_blocks", {"state": "free"}) \
+        + total("serving_kv_pool_blocks", {"state": "parked"})
+    out["kv"] = live / pool if pool > 0 else None
+    tokens = total("serving_tokens_total")
+    good = total("serving_goodput_tokens_total")
+    out["goodput"] = good / tokens if tokens > 0 else None
+    return out
+
+
+def _fmt(v, pattern: str = "{:.3f}", dash: str = "-") -> str:
+    return pattern.format(v) if v is not None else dash
+
+
+def render_report(report: dict, fleet: Optional[dict] = None) -> str:
+    """One scenario report frame. `report` is runner.summarize()'s dict;
+    `fleet` an optional parsed /metrics/fleet exposition."""
+    total = report["all"]
+    lines = [
+        f"SCENARIO {report.get('scenario') or '-'}"
+        f"  seed={report.get('seed') if report.get('seed') is not None else '-'}"
+        f"  requests={total['count']}  completed={total['completed']}"
+        f"  wall={_fmt(report.get('wall_s'), '{:.2f}s')}",
+        f"load: offered={_fmt(report.get('offered_rps'), '{:.1f}')} rps"
+        f"  achieved={_fmt(report.get('achieved_rps'), '{:.1f}')} rps"
+        f"  (horizon {_fmt(report.get('horizon_s'), '{:.2f}s')})",
+        "",
+        f"{'CLASS':<12}{'REQS':>6}{'DONE':>6}{'ATTAIN':>8}{'GOODPUT':>9}"
+        f"{'TOKENS':>8}{'TTFT_P50':>10}{'TTFT_P95':>10}{'TTFT_P99':>10}"
+        f"{'ITL_P50':>9}{'ITL_P95':>9}{'ITL_P99':>9}{'QUEUE_P95':>10}",
+    ]
+
+    def row(name: str, s: dict) -> str:
+        return (
+            f"{name:<12}{s['count']:>6}{s['completed']:>6}"
+            f"{_fmt(s.get('attainment'), '{:.0%}'):>8}"
+            f"{_fmt(s.get('goodput_fraction'), '{:.0%}'):>9}"
+            f"{s.get('tokens', 0):>8}"
+            f"{_fmt(s.get('ttft_p50'), '{:.3f}s'):>10}"
+            f"{_fmt(s.get('ttft_p95'), '{:.3f}s'):>10}"
+            f"{_fmt(s.get('ttft_p99'), '{:.3f}s'):>10}"
+            f"{_fmt(s.get('itl_p50'), '{:.4f}s'):>9}"
+            f"{_fmt(s.get('itl_p95'), '{:.4f}s'):>9}"
+            f"{_fmt(s.get('itl_p99'), '{:.4f}s'):>9}"
+            f"{_fmt(s.get('queue_p95'), '{:.3f}s'):>10}"
+        )
+
+    for name, stats in report["classes"].items():
+        lines.append(row(name, stats))
+    all_stats = dict(total)
+    all_stats.setdefault("queue_p95", None)
+    lines.append(row("ALL", all_stats))
+    if fleet is not None:
+        f = fold_fleet(fleet)
+        lines.append("")
+        lines.append(
+            "fleet: "
+            f"GOODPUT%={_fmt(f.get('goodput'), '{:.0%}')}"
+            f"  PFX%={_fmt(f.get('pfx'), '{:.0%}')}"
+            f"  SPEC%={_fmt(f.get('spec'), '{:.0%}')}"
+            f"  KV%={_fmt(f.get('kv'), '{:.0%}')}"
+        )
+    return "\n".join(lines)

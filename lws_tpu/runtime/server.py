@@ -210,10 +210,14 @@ class ApiServer:
                     # inspectable from one scrape).
                     from lws_tpu.core import metrics as metricsmod
                     from lws_tpu.core import profile as profmod
+                    from lws_tpu.core import slo as slomod
 
                     # Device-memory gauges refresh per scrape (CPU-safe
-                    # no-op without allocator stats).
+                    # no-op without allocator stats); SLO attainment
+                    # windows age-evict the same way (stale-attainment
+                    # guard, core/slo.py).
                     profmod.record_device_memory()
+                    slomod.RECORDER.refresh()
                     regs = (cp.metrics,) if cp.metrics is metricsmod.REGISTRY \
                         else (cp.metrics, metricsmod.REGISTRY)
                     self._send_exposition(metricsmod.render_exposition(*regs))

@@ -79,6 +79,7 @@ class TelemetryServer:
         from lws_tpu.core import metrics as metricsmod
         from lws_tpu.core import profile as profmod
         from lws_tpu.core import resilience as resmod
+        from lws_tpu.core import slo as slomod
         from lws_tpu.core import trace as tracemod
 
         self.watchdog = watchdog
@@ -117,8 +118,11 @@ class TelemetryServer:
                     return
                 if path == "/metrics":
                     # Device-memory gauges are state, not a feed: refresh
-                    # them per scrape (guarded no-op on CPU backends).
+                    # them per scrape (guarded no-op on CPU backends). The
+                    # SLO attainment windows age-evict the same way — a
+                    # quiet engine must not advertise stale attainment.
                     profmod.record_device_memory()
+                    slomod.RECORDER.refresh()
                     body, ctype = metricsmod.negotiate_exposition(
                         metricsmod.REGISTRY.render(), self.headers.get("Accept")
                     )

@@ -118,8 +118,14 @@ class BatchEngine:
         self._step_fn = _step
 
     # ------------------------------------------------------------------
-    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> Optional[int]:
-        """Admit a request into a free slot; returns request id (None = full)."""
+    def submit(self, prompt: np.ndarray, max_new_tokens: int,
+               klass: str = "",
+               arrival_t: Optional[float] = None) -> Optional[int]:
+        """Admit a request into a free slot; returns request id (None =
+        full). `klass` labels the request's SLO/goodput series by workload
+        class; `arrival_t` (a time.perf_counter() stamp) backdates the SLO
+        arrival clock — the loadgen harness passes the scheduled open-loop
+        arrival so admission delay shows up as queue wait."""
         if not self._free and self._pipeline:
             # A completion may be sitting unconsumed in the in-flight ring.
             self._pipeline.flush()
@@ -129,7 +135,7 @@ class BatchEngine:
             raise ValueError("prompt + max_new_tokens exceeds max_len")
         slot = self._free.pop(0)
         req = Request(next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot,
-                      slo=slo.request("batch"))
+                      slo=slo.request("batch", arrival_t, klass=klass))
 
         plen = len(prompt)
         t0 = time.perf_counter()
@@ -158,9 +164,11 @@ class BatchEngine:
         )
         req.tokens.append(int(first[0]))
         # Queue wait (arrival -> slot) and TTFT (arrival -> prefill token):
-        # for this engine both end here — the prompt queued only in the
-        # sense that submit() was the admission.
-        req.slo.queue_wait(0.0)
+        # for this engine both end at submit() — with a backdated arrival
+        # (open-loop loadgen), the wait is the real arrival -> submit gap.
+        req.slo.queue_wait(
+            0.0 if arrival_t is None else max(0.0, t0 - arrival_t)
+        )
         req.slo.first_token()
         if req.done:
             # max_new_tokens == 1: the prefill token alone finishes it.

@@ -66,6 +66,7 @@ def build_engine(batch: int, max_len: int):
 
 def _decode_bundle(
     engine, payload, steps: int, gamma: int = 0, ngram: int = 3,
+    klass: str = "",
 ) -> tuple[np.ndarray, dict, list]:  # hot-path
     """Bundle (monolithic payload bytes, or a finished streamed
     `CacheAssembler`) -> ([B, steps+1] tokens, per-handoff stats, span
@@ -147,8 +148,9 @@ def _decode_bundle(
             pipe.flush()  # blocks: decode_s is the real dispatch time
     toks = out["toks"]
     # SLO timeline, decode leg: the chunk's mean step gap is the ITL sample
-    # (same per-dispatch discipline as the engines' commit paths).
-    timeline = slo.request("disagg")
+    # (same per-dispatch discipline as the engines' commit paths). The
+    # workload class rode the bundle meta from the submitting client.
+    timeline = slo.request("disagg", klass=klass)
     timeline.tokens(steps, s_decode.duration_s)
     timeline.finish()
     stats = {
@@ -241,13 +243,16 @@ def _prefill_streamed(
         "serve.request", parent=meta.get("trace"),
         role="prefill", request_id=req_id,
     )
+    klass = str(meta.get("klass") or "")
     bundle_meta = {"id": req_id, "trace": s_req.context}
+    if klass:
+        bundle_meta["klass"] = klass  # rides to the decode leg's timeline
     if deadline is not None:
         bundle_meta["deadline_s"] = deadline.to_wire()
     server.offer_stream(bundle_meta, stream)
     try:
         with s_req:
-            timeline = slo.request("disagg")
+            timeline = slo.request("disagg", klass=klass)
             wait = float(meta.get("queue_wait_s", 0.0))
             timeline.queue_wait(wait)
             # kv.gather parents serve.prefill here: the two phases overlap
@@ -365,7 +370,7 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
             # SLO timeline, prefill leg: the KVServer stamped the prompt at
             # enqueue, so queue wait is the REAL socket-to-worker wait; TTFT
             # covers queue + prefill (the token exists after this dispatch).
-            timeline = slo.request("disagg")
+            timeline = slo.request("disagg", klass=str(meta.get("klass") or ""))
             wait = float(meta.get("queue_wait_s", 0.0))
             timeline.queue_wait(wait)
             with trace.span("serve.prefill", chunked=False,
@@ -402,6 +407,8 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
             "id": req_id, "handoff": handoff, "trace": s_req.context,
             "spans": [s.to_dict() for s in (s_req, s_prefill, s_gather)],
         }
+        if meta.get("klass"):
+            bundle_meta["klass"] = str(meta["klass"])  # decode leg's series
         if deadline is not None:
             bundle_meta["deadline_s"] = deadline.to_wire()
         server.offer_bundle(bundle_meta, bundle)
@@ -487,7 +494,8 @@ def run_decode_tcp(
         try:
             with s_req:
                 full, dstats, dspans = _decode_bundle(
-                    engine, payload, steps, gamma=gamma, ngram=ngram
+                    engine, payload, steps, gamma=gamma, ngram=ngram,
+                    klass=str(meta.get("klass") or ""),
                 )
         except Exception as e:  # noqa: BLE001
             # Poison-message guard: a bundle this engine can't process (e.g.

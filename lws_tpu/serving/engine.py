@@ -703,7 +703,7 @@ class Engine:
 
     def generate_speculative(  # hot-path
         self, prompt: jax.Array, max_new_tokens: int,
-        gamma: int = 8, ngram: int = 3,
+        gamma: int = 8, ngram: int = 3, klass: str = "",
     ) -> GenerationResult:
         """Greedy generation with n-gram speculative decoding: each dispatch
         verifies `gamma` drafted tokens plus the running token in ONE
@@ -741,7 +741,7 @@ class Engine:
             "serve.request", engine="dense", speculative=True,
             prompt_len=int(prompt.shape[1]), max_new_tokens=max_new_tokens,
         ) as request_span, _occupancy_gauge("dense"):
-            timeline = slo.request("dense")
+            timeline = slo.request("dense", klass=klass)
             t0 = time.perf_counter()
             with trace.span("serve.prefill", chunked=False,
                             prompt_len=int(prompt.shape[1])):
@@ -818,7 +818,8 @@ class Engine:
         self._warm_decode(chunked=False, single=True)
         warmed.add((gamma, ngram))
 
-    def generate(self, prompt: jax.Array, max_new_tokens: int) -> GenerationResult:  # hot-path
+    def generate(self, prompt: jax.Array, max_new_tokens: int,
+                 klass: str = "") -> GenerationResult:  # hot-path
         """Generation under the engine's SamplingParams (greedy by default),
         with timing split (TTFT vs steady decode).
 
@@ -839,7 +840,7 @@ class Engine:
             max_new_tokens=max_new_tokens,
         )
         with request_span, _occupancy_gauge("dense"):
-            timeline = slo.request("dense")
+            timeline = slo.request("dense", klass=klass)
             t0 = time.perf_counter()
             with trace.span("serve.prefill", chunked=False,
                             prompt_len=int(prompt.shape[1])):

@@ -971,12 +971,16 @@ def _deadline_meta(meta: dict) -> dict:
 
 
 def submit_prompt(endpoint, req_id: str, prompt_bytes: bytes,
-                  trace_ctx: Optional[dict] = None) -> None:
+                  trace_ctx: Optional[dict] = None,
+                  klass: Optional[str] = None) -> None:
     """`trace_ctx` (default: the caller's current span context) rides the
     frame meta so the prefill worker's span subtree grafts onto the
     caller's trace — the cross-process leg of the trace spine. The bound
     `resilience.Deadline` (if any) rides the same way: the prefill worker
-    drops expired prompts instead of burning prefill on them."""
+    drops expired prompts instead of burning prefill on them. `klass`
+    labels the request's workload/QoS class; it rides the meta to the
+    prefill leg and onward with the bundle to decode, so BOTH workers'
+    SLO/goodput series carry the class label (core/slo.py)."""
     if trace_ctx is None:
         from lws_tpu.core import trace
 
@@ -984,6 +988,8 @@ def submit_prompt(endpoint, req_id: str, prompt_bytes: bytes,
     meta = _deadline_meta({"op": "submit_prompt", "id": req_id})
     if trace_ctx:
         meta["trace"] = trace_ctx
+    if klass:
+        meta["klass"] = klass
     meta, _ = _one_shot(endpoint, meta, prompt_bytes)
     if not (meta or {}).get("ok"):
         raise RuntimeError(f"submit_prompt failed: {meta}")

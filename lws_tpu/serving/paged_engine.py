@@ -818,6 +818,8 @@ class PagedBatchEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         seed: Optional[int] = None,
+        klass: str = "",
+        arrival_t: Optional[float] = None,
     ) -> Optional[int]:
         """Admit a request; returns request id, or None when out of slots OR
         out of pool blocks (the density backpressure signal). Sampling is
@@ -827,9 +829,13 @@ class PagedBatchEngine:
         in one batch without perturbing each other. With prefix_cache=True,
         block-aligned prompt prefixes already resident in the pool are
         REUSED: only the suffix is prefilled (vLLM automatic-prefix-caching
-        shape; exactness-tested against the uncached engine)."""
+        shape; exactness-tested against the uncached engine). `klass`
+        labels the request's SLO/goodput series by workload class;
+        `arrival_t` (a time.perf_counter() stamp) backdates the SLO arrival
+        clock so open-loop admission delay shows up as queue wait."""
         t0 = time.perf_counter()
-        timeline = slo.request("paged")  # arrival clock starts at submit()
+        # Arrival clock starts at submit() unless the caller backdates it.
+        timeline = slo.request("paged", arrival_t, klass=klass)
         with trace.span(
             "serve.admission", engine="paged", prompt_len=len(prompt)
         ) as sp:

@@ -80,7 +80,8 @@ def role_spec(role: str, kv_port: int, api_url: str, extra_env: list | None = No
 
 def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
                     backend_env: dict | None = None,
-                    expect_streamed: bool = False):
+                    expect_streamed: bool = False,
+                    run_scenario: bool = False):
     from lws_tpu.core import trace as _trace
 
     _trace.TRACER.enabled = True
@@ -256,6 +257,52 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
             debug_spans = _json.loads(resp.read().decode())
         assert debug_spans and any(s["name"] == "reconcile" for s in debug_spans)
 
+        # Loadgen scenario over the LIVE pair (ISSUE 11): a seeded
+        # open-loop schedule with two workload classes drives the same
+        # client path — the class labels ride the frame meta to BOTH
+        # workers' SLO/goodput series, and the fixed seed reproduces the
+        # request schedule end to end.
+        if run_scenario:
+            from lws_tpu import loadgen
+
+            scen_spec = {
+                "name": "e2e_mix", "horizon_s": 0.4, "max_len": 16,
+                "vocab": 64,
+                "arrivals": {"process": "poisson", "rate_rps": 10.0},
+                "classes": [
+                    {"name": "premium", "weight": 0.5,
+                     "prompt_len": {"kind": "uniform", "lo": 4, "hi": 6},
+                     "output_len": 2,
+                     "targets": {"ttft_s": 30.0, "itl_s": 30.0,
+                                 "queue_wait_s": 30.0}},
+                    {"name": "chat", "weight": 0.5,
+                     "prompt_len": {"kind": "uniform", "lo": 4, "hi": 6},
+                     "output_len": 2,
+                     "targets": {"ttft_s": 30.0, "itl_s": 30.0,
+                                 "queue_wait_s": 30.0}},
+                ],
+            }
+            schedule = loadgen.build_schedule(scen_spec, seed=5)
+            # Acceptance: a fixed seed reproduces an identical schedule.
+            assert loadgen.schedule_digest(schedule) == \
+                loadgen.schedule_digest(loadgen.build_schedule(scen_spec, seed=5))
+            assert {r.klass for r in schedule} == {"premium", "chat"}
+            scen_result = loadgen.run_schedule(
+                schedule,
+                loadgen.DisaggTarget(endpoints["prefill"], endpoints["decode"]),
+                max_wall_s=90.0,
+            )
+            scen_report = loadgen.summarize(
+                scen_result, loadgen.class_targets(scen_spec),
+                scen_spec["horizon_s"], "e2e_mix", 5,
+            )
+            assert scen_report["all"]["completed"] == len(schedule), scen_report
+            # The decode worker's --steps decides tokens per request.
+            assert scen_report["all"]["tokens"] == \
+                len(schedule) * (DECODE_STEPS + 1), scen_report
+            frame = loadgen.render_report(scen_report)
+            assert "premium" in frame and "chat" in frame, frame
+
         # Fleet telemetry plane (ISSUE 4): the control plane scrapes BOTH
         # worker processes' /metrics (addresses from pod records, ports from
         # the pod-declared LWS_TPU_METRICS_PORT) and serves ONE merged
@@ -300,6 +347,28 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
             and name.endswith("_count") and value > 0
             for name, labels, value in fleet["serving_itl_seconds"]["samples"]
         ), fleet["serving_itl_seconds"]["samples"]
+        if run_scenario:
+            # ISSUE 11 acceptance: the goodput ledger and class-granular
+            # attainment ride the MERGED fleet exposition during a live
+            # disagg scenario run — both workload classes, both workers.
+            goodput = fleet.get("serving_goodput_tokens_total", {})
+            klasses = {
+                labels.get("klass")
+                for _, labels, value in goodput.get("samples", [])
+                if labels.get("engine") == "disagg" and value > 0
+            }
+            assert {"premium", "chat"} <= klasses, goodput.get("samples")
+            assert any(
+                labels.get("engine") == "disagg" and labels.get("klass")
+                for _, labels, _ in
+                fleet.get("serving_slo_attainment", {}).get("samples", [])
+            ), fleet.get("serving_slo_attainment", {}).get("samples")
+            assert any(
+                labels.get("engine") == "disagg"
+                and labels.get("klass") in ("premium", "chat") and value > 0
+                for _, labels, value in
+                fleet.get("serving_tokens_total", {}).get("samples", [])
+            ), fleet.get("serving_tokens_total", {}).get("samples")
         # Exemplars survive scrape + merge: a breach bucket links to a trace.
         assert 'trace_id="' in fleet_text
         # The control plane's own registries merged in under their instance.
@@ -362,6 +431,10 @@ def test_disaggregated_prefill_decode_over_tcp_streamed(tmp_path):
         tmp_path,
         extra_env=[EnvVar("LWS_TPU_KV_CHUNK", "2")],
         expect_streamed=True,
+        # ISSUE 11: a seeded two-class loadgen scenario runs over the live
+        # pair mid-test; goodput + class-granular attainment must ride the
+        # merged fleet exposition.
+        run_scenario=True,
     )
 
 

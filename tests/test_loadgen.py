@@ -1,0 +1,572 @@
+"""Scenario load-generation harness + per-class goodput plane (ISSUE 11):
+arrival-process determinism (same seed -> byte-identical schedules, across
+processes too), workload-mix composition, the goodput ledger and age-bound
+attainment windows in core/slo.py, the open-loop runner against a real
+paged engine, the pure report renderer, and the `lws-tpu top`
+GOODPUT%/--by-class columns."""
+
+import json
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu import loadgen
+from lws_tpu.core import metrics
+from lws_tpu.core.metrics import MetricsRegistry
+from lws_tpu.core.slo import (
+    SLORecorder,
+    SLOTargets,
+    class_targets_from_env,
+    token_deadline_s,
+)
+from lws_tpu.loadgen.arrivals import (
+    BurstProcess,
+    FlashCrowdProcess,
+    GammaProcess,
+    PoissonProcess,
+    TraceReplayProcess,
+)
+
+
+# ---------------------------------------------------------------------------
+# Arrival processes: determinism + shape
+
+
+def _times(process, horizon, seed):
+    return process.times(horizon, random.Random(seed))
+
+
+@pytest.mark.parametrize("process", [
+    PoissonProcess(20.0),
+    GammaProcess(20.0, shape=3),
+    BurstProcess(4.0, 40.0, period_s=0.5, duty=0.3),
+    FlashCrowdProcess(4.0, 40.0, spike_at_s=0.5, spike_len_s=0.3),
+    TraceReplayProcess([{"t_s": 0.0, "rate_rps": 5.0},
+                        {"t_s": 0.5, "rate_rps": 30.0},
+                        {"t_s": 1.0, "rate_rps": 5.0}]),
+], ids=["poisson", "gamma", "burst", "flash", "trace"])
+def test_arrivals_deterministic_and_seed_sensitive(process):
+    a = _times(process, 2.0, seed=7)
+    b = _times(process, 2.0, seed=7)
+    c = _times(process, 2.0, seed=8)
+    assert a == b  # byte-identical replay, not approximately equal
+    assert a != c
+    assert a == sorted(a)
+    assert all(0.0 <= t < 2.0 for t in a)
+
+
+def test_flash_crowd_spikes_where_told():
+    """The step really is a step: arrival density inside the spike window
+    dwarfs the base windows (40 rps vs 4 rps over a 2s horizon)."""
+    times = _times(FlashCrowdProcess(4.0, 40.0, 0.5, 0.5), 2.0, seed=3)
+    in_spike = sum(0.5 <= t < 1.0 for t in times)
+    outside = len(times) - in_spike
+    assert in_spike > outside  # 20 expected in-spike vs ~6 outside
+
+
+def test_trace_replay_holds_segment_rates():
+    trace = [{"t_s": 0.0, "rate_rps": 2.0}, {"t_s": 1.0, "rate_rps": 50.0}]
+    times = _times(TraceReplayProcess(trace), 2.0, seed=11)
+    assert sum(t >= 1.0 for t in times) > 5 * max(1, sum(t < 1.0 for t in times))
+
+
+def test_unknown_process_rejected():
+    with pytest.raises(ValueError, match="unknown arrival process"):
+        loadgen.make_process({"process": "lunar"})
+
+
+# ---------------------------------------------------------------------------
+# Schedules: byte-reproducible, including across processes
+
+
+def test_schedule_reproducible_and_divergent():
+    spec = loadgen.load_scenario("steady_poisson")
+    a = loadgen.build_schedule(spec, seed=42)
+    b = loadgen.build_schedule(spec, seed=42)
+    c = loadgen.build_schedule(spec, seed=43)
+    assert loadgen.schedule_digest(a) == loadgen.schedule_digest(b)
+    assert loadgen.schedule_digest(a) != loadgen.schedule_digest(c)
+    # The digest covers the real content: every field byte-identical.
+    for ra, rb in zip(a, b):
+        assert ra.arrival_s == rb.arrival_s
+        assert ra.klass == rb.klass
+        assert ra.max_new_tokens == rb.max_new_tokens
+        np.testing.assert_array_equal(ra.prompt, rb.prompt)
+
+
+@pytest.mark.parametrize("name", ["burst", "flash_crowd", "diurnal",
+                                  "shared_prefix"])
+def test_every_builtin_scenario_compiles_reproducibly(name):
+    spec = loadgen.load_scenario(name)
+    a = loadgen.build_schedule(spec, seed=5)
+    assert loadgen.schedule_digest(a) == \
+        loadgen.schedule_digest(loadgen.build_schedule(spec, seed=5))
+    assert len(a) > 0
+    max_len = int(spec["max_len"])
+    for r in a:
+        assert len(r.prompt) + r.max_new_tokens <= max_len
+
+
+def test_schedule_digest_stable_across_processes():
+    """The committed-budget property: a FRESH interpreter compiles the same
+    (spec, seed) to the same digest — no dict-order, hash-seed, or
+    module-state dependence."""
+    spec = loadgen.load_scenario("steady_poisson")
+    local = loadgen.schedule_digest(loadgen.build_schedule(spec, seed=1234))
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from lws_tpu.loadgen import scenario as s;"
+         "print(s.schedule_digest(s.build_schedule("
+         "s.load_scenario('steady_poisson'), 1234)))"],
+        capture_output=True, text=True, check=True, cwd="/root/repo",
+    )
+    assert out.stdout.strip() == local
+
+
+def test_shared_prefix_requests_share_real_prefixes():
+    spec = loadgen.load_scenario("shared_prefix")
+    schedule = loadgen.build_schedule(spec, seed=9)
+    shared = [r for r in schedule if r.shared_prefix]
+    assert shared, "0.75 ratio produced no shared-prefix requests"
+    prefix_len = int(spec["prefix_len"])
+    heads = {tuple(r.prompt[:prefix_len].tolist()) for r in shared}
+    # Drawn from a pool of 2 — at most 2 distinct heads, shared across many.
+    assert len(heads) <= int(spec["prefix_pool"])
+    fresh = [r for r in schedule if not r.shared_prefix]
+    for r in fresh:
+        assert tuple(r.prompt[:prefix_len].tolist()) not in heads or \
+            len(r.prompt) < prefix_len
+
+
+def test_class_mix_and_targets_parse():
+    spec = loadgen.load_scenario("steady_poisson")
+    schedule = loadgen.build_schedule(spec, seed=2)
+    assert {r.klass for r in schedule} <= {"chat", "batch"}
+    targets = loadgen.class_targets(spec)
+    assert targets["batch"].ttft_s == 10.0
+    assert targets["chat"].ttft_s == 5.0
+    with pytest.raises(ValueError, match="unknown SLO target"):
+        SLOTargets().overridden({"ttft": 1.0})  # typo must not pass silently
+
+
+def test_class_targets_from_env(monkeypatch):
+    monkeypatch.setenv("LWS_TPU_SLO_CLASS_TARGETS",
+                       '{"premium": {"ttft_s": 0.25}}')
+    targets = class_targets_from_env(SLOTargets())
+    assert targets["premium"].ttft_s == 0.25
+    assert targets["premium"].itl_s == SLOTargets().itl_s  # base preserved
+    monkeypatch.setenv("LWS_TPU_SLO_CLASS_TARGETS", "[1,2]")
+    with pytest.raises(ValueError, match="LWS_TPU_SLO_CLASS_TARGETS"):
+        class_targets_from_env(SLOTargets())
+
+
+# ---------------------------------------------------------------------------
+# Goodput ledger + class-granular SLO accounting (core/slo.py)
+
+
+def test_token_deadline_rule():
+    t = SLOTargets(ttft_s=1.0, itl_s=0.1, queue_wait_s=1.0)
+    assert token_deadline_s(t, 1) == 1.0
+    assert token_deadline_s(t, 5) == pytest.approx(1.4)
+
+
+def test_timeline_goodput_counts_on_time_tokens_only():
+    reg = MetricsRegistry()
+    rec = SLORecorder(SLOTargets(ttft_s=1.0, itl_s=0.1, queue_wait_s=1.0),
+                      registry=reg, window=8)
+    tl = rec.request("paged", klass="gold")
+    tl.first_token(0.5)   # on time (<= 1.0)
+    tl.tokens(4, 0.2)     # cursor 0.7 <= deadline(5)=1.4 -> good
+    tl.tokens(4, 5.0)     # cursor 5.7 >  deadline(9)=1.8 -> late
+    tl.finish()
+    labels = {"engine": "paged", "klass": "gold"}
+    assert reg.counter_value("serving_tokens_total", labels) == 9.0
+    assert reg.counter_value("serving_goodput_tokens_total", labels) == 5.0
+    # Fast-but-late also failed the worst-ITL check -> attainment 0.
+    assert reg.gauge_value("serving_slo_attainment", labels) == 0.0
+    assert rec.attainment("paged", klass="gold") == 0.0
+    # Class-free series untouched: the klass label split, not polluted.
+    assert rec.attainment("paged") is None
+
+
+def test_late_first_token_is_not_goodput():
+    reg = MetricsRegistry()
+    rec = SLORecorder(SLOTargets(ttft_s=0.1, itl_s=1.0, queue_wait_s=1.0),
+                      registry=reg, window=8)
+    tl = rec.request("dense")
+    tl.first_token(0.5)  # late
+    tl.finish()
+    assert reg.counter_value("serving_tokens_total", {"engine": "dense"}) == 1.0
+    assert reg.counter_value(
+        "serving_goodput_tokens_total", {"engine": "dense"}) == 0.0
+
+
+def test_per_class_targets_grade_each_class_separately():
+    reg = MetricsRegistry()
+    rec = SLORecorder(
+        SLOTargets(ttft_s=0.1, itl_s=0.1, queue_wait_s=0.1), registry=reg,
+        window=8,
+        class_targets={"relaxed": SLOTargets(ttft_s=10.0, itl_s=10.0,
+                                             queue_wait_s=10.0)},
+    )
+    for klass in ("relaxed", "strict"):
+        tl = rec.request("paged", klass=klass)
+        tl.first_token(0.5)
+        tl.finish()
+    assert rec.attainment("paged", klass="relaxed") == 1.0
+    assert rec.attainment("paged", klass="strict") == 0.0  # default targets
+    assert reg.gauge_value("serving_slo_attainment",
+                           {"engine": "paged", "klass": "relaxed"}) == 1.0
+
+
+def test_attainment_window_ages_out_and_series_retire():
+    """The staleness satellite: a quiet engine stops advertising attainment
+    — reads evict aged entries, and refresh() retires the gauge series so
+    `lws-tpu top` (and the future autoscaler) can't act on fiction."""
+    reg = MetricsRegistry()
+    rec = SLORecorder(registry=reg, window=8, max_age_s=0.05)
+    tl = rec.request("paged")
+    tl.first_token(0.01)
+    tl.finish()
+    assert rec.attainment("paged") == 1.0
+    assert reg.gauge_value("serving_slo_attainment", {"engine": "paged"}) == 1.0
+    assert reg.gauge_value(
+        "serving_slo_window_age_seconds", {"engine": "paged"}) == 0.0
+    time.sleep(0.12)  # 2x the age bound
+    assert rec.attainment("paged") is None
+    rec.refresh()
+    assert reg.gauge_value("serving_slo_attainment", {"engine": "paged"}) is None
+    assert reg.gauge_value(
+        "serving_slo_window_age_seconds", {"engine": "paged"}) is None
+    assert "serving_slo_attainment" not in reg.render()
+
+
+def test_refresh_retiring_classfree_window_spares_class_series():
+    """Regression: clear_gauge matches by label SUBSET, so retiring the
+    emptied class-free {engine} window must use an exact match — or it
+    would wipe every live {engine, klass} sibling it just re-published."""
+    reg = MetricsRegistry()
+    rec = SLORecorder(registry=reg, window=8, max_age_s=0.05,
+                      class_targets={"premium": SLOTargets(10.0, 10.0, 10.0)})
+    tl = rec.request("paged")  # class-free traffic that will go quiet
+    tl.first_token(0.01)
+    tl.finish()
+    time.sleep(0.12)  # past the age bound
+    tl2 = rec.request("paged", klass="premium")  # live classed traffic
+    tl2.first_token(0.01)
+    tl2.finish()
+    rec.refresh()
+    assert reg.gauge_value("serving_slo_attainment", {"engine": "paged"}) is None
+    assert reg.gauge_value(
+        "serving_slo_attainment", {"engine": "paged", "klass": "premium"}
+    ) == 1.0
+
+
+def test_refresh_reports_window_age_for_live_series():
+    reg = MetricsRegistry()
+    rec = SLORecorder(registry=reg, window=8, max_age_s=60.0)
+    tl = rec.request("paged")
+    tl.first_token(0.01)
+    tl.finish()
+    time.sleep(0.05)
+    rec.refresh()
+    age = reg.gauge_value("serving_slo_window_age_seconds", {"engine": "paged"})
+    assert age is not None and age >= 0.05
+    assert reg.gauge_value("serving_slo_attainment", {"engine": "paged"}) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Client-side goodput grading (runner)
+
+
+def test_client_goodput_split():
+    t = SLOTargets(ttft_s=1.0, itl_s=0.1, queue_wait_s=1.0)
+    # All on time: 5 tokens, uniform delivery well inside the deadlines.
+    assert loadgen.goodput_tokens(t, 0.5, 5, 0.8) == 5
+    # First token late: everything after inherits lateness too.
+    assert loadgen.goodput_tokens(t, 2.0, 3, 2.1) == 0
+    # Partial: on-time head, late tail.
+    good = loadgen.goodput_tokens(t, 0.5, 10, 9.0)
+    assert 0 < good < 10
+
+
+# ---------------------------------------------------------------------------
+# Open-loop runner against a real paged engine
+
+
+@pytest.fixture(scope="module")
+def small_engine():
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return PagedBatchEngine(cfg, params, slots=4, max_len=64, block_size=8,
+                            prefix_cache=True)
+
+
+def test_open_loop_run_completes_and_ledgers_agree(small_engine):
+    spec = loadgen.load_scenario("shared_prefix")
+    schedule = loadgen.build_schedule(spec, seed=21)
+    targets = loadgen.class_targets(spec)
+    before_tokens = metrics.REGISTRY.counter_value(
+        "serving_tokens_total", {"engine": "paged", "klass": "assist"})
+    before_hits = metrics.REGISTRY.counter_value(
+        "serving_prefix_cache_hits_total", {"engine": "paged"})
+    result = loadgen.run_schedule(
+        schedule, loadgen.EngineTarget(small_engine, "paged"), max_wall_s=90.0
+    )
+    report = loadgen.summarize(result, targets, spec["horizon_s"],
+                               "shared_prefix", 21)
+    assert report["all"]["count"] == len(schedule)
+    assert report["all"]["completed"] == len(schedule)
+    assert report["all"]["tokens"] == sum(r.max_new_tokens for r in schedule)
+    assert report["classes"]["assist"]["ttft_p95"] is not None
+    # Server-side ledger moved, class-labelled, by the same token count.
+    after_tokens = metrics.REGISTRY.counter_value(
+        "serving_tokens_total", {"engine": "paged", "klass": "assist"})
+    assert after_tokens - before_tokens == report["all"]["tokens"]
+    # The pooled prefixes really exercised the prefix cache.
+    assert metrics.REGISTRY.counter_value(
+        "serving_prefix_cache_hits_total", {"engine": "paged"}) > before_hits
+    # Open-loop accounting: offered load derives from the schedule, not
+    # from how fast the engine happened to drain it.
+    assert report["offered_rps"] == pytest.approx(
+        len(schedule) / spec["horizon_s"])
+
+
+def test_overloaded_run_reports_incompletes():
+    """A target that refuses everything must show up as incomplete requests
+    and zero attainment — never hang the driver."""
+
+    class DeafTarget:
+        def submit(self, req, arrival_wall_t):
+            return None
+
+        def step(self):
+            time.sleep(0.001)
+
+        def poll(self, handle):
+            return None
+
+    spec = loadgen.load_scenario("burst")
+    schedule = loadgen.build_schedule(spec, seed=3)[:5]
+    result = loadgen.run_schedule(schedule, DeafTarget(), max_wall_s=0.5)
+    report = loadgen.summarize(result, {}, spec["horizon_s"], "burst", 3)
+    assert report["all"]["completed"] == 0
+    assert report["all"]["attainment"] == 0.0
+    assert report["all"]["tokens"] == 0
+
+
+def test_dense_target_splits_queue_from_ttft():
+    """Regression: the dense target's submit() BLOCKS through generate(),
+    so the loop's own stamps would fold the whole generation into queue
+    wait and then double-count it into TTFT (reported first token AFTER
+    completion). The wall-second overrides keep the splits honest."""
+
+    class FakeDense:
+        max_len = 64
+
+        def generate(self, prompt, max_new_tokens, klass=""):
+            time.sleep(0.08)  # decode long relative to its 0.01s TTFT
+
+            class R:
+                tokens = np.zeros((1, max_new_tokens), np.int32)
+                ttft_s = 0.01
+
+            return R()
+
+    spec = loadgen.load_scenario("burst")
+    schedule = loadgen.build_schedule(spec, seed=3)[:2]
+    result = loadgen.run_schedule(
+        schedule, loadgen.EngineTarget(FakeDense(), "dense"), max_wall_s=10.0
+    )
+    for out in result.outcomes:
+        assert out.completed
+        assert out.ttft_s <= out.total_s  # first token never after completion
+        # TTFT ~= queue (time blocked behind the previous generate) + 0.01,
+        # NOT + the 0.08s decode.
+        assert out.ttft_s == pytest.approx(out.queue_s + 0.01, abs=0.03)
+
+
+def test_wall_offsets_respect_time_scale():
+    """Regression: target-reported offsets are WALL seconds and must be
+    scaled into scenario time like every other stamp — at --time-scale 2
+    a 0.1s prefill is 0.05 scenario seconds, not 0.1."""
+
+    class InstantPair:
+        def submit(self, req, arrival_wall_t):
+            return req.index
+
+        def step(self):
+            time.sleep(0.001)
+
+        def poll(self, handle):
+            return {"n_tokens": 3, "ttft_after_admit_wall_s": 0.1}
+
+    spec = loadgen.load_scenario("burst")
+    schedule = loadgen.build_schedule(spec, seed=3)[:1]
+    result = loadgen.run_schedule(schedule, InstantPair(), time_scale=2.0,
+                                  max_wall_s=10.0)
+    (out,) = result.outcomes
+    assert out.ttft_s == pytest.approx(out.queue_s + 0.05, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# Report rendering (pure)
+
+
+def test_render_report_with_fleet_block():
+    report = {
+        "scenario": "steady_poisson", "seed": 1, "horizon_s": 1.5,
+        "wall_s": 1.6, "offered_rps": 12.0, "achieved_rps": 11.5,
+        "classes": {
+            "chat": {"count": 10, "completed": 10, "attainment": 0.9,
+                     "goodput_fraction": 0.8, "tokens": 60,
+                     "good_tokens": 48, "ttft_p50": 0.01, "ttft_p95": 0.05,
+                     "ttft_p99": 0.06, "itl_p50": 0.001, "itl_p95": 0.002,
+                     "itl_p99": 0.003, "queue_p95": 0.004},
+        },
+        "all": {"count": 10, "completed": 10, "attainment": 0.9,
+                "goodput_fraction": 0.8, "tokens": 60, "good_tokens": 48,
+                "ttft_p50": 0.01, "ttft_p95": 0.05, "ttft_p99": 0.06,
+                "itl_p50": 0.001, "itl_p95": 0.002, "itl_p99": 0.003},
+    }
+    fleet = metrics.parse_exposition(
+        "# HELP serving_tokens_total x\n# TYPE serving_tokens_total counter\n"
+        'serving_tokens_total{engine="paged",klass="chat"} 60.0\n'
+        "# HELP serving_goodput_tokens_total x\n"
+        "# TYPE serving_goodput_tokens_total counter\n"
+        'serving_goodput_tokens_total{engine="paged",klass="chat"} 48.0\n'
+        "# HELP serving_prefix_cache_hits_total x\n"
+        "# TYPE serving_prefix_cache_hits_total counter\n"
+        "serving_prefix_cache_hits_total 30.0\n"
+        "# HELP serving_prefix_cache_misses_total x\n"
+        "# TYPE serving_prefix_cache_misses_total counter\n"
+        "serving_prefix_cache_misses_total 10.0\n"
+    )
+    frame = loadgen.render_report(report, fleet)
+    assert "SCENARIO steady_poisson" in frame
+    assert "chat" in frame and "90%" in frame and "80%" in frame
+    assert "GOODPUT%=80%" in frame
+    assert "PFX%=75%" in frame
+    folds = loadgen.fold_fleet(fleet)
+    assert folds["goodput"] == pytest.approx(0.8)
+    assert folds["spec"] is None  # absent series stay None, not 0
+
+
+# ---------------------------------------------------------------------------
+# lws-tpu top: GOODPUT% column + --by-class rows
+
+
+TOP_CLASS_EXPOSITION = """\
+# HELP serving_slo_attainment x
+# TYPE serving_slo_attainment gauge
+serving_slo_attainment{engine="paged",instance="w0",klass="gold"} 1.0
+serving_slo_attainment{engine="paged",instance="w0",klass="bulk"} 0.5
+# HELP serving_tokens_total x
+# TYPE serving_tokens_total counter
+serving_tokens_total{engine="paged",instance="w0",klass="gold"} 100.0
+serving_tokens_total{engine="paged",instance="w0",klass="bulk"} 100.0
+# HELP serving_goodput_tokens_total x
+# TYPE serving_goodput_tokens_total counter
+serving_goodput_tokens_total{engine="paged",instance="w0",klass="gold"} 100.0
+serving_goodput_tokens_total{engine="paged",instance="w0",klass="bulk"} 50.0
+# HELP serving_requests_total x
+# TYPE serving_requests_total counter
+serving_requests_total{engine="paged",instance="w0"} 20.0
+"""
+
+
+def test_top_goodput_column_and_by_class_rows():
+    from lws_tpu.cli import _top_rows, render_top
+
+    fams = metrics.parse_exposition(TOP_CLASS_EXPOSITION)
+    # Default fold: class series SUM into the engine row -> 150/200 = 75%.
+    rows = _top_rows(fams)
+    assert rows[("w0", "paged")]["tokens"] == 200.0
+    assert rows[("w0", "paged")]["good_tokens"] == 150.0
+    frame = render_top(fams)
+    assert "GOOD%" in frame
+    row = next(l for l in frame.splitlines() if l.startswith("w0"))
+    assert "75%" in row
+    # --by-class: one row per class, graded separately.
+    by_rows = _top_rows(fams, by_class=True)
+    assert by_rows[("w0", "paged", "gold")]["slo"] == 1.0
+    assert by_rows[("w0", "paged", "bulk")]["slo"] == 0.5
+    frame2 = render_top(fams, by_class=True)
+    assert "CLASS" in frame2
+    gold = next(l for l in frame2.splitlines() if "gold" in l)
+    bulk = next(l for l in frame2.splitlines() if "bulk" in l)
+    assert "100%" in gold and "1.00" in gold
+    assert "50%" in bulk and "0.50" in bulk
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cmd_loadgen_list(capsys):
+    from lws_tpu import cli
+
+    assert cli.main(["loadgen", "--list"]) == 0
+    out = capsys.readouterr().out
+    for name in loadgen.scenario_names():
+        assert name in out
+
+
+@pytest.mark.slow  # builds its own engine: covered by `make test`/`make check`
+def test_cmd_loadgen_runs_spec_file(tmp_path, capsys):
+    from lws_tpu import cli
+
+    spec = {
+        "name": "tiny", "horizon_s": 0.3, "max_len": 32, "vocab": 64,
+        "arrivals": {"process": "poisson", "rate_rps": 12.0},
+        "classes": [{"name": "c", "prompt_len": 4, "output_len": 2,
+                     "targets": {"ttft_s": 30.0, "itl_s": 30.0,
+                                 "queue_wait_s": 30.0}}],
+    }
+    path = tmp_path / "tiny.json"
+    path.write_text(json.dumps(spec))
+    rc = cli.main(["loadgen", "--spec", str(path), "--seed", "3",
+                   "--target", "paged", "--max-wall", "60"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "SCENARIO tiny" in out
+    assert "schedule " in out  # digest printed for reproducibility
+    assert "ALL" in out
+
+
+def test_cmd_loadgen_requires_scenario(capsys):
+    from lws_tpu import cli
+
+    assert cli.main(["loadgen"]) == 2
+
+
+def test_scenario_bench_budget_floors_logic():
+    """The bench's floor checker (pure): a missing scenario or a value
+    below its floor fails; absent floors are skipped."""
+    sys.path.insert(0, "/root/repo/benchmarks")
+    try:
+        import scenario_bench
+    finally:
+        sys.path.pop(0)
+    budget = {"scenarios": {"s": {"min_attainment": 0.9,
+                                  "min_prefix_hit_rate": 0.3}}}
+    ok = {"s": {"attainment": 0.95, "prefix_hit_rate": 0.5}}
+    assert scenario_bench.check(ok, budget) == []
+    bad = {"s": {"attainment": 0.5, "prefix_hit_rate": None}}
+    failures = scenario_bench.check(bad, budget)
+    assert len(failures) == 2
+    assert scenario_bench.check({}, budget)  # did not run -> failure

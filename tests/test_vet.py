@@ -193,6 +193,29 @@ def test_spans_context_and_literal_rules():
     }, phases
 
 
+def test_spans_rules_cover_loadgen_package():
+    """lws_tpu/loadgen/ is INSIDE the catalogue scope: scenario-emitted
+    metric/span names must be literal (and spans entered) exactly like the
+    serving plane's — a computed per-scenario name would mint ungreppable
+    families the catalogue checker can't see."""
+    found = run_pass(
+        "spans",
+        [FIXTURES / "lws_tpu" / "loadgen" / "report_cases.py"],
+        root=FIXTURES,
+    )
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert any("bad_scenario_metric" in f.detail
+               for f in by_rule.get("metric-name-literal", [])), found
+    assert any("bad_scenario_span" in f.detail
+               for f in by_rule.get("span-name-literal", [])), found
+    assert any("bad_unentered_span" in f.detail
+               for f in by_rule.get("span-context-manager", [])), found
+    for f in found:
+        assert not f.detail.startswith("ok_"), f
+
+
 def test_spans_name_rules_scoped_to_catalogue_source():
     """The same file OUTSIDE an lws_tpu/ root only keeps the context-
     manager rule — test code can't pollute the metrics catalogue."""

@@ -1,0 +1,34 @@
+"""Fixture: span/metric hygiene inside the loadgen package. Lives under a
+fake lws_tpu/loadgen/ root (the self-tests pass root=tests/vet_fixtures)
+because scenario-emitted metric/span names must stay catalogue-checkable —
+a harness that measured the fleet through uncatalogued names would be the
+one observability surface nobody can audit."""
+
+from lws_tpu.core import metrics, trace
+
+SCENARIO = "burst"
+
+
+def bad_scenario_metric():
+    # Building the metric name from the scenario would fragment the
+    # catalogue: every scenario run would mint a new, ungreppable family.
+    metrics.inc("loadgen_" + SCENARIO + "_requests_total")
+
+
+def bad_scenario_span(name):
+    with trace.span(name):
+        return None
+
+
+def bad_unentered_span():
+    leak = trace.span("loadgen.run")
+    return leak is not None
+
+
+def ok_scenario_metric():
+    metrics.inc("loadgen_requests_total", {"scenario": SCENARIO})
+
+
+def ok_scenario_span():
+    with trace.span("loadgen.run", scenario=SCENARIO):
+        return None

@@ -8,7 +8,9 @@ shape). A span constructed and never entered never closes, skewing
 duration attribution and leaking the thread-local span stack.
 
 `metric-name-literal` / `span-name-literal` — in lws_tpu/ source (the
-catalogue checker's scope), metric and span names must be string
+catalogue checker's scope — ALL of it, including `lws_tpu/loadgen/`:
+scenario-emitted names would otherwise fragment per scenario into
+families nobody can grep for), metric and span names must be string
 literals at the emission site: the docs catalogue
 checker (tools/check_metrics_catalogue.py) anchors on literal first
 arguments, so a dynamically-built name silently escapes the catalogue
