@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead clean
 
 test:
 	python -m pytest tests/ -q
@@ -30,7 +30,10 @@ bench-kv-handoff:  ## streamed KV handoff must beat the monolithic oracle's wall
 bench-scenarios:  ## committed loadgen scenarios must stay above their attainment/goodput/completion floors (budget json)
 	python benchmarks/scenario_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios  ## what CI would run (vet gates before tests)
+bench-history-overhead:  ## history-ring sampling at the default interval must cost <2% decode throughput (budget json)
+	python benchmarks/history_overhead_bench.py --check
+
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

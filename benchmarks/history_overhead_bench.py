@@ -1,0 +1,161 @@
+"""History-plane overhead microbench: the always-on guarantee for the ring.
+
+The history ring (lws_tpu/obs/history.py) is only allowed near the serving
+hot path if it is nearly free — the acceptance line is <2% decode
+throughput cost with sampling at the default interval. Like the profile
+sampler, the ring runs OFF the decode thread (its own daemon thread, or
+piggybacked on a scrape handler thread), so its entire cost to the decode
+loop is the GIL time one sample consumes: `(1/interval) x per-sample cost`
+seconds of interpreter time per second of wall clock. This bench measures
+exactly that quantity with the profile bench's deterministic decomposition
+(an end-to-end A/B flapped an order of magnitude above the effect there;
+the same applies here):
+
+  * per-sample cost — the median wall time of one full sampling pass
+    (render the live process registry + parse + ingest into the ring),
+    taken WHILE a real paged decode workload runs on a background thread,
+    so the registry size, thread count, and GIL contention are the serving
+    shape (the measured call also pays any GIL wait — conservative);
+  * decode dispatch cost — the median `step_n(1)` wall time, for scale.
+
+Run:    python benchmarks/history_overhead_bench.py            # report only
+CI:     python benchmarks/history_overhead_bench.py --check    # enforce
+The budget lives in benchmarks/history_overhead_budget.json (same contract
+shape as profile_overhead_budget.json; wired into `make check`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from lws_tpu.core import metrics  # noqa: E402
+from lws_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from lws_tpu.obs.history import DEFAULT_INTERVAL_S, HistoryRing  # noqa: E402
+from lws_tpu.serving.paged_engine import PagedBatchEngine  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "history_overhead_budget.json")
+
+
+def build_engine():
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=2048, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    # pipeline_depth=0: each step_n(1) contains its own chunk's device
+    # compute, so the dispatch median reported for scale is a whole chunk
+    # (same reasoning as profile_overhead_bench.py).
+    return PagedBatchEngine(cfg, params, slots=8, max_len=2048, block_size=16,
+                            pipeline_depth=0)
+
+
+def median(xs: list) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--samples", type=int, default=300,
+                        help="ring sampling passes to time")
+    parser.add_argument("--dispatches", type=int, default=200,
+                        help="step_n(1) calls to time for the scale row")
+    parser.add_argument("--check", action="store_true",
+                        help="enforce history_overhead_budget.json (CI mode)")
+    args = parser.parse_args()
+
+    engine = build_engine()
+    r = np.random.RandomState(0)
+    for _ in range(engine.slots):
+        assert engine.submit(
+            r.randint(1, 255, size=24).astype(np.int32), 2000
+        ) is not None
+    engine.step_n(1)  # compile outside every timed window
+
+    # Decode dispatch cost, for scale (main thread, nothing else running).
+    dispatch_times = []
+    for _ in range(args.dispatches):
+        t0 = time.perf_counter()
+        executed = engine.step_n(1)
+        dispatch_times.append(time.perf_counter() - t0)
+        assert executed == 1, "engine drained mid-run; shrink --dispatches"
+    dispatch_s = median(dispatch_times)
+
+    # Per-sample cost against a LIVE decode workload: the background thread
+    # keeps the registry churning and the GIL contended — the serving shape.
+    ring = HistoryRing(interval_s=DEFAULT_INTERVAL_S, retention_s=900.0)
+    stop = threading.Event()
+
+    def workload() -> None:
+        while not stop.is_set() and engine.active_count:
+            engine.step_n(1)
+
+    worker = threading.Thread(target=workload, daemon=True)
+    worker.start()
+    try:
+        sample_times = []
+        for _ in range(args.samples):
+            t0 = time.perf_counter()
+            n = ring.ingest(metrics.REGISTRY.render())
+            sample_times.append(time.perf_counter() - t0)
+            assert n >= 1, "ring ingested an empty exposition"
+    finally:
+        stop.set()
+        worker.join(timeout=30)
+    sample_s = median(sample_times)
+
+    overhead_pct = (1.0 / DEFAULT_INTERVAL_S) * sample_s * 100.0
+    print(json.dumps({
+        "metric": "paged decode dispatch (scale reference)",
+        "dispatches": len(dispatch_times),
+        "value": round(engine.slots / dispatch_s, 1),
+        "unit": "tok/s (median dispatch)",
+    }))
+    print(json.dumps({
+        "metric": "history ring render+parse+ingest against live decode workload",
+        "samples": len(sample_times),
+        "value": round(sample_s * 1e6, 1),
+        "unit": "us (median)",
+    }))
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    verdict = {
+        "metric": "history sampling overhead on paged decode loop "
+                  "((1/interval) x per-sample cost)",
+        "value": round(overhead_pct, 4),
+        "unit": "% of wall time",
+        "interval_s": DEFAULT_INTERVAL_S,
+        "sample_us": round(sample_s * 1e6, 1),
+        "budget_pct": budget["max_overhead_pct"],
+        "within_budget": overhead_pct < budget["max_overhead_pct"],
+    }
+    print(json.dumps(verdict), flush=True)
+    if args.check and not verdict["within_budget"]:
+        print(
+            f"[history-overhead] FAIL: {overhead_pct:.3f}% >= budget "
+            f"{budget['max_overhead_pct']}%",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,6 +6,7 @@
   python -m lws_tpu delete KIND NAMESPACE NAME [--server HOST:PORT]
   python -m lws_tpu scale  NAME REPLICAS [--server HOST:PORT]
   python -m lws_tpu top    [--watch] [--server HOST:PORT]
+  python -m lws_tpu monitor [FILTER] [--watch] [--server HOST:PORT]
   python -m lws_tpu faults [point=spec ...] [--clear] [--drain] [--server HOST:PORT]
   python -m lws_tpu plan-steps --initial 4,4 --target 4,4 [--surge 1,1] [--unavailable 0,0]
 """
@@ -509,24 +510,11 @@ def cmd_install(args) -> int:
 
 def _histogram_quantile(buckets: list[tuple[float, float]], q: float):
     """Estimate a quantile from cumulative (le, count) pairs — the PromQL
-    histogram_quantile shape, linear within the winning bucket."""
-    if not buckets:
-        return None
-    buckets = sorted(buckets, key=lambda b: b[0])
-    total = buckets[-1][1]
-    if total <= 0:
-        return None
-    rank = q * total
-    prev_le, prev_cum = 0.0, 0.0
-    for le, cum in buckets:
-        if cum >= rank:
-            if le == float("inf"):
-                return prev_le  # open-ended bucket: report its lower bound
-            span = cum - prev_cum
-            frac = (rank - prev_cum) / span if span > 0 else 1.0
-            return prev_le + (le - prev_le) * frac
-        prev_le, prev_cum = le, cum
-    return buckets[-1][0]
+    histogram_quantile shape (the implementation lives with the other
+    derived-signal math in lws_tpu/obs/signals.py)."""
+    from lws_tpu.obs.signals import histogram_quantile
+
+    return histogram_quantile(buckets, q)
 
 
 def _top_rows(fams: dict, by_class: bool = False) -> dict:
@@ -605,16 +593,74 @@ def _top_rows(fams: dict, by_class: bool = False) -> dict:
     return rows
 
 
+def history_rates(ring, now: float | None = None, window_s: float = 30.0,
+                  by_class: bool = False) -> dict:
+    """Fold a HistoryRing into the per-row rate cells `render_top` renders:
+    {row key: {disp_rate, kv_mbps, good}}. Rates come from the ring's
+    retained points (`obs/signals.rate` over the trailing `window_s`), so
+    the FIRST rendered frame already has them when the ring was seeded from
+    the server's /debug/history — and a skipped scrape widens a rate's
+    denominator instead of corrupting it. GOOD% here is the WINDOW's
+    on-time fraction (increase(good)/increase(total)), not the lifetime
+    ratio — a recovering engine's column recovers with it."""
+    from lws_tpu.obs import signals
+
+    def key_of(labels: dict) -> tuple:
+        key = (labels.get("instance", "-"), labels.get("engine", "-"))
+        if by_class:
+            key += (labels.get("klass", "-") or "-",)
+        return key
+
+    rates: dict = {}
+
+    def slot(key: tuple) -> dict:
+        return rates.setdefault(key, {})
+
+    for _, labels, _, pts, _ in ring.series(
+            "serving_decode_dispatch_duration_seconds_count"):
+        r = signals.rate(pts, window_s, now)
+        if r is not None:
+            s = slot(key_of(labels))
+            s["disp_rate"] = s.get("disp_rate", 0.0) + r
+    # The KV transfer counter is engine-less (it lives in the transport):
+    # it folds into the instance's `-` row, exactly like _top_rows.
+    for _, labels, _, pts, _ in ring.series("serving_kv_transfer_bytes_total"):
+        r = signals.rate(pts, window_s, now)
+        if r is not None:
+            key = (labels.get("instance", "-"), "-")
+            if by_class:
+                key += ("-",)
+            s = slot(key)
+            s["kv_mbps"] = s.get("kv_mbps", 0.0) + r / 1e6
+    inc_good: dict = {}
+    inc_tok: dict = {}
+    for family, acc in (("serving_goodput_tokens_total", inc_good),
+                        ("serving_tokens_total", inc_tok)):
+        for _, labels, _, pts, _ in ring.series(family):
+            inc = signals.increase(pts, window_s, now)
+            if inc is not None:
+                key = key_of(labels)
+                acc[key] = acc.get(key, 0.0) + inc
+    for key, tok in inc_tok.items():
+        if tok > 0:
+            slot(key)["good"] = inc_good.get(key, 0.0) / tok
+    return rates
+
+
 def render_top(fams: dict, alerts: dict | None = None,
                prev: dict | None = None, dt_s: float | None = None,
-               rows: dict | None = None, by_class: bool = False) -> str:
-    """One frame of `lws-tpu top`. `prev`/`dt_s` (a previous _top_rows fold
-    and the seconds since it) turn cumulative counters into rates in
-    --watch mode; one-shot renders totals. `rows` takes a precomputed
-    _top_rows fold so --watch folds each frame once, not twice. With
-    `by_class` (`--by-class`), class-labelled series split into one row
-    per (instance, engine, klass) — `rows`/`prev` must then be by-class
-    folds too."""
+               rows: dict | None = None, by_class: bool = False,
+               rates: dict | None = None) -> str:
+    """One frame of `lws-tpu top`. `rates` (a `history_rates` fold over the
+    HistoryRing) supplies the DISP/S, KV_MB/S, and windowed GOOD% cells —
+    present from the very first frame when the ring was seeded from
+    /debug/history. `prev`/`dt_s` (a previous _top_rows fold and the
+    seconds since it) remain the frame-to-frame fallback for servers
+    without the history surface; one-shot renders totals. `rows` takes a
+    precomputed _top_rows fold so --watch folds each frame once, not
+    twice. With `by_class` (`--by-class`), class-labelled series split
+    into one row per (instance, engine, klass) — `rows`/`prev`/`rates`
+    must then be by-class folds too."""
     if rows is None:
         rows = _top_rows(fams, by_class=by_class)
     instances = None
@@ -648,18 +694,22 @@ def render_top(fams: dict, alerts: dict | None = None,
             klass = None
         if engine == "-" and "requests" not in r and "slo" not in r:
             continue  # fleet-plumbing rows without serving data
-        rate = None
-        if prev is not None and dt_s:
+        rr = (rates or {}).get(key, {})
+        rate = rr.get("disp_rate")
+        if rate is None and prev is not None and dt_s:
             before = prev.get(key, {}).get("dispatches", 0.0)
             rate = max(0.0, r.get("dispatches", 0.0) - before) / dt_s
         # KV handoff wire throughput: the transfer counter is engine-less
         # (it lives in the transport), so it rides the instance's `-` row.
-        kv_rate = None
-        kv_now = r.get("kv_bytes", rows.get(blank_key(instance), {}).get("kv_bytes"))
-        if prev is not None and dt_s and kv_now is not None:
-            kv_prev = prev.get(key, {}).get(
-                "kv_bytes", prev.get(blank_key(instance), {}).get("kv_bytes", 0.0))
-            kv_rate = max(0.0, kv_now - kv_prev) / dt_s / 1e6
+        kv_rate = rr.get("kv_mbps")
+        if kv_rate is None and rates is not None:
+            kv_rate = rates.get(blank_key(instance), {}).get("kv_mbps")
+        if kv_rate is None:
+            kv_now = r.get("kv_bytes", rows.get(blank_key(instance), {}).get("kv_bytes"))
+            if prev is not None and dt_s and kv_now is not None:
+                kv_prev = prev.get(key, {}).get(
+                    "kv_bytes", prev.get(blank_key(instance), {}).get("kv_bytes", 0.0))
+                kv_rate = max(0.0, kv_now - kv_prev) / dt_s / 1e6
         # KV-pool occupancy (live / pool) and prefix-cache hit rate — the
         # capacity columns: a row pinned near 100% KV with a low hit rate
         # is the backpressure case paging exists to relieve.
@@ -681,8 +731,8 @@ def render_top(fams: dict, alerts: dict | None = None,
         # delivered (core/slo.py ledger). A row serving fast-but-late work
         # shows high DISP/S with a sagging GOOD% — throughput that isn't
         # helping anyone.
-        good = None
-        if r.get("tokens", 0.0) > 0:
+        good = rr.get("good")
+        if good is None and r.get("tokens", 0.0) > 0:
             good = r.get("good_tokens", 0.0) / r["tokens"]
         klass_cell = f"{klass:<9}" if by_class else ""
         lines.append(
@@ -703,17 +753,20 @@ def render_top(fams: dict, alerts: dict | None = None,
     return "\n".join(lines)
 
 
-def _fetch_top_state(server: str) -> tuple[dict, dict]:
-    """(parsed fleet families, active alerts) from the API server. Alerts
-    merge two feeds: the control plane's own watchdog (live detail via
-    /debug/flightrecorder) and any WORKER whose `lws_watchdog_active` gauge
-    rides the fleet scrape at 1 — a worker-side stall renders here too."""
+def _fetch_top_state(server: str) -> tuple[dict, dict, str]:
+    """(parsed fleet families, active alerts, raw exposition text) from the
+    API server — the raw text also feeds the client-side HistoryRing.
+    Alerts merge two feeds: the control plane's own watchdog (live detail
+    via /debug/flightrecorder) and any WORKER whose `lws_watchdog_active`
+    gauge rides the fleet scrape at 1 — a worker-side stall renders here
+    too."""
     from lws_tpu.core.metrics import parse_exposition
 
     url = f"{_server_base(server)}/metrics/fleet"
     req = urllib.request.Request(url, headers=_auth_headers())
     with urllib.request.urlopen(req, timeout=30, context=_url_context(url)) as resp:
-        fams = parse_exposition(resp.read().decode())
+        text = resp.read().decode()
+    fams = parse_exposition(text)
     alerts = {}
     for name, labels, value, _ in fams.get("lws_watchdog_active", {}).get("samples", []):
         if name == "lws_watchdog_active" and value >= 1.0:
@@ -726,31 +779,64 @@ def _fetch_top_state(server: str) -> tuple[dict, dict]:
             alerts[name] = details  # richer detail wins over the gauge row
     except SystemExit:
         pass  # an older server without the endpoint still gets the table
-    return fams, alerts
+    return fams, alerts, text
 
 
 def cmd_top(args) -> int:
     """Live fleet view: SLO attainment, throughput/occupancy, in-flight
     depth, and watchdog alerts from the aggregated /metrics/fleet surface.
     One-shot by default; --watch redraws every --interval seconds (floored
-    at 1s — the fleet collector caches scrapes for ~1s, and rating a cached
-    exposition against a shorter dt would flap between 0 and 2x)."""
+    at 1s — the fleet collector caches scrapes for ~1s). Rate columns
+    (DISP/S, KV_MB/S) and the windowed GOOD% derive from a client-side
+    HistoryRing seeded from the server's /debug/history, so the FIRST
+    frame already renders them and a skipped scrape widens a rate's
+    window instead of corrupting it."""
+    from lws_tpu.obs.history import HistoryRing
+
     args.interval = max(args.interval, 1.0)
+    ring = HistoryRing(interval_s=0.0, retention_s=600.0)
     prev = prev_t = None
+    first = True
+    seeded = False
     while True:
         try:
-            fams, alerts = _fetch_top_state(args.server)
+            fams, alerts, text = _fetch_top_state(args.server)
         except urllib.error.URLError as e:
             raise SystemExit(
                 f"error: cannot reach server {args.server}: {e.reason}"
             ) from None
         now = time.monotonic()
+        if first:
+            first = False
+            try:
+                # The server's retained history gives frame 1 real rates;
+                # an older server without the endpoint degrades to the
+                # frame-to-frame fallback.
+                seeded = ring.load_snapshot(
+                    _http(args.server, "GET", "/debug/history?limit=4096"),
+                    now=now,
+                ) > 0
+            except SystemExit:
+                pass
+            if seeded:
+                # Frame 1 renders from the seed ALONE: the fleet text just
+                # fetched may be older than the server ring's newest ingest
+                # (collector cache), and ingesting it would misread the
+                # older raw counters as a reset. Frame 2+ fetches are fresh
+                # renders (the cache expires within the watch interval).
+                text = None
+        if text is not None:
+            ring.ingest(text, now=now)
         by_class = getattr(args, "by_class", False)
         rows = _top_rows(fams, by_class=by_class)
+        rates = history_rates(
+            ring, now=now, window_s=max(30.0, 3 * args.interval),
+            by_class=by_class,
+        )
         frame = render_top(
             fams, alerts, prev=prev,
             dt_s=(now - prev_t) if prev_t is not None else None,
-            rows=rows, by_class=by_class,
+            rows=rows, by_class=by_class, rates=rates,
         )
         if not args.watch:
             print(frame)
@@ -758,6 +844,183 @@ def cmd_top(args) -> int:
         sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
         sys.stdout.flush()
         prev, prev_t = rows, now
+        time.sleep(args.interval)
+
+
+# ---------------------------------------------------------------------------
+# lws-tpu monitor: the history-plane view — per-series sparklines, burn
+# columns, firing alerts, and the current dry-run scale recommendation.
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list, width: int = 24) -> str:
+    """Unicode sparkline of the trailing `width` values, min-max
+    normalized (a flat series renders flat, not empty)."""
+    values = [v for v in values if v is not None][-width:]
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(values)
+    return "".join(
+        _SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1,
+                          int((v - lo) / span * (len(_SPARK_BLOCKS) - 0.001)))]
+        for v in values
+    )
+
+
+def _series_cells(kind: str, points: list) -> tuple[list, str]:
+    """(plotted values, unit suffix) for one retained series: counters plot
+    their successive per-second rates (a cumulative line is always just
+    'up'), gauges plot raw values."""
+    if kind != "counter":
+        return [v for _, v in points], ""
+    vals = []
+    for (t0, v0), (t1, v1) in zip(points, points[1:]):
+        if t1 > t0:
+            vals.append(max(0.0, v1 - v0) / (t1 - t0))
+    return vals, "/s"
+
+
+def render_monitor(snapshot: dict, fams: dict | None = None,
+                   alerts: dict | None = None, now: float | None = None,
+                   top_n: int = 24, name_filter: str = "") -> str:
+    """One frame of `lws-tpu monitor`: the /debug/history snapshot's series
+    as sparklines (counters as rates, gauges raw), the burn-rate and
+    scale-recommendation gauges folded from the metrics surface, and the
+    firing alerts. Pure function of its inputs so tests drive it from
+    canned data."""
+    series = snapshot.get("series") or []
+    header = (
+        f"MONITOR  series={snapshot.get('series_total', len(series))}"
+        f"  interval={snapshot.get('interval_s', '-')}s"
+        f"  retention={snapshot.get('retention_s', '-')}s"
+    )
+    firing = sorted((alerts or {}).keys())
+    header += f"  alerts={','.join(firing) if firing else 'none'}"
+    lines = [header]
+    for name, details in sorted((alerts or {}).items()):
+        for d in details:
+            lines.append(f"  ALERT {name}: {json.dumps(d)}")
+    # The dry-run recommendation + burn gauges ride the normal metrics
+    # surface (obs/recommend.py publishes them like any other sensor).
+    if fams:
+        rec = {
+            labels.get("role", "-"): value
+            for name, labels, value, _ in
+            fams.get("serving_scale_recommendation", {}).get("samples", [])
+            if name == "serving_scale_recommendation"
+        }
+        if rec:
+            cells = "  ".join(f"{role}={int(v)}" for role, v in sorted(rec.items()))
+            lines.append(f"recommendation: {cells}")
+        burns = [
+            (labels, value)
+            for name, labels, value, _ in
+            fams.get("serving_slo_burn_rate", {}).get("samples", [])
+            if name == "serving_slo_burn_rate"
+        ]
+        if burns:
+            lines.append("")
+            lines.append(f"{'BURN SERIES':<28}{'WINDOW':<8}{'BURN':>8}")
+            for labels, value in sorted(
+                    burns, key=lambda b: (b[0].get("engine", ""),
+                                          b[0].get("klass", ""),
+                                          b[0].get("window", ""))):
+                key = labels.get("engine", "-")
+                if labels.get("klass"):
+                    key += "/" + labels["klass"]
+                if labels.get("instance"):
+                    key += "@" + labels["instance"]
+                lines.append(
+                    f"{key:<28}{labels.get('window', '-'):<8}{value:>7.1f}x"
+                )
+    lines.append("")
+    lines.append(f"{'SERIES':<58}{'LAST':>12}  TREND")
+    shown = 0
+    skipped = 0
+    for s in series:
+        name = s.get("name", "")
+        if name.endswith(("_bucket", "_sum")):
+            continue  # bucket/sum decompositions: noise at this altitude
+        labels = s.get("labels") or {}
+        label_txt = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        full = f"{name}{{{label_txt}}}" if label_txt else name
+        if name_filter and name_filter not in full:
+            continue
+        if shown >= top_n:
+            skipped += 1
+            continue
+        vals, unit = _series_cells(s.get("kind", "gauge"), s.get("points") or [])
+        lastv = vals[-1] if vals else None
+        cell = f"{lastv:.4g}{unit}" if lastv is not None else "-"
+        lines.append(f"{full[:58]:<58}{cell:>12}  {_sparkline(vals)}")
+        shown += 1
+    if skipped or snapshot.get("truncated"):
+        lines.append(
+            f"... {skipped + int(snapshot.get('truncated') or 0)} more series"
+            " (raise --limit / narrow the filter)"
+        )
+    return "\n".join(lines)
+
+
+def _fetch_monitor_state(server: str) -> tuple[dict, dict]:
+    """(parsed metric families, active alerts) for the monitor frame. The
+    fleet surface wins when the server has one (the API server); a worker
+    telemetry port degrades to its own /metrics. Alerts merge the watchdog
+    gauges riding the exposition with the live /debug/flightrecorder
+    detail, exactly like `lws-tpu top`."""
+    from lws_tpu.core.metrics import parse_exposition
+
+    fams: dict = {}
+    for path in ("/metrics/fleet", "/metrics"):
+        url = f"{_server_base(server)}{path}"
+        req = urllib.request.Request(url, headers=_auth_headers())
+        try:
+            with urllib.request.urlopen(req, timeout=30,
+                                        context=_url_context(url)) as resp:
+                fams = parse_exposition(resp.read().decode())
+            break
+        except urllib.error.HTTPError:
+            continue  # worker port: no fleet surface — fall back
+    alerts: dict = {}
+    for name, labels, value, _ in fams.get("lws_watchdog_active", {}).get("samples", []):
+        if name == "lws_watchdog_active" and value >= 1.0:
+            alerts.setdefault(labels.get("watchdog", "?"), []).append(
+                {"instance": labels.get("instance", "-")}
+            )
+    try:
+        fr = _http(server, "GET", "/debug/flightrecorder?limit=0")
+        for name, details in (fr.get("alerts") or {}).items():
+            alerts[name] = details
+    except SystemExit:
+        pass
+    return fams, alerts
+
+
+def cmd_monitor(args) -> int:
+    """History-plane view: the server's retained series (/debug/history) as
+    sparklines, the burn-rate columns and current dry-run scale
+    recommendation from its metrics surface, and firing watchdog alerts.
+    One-shot by default; --watch redraws every --interval seconds."""
+    args.interval = max(args.interval, 1.0)
+    while True:
+        snap = _http(args.server, "GET", f"/debug/history?limit={args.limit}")
+        try:
+            fams, alerts = _fetch_monitor_state(args.server)
+        except urllib.error.URLError as e:
+            raise SystemExit(
+                f"error: cannot reach server {args.server}: {e.reason}"
+            ) from None
+        frame = render_monitor(snap, fams, alerts, top_n=args.top,
+                               name_filter=args.filter or "")
+        if not args.watch:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
         time.sleep(args.interval)
 
 
@@ -890,13 +1153,42 @@ def cmd_loadgen(args) -> int:
         )
     else:
         target = loadgen.build_local_target(args.target, spec)
-    result = loadgen.run_schedule(
-        schedule, target, time_scale=args.time_scale, max_wall_s=args.max_wall
-    )
+    # With --server, a SAMPLER THREAD feeds a HistoryRing from the live
+    # fleet surface for the run's duration (off the drive loop: a stalled
+    # server must cost a sample gap, never delay an open-loop arrival),
+    # and the final report appends the peak burn per class plus the
+    # dry-run recommendation trace.
+    ring = None
+    if args.server:
+        from lws_tpu.obs.history import HistoryRing
+
+        ring = HistoryRing(interval_s=0.5, retention_s=3600.0)
+        fleet_url = f"{_server_base(args.server)}/metrics/fleet"
+
+        def _fetch_fleet_text() -> str:
+            # Raises on failure: the ring's sampler thread skips that tick
+            # — a gap in history, never a phantom empty sample.
+            req = urllib.request.Request(fleet_url, headers=_auth_headers())
+            with urllib.request.urlopen(req, timeout=2,
+                                        context=_url_context(fleet_url)) as resp:
+                return resp.read().decode()
+
+        ring.start(_fetch_fleet_text)
+
+    try:
+        result = loadgen.run_schedule(
+            schedule, target, time_scale=args.time_scale,
+            max_wall_s=args.max_wall,
+        )
+    finally:
+        if ring is not None:
+            ring.stop()
     report = loadgen.summarize(
         result, targets, float(spec.get("horizon_s", 1.0)),
         spec.get("name", args.scenario or "-"), args.seed,
     )
+    if ring is not None and ring.series():
+        report["history"] = loadgen.fold_history(ring, targets)
     fleet = None
     if args.server:
         from lws_tpu.core.metrics import parse_exposition
@@ -1095,6 +1387,23 @@ def main(argv=None) -> int:
                          "(instance, engine, class) — SLO/GOOD% per "
                          "workload class")
     tp.set_defaults(fn=cmd_top)
+
+    mon = sub.add_parser("monitor", help="history-plane view: retained series "
+                         "as sparklines, burn-rate columns, firing alerts, "
+                         "and the dry-run scale recommendation "
+                         "(from /debug/history)")
+    mon.add_argument("filter", nargs="?", default="",
+                     help="only show series whose name{labels} contains this")
+    mon.add_argument("--server", default="127.0.0.1:9443",
+                     help="API server or worker telemetry host:port")
+    mon.add_argument("--watch", action="store_true",
+                     help="redraw every --interval seconds")
+    mon.add_argument("--interval", type=float, default=2.0)
+    mon.add_argument("--top", type=int, default=24,
+                     help="series rows to render")
+    mon.add_argument("--limit", type=int, default=512,
+                     help="series to fetch from /debug/history")
+    mon.set_defaults(fn=cmd_monitor)
 
     prf = sub.add_parser("profile", help="continuous-profiling view: per-span "
                          "and top-of-stack self-time (from /debug/profile)")

@@ -140,9 +140,12 @@ class FlightRecorder:
         """The diagnostics bundle: everything an operator needs to explain
         the window that just went wrong, in one JSON-serializable dict —
         including the process profile, so a stall alert ships the collapsed
-        stacks of the window that stalled (local import: profile.py is a
-        consumer of this module's surfaces, not a dependency)."""
+        stacks of the window that stalled, and the process history ring, so
+        a burn-rate alert ships the series window that burned (local
+        imports: profile.py and obs/history.py are consumers of this
+        module's surfaces, not dependencies)."""
         from lws_tpu.core import profile as profmod
+        from lws_tpu.obs import history as historymod
 
         exposition = (
             metrics.render_exposition(metrics.REGISTRY, *registries)
@@ -156,6 +159,7 @@ class FlightRecorder:
             "spans": trace.TRACER.spans(span_limit),
             "metrics": exposition,
             "profile": profmod.PROFILER.snapshot(limit=128),
+            "history": historymod.HISTORY.snapshot(limit=64, max_points=256),
         }
 
 
@@ -277,6 +281,14 @@ def default_rules() -> list:
                     depth_threshold=1.0, sustain_s=0.0),
         TripRule("deadline_tripped", "deadline_trips:*",
                  window_s=_env_float("LWS_TPU_WATCHDOG_TRIP_WINDOW_S", 5.0)),
+        # History-plane rule (lws_tpu/obs/recommend.py feed): while an SLO
+        # series' fast burn tier fires, the recommender holds a
+        # `burn_rate:{engine}[/{klass}]` heartbeat at depth 1 with pinned
+        # progress (the circuit_open convention) — one edge-triggered
+        # alert + diagnostics dump per burn episode, the dump's event ring
+        # carrying the offending error-series window.
+        BacklogRule("burn_rate", "burn_rate:*",
+                    depth_threshold=1.0, sustain_s=0.0),
     ]
 
 

@@ -522,3 +522,12 @@ describe("serving_kv_connection_errors_total", "KV handoff connections that died
 describe("lws_fault_trips_total", "Injected-fault firings per fault point and mode (chaos runs only; zero in production)")
 describe("lws_fault_points_armed", "Fault points currently armed in this process")
 describe("lws_fleet_scrape_skipped_total", "Fleet scrapes skipped because the instance is in failure backoff")
+# --- time-series history plane + dry-run recommender (lws_tpu/obs/) --------
+describe("lws_history_samples_total",
+         "Exposition sampling passes folded into the process history ring")
+describe("lws_history_series_dropped_total",
+         "New series refused by the history ring's cardinality cap (retained series keep accruing points)")
+describe("serving_slo_burn_rate",
+         "Error-budget burn of the short window per tier (window=fast/slow), per engine and workload class — burn 1.0 exhausts the budget exactly at the SLO horizon; the fast tier pages at 14.4")
+describe("serving_scale_recommendation",
+         "Dry-run desired replica count per DS role from the burn/occupancy signals (lws_tpu/obs/recommend.py) — published as a decision, actuated only through the opt-in annotation adapter")

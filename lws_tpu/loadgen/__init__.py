@@ -27,7 +27,7 @@ from lws_tpu.loadgen.arrivals import (
     make_process,
     piecewise_poisson,
 )
-from lws_tpu.loadgen.report import fold_fleet, render_report
+from lws_tpu.loadgen.report import fold_fleet, fold_history, render_report
 from lws_tpu.loadgen.runner import (
     DisaggTarget,
     EngineTarget,
@@ -80,6 +80,7 @@ __all__ = [
     "class_targets",
     "describe_scenario",
     "fold_fleet",
+    "fold_history",
     "goodput_tokens",
     "install_class_targets",
     "load_scenario",

@@ -47,6 +47,84 @@ def fold_fleet(fams: dict) -> dict:
     return out
 
 
+def fold_history(ring, targets_by_class: Optional[dict] = None,
+                 attainment_target: Optional[float] = None,
+                 windows: Optional[tuple] = None,
+                 current: Optional[dict] = None,
+                 max_steps: int = 64) -> dict:
+    """Fold a HistoryRing sampled DURING the run (`lws-tpu loadgen
+    --server`) into the report's history block: per-class peak/final
+    fast-window burn over the run, plus the dry-run recommendation trace —
+    a throwaway ScaleRecommender replayed at each retained sample time,
+    recording every point the desired-replica verdict changed. Pure
+    function of the ring (private registry/recorder), so it never leaks
+    gauges or alerts into the driving process."""
+    from lws_tpu.core.flightrecorder import FlightRecorder
+    from lws_tpu.core.metrics import MetricsRegistry
+    from lws_tpu.obs import signals
+    from lws_tpu.obs.recommend import (
+        DEFAULT_ATTAINMENT_TARGET,
+        ScaleRecommender,
+    )
+
+    if attainment_target is None:
+        attainment_target = DEFAULT_ATTAINMENT_TARGET
+    windows = windows if windows is not None else signals.burn_windows()
+    fast = windows[0]
+    goods = {
+        tuple(sorted(labels.items())): pts
+        for _, labels, _, pts, _ in ring.series("serving_goodput_tokens_total")
+    }
+    classes: dict = {}
+    times: set = set()
+    for _, labels, _, total, _ in ring.series("serving_tokens_total"):
+        good = goods.get(tuple(sorted(labels.items())), [])
+        key = labels.get("engine", "-")
+        if labels.get("klass"):
+            key += "/" + labels["klass"]
+        peak = final = None
+        for t, _v in total:
+            burn = signals.burn_rate_from_counters(
+                good, total, attainment_target, fast.short_s, now=t)
+            if burn is None:
+                continue
+            final = burn
+            if peak is None or burn > peak:
+                peak = burn
+        # A fleet-fed ring holds the same (engine, klass) once per
+        # instance: both columns fold as the WORST instance (independent
+        # maxes — a calm survivor must not mask the peak, and the peak
+        # winner's stale tail must not pin the FINAL column).
+        slot = classes.setdefault(key, {"peak_fast_burn": None,
+                                        "final_fast_burn": None})
+        if peak is not None and (slot["peak_fast_burn"] is None
+                                 or peak > slot["peak_fast_burn"]):
+            slot["peak_fast_burn"] = peak
+        if final is not None and (slot["final_fast_burn"] is None
+                                  or final > slot["final_fast_burn"]):
+            slot["final_fast_burn"] = final
+        times.update(t for t, _v in total)
+    rec = ScaleRecommender(
+        ring, class_targets=targets_by_class or {},
+        attainment_target=attainment_target, windows=windows,
+        current=current, registry=MetricsRegistry(),
+        recorder=FlightRecorder(),
+    )
+    trace: list = []
+    last_desired: Optional[dict] = None
+    t0 = min(times) if times else 0.0  # trace times are RUN-relative
+    for t in sorted(times)[-max_steps:]:
+        verdict = rec.evaluate(now=t)
+        if verdict.desired != last_desired:
+            trace.append({
+                "t": round(t - t0, 3),
+                "desired": dict(verdict.desired),
+                "reasons": dict(verdict.reasons),
+            })
+            last_desired = dict(verdict.desired)
+    return {"classes": classes, "recommendation": trace}
+
+
 def _fmt(v, pattern: str = "{:.3f}", dash: str = "-") -> str:
     return pattern.format(v) if v is not None else dash
 
@@ -99,4 +177,19 @@ def render_report(report: dict, fleet: Optional[dict] = None) -> str:
             f"  SPEC%={_fmt(f.get('spec'), '{:.0%}')}"
             f"  KV%={_fmt(f.get('kv'), '{:.0%}')}"
         )
+    hist = report.get("history")
+    if hist:
+        lines.append("")
+        lines.append(f"{'HISTORY':<16}{'PEAK_BURN':>10}{'FINAL':>8}")
+        for key, s in sorted(hist.get("classes", {}).items()):
+            lines.append(
+                f"{key:<16}"
+                f"{_fmt(s.get('peak_fast_burn'), '{:.1f}x'):>10}"
+                f"{_fmt(s.get('final_fast_burn'), '{:.1f}x'):>8}"
+            )
+        for step in hist.get("recommendation", []):
+            desired = " ".join(
+                f"{role}={n}" for role, n in sorted(step["desired"].items())
+            )
+            lines.append(f"recommendation @{step['t']:.2f}s: {desired}")
     return "\n".join(lines)

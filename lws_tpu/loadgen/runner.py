@@ -270,12 +270,16 @@ def run_schedule(
     max_wall_s: float = 120.0,
     clock=time.perf_counter,
     sleep=time.sleep,
+    on_tick=None,
 ) -> RunResult:
     """Play `schedule` against `target` open-loop. `time_scale` maps
     scenario seconds onto wall seconds (2.0 = half speed); `max_wall_s`
     bounds the drain — requests still unfinished at the bound are recorded
     as incomplete (goodput zero), which is exactly what an overload
-    scenario is supposed to show."""
+    scenario is supposed to show. `on_tick(now)` runs once per drive-loop
+    iteration — the seam the history sampler rides (`lws-tpu loadgen
+    --server` feeds a HistoryRing from here; the ring's own interval gate
+    keeps the sampling cadence independent of loop speed)."""
     pending = deque(sorted(schedule, key=lambda r: (r.arrival_s, r.index)))
     waiting: deque[ScheduledRequest] = deque()
     active: dict = {}  # handle -> RequestOutcome (partially filled)
@@ -288,6 +292,8 @@ def run_schedule(
 
     while pending or waiting or active:
         now = clock()
+        if on_tick is not None:
+            on_tick(now)
         if now - start > max_wall_s:
             break
         rel = scen(now - start)

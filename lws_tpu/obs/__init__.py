@@ -1,0 +1,75 @@
+"""The time-series decision plane (ROADMAP item 4's missing middle):
+retained scrape rings over the existing metrics surfaces, pure derived
+signals (rates, windowed quantiles, SRE-workbook multi-window burn rates),
+and a dry-run autoscaling recommender that publishes decisions as metrics
+and edge-triggered alerts — actuation stays opt-in via the
+`AnnotationAdapter` seam into the stock `AutoscalerReconciler`.
+
+    from lws_tpu import obs
+    ring = obs.HistoryRing(interval_s=5.0, retention_s=900.0)
+    ring.ingest(metrics.REGISTRY.render())          # or the fleet exposition
+    rec = obs.ScaleRecommender(ring).evaluate()     # dry-run decision
+
+Served at `GET /debug/history` on both the API server and the worker
+telemetry server; rendered by `lws-tpu monitor` and backing `lws-tpu top`'s
+rate columns. Docs: docs/observability.md ("History & burn-rate alerting"),
+docs/tasks/autoscaling.md (the recommender walkthrough).
+"""
+
+from lws_tpu.obs.history import (
+    DEFAULT_INTERVAL_S,
+    DEFAULT_RETENTION_S,
+    HISTORY,
+    HistoryRing,
+    start_from_env,
+)
+from lws_tpu.obs.recommend import (
+    AnnotationAdapter,
+    Recommendation,
+    ScaleRecommender,
+)
+from lws_tpu.obs.signals import (
+    DEFAULT_BURN_WINDOWS,
+    BurnVerdict,
+    BurnWindow,
+    breach_fraction,
+    burn_rate_from_counters,
+    burn_rate_from_gauge,
+    burn_windows,
+    error_series,
+    ewma,
+    histogram_quantile,
+    increase,
+    mean,
+    multiwindow_burn,
+    quantile_over_window,
+    rate,
+    slope,
+)
+
+__all__ = [
+    "DEFAULT_BURN_WINDOWS",
+    "DEFAULT_INTERVAL_S",
+    "DEFAULT_RETENTION_S",
+    "HISTORY",
+    "AnnotationAdapter",
+    "BurnVerdict",
+    "BurnWindow",
+    "HistoryRing",
+    "Recommendation",
+    "ScaleRecommender",
+    "breach_fraction",
+    "burn_rate_from_counters",
+    "burn_rate_from_gauge",
+    "burn_windows",
+    "error_series",
+    "ewma",
+    "histogram_quantile",
+    "increase",
+    "mean",
+    "multiwindow_burn",
+    "quantile_over_window",
+    "rate",
+    "slope",
+    "start_from_env",
+]

@@ -23,6 +23,10 @@ Endpoints:
   GET  /debug/profile/fleet           every ready worker's /debug/profile,
                                       merged with instance/role labels
                                       (runtime/fleet.py)
+  GET  /debug/history[?limit=N]       the process history ring: retained
+                                      per-series time series sampled from
+                                      the /metrics and /metrics/fleet
+                                      surfaces (lws_tpu/obs/history.py)
   GET  /debug/faults                  armed fault points + hit/trip counters
   POST /debug/faults                  arm/disarm deterministic fault
                                       schedules in this process
@@ -220,7 +224,17 @@ class ApiServer:
                     slomod.RECORDER.refresh()
                     regs = (cp.metrics,) if cp.metrics is metricsmod.REGISTRY \
                         else (cp.metrics, metricsmod.REGISTRY)
-                    self._send_exposition(metricsmod.render_exposition(*regs))
+                    text = metricsmod.render_exposition(*regs)
+                    # Feed the process history ring ONLY when no fleet
+                    # collector is wired (the fleet handler below is the
+                    # richer source then, and two sources racing one
+                    # interval gate would starve each other and flap the
+                    # ring's live-series flags between shapes).
+                    if getattr(cp, "fleet", None) is None:
+                        from lws_tpu.obs import history as historymod
+
+                        historymod.HISTORY.ingest_if_due(text)
+                    self._send_exposition(text)
                 elif path == "/metrics/fleet":
                     # The aggregated fleet view: every ready worker's
                     # /metrics merged with instance/role/revision labels
@@ -229,7 +243,28 @@ class ApiServer:
                     if fleet is None:
                         self._json(404, {"error": "fleet collector not wired"})
                         return
-                    self._send_exposition(fleet.render_fleet())
+                    from lws_tpu.obs import history as historymod
+
+                    text = fleet.render_fleet()
+                    # The instance-labelled fleet view is the control
+                    # plane's history source: per-worker series ride the
+                    # process ring (interval-gated). Each fresh ingest also
+                    # evaluates the process-default dry-run recommender, so
+                    # `serving_scale_recommendation`/`serving_slo_burn_rate`
+                    # and the `burn_rate` alert feed exist on every live
+                    # deployment — published on the NEXT scrape, like every
+                    # refresh-per-scrape gauge.
+                    if historymod.HISTORY.ingest_if_due(text):
+                        from lws_tpu.obs import recommend as recmod
+
+                        try:
+                            # `current` re-syncs from the store's DS roles
+                            # so desired counts scale from the fleet's REAL
+                            # width, not a hardcoded baseline of 1.
+                            recmod.default_recommender(cp.store).evaluate()
+                        except Exception:  # vet: ignore[hazard-exception-swallow]: a recommender hiccup must never 500 the fleet scrape (BLE001 intended)
+                            pass
+                    self._send_exposition(text)
                 elif path == "/debug/traces":
                     from urllib.parse import parse_qs, urlparse
 
@@ -298,6 +333,19 @@ class ApiServer:
                             {"labels": labels, "profile": snap}
                             for labels, snap in sources
                         ]})
+                elif path == "/debug/history":
+                    from urllib.parse import parse_qs, urlparse
+
+                    from lws_tpu.obs import history as historymod
+                    from lws_tpu.runtime.telemetry import parse_limit
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad limit: {e}"})
+                        return
+                    self._json(200, historymod.HISTORY.snapshot(limit))
                 elif path == "/debug/faults":
                     from lws_tpu.core import faults as faultsmod
 

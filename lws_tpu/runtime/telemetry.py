@@ -11,6 +11,10 @@ engine) exposes its process-default registries over one tiny HTTP server:
   GET /debug/profile         the process profiler's collapsed-stack table
                              (?format=collapsed for raw flamegraph input,
                              ?limit=N keeps the heaviest N stacks)
+  GET /debug/history         the process history ring: retained per-series
+                             time series sampled from /metrics
+                             (lws_tpu/obs/history.py; ?limit=N bounds the
+                             series list, same 400 contract as the rest)
   GET  /debug/faults         armed fault points + hit/trip counters
   POST /debug/faults         arm/disarm fault schedules in this process
                              ({"arm": {point: spec}}, {"disarm": [...]},
@@ -81,6 +85,7 @@ class TelemetryServer:
         from lws_tpu.core import resilience as resmod
         from lws_tpu.core import slo as slomod
         from lws_tpu.core import trace as tracemod
+        from lws_tpu.obs import history as historymod
 
         self.watchdog = watchdog
         outer = self
@@ -123,8 +128,13 @@ class TelemetryServer:
                     # quiet engine must not advertise stale attainment.
                     profmod.record_device_memory()
                     slomod.RECORDER.refresh()
+                    text = metricsmod.REGISTRY.render()
+                    # The scrape opportunistically feeds the history ring
+                    # (interval-gated), so history accrues at scrape
+                    # cadence even without the sampling thread.
+                    historymod.HISTORY.ingest_if_due(text)
                     body, ctype = metricsmod.negotiate_exposition(
-                        metricsmod.REGISTRY.render(), self.headers.get("Accept")
+                        text, self.headers.get("Accept")
                     )
                     self._send(200, body, ctype)
                 elif path == "/debug/profile":
@@ -161,6 +171,16 @@ class TelemetryServer:
                         return
                     snapshot = frmod.debug_snapshot(limit, outer.watchdog)
                     self._send(200, json.dumps(snapshot, default=str),
+                               "application/json")
+                elif path == "/debug/history":
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": f"bad limit: {e}"}),
+                                   "application/json")
+                        return
+                    self._send(200,
+                               json.dumps(historymod.HISTORY.snapshot(limit)),
                                "application/json")
                 elif path == "/debug/faults":
                     self._send(200, json.dumps(faultsmod.INJECTOR.snapshot()),
@@ -228,6 +248,11 @@ def start_from_env() -> Optional[TelemetryServer]:
     if not raw:
         return None
     profmod.start_from_env()
+    # History ring sampling thread (LWS_TPU_HISTORY_INTERVAL_S; 0 disables
+    # — the /metrics handler still feeds the ring per scrape).
+    from lws_tpu.obs import history as history_env
+
+    history_env.start_from_env()
     server = TelemetryServer(
         port=int(raw),
         watchdog=Watchdog(),

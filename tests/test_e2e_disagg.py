@@ -407,6 +407,140 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
         for instance in by_role["prefill"] | by_role["decode"]:
             assert instance in frame, frame
 
+        # ISSUE 12 satellite: counter resets + series retirement across a
+        # REAL worker restart, as seen by the history plane. Sample the
+        # merged fleet exposition into a HistoryRing, kill the prefill
+        # worker through its own fault surface (exit at the next handoff),
+        # let the restart policy bring up a fresh process whose counters
+        # restart near zero, and sample again: no counter-backed window
+        # may yield a negative rate, and the dead process's retired
+        # class-labelled attainment series (PR 11's clear_gauge contract)
+        # must stay retired through the sampling cadence and the fleet
+        # cache TTL — frozen history, never current again.
+        if run_scenario:
+            from lws_tpu.obs import rate as history_rate
+            from lws_tpu.obs.history import HistoryRing
+
+            ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+            ring.ingest(fleet_text, now=0.0)
+            prefill_instance = next(iter(by_role["prefill"]))
+
+            def _ttft_count(fams):
+                acc = 0.0
+                for name, labels, value, _ in \
+                        fams.get("serving_ttft_seconds", {}).get("samples", []):
+                    if name == "serving_ttft_seconds_count" \
+                            and labels.get("instance") == prefill_instance:
+                        acc += value
+                return acc
+
+            def _prefill_wire_bytes(fams):
+                for name, labels, value, _ in \
+                        fams.get("serving_kv_transfer_bytes_total", {}).get("samples", []):
+                    if name == "serving_kv_transfer_bytes_total" \
+                            and labels.get("instance") == prefill_instance \
+                            and labels.get("role") == "prefill":
+                        return labels, value
+                return None, None
+
+            pre_count = _ttft_count(prod_fams)
+            assert pre_count > 1, prod_fams.get("serving_ttft_seconds")
+            _, pre_wire = _prefill_wire_bytes(prod_fams)
+            assert pre_wire, "prefill send leg never metered its wire bytes"
+            retired_keys = [
+                (name, tuple(sorted(labels.items())))
+                for name, labels, _, _pts, _ in ring.series("serving_slo_attainment")
+                if labels.get("instance") == prefill_instance
+                and labels.get("klass")
+            ]
+            assert retired_keys, "scenario left no class-labelled attainment"
+
+            arm = _json.dumps(
+                {"arm": {"disagg.prefill.handoff": "exit:1"}}
+            ).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{prefill_metrics}/debug/faults", data=arm,
+                headers={"Content-Type": "application/json"},
+            ), timeout=10) as resp:
+                assert resp.status == 200
+            # The next prompt kills prefill mid-handoff; the at-least-once
+            # resubmit contract delivers it through the restarted
+            # replacement (same pod name -> same instance label).
+            restart_deadline = time.time() + 120
+            prompt2 = np.array([3, 1, 4, 1, 5], dtype=np.int32)
+            result2 = None
+            while time.time() < restart_deadline and result2 is None:
+                backend.poll_all()
+                cp.run_until_stable()
+                try:
+                    kt.submit_prompt(endpoints["prefill"], "req-reset",
+                                     kt.arrays_to_bytes(prompt=prompt2))
+                except OSError:
+                    time.sleep(0.5)  # replacement still compiling/binding
+                    continue
+                poll_until = time.time() + 6
+                while time.time() < poll_until:
+                    backend.poll_all()
+                    try:
+                        got2 = kt.pull_result(endpoints["decode"], "req-reset")
+                    except OSError:
+                        got2 = None
+                    if got2 is not None:
+                        result2 = kt.bytes_to_arrays(got2[1])["tokens"]
+                        break
+                    time.sleep(0.5)
+            assert result2 is not None, "request never completed across restart"
+
+            # Wait out the fleet cache TTL until the scrape shows the
+            # REPLACEMENT (its ttft count restarted below the old one).
+            post_fams = None
+            while time.time() < restart_deadline:
+                with urllib.request.urlopen(fleet_req, timeout=10) as resp:
+                    new_text = resp.read().decode()
+                post_fams = parse_prod(new_text)
+                if 0 < _ttft_count(post_fams) < pre_count:
+                    break
+                time.sleep(1.1)  # collector cache TTL is 1s
+            else:
+                pytest.fail("restarted worker never re-entered the fleet scrape")
+            ring.ingest(new_text, now=10.0)
+
+            # (a) Reset awareness: every counter series' stored values are
+            # monotone and every window rates non-negative — including the
+            # series whose raw value just fell across the restart.
+            for name, labels, kind, pts, _last in ring.series():
+                if kind != "counter" or len(pts) < 2:
+                    continue
+                assert all(b >= a for (_, a), (_, b) in zip(pts, pts[1:])), \
+                    (name, labels, pts)
+                r = history_rate(pts, now=10.0)
+                assert r is not None and r >= 0.0, (name, labels, pts)
+            # The replacement's wire-bytes counter RAW value really fell
+            # (it restarted from zero and sent one bundle)...
+            wire_labels, post_wire = _prefill_wire_bytes(post_fams)
+            assert post_wire and post_wire < pre_wire, (pre_wire, post_wire)
+            # ...while the ring's reset-adjusted series kept rising.
+            reset_pts = ring.window("serving_kv_transfer_bytes_total",
+                                    wire_labels)
+            assert len(reset_pts) == 2 and reset_pts[1][1] > reset_pts[0][1], \
+                reset_pts
+
+            # (b) Retirement: the dead process's class-labelled attainment
+            # series are ABSENT from the post-restart scrape, absent from
+            # the ring's live set, and their retained tails froze at the
+            # pre-restart sample — history, never resurrected as current.
+            post_attain = {
+                (name, tuple(sorted(labels.items())))
+                for name, labels, _v, _ in
+                post_fams.get("serving_slo_attainment", {}).get("samples", [])
+            }
+            live = ring.live_keys()
+            for key in retired_keys:
+                assert key not in post_attain, key
+                assert key not in live, key
+                tail = ring.window(key[0], dict(key[1]))
+                assert tail and tail[-1][0] == 0.0, (key, tail)
+
         # Oracle: the same model end-to-end in one engine.
         from lws_tpu.serving.disagg_worker import build_engine
 

@@ -1,0 +1,839 @@
+"""Fleet time-series history plane (ISSUE 12): retained scrape rings
+(reset-aware, retention/cardinality-bounded), pure derived signals (rates,
+windowed quantiles, SRE-workbook multi-window burn), the dry-run scale
+recommender + edge-triggered burn alerts, the /debug/history surfaces, the
+`lws-tpu monitor`/`top` renders, and the deterministic end-to-end proof: a
+seeded flash-crowd scenario against a live engine drives attainment below
+target -> the fast-burn tier fires a Watchdog alert whose dump embeds the
+offending series window, `serving_scale_recommendation{role="decode"}`
+rises on the merged fleet exposition, and the opt-in annotation adapter
+feeds the stock AutoscalerReconciler to the recommended replica count."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lws_tpu import obs
+from lws_tpu.core import metrics, slo
+from lws_tpu.core.flightrecorder import FlightRecorder, Watchdog, default_rules
+from lws_tpu.core.metrics import MetricsRegistry, parse_exposition
+from lws_tpu.obs.history import HistoryRing
+from lws_tpu.obs.recommend import AnnotationAdapter, ScaleRecommender
+
+# A second-scale twin of the SRE windows: same thresholds, 1/100th wall.
+WINDOWS = tuple(w.scaled(0.05) for w in obs.DEFAULT_BURN_WINDOWS)
+
+
+def _counter_text(name: str, labels: dict, value: float) -> str:
+    reg = MetricsRegistry()
+    reg.inc(name, labels, value)  # vet-exempt: test fixture, not lws_tpu/
+    return reg.render()
+
+
+# ---------------------------------------------------------------------------
+# HistoryRing semantics
+
+
+def test_ring_counter_reset_never_negative():
+    """A restarted source's counter drops to (near) zero on the wire; the
+    ring's reset adjustment keeps the stored series monotone, so rate() and
+    increase() stay non-negative across the restart."""
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    labels = {"engine": "paged"}
+    ring.ingest(_counter_text("serving_requests_total", labels, 100.0), now=0.0)
+    ring.ingest(_counter_text("serving_requests_total", labels, 150.0), now=10.0)
+    # Restart: raw value falls back to 7.
+    ring.ingest(_counter_text("serving_requests_total", labels, 7.0), now=20.0)
+    pts = ring.window("serving_requests_total", labels)
+    assert [v for _, v in pts] == [100.0, 150.0, 157.0]
+    assert obs.rate(pts, now=20.0) == pytest.approx((150 + 7 - 100) / 20.0)
+    assert obs.increase(pts, window_s=15.0, now=20.0) == pytest.approx(7.0)
+
+
+def test_ring_retention_and_retirement():
+    """Points age out of the retention window; a series the source stopped
+    exposing freezes (absent from live_keys), then drops wholesale once its
+    tail ages out — retired series are never resurrected as current."""
+    ring = HistoryRing(interval_s=0.0, retention_s=30.0)
+    reg = MetricsRegistry()
+    reg.set("serving_slo_attainment", 0.5, {"engine": "paged"})
+    ring.ingest(reg.render(), now=0.0)
+    key = ("serving_slo_attainment", (("engine", "paged"),))
+    assert key in ring.live_keys()
+    # The source retired the series (clear_gauge): later ingests omit it.
+    reg.clear_gauge("serving_slo_attainment", {"engine": "paged"})
+    reg.set("serving_active_slots", 1.0, {"engine": "paged"})
+    ring.ingest(reg.render(), now=10.0)
+    assert key not in ring.live_keys()
+    # ...but the tail is retained (history, not current state) until the
+    # retention bound passes, then the whole series disappears.
+    assert ring.window("serving_slo_attainment", {"engine": "paged"})
+    ring.ingest(reg.render(), now=45.0)
+    assert ring.window("serving_slo_attainment", {"engine": "paged"}) == []
+    assert not ring.series("serving_slo_attainment")
+
+
+def test_ring_cardinality_cap_counts_drops():
+    own = MetricsRegistry()
+    ring = HistoryRing(interval_s=0.0, retention_s=60.0, max_series=2,
+                       metrics_registry=own)
+    reg = MetricsRegistry()
+    for i in range(5):
+        reg.inc("serving_requests_total", {"engine": f"e{i}"})
+    ring.ingest(reg.render(), now=0.0)
+    assert len(ring.series("serving_requests_total")) == 2
+    assert own.counter_value("lws_history_series_dropped_total") == 3.0
+    assert own.counter_value("lws_history_samples_total") == 1.0
+
+
+def test_ring_ingest_if_due_gates_on_interval():
+    ring = HistoryRing(interval_s=5.0, retention_s=60.0)
+    calls = []
+
+    def render():
+        calls.append(1)
+        return _counter_text("serving_requests_total", {}, float(len(calls)))
+
+    assert ring.ingest_if_due(render, now=0.0) is True
+    assert ring.ingest_if_due(render, now=2.0) is False  # inside the interval
+    assert ring.ingest_if_due(render, now=5.0) is True
+    assert len(calls) == 2  # the render thunk is only paid when due
+
+
+def test_ring_histogram_buckets_are_reset_aware_counters():
+    ring = HistoryRing(interval_s=0.0, retention_s=600.0)
+    reg = MetricsRegistry()
+    reg.observe("serving_ttft_seconds", 0.2, {"engine": "paged"})
+    ring.ingest(reg.render(), now=0.0)
+    reg.observe("serving_ttft_seconds", 3.0, {"engine": "paged"})
+    ring.ingest(reg.render(), now=10.0)
+    rows = ring.series("serving_ttft_seconds_bucket",
+                       {"engine": "paged", "le": "+Inf"})
+    assert len(rows) == 1
+    _, _, kind, pts, _ = rows[0]
+    assert kind == "counter"
+    assert [v for _, v in pts] == [1.0, 2.0]
+
+
+def test_ring_snapshot_roundtrip_seeds_a_client_ring():
+    """load_snapshot rebases server timestamps onto the client clock while
+    keeping relative spacing — the `lws-tpu top` first-frame seed path."""
+    server = HistoryRing(interval_s=0.0, retention_s=600.0)
+    labels = {"role": "prefill"}
+    server.ingest(_counter_text("serving_kv_transfer_bytes_total", labels, 1e6),
+                  now=1000.0)
+    server.ingest(_counter_text("serving_kv_transfer_bytes_total", labels, 3e6),
+                  now=1010.0)
+    snap = server.snapshot()
+    assert snap["series_total"] == 1
+    client = HistoryRing(interval_s=0.0, retention_s=600.0)
+    assert client.load_snapshot(snap, now=50.0) == 2
+    pts = client.window("serving_kv_transfer_bytes_total", labels)
+    assert [t for t, _ in pts] == [40.0, 50.0]
+    assert obs.rate(pts, now=50.0) == pytest.approx(2e5)
+
+
+def test_ring_seed_preserves_raw_state_across_server_resets():
+    """A seeded client ring must keep comparing raw-to-raw: the server
+    ring's ADJUSTED tail (raw 100 + offset 500 = 600) followed by a live
+    raw sample of 101 is +1 of growth, not a fresh reset worth +101."""
+    server = HistoryRing(interval_s=0.0, retention_s=600.0)
+    labels = {"engine": "paged"}
+    server.ingest(_counter_text("serving_requests_total", labels, 500.0), now=0.0)
+    server.ingest(_counter_text("serving_requests_total", labels, 100.0), now=10.0)
+    assert server.window("serving_requests_total", labels)[-1][1] == 600.0
+    client = HistoryRing(interval_s=0.0, retention_s=600.0)
+    client.load_snapshot(server.snapshot(), now=10.0)
+    client.ingest(_counter_text("serving_requests_total", labels, 101.0), now=11.0)
+    pts = client.window("serving_requests_total", labels)
+    assert [v for _, v in pts] == [500.0, 600.0, 601.0]
+    # ...and a REAL reset right after seeding still adjusts cleanly.
+    client.ingest(_counter_text("serving_requests_total", labels, 2.0), now=12.0)
+    assert client.window("serving_requests_total", labels)[-1][1] == 603.0
+
+
+def test_ring_ingest_if_due_claims_the_slot_atomically():
+    """Two threads crossing the interval boundary together must produce
+    ONE ingest (the handler runs on a ThreadingHTTPServer)."""
+    import threading
+
+    ring = HistoryRing(interval_s=5.0, retention_s=60.0)
+    text = _counter_text("serving_requests_total", {}, 1.0)
+    results = []
+    gate = threading.Barrier(2)
+
+    def hit():
+        gate.wait()
+        results.append(ring.ingest_if_due(text, now=10.0))
+
+    threads = [threading.Thread(target=hit) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == [False, True]
+    assert len(ring.window("serving_requests_total", {})) == 1
+
+
+# ---------------------------------------------------------------------------
+# Signals
+
+
+def test_rate_and_increase_need_two_points():
+    assert obs.rate([(0.0, 5.0)]) is None
+    assert obs.increase([(0.0, 5.0)]) is None
+    assert obs.rate([]) is None
+
+
+def test_rate_uses_observed_span_not_the_window():
+    """A skipped scrape widens the denominator instead of corrupting the
+    rate: 100 increments over 20 observed seconds is 5/s even when asked
+    about a 60s window."""
+    pts = [(0.0, 0.0), (20.0, 100.0)]
+    assert obs.rate(pts, window_s=60.0, now=20.0) == pytest.approx(5.0)
+
+
+def test_mean_is_time_weighted():
+    # 0.0 held for 9s, then 1.0 for 1s: the simple mean (0.5) would
+    # over-weight the late burst.
+    pts = [(0.0, 0.0), (9.0, 1.0), (10.0, 1.0)]
+    assert obs.mean(pts, now=10.0) == pytest.approx(0.1)
+
+
+def test_ewma_and_slope():
+    pts = [(float(t), float(t)) for t in range(10)]
+    assert obs.slope(pts) == pytest.approx(1.0)
+    smoothed = obs.ewma(pts, tau_s=1.0)
+    assert smoothed is not None and 7.0 < smoothed < 9.0
+    assert obs.slope([(0.0, 1.0)]) is None
+
+
+def test_quantile_over_window_recovers_after_bad_hour():
+    """The windowed quantile sags back once traffic improves — the lifetime
+    histogram can't, which is why the monitor uses this one."""
+    # Bad era (t<=10): 100 slow observations land past every finite bucket;
+    # good era (t>10): 200 fast observations land under 0.1s.
+    buckets = {
+        "0.1": [(0.0, 0.0), (10.0, 0.0), (20.0, 100.0), (30.0, 200.0)],
+        "1.0": [(0.0, 0.0), (10.0, 0.0), (20.0, 100.0), (30.0, 200.0)],
+        "+Inf": [(0.0, 0.0), (10.0, 100.0), (20.0, 200.0), (30.0, 300.0)],
+    }
+    lifetime = obs.quantile_over_window(buckets, 0.95, now=30.0)
+    recent = obs.quantile_over_window(buckets, 0.95, window_s=15.0, now=30.0)
+    assert lifetime > 0.5
+    assert recent <= 0.1
+
+
+def test_breach_fraction_grades_against_the_covering_bucket():
+    buckets = {
+        "0.5": [(0.0, 0.0), (10.0, 80.0)],
+        "1.0": [(0.0, 0.0), (10.0, 90.0)],
+        "+Inf": [(0.0, 0.0), (10.0, 100.0)],
+    }
+    # Target 1.0 -> covering bucket le=1.0 -> 10% breached.
+    assert obs.breach_fraction(buckets, 1.0, now=10.0) == pytest.approx(0.10)
+    # Target 0.7 falls between bounds -> conservative covering le=1.0.
+    assert obs.breach_fraction(buckets, 0.7, now=10.0) == pytest.approx(0.10)
+    # A target past every finite bucket: the widest bucket's observations
+    # are certainly within target; the open-ended tail stays counted.
+    assert obs.breach_fraction(buckets, 99.0, now=10.0) == pytest.approx(0.10)
+    assert obs.breach_fraction({}, 1.0, now=10.0) is None
+
+
+def _ledger(points):
+    """(good, total) point lists from [(t, good_cum, total_cum)]."""
+    return ([(t, g) for t, g, _ in points], [(t, tot) for t, _, tot in points])
+
+
+def test_multiwindow_burn_blip_does_not_fire_sustained_does():
+    """The SRE AND-of-two-windows: a 15s blip of 100% errors inside an
+    otherwise healthy long window burns the short window hot but not the
+    long one — no page. Sustained errors burn both."""
+    fast = WINDOWS[0]  # short 15s, long 180s at threshold 14.4
+    # Blip: healthy, regularly-sampled traffic (50 tok/s all on time),
+    # then 15s of all-bad — the short window burns hot, the long window
+    # dilutes the blip below threshold.
+    blip_pts = [(t, t * 50.0, t * 50.0) for t in range(0, 181, 30)]
+    blip_pts.append((195.0, 9000.0, 9500.0))
+    good, total = _ledger(blip_pts)
+    verdicts = obs.multiwindow_burn(good, total, 0.99, WINDOWS, now=195.0)
+    fast_v = verdicts[0]
+    assert fast_v.short_burn == pytest.approx(100.0)
+    assert fast_v.long_burn < fast.threshold
+    assert not fast_v.firing
+    # Sustained: the whole long window is all-bad.
+    bad_pts = [(t, 0.0, t * 50.0) for t in range(0, 181, 30)]
+    bad_pts.append((195.0, 0.0, 9500.0))
+    good, total = _ledger(bad_pts)
+    verdicts = obs.multiwindow_burn(good, total, 0.99, WINDOWS, now=195.0)
+    assert verdicts[0].firing
+    assert verdicts[0].short_burn == pytest.approx(100.0)
+
+
+def test_burn_window_scale_env(monkeypatch):
+    monkeypatch.setenv("LWS_TPU_BURN_WINDOW_SCALE", "0.01")
+    ws = obs.burn_windows()
+    assert ws[0].short_s == pytest.approx(3.0)
+    assert ws[0].long_s == pytest.approx(36.0)
+    assert ws[0].threshold == 14.4  # thresholds are scale-free
+    monkeypatch.delenv("LWS_TPU_BURN_WINDOW_SCALE")
+    assert obs.burn_windows() == obs.DEFAULT_BURN_WINDOWS
+
+
+def test_burn_from_gauge_series():
+    err = [(0.0, 0.0), (10.0, 0.5), (20.0, 0.5)]
+    burn = obs.burn_rate_from_gauge(err, 0.95, window_s=10.0, now=20.0)
+    assert burn == pytest.approx(10.0)  # 50% errors / 5% budget
+
+
+# ---------------------------------------------------------------------------
+# Recommender
+
+
+def _burning_ring(now_span=195.0):
+    """A ring whose decode-side ITL histogram breaches hard and whose
+    goodput ledger burns both fast windows, plus calm prefill series."""
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    # Cumulative snapshots, all-bad from the start: the token ledger grows
+    # with ZERO goodput (an all-late workload never increments the goodput
+    # counter at all — the recommender must read absence as zero, not as
+    # no-signal), and every ITL observation lands 50x over target.
+    acc_total, acc_itl = 0.0, 0
+    for t in (0.0, 90.0, 180.0, now_span):
+        acc_total += 500.0
+        acc_itl += 10
+        cum = MetricsRegistry()
+        for _ in range(acc_itl):
+            cum.observe("serving_itl_seconds", 5.0, {"engine": "paged"})
+        cum.inc("serving_tokens_total", {"engine": "paged"}, acc_total)
+        ring.ingest(cum.render(), now=t)
+    return ring
+
+
+def test_recommender_scales_decode_on_itl_burn_and_publishes_gauges():
+    ring = _burning_ring()
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    rec = ScaleRecommender(
+        ring, targets=slo.SLOTargets(ttft_s=1.0, itl_s=0.1, queue_wait_s=0.5),
+        attainment_target=0.99, windows=WINDOWS,
+        current={"prefill": 1, "decode": 2}, max_replicas=8,
+        registry=reg, recorder=fr,
+    )
+    verdict = rec.evaluate(now=195.0)
+    # Every ITL observation is 5s against a 0.1s target: breach 1.0, burn
+    # 100x -> severity caps at 4x of current.
+    assert verdict.desired["decode"] == 8
+    assert verdict.desired["prefill"] == 1  # no prefill-side signal
+    assert "paged" in verdict.firing
+    assert reg.gauge_value("serving_scale_recommendation",
+                           {"role": "decode"}) == 8.0
+    assert reg.gauge_value("serving_scale_recommendation",
+                           {"role": "prefill"}) == 1.0
+    fast_burn = reg.gauge_value("serving_slo_burn_rate",
+                                {"engine": "paged", "window": "fast"})
+    assert fast_burn is not None and fast_burn >= 14.4
+
+
+def test_recommender_edge_triggered_watchdog_alert_with_window_in_dump():
+    """The firing edge produces ONE alert + dump per episode (the
+    circuit_open convention), and the dump's event ring carries the
+    offending error-series window — evidence, not just a verdict."""
+    ring = _burning_ring()
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    wd = Watchdog(recorder=fr, rules=default_rules())
+    rec = ScaleRecommender(ring, attainment_target=0.99, windows=WINDOWS,
+                           registry=reg, recorder=fr)
+    rec.evaluate(now=195.0)
+    firing = wd.check_now(now=196.0)
+    assert "burn_rate" in firing
+    assert metrics.REGISTRY.gauge_value(
+        "lws_watchdog_active", {"watchdog": "burn_rate"}) == 1.0
+    dump = wd.last_dump
+    assert dump is not None and dump["reason"] == "watchdog:burn_rate"
+    fired = [e for e in dump["events"] if e["kind"] == "burn_rate_fired"]
+    assert fired, dump["events"]
+    assert fired[0]["series"] == "paged"
+    assert fired[0]["error_window"], fired[0]
+    assert all(v >= 0.99 for _, v in fired[0]["error_window"])
+    # Steady firing: neither a second alert nor a second edge event.
+    rec.evaluate(now=200.0)
+    wd.check_now(now=201.0)
+    assert metrics.REGISTRY.counter_value(
+        "lws_watchdog_alerts_total", {"watchdog": "burn_rate"}) >= 1.0
+    assert len([e for e in fr.events() if e["kind"] == "burn_rate_fired"]) == 1
+    # The dump embeds a history snapshot alongside the usual surfaces.
+    assert "history" in dump
+
+
+def test_recommender_publishes_worst_instance_burn_not_last_write():
+    """On a fleet-fed ring the same (engine, klass) exists once per
+    instance: the published burn gauge must be the WORST instance's, never
+    whichever series happened to iterate last."""
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    for t in (0.0, 90.0, 180.0, 195.0):
+        reg = MetricsRegistry()
+        good_cal = 500.0 * (t / 195.0 * 3 + 1)
+        # w-calm delivers everything on time; w-hot delivers nothing on time.
+        reg.inc("serving_tokens_total",
+                {"engine": "paged", "instance": "w-calm"}, good_cal)
+        reg.inc("serving_goodput_tokens_total",
+                {"engine": "paged", "instance": "w-calm"}, good_cal)
+        reg.inc("serving_tokens_total",
+                {"engine": "paged", "instance": "w-hot"}, good_cal)
+        ring.ingest(reg.render(), now=t)
+    out = MetricsRegistry()
+    rec = ScaleRecommender(ring, attainment_target=0.99, windows=WINDOWS,
+                           registry=out, recorder=FlightRecorder())
+    verdict = rec.evaluate(now=195.0)
+    burn = out.gauge_value("serving_slo_burn_rate",
+                           {"engine": "paged", "window": "fast"})
+    assert burn is not None and burn >= 14.4, burn  # w-hot's 100x, not 0x
+    assert verdict.firing == ["paged"]  # one alert key, not one per instance
+    assert any(b["instance"] == "w-hot" and b["firing"] for b in verdict.burns
+               if b["window"] == "fast")
+
+
+def test_recommender_retires_burn_gauges_when_series_leave_the_ring():
+    """A burn gauge whose feeding goodput pair vanished (retired worker,
+    aged-out class) must retire, not freeze at its last value — the same
+    staleness contract core/slo.py applies to attainment."""
+    ring = _burning_ring()
+    reg = MetricsRegistry()
+    rec = ScaleRecommender(ring, attainment_target=0.99, windows=WINDOWS,
+                           registry=reg, recorder=FlightRecorder())
+    rec.evaluate(now=195.0)
+    labels = {"engine": "paged", "window": "fast"}
+    assert reg.gauge_value("serving_slo_burn_rate", labels) is not None
+    ring.clear()
+    rec.evaluate(now=200.0)
+    assert reg.gauge_value("serving_slo_burn_rate", labels) is None
+
+
+def test_recommender_kv_occupancy_bumps_decode_without_burn():
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    for t, live in ((0.0, 80.0), (5.0, 88.0), (10.0, 92.0)):
+        reg = MetricsRegistry()
+        reg.set("serving_kv_pool_blocks", live, {"engine": "paged", "state": "live"})
+        reg.set("serving_kv_pool_blocks", 100.0 - live,
+                {"engine": "paged", "state": "free"})
+        reg.set("serving_kv_pool_blocks", 0.0, {"engine": "paged", "state": "parked"})
+        ring.ingest(reg.render(), now=t)
+    rec = ScaleRecommender(ring, windows=WINDOWS, current={"decode": 2},
+                           registry=MetricsRegistry(), recorder=FlightRecorder())
+    verdict = rec.evaluate(now=10.0)
+    assert verdict.desired["decode"] == 3
+    assert "occupancy" in verdict.reasons["decode"]
+
+
+def test_recommender_scales_in_one_step_when_calm_and_never_on_no_data():
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    # Calm: plenty of observations (dense enough that even the 15s short
+    # window holds two samples), all within target.
+    for t, n in ((0.0, 1), (90.0, 50), (180.0, 80), (190.0, 90), (195.0, 100)):
+        reg = MetricsRegistry()
+        for _ in range(max(1, n)):
+            reg.observe("serving_itl_seconds", 0.001, {"engine": "paged"})
+        reg.set("serving_kv_pool_blocks", 5.0, {"engine": "paged", "state": "live"})
+        reg.set("serving_kv_pool_blocks", 95.0, {"engine": "paged", "state": "free"})
+        reg.set("serving_kv_pool_blocks", 0.0, {"engine": "paged", "state": "parked"})
+        ring.ingest(reg.render(), now=t)
+    rec = ScaleRecommender(ring, windows=WINDOWS,
+                           current={"prefill": 3, "decode": 3},
+                           registry=MetricsRegistry(), recorder=FlightRecorder())
+    verdict = rec.evaluate(now=195.0)
+    assert verdict.desired["decode"] == 2  # one step, not a cliff
+    # No data at all: recommendation holds — absence of data is not calm.
+    empty = ScaleRecommender(HistoryRing(interval_s=0.0), windows=WINDOWS,
+                             current={"prefill": 3, "decode": 3},
+                             registry=MetricsRegistry(),
+                             recorder=FlightRecorder())
+    hold = empty.evaluate(now=0.0)
+    assert hold.desired == {"prefill": 3, "decode": 3}
+    assert hold.reasons["decode"] == "no signal"
+
+
+def test_default_recommender_syncs_current_from_store_ds_roles():
+    """The auto-evaluated process recommender must scale from the fleet's
+    REAL per-role width, not a hardcoded baseline of 1."""
+    from lws_tpu.api.disagg import (
+        DisaggregatedRoleSpec,
+        DisaggregatedSet,
+        DisaggregatedSetSpec,
+    )
+    from lws_tpu.core.store import Store, new_meta
+    from lws_tpu.obs import recommend as recmod
+
+    store = Store()
+    store.create(DisaggregatedSet(
+        meta=new_meta("pair"),
+        spec=DisaggregatedSetSpec(roles=[
+            DisaggregatedRoleSpec(name="prefill", replicas=2),
+            DisaggregatedRoleSpec(name="decode", replicas=5),
+        ]),
+    ))
+    assert recmod.role_replicas_from_store(store) == {"prefill": 2,
+                                                      "decode": 5}
+    rec = recmod.default_recommender(store)
+    try:
+        assert rec.current["decode"] == 5
+        assert rec.current["prefill"] == 2
+    finally:
+        recmod.RECOMMENDER = None  # don't leak the baseline across tests
+
+
+# ---------------------------------------------------------------------------
+# The opt-in actuation seam
+
+
+def test_annotation_adapter_feeds_stock_autoscaler_to_recommended_count():
+    from lws_tpu.api.autoscaler import Autoscaler, AutoscalerSpec
+    from lws_tpu.core.store import new_meta
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.testing import LWSBuilder
+
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).build())
+    cp.create(Autoscaler(
+        meta=new_meta("rec-asc"),
+        spec=AutoscalerSpec(
+            target="sample", min_replicas=1, max_replicas=6,
+            metric="scale_recommendation", target_value=1.0,
+            scale_down_stabilization=2,
+        ),
+    ))
+    cp.run_until_stable()
+    adapter = AnnotationAdapter(cp.store, "default", "sample")
+    assert adapter.publish(4) == 1  # one ready leader annotated
+    cp.run_until_stable()
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    assert lws.spec.replicas == 4
+    # The normalization holds at the new width: every leader reports
+    # desired/n, so the HPA math reproduces the recommendation, not n x it.
+    assert adapter.publish(4) == 4
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 4
+    # Scale-in rides the controller's own stabilization guardrail.
+    assert adapter.publish(2) == 4
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 4
+    adapter.publish(2)
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 2
+
+
+def test_annotation_adapter_exact_on_awkward_float_pairs():
+    """(desired=25, n=11): a bare desired/n share makes the HPA ceil land
+    on 26 (float round-trip epsilon); the half-offset share must reproduce
+    the recommendation exactly at every width."""
+    from lws_tpu.api.autoscaler import Autoscaler, AutoscalerSpec
+    from lws_tpu.core.store import new_meta
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.testing import LWSBuilder
+
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(11).size(1).build())
+    cp.create(Autoscaler(
+        meta=new_meta("rec-asc"),
+        spec=AutoscalerSpec(
+            target="sample", min_replicas=1, max_replicas=40,
+            metric="scale_recommendation", target_value=1.0,
+        ),
+    ))
+    cp.run_until_stable()
+    adapter = AnnotationAdapter(cp.store, "default", "sample")
+    assert adapter.publish(25) == 11
+    cp.run_until_stable()
+    assert cp.store.get("LeaderWorkerSet", "default", "sample").spec.replicas == 25
+
+
+# ---------------------------------------------------------------------------
+# /debug/history surfaces
+
+
+def test_worker_telemetry_serves_history_with_limit_and_token_parity():
+    from lws_tpu.obs import history as historymod
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    historymod.HISTORY.clear()
+    historymod.HISTORY.ingest(
+        _counter_text("serving_requests_total", {"engine": "paged"}, 3.0),
+        now=0.0,
+    )
+    server = TelemetryServer(port=0, token="s3cret")
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/history", timeout=10)
+        assert err.value.code == 401  # bearer-gating parity
+        req = urllib.request.Request(
+            f"{base}/debug/history?limit=8",
+            headers={"Authorization": "Bearer s3cret"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        names = {s["name"] for s in body["series"]}
+        assert "serving_requests_total" in names
+        assert body["retention_s"] > 0
+        bad = urllib.request.Request(
+            f"{base}/debug/history?limit=wat",
+            headers={"Authorization": "Bearer s3cret"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=10)
+        assert err.value.code == 400  # parse_limit parity
+    finally:
+        server.stop()
+
+
+def test_api_server_serves_history_and_fleet_scrape_feeds_the_ring():
+    from lws_tpu.obs import history as historymod
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+
+    historymod.HISTORY.clear()
+    cp = ControlPlane(auto_ready=True)
+    api = ApiServer(cp, port=0)
+    api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    try:
+        # With a fleet collector wired, /metrics does NOT feed the ring
+        # (two sources racing one interval gate would starve each other) —
+        # the fleet scrape is the control plane's one history source, and
+        # each fresh ingest also evaluates the default dry-run recommender
+        # so the recommendation gauges exist on the NEXT scrape.
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+        assert historymod.HISTORY.last_ingest_age() is None
+        with urllib.request.urlopen(f"{base}/metrics/fleet", timeout=10) as resp:
+            assert resp.status == 200
+        assert historymod.HISTORY.last_ingest_age() is not None
+        # The ingest evaluated the default recommender: the decision gauge
+        # is in the process registry and rides the next FRESH fleet render
+        # (the served text above predates it by construction — it was
+        # rendered before the evaluation ran).
+        assert metrics.REGISTRY.gauge_value(
+            "serving_scale_recommendation", {"role": "decode"}) is not None
+        assert "serving_scale_recommendation" in cp.fleet.render_fleet(force=True)
+        with urllib.request.urlopen(f"{base}/debug/history?limit=0", timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["series_total"] > 0
+        assert body["series"] == []  # limit=0 keeps the body bounded
+        assert body["truncated"] == body["series_total"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/history?limit=-1", timeout=10)
+        assert err.value.code == 400
+    finally:
+        api.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI renders
+
+
+def _fleet_fams(rec_reg: MetricsRegistry) -> dict:
+    return parse_exposition(rec_reg.render())
+
+
+def test_render_monitor_sparklines_burn_and_recommendation():
+    from lws_tpu.cli import render_monitor
+
+    ring = HistoryRing(interval_s=0.0, retention_s=600.0)
+    reg = MetricsRegistry()
+    for t, v in ((0.0, 0.0), (10.0, 100.0), (20.0, 400.0)):
+        cum = MetricsRegistry()
+        cum.inc("serving_tokens_total", {"engine": "paged"}, v or 0.001)
+        cum.set("serving_active_slots", t / 10.0, {"engine": "paged"})
+        ring.ingest(cum.render(), now=t)
+    reg.set("serving_slo_burn_rate", 20.0,
+            {"engine": "paged", "klass": "chat", "window": "fast"})
+    reg.set("serving_scale_recommendation", 3.0, {"role": "decode"})
+    frame = render_monitor(
+        ring.snapshot(), _fleet_fams(reg),
+        alerts={"burn_rate": [{"source": "burn_rate:paged/chat"}]},
+        now=20.0,
+    )
+    assert frame.startswith("MONITOR")
+    assert "ALERT burn_rate" in frame
+    assert "decode=3" in frame
+    assert "20.0x" in frame  # the burn column
+    assert "serving_tokens_total" in frame
+    assert any(ch in frame for ch in "▁▂▃▄▅▆▇█")  # sparklines rendered
+
+
+def test_top_first_frame_rates_from_seeded_history():
+    """Satellite: `lws-tpu top --watch` frame 1 — KV_MB/S and GOOD% derive
+    from the HistoryRing (seeded from the server's /debug/history), so the
+    first rendered frame is never blank."""
+    from lws_tpu.cli import _top_rows, history_rates, render_top
+
+    server = HistoryRing(interval_s=0.0, retention_s=600.0)
+    for t, kv, good, tot, disp in ((0.0, 0.0, 0.0, 0.0, 0.0),
+                                   (10.0, 20e6, 900.0, 1000.0, 40.0)):
+        reg = MetricsRegistry()
+        reg.inc("serving_kv_transfer_bytes_total",
+                {"instance": "w0", "role": "prefill"}, kv or 1e-9)
+        reg.inc("serving_tokens_total",
+                {"instance": "w0", "engine": "disagg"}, tot or 1e-9)
+        reg.inc("serving_goodput_tokens_total",
+                {"instance": "w0", "engine": "disagg"}, good or 1e-9)
+        reg.observe("serving_decode_dispatch_duration_seconds", 0.01,
+                    {"instance": "w0", "engine": "disagg"})
+        for _ in range(int(disp)):
+            reg.observe("serving_decode_dispatch_duration_seconds", 0.01,
+                        {"instance": "w0", "engine": "disagg"})
+        server.ingest(reg.render(), now=t)
+    # The client ring seeds from the server snapshot BEFORE its first
+    # fleet fetch — one fetch later it renders real rates.
+    client = HistoryRing(interval_s=0.0, retention_s=600.0)
+    assert client.load_snapshot(server.snapshot(), now=100.0) > 0
+    reg = MetricsRegistry()
+    reg.inc("serving_kv_transfer_bytes_total",
+            {"instance": "w0", "role": "prefill"}, 20e6)
+    reg.inc("serving_tokens_total", {"instance": "w0", "engine": "disagg"}, 1000.0)
+    reg.inc("serving_goodput_tokens_total",
+            {"instance": "w0", "engine": "disagg"}, 900.0)
+    reg.observe("serving_decode_dispatch_duration_seconds", 0.01,
+                {"instance": "w0", "engine": "disagg"})
+    text = reg.render()
+    client.ingest(text, now=100.0)
+    fams = parse_exposition(text)
+    rates = history_rates(client, now=100.0, window_s=600.0)
+    rows = _top_rows(fams)
+    frame = render_top(fams, rows=rows, rates=rates)
+    line = next(ln for ln in frame.splitlines() if ln.startswith("w0"))
+    assert "2.0" in line       # KV_MB/S: 20 MB over 10s
+    assert "90%" in line       # GOOD% from the windowed ledger
+    # Without history the same first frame would dash both columns.
+    blank = next(ln for ln in render_top(fams, rows=rows).splitlines()
+                 if ln.startswith("w0"))
+    assert blank.rstrip().endswith("-")
+
+
+# ---------------------------------------------------------------------------
+# The end-to-end proof (ISSUE 12 acceptance): flash crowd -> burn alert ->
+# recommendation on the fleet surface -> adapter feeds the stock autoscaler.
+
+
+def test_flash_crowd_drives_burn_alert_recommendation_and_autoscaler():
+    import numpy as np
+
+    from lws_tpu import loadgen
+    from lws_tpu.api.autoscaler import Autoscaler, AutoscalerSpec
+    from lws_tpu.core.store import new_meta
+    from lws_tpu.obs import history as historymod
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.testing import LWSBuilder
+
+    historymod.HISTORY.clear()
+    ring = historymod.HISTORY
+
+    # A seeded flash-crowd scenario with UNMEETABLE targets: every token is
+    # late by construction, so attainment lands below any target and the
+    # goodput ledger burns its whole budget — deterministically.
+    spec = loadgen.load_scenario("flash_crowd")
+    for c in spec["classes"]:
+        c["targets"] = {"ttft_s": 1e-4, "itl_s": 1e-6, "queue_wait_s": 1e-4}
+    schedule = loadgen.build_schedule(spec, seed=7)
+    assert loadgen.schedule_digest(schedule) == \
+        loadgen.schedule_digest(loadgen.build_schedule(spec, seed=7))
+    targets = loadgen.install_class_targets(spec)
+    try:
+        target = loadgen.build_local_target("paged", spec)
+        # Warm one request per class BEFORE the baseline sample: every SLO
+        # series must exist at t=0 so the window's deltas are the crowd's
+        # alone (a counter born mid-window carries no first delta).
+        warm = [
+            loadgen.ScheduledRequest(index=i, arrival_s=0.0, klass=klass,
+                                     prompt=np.array([5, 6, 7 + i], np.int32),
+                                     max_new_tokens=2)
+            for i, klass in enumerate(("chat", "premium"))
+        ]
+        warm_result = loadgen.run_schedule(warm, target, max_wall_s=30.0)
+        assert all(o.completed for o in warm_result.outcomes)
+        ring.ingest(metrics.REGISTRY.render(), now=0.0)  # pre-crowd baseline
+        result = loadgen.run_schedule(schedule, target, max_wall_s=90.0)
+        report = loadgen.summarize(result, targets, spec["horizon_s"],
+                                   "flash_crowd", 7)
+        assert report["all"]["completed"] == len(schedule)
+        assert report["all"]["attainment"] == 0.0  # below target, hard
+        ring.ingest(metrics.REGISTRY.render(), now=195.0)
+
+        # Attainment on the live registry really sits below target.
+        att = metrics.REGISTRY.gauge_value(
+            "serving_slo_attainment", {"engine": "paged", "klass": "chat"})
+        assert att is not None and att < 0.99
+
+        fr = FlightRecorder()
+        wd = Watchdog(recorder=fr, rules=default_rules())
+        # The wall-scale SRE windows: the two injected sample times (0,
+        # 195) both sit inside the 5m fast-short window, so the whole run
+        # IS the window — deterministic regardless of how fast the engine
+        # actually drained it.
+        rec = ScaleRecommender(
+            ring, class_targets=targets, attainment_target=0.99,
+            windows=obs.DEFAULT_BURN_WINDOWS,
+            current={"prefill": 1, "decode": 1},
+            max_replicas=6, recorder=fr,
+        )
+        verdict = rec.evaluate(now=195.0)
+
+        # 1. The fast-burn tier fires an edge-triggered Watchdog alert
+        #    whose dump embeds the offending series window.
+        assert any(k.startswith("paged") for k in verdict.firing), verdict
+        firing = wd.check_now(now=196.0)
+        assert "burn_rate" in firing
+        dump = wd.last_dump
+        fired = [e for e in dump["events"] if e["kind"] == "burn_rate_fired"]
+        assert fired and fired[0]["error_window"], fired
+        assert all(v > 0.9 for _, v in fired[0]["error_window"])
+        assert "history" in dump  # the ring itself rides the dump
+
+        # 2. The recommendation rises and rides the MERGED fleet
+        #    exposition (the recommender publishes into the process
+        #    registry, exactly like every other sensor).
+        assert verdict.desired["decode"] > 1
+        merged = metrics.merge_expositions([
+            ({"instance": "engine-0", "role": "decode"},
+             metrics.REGISTRY.render()),
+        ])
+        fams = parse_exposition(merged)
+        rec_samples = {
+            labels.get("role"): value
+            for name, labels, value, _ in
+            fams["serving_scale_recommendation"]["samples"]
+            if name == "serving_scale_recommendation"
+        }
+        assert rec_samples["decode"] == float(verdict.desired["decode"])
+        assert rec_samples["decode"] > 1.0
+        burn_samples = [
+            value for name, labels, value, _ in
+            fams["serving_slo_burn_rate"]["samples"]
+            if name == "serving_slo_burn_rate" and labels.get("window") == "fast"
+        ]
+        assert burn_samples and max(burn_samples) >= 14.4
+
+        # 3. The opt-in annotation adapter feeds the stock
+        #    AutoscalerReconciler to the recommended count, store-backed.
+        cp = ControlPlane(auto_ready=True)
+        cp.create(LWSBuilder().replicas(1).size(1).build())
+        cp.create(Autoscaler(
+            meta=new_meta("rec-asc"),
+            spec=AutoscalerSpec(
+                target="sample", min_replicas=1, max_replicas=6,
+                metric="scale_recommendation", target_value=1.0,
+            ),
+        ))
+        cp.run_until_stable()
+        adapter = AnnotationAdapter(cp.store, "default", "sample")
+        assert adapter.publish(verdict.desired["decode"]) == 1
+        cp.run_until_stable()
+        lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+        assert lws.spec.replicas == verdict.desired["decode"]
+    finally:
+        slo.RECORDER.set_class_targets({})
+        historymod.HISTORY.clear()

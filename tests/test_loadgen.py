@@ -514,6 +514,109 @@ def test_top_goodput_column_and_by_class_rows():
 
 
 # ---------------------------------------------------------------------------
+# History plane integration (ISSUE 12): a run samples the live surface into
+# a HistoryRing and the report appends peak burn + the recommendation trace.
+
+
+def test_run_samples_history_and_report_appends_burn_trace(small_engine):
+    """A committed scenario driven with the on_tick sampler: the ring fills
+    DURING the run, fold_history grades each class's fast-window burn over
+    the run, and the rendered report carries the HISTORY block plus the
+    dry-run recommendation trace."""
+    from lws_tpu.obs.history import HistoryRing
+
+    spec = loadgen.load_scenario("steady_poisson")
+    schedule = loadgen.build_schedule(spec, seed=11)
+    targets = loadgen.class_targets(spec)
+    target = loadgen.EngineTarget(small_engine, "paged")
+    # Warm one request per class so every ledger series exists before the
+    # first ring sample (a counter born at the run's LAST sample has one
+    # point and no burn — this keeps the fold deterministic on any
+    # machine speed), and take a final post-drain sample for the same
+    # reason: every series ends with at least two points.
+    warm = [loadgen.ScheduledRequest(index=i, arrival_s=0.0, klass=k,
+                                     prompt=np.array([5, 6, 7 + i], np.int32),
+                                     max_new_tokens=2)
+            for i, k in enumerate(("chat", "batch"))]
+    warm_result = loadgen.run_schedule(warm, target, max_wall_s=30.0)
+    assert all(o.completed for o in warm_result.outcomes)
+    ring = HistoryRing(interval_s=0.05, retention_s=3600.0)
+    result = loadgen.run_schedule(
+        schedule, target, max_wall_s=90.0,
+        on_tick=lambda _now: ring.ingest_if_due(metrics.REGISTRY.render),
+    )
+    ring.ingest(metrics.REGISTRY.render())
+    assert ring.series(), "the drive loop never sampled the ring"
+    report = loadgen.summarize(result, targets, spec["horizon_s"],
+                               "steady_poisson", 11)
+    report["history"] = loadgen.fold_history(ring, targets)
+    classes = report["history"]["classes"]
+    # Both committed classes flowed through the ring's goodput series.
+    assert {"paged/chat", "paged/batch"} <= set(classes), classes
+    for key in ("paged/chat", "paged/batch"):
+        assert classes[key]["peak_fast_burn"] is not None
+        assert classes[key]["peak_fast_burn"] >= 0.0
+    trace = report["history"]["recommendation"]
+    assert trace, "the recommendation trace must record its first verdict"
+    assert set(trace[0]["desired"]) == {"prefill", "decode"}
+    frame = loadgen.render_report(report)
+    assert "HISTORY" in frame
+    assert "paged/chat" in frame
+    assert "recommendation @" in frame
+
+
+@pytest.mark.slow  # builds its own engine: covered by `make test`/`make check`
+def test_cmd_loadgen_server_appends_history_block(tmp_path, capsys):
+    """`lws-tpu loadgen SCENARIO --server` samples that server's
+    /metrics/fleet into a HistoryRing for the run's duration and the final
+    report appends the history block — end to end through the CLI against
+    a live (stub) fleet surface."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from lws_tpu import cli
+
+    hits = {"n": 0}
+
+    class StubFleet(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            hits["n"] += 1
+            body = (
+                "# HELP serving_tokens_total t\n"
+                "# TYPE serving_tokens_total counter\n"
+                f'serving_tokens_total{{engine="disagg",klass="chat",instance="w0"}} {100.0 * hits["n"]}\n'
+                "# HELP serving_goodput_tokens_total g\n"
+                "# TYPE serving_goodput_tokens_total counter\n"
+                f'serving_goodput_tokens_total{{engine="disagg",klass="chat",instance="w0"}} {90.0 * hits["n"]}\n'
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), StubFleet)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        rc = cli.main([
+            "loadgen", "steady_poisson", "--seed", "3", "--target", "paged",
+            "--max-wall", "60",
+            "--server", f"127.0.0.1:{httpd.server_port}",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert hits["n"] >= 2, "the run never sampled the fleet surface"
+        assert "HISTORY" in out
+        assert "disagg/chat" in out
+        assert "recommendation @" in out
+    finally:
+        httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # CLI
 
 

@@ -216,6 +216,30 @@ def test_spans_rules_cover_loadgen_package():
         assert not f.detail.startswith("ok_"), f
 
 
+def test_spans_rules_cover_obs_package():
+    """lws_tpu/obs/ is INSIDE the catalogue scope: the history plane's
+    decision metrics (`serving_scale_recommendation`,
+    `serving_slo_burn_rate`) are exactly the names dashboards and the
+    autoscaler seam are built against — a recommender minting per-role or
+    per-window names dynamically would evade the catalogue contract."""
+    found = run_pass(
+        "spans",
+        [FIXTURES / "lws_tpu" / "obs" / "signal_cases.py"],
+        root=FIXTURES,
+    )
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert any("bad_role_metric" in f.detail
+               for f in by_rule.get("metric-name-literal", [])), found
+    assert any("bad_window_span" in f.detail
+               for f in by_rule.get("span-name-literal", [])), found
+    assert any("bad_unentered_span" in f.detail
+               for f in by_rule.get("span-context-manager", [])), found
+    for f in found:
+        assert not f.detail.startswith("ok_"), f
+
+
 def test_spans_name_rules_scoped_to_catalogue_source():
     """The same file OUTSIDE an lws_tpu/ root only keeps the context-
     manager rule — test code can't pollute the metrics catalogue."""

@@ -1,0 +1,42 @@
+"""Fixture: span/metric hygiene inside the obs package. Lives under a fake
+lws_tpu/obs/ root (the self-tests pass root=tests/vet_fixtures) because the
+history/recommendation plane emits the decision metrics dashboards page on
+(`serving_scale_recommendation`, `serving_slo_burn_rate`) — a recommender
+that minted per-role or per-window names dynamically would be the one
+decision surface the catalogue checker can't audit."""
+
+from lws_tpu.core import metrics, trace
+
+ROLE = "decode"
+WINDOW = "fast"
+
+
+def bad_role_metric():
+    # Building the gauge name from the role would fragment the catalogue:
+    # every role would mint its own ungreppable family instead of riding
+    # the `role` label.
+    metrics.set("serving_scale_recommendation_" + ROLE, 2.0)
+
+
+def bad_window_span(name):
+    with trace.span(name):
+        return None
+
+
+def bad_unentered_span():
+    leak = trace.span("obs.evaluate")
+    return leak is not None
+
+
+def ok_role_metric():
+    metrics.set("serving_scale_recommendation", 2.0, {"role": ROLE})
+
+
+def ok_window_metric():
+    metrics.set("serving_slo_burn_rate", 1.5,
+                {"engine": "paged", "window": WINDOW})
+
+
+def ok_entered_span():
+    with trace.span("obs.evaluate", window=WINDOW):
+        return None
