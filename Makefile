@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead clean
 
 test:
 	python -m pytest tests/ -q
@@ -33,7 +33,10 @@ bench-scenarios:  ## committed loadgen scenarios must stay above their attainmen
 bench-history-overhead:  ## history-ring sampling at the default interval must cost <2% decode throughput (budget json)
 	python benchmarks/history_overhead_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead  ## what CI would run (vet gates before tests)
+bench-journey-overhead:  ## the journey vault's span listener must cost <2% decode throughput (budget json)
+	python benchmarks/journey_overhead_bench.py --check
+
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

@@ -1,0 +1,197 @@
+"""Journey-vault overhead microbench: the always-on guarantee for the
+tail-sampled trace vault (lws_tpu/obs/journey.py).
+
+The vault's recurring cost to a serving process is its span finish
+listener: every finished span pays one `JourneyVault.on_span` call (a lock,
+a dict lookup, an append — plus an LRU eviction in the worst case where
+every span opens a novel trace at capacity). The acceptance line is <2% of
+paged decode throughput with the vault installed at default sampling. Like
+the profile and history benches, an end-to-end A/B flaps an order of
+magnitude above the gated effect, so this bench measures the deterministic
+decomposition instead:
+
+  * spans per dispatch — counted with a listener over real `step_n(1)`
+    dispatches (tracing on, the production worker shape);
+  * per-span vault cost — the median `on_span` wall time WHILE a real
+    paged decode workload runs on a background thread (registry churn +
+    GIL contention = the serving shape), fed novel trace ids with the
+    open-trace LRU at capacity so every call pays the eviction too
+    (conservative);
+  * decode dispatch cost — the median `step_n(1)` wall time, the scale.
+
+overhead = spans_per_dispatch x per_span_cost / dispatch_cost.
+
+Run:    python benchmarks/journey_overhead_bench.py            # report only
+CI:     python benchmarks/journey_overhead_bench.py --check    # enforce
+The budget lives in benchmarks/journey_overhead_budget.json (same contract
+shape as history_overhead_budget.json; wired into `make check`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LWS_TPU_TRACE", "1")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from lws_tpu.core import trace  # noqa: E402
+from lws_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from lws_tpu.obs.journey import JourneyVault  # noqa: E402
+from lws_tpu.serving.paged_engine import PagedBatchEngine  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "journey_overhead_budget.json")
+
+
+def build_engine():
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=2048, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    # pipeline_depth=0: each step_n(1) contains its own chunk's device
+    # compute, so the dispatch median reported for scale is a whole chunk
+    # (same reasoning as history_overhead_bench.py).
+    return PagedBatchEngine(cfg, params, slots=8, max_len=2048, block_size=16,
+                            pipeline_depth=0)
+
+
+def median(xs: list) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--samples", type=int, default=5000,
+                        help="on_span calls to time")
+    parser.add_argument("--dispatches", type=int, default=200,
+                        help="step_n(1) calls to time for the scale row")
+    parser.add_argument("--check", action="store_true",
+                        help="enforce journey_overhead_budget.json (CI mode)")
+    args = parser.parse_args()
+
+    trace.TRACER.enabled = True
+    trace.TRACER.sample_rate = 1.0
+    engine = build_engine()
+    r = np.random.RandomState(0)
+    for _ in range(engine.slots):
+        assert engine.submit(
+            r.randint(1, 255, size=24).astype(np.int32), 2000
+        ) is not None
+    engine.step_n(1)  # compile outside every timed window
+
+    # Spans per dispatch: counted over real dispatches with tracing on —
+    # the exact number of on_span calls the vault pays per decode chunk.
+    counted = {"n": 0}
+
+    def counter(record: dict) -> None:
+        counted["n"] += 1
+
+    trace.TRACER.add_finish_listener(counter)
+    dispatch_times = []
+    try:
+        for _ in range(args.dispatches):
+            t0 = time.perf_counter()
+            executed = engine.step_n(1)
+            dispatch_times.append(time.perf_counter() - t0)
+            assert executed == 1, "engine drained mid-run; shrink --dispatches"
+    finally:
+        trace.TRACER.remove_finish_listener(counter)
+    dispatch_s = median(dispatch_times)
+    spans_per_dispatch = counted["n"] / max(1, len(dispatch_times))
+
+    # Per-span vault cost against a LIVE decode workload, worst case: the
+    # open-trace LRU pre-filled to capacity and every timed record opening
+    # a NOVEL trace, so each call pays lookup + eviction + append.
+    vault = JourneyVault(sample_rate=0.0, rng=lambda: 1.0)
+    for i in range(vault.max_open_traces):
+        vault.on_span({
+            "name": "serve.decode_dispatch", "trace_id": f"warm{i:08x}",
+            "span_id": f"s{i:08x}", "parent_id": None,
+            "start_unix": 0.0, "duration_s": 0.001, "status": "ok",
+            "attrs": {"engine": "paged"},
+        })
+    stop = threading.Event()
+
+    def workload() -> None:
+        while not stop.is_set() and engine.active_count:
+            engine.step_n(1)
+
+    worker = threading.Thread(target=workload, daemon=True)
+    worker.start()
+    try:
+        span_times = []
+        for i in range(args.samples):
+            record = {
+                "name": "serve.decode_dispatch", "trace_id": f"t{i:012x}",
+                "span_id": f"x{i:012x}", "parent_id": None,
+                "start_unix": 0.0, "duration_s": 0.001, "status": "ok",
+                "attrs": {"engine": "paged", "steps": 1},
+            }
+            t0 = time.perf_counter()
+            vault.on_span(record)
+            span_times.append(time.perf_counter() - t0)
+    finally:
+        stop.set()
+        worker.join(timeout=30)
+    span_s = median(span_times)
+
+    overhead_pct = spans_per_dispatch * span_s / dispatch_s * 100.0
+    print(json.dumps({
+        "metric": "paged decode dispatch (scale reference)",
+        "dispatches": len(dispatch_times),
+        "value": round(engine.slots / dispatch_s, 1),
+        "unit": "tok/s (median dispatch)",
+    }))
+    print(json.dumps({
+        "metric": "spans finished per decode dispatch (tracing on)",
+        "value": round(spans_per_dispatch, 2),
+        "unit": "spans/dispatch",
+    }))
+    print(json.dumps({
+        "metric": "vault on_span against live decode workload "
+                  "(novel trace, LRU at capacity)",
+        "samples": len(span_times),
+        "value": round(span_s * 1e6, 2),
+        "unit": "us (median)",
+    }))
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    verdict = {
+        "metric": "journey-vault span-listener overhead on paged decode "
+                  "loop (spans/dispatch x per-span cost / dispatch cost)",
+        "value": round(overhead_pct, 4),
+        "unit": "% of decode throughput",
+        "spans_per_dispatch": round(spans_per_dispatch, 2),
+        "span_us": round(span_s * 1e6, 2),
+        "budget_pct": budget["max_overhead_pct"],
+        "within_budget": overhead_pct < budget["max_overhead_pct"],
+    }
+    print(json.dumps(verdict), flush=True)
+    if args.check and not verdict["within_budget"]:
+        print(
+            f"[journey-overhead] FAIL: {overhead_pct:.3f}% >= budget "
+            f"{budget['max_overhead_pct']}%",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

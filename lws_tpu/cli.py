@@ -1024,6 +1024,195 @@ def cmd_monitor(args) -> int:
         time.sleep(args.interval)
 
 
+# ---------------------------------------------------------------------------
+# lws-tpu explain: request-journey forensics — one request's cross-process
+# waterfall (phases with self-time, wire chunks, retries) and a one-line
+# verdict naming the phase that blew the budget (lws_tpu/obs/journey.py).
+
+
+def _explain_verdict(journey: dict) -> dict:
+    """The verdict for a (possibly fleet-joined) journey record: the first
+    leg whose timeline breached names the phase; a joined record without
+    leg timelines falls back to its merged flags."""
+    from lws_tpu.obs.journey import verdict
+
+    fallback = None
+    for leg in journey.get("legs") or []:
+        v = verdict(leg.get("journey") or {})
+        instance = (leg.get("labels") or {}).get("instance", "-")
+        if not v["ok"]:
+            v["text"] += f"  [leg {instance}]"
+            return v
+        if (leg.get("journey") or {}).get("timeline"):
+            fallback = v
+    return fallback if fallback is not None else verdict(journey)
+
+
+def render_request_index(rows: list) -> str:
+    """The `lws-tpu explain --slowest/--breached/--errored` table: retained
+    journeys worst-first, each row explainable by id."""
+    lines = [
+        f"{'REQUEST':<22}{'OUTCOME':<18}{'KLASS':<10}{'ENGINE':<8}"
+        f"{'TTFT':>9}{'TOTAL':>9}{'SPANS':>7}  INSTANCE",
+    ]
+
+    def fmt(v, pattern="{:.3f}s"):
+        return pattern.format(v) if v is not None else "-"
+
+    for row in rows:
+        lines.append(
+            f"{str(row.get('id', '-'))[:21]:<22}"
+            f"{str(row.get('outcome', '-')):<18}"
+            f"{str(row.get('klass') or '-'):<10}"
+            f"{str(row.get('engine') or '-'):<8}"
+            f"{fmt(row.get('ttft_s')):>9}"
+            f"{fmt(row.get('total_s')):>9}"
+            f"{row.get('spans', 0):>7}"
+            f"  {row.get('instance', '-')}"
+        )
+    if len(lines) == 1:
+        lines.append("(no retained journeys matched)")
+    return "\n".join(lines)
+
+
+def render_explain(journey: dict, bar_width: int = 28) -> str:
+    """One `lws-tpu explain <id>` frame: the journey's span tree as a
+    waterfall (offset bars on a shared clock, per-span self-time), the
+    KV-stream chunk timeline, the resilience events that touched the
+    request, and the verdict. Pure function of the journey record so tests
+    drive it from canned data."""
+    spans = list(journey.get("spans") or [])
+    lines = [
+        f"JOURNEY {journey.get('id', '-')}"
+        f"  outcome={journey.get('outcome', '-')}"
+        f"  flags={','.join(journey.get('flags') or []) or '-'}"
+        f"  trace={str(journey.get('trace_id') or '-')[:16]}"
+        f"  spans={len(spans)}"
+        + ("  connected" if journey.get("connected") else ""),
+    ]
+    legs = journey.get("legs") or []
+    if legs:
+        lines.append("legs: " + ", ".join(
+            "{}{}".format(
+                (leg.get("labels") or {}).get("instance", "-"),
+                " [{}]".format((leg.get("labels") or {}).get("role"))
+                if (leg.get("labels") or {}).get("role") else "",
+            )
+            for leg in legs
+        ))
+    if spans:
+        t0 = min(s.get("start_unix", 0.0) for s in spans)
+        t_end = max(
+            s.get("start_unix", 0.0) + s.get("duration_s", 0.0) for s in spans
+        )
+        total = max(t_end - t0, 1e-9)
+        by_id = {s.get("span_id"): s for s in spans}
+        children: dict = {}
+        for s in spans:
+            children.setdefault(s.get("parent_id"), []).append(s)
+        for kids in children.values():
+            kids.sort(key=lambda s: s.get("start_unix", 0.0))
+        roots = sorted(
+            (s for s in spans if s.get("parent_id") not in by_id),
+            key=lambda s: s.get("start_unix", 0.0),
+        )
+        lines.append("")
+        lines.append(
+            f"WATERFALL (total {total:.4f}s)"
+        )
+        lines.append(
+            f"{'SPAN':<34}{'INSTANCE':<16}{'START':>9}{'SELF':>9}"
+            f"{'TOTAL':>9}  TIMELINE"
+        )
+
+        def bar(start: float, dur: float) -> str:
+            lo = int((start - t0) / total * bar_width)
+            hi = int((start + dur - t0) / total * bar_width)
+            hi = max(hi, lo + 1)
+            return " " * lo + "█" * (hi - lo)
+
+        def walk(span: dict, depth: int) -> None:
+            dur = span.get("duration_s", 0.0)
+            kids = children.get(span.get("span_id"), [])
+            self_s = max(0.0, dur - sum(k.get("duration_s", 0.0) for k in kids))
+            name = "  " * depth + str(span.get("name", "-"))
+            status = "!" if span.get("status") == "error" else ""
+            lines.append(
+                f"{(name + status)[:33]:<34}"
+                f"{str(span.get('instance', '-'))[:15]:<16}"
+                f"{span.get('start_unix', 0.0) - t0:>8.4f}s"
+                f"{self_s:>8.4f}s"
+                f"{dur:>8.4f}s"
+                f"  {bar(span.get('start_unix', 0.0), dur)}"
+            )
+            for kid in kids:
+                walk(kid, depth + 1)
+
+        for root in roots:
+            walk(root, 0)
+    chunks = (journey.get("annotations") or {}).get("chunks") or []
+    if chunks:
+        arrivals = " ".join(f"+{c.get('t_s', 0.0):.3f}s" for c in chunks)
+        nbytes = sum(int(c.get("bytes", 0)) for c in chunks)
+        lines.append("")
+        lines.append(
+            f"wire chunks: {len(chunks)} ({nbytes} B) arrivals {arrivals}"
+        )
+    events = journey.get("events") or []
+    if events:
+        lines.append("")
+        for ev in events[:12]:
+            detail = " ".join(
+                f"{k}={ev[k]}" for k in ("site", "point", "mode", "endpoint",
+                                         "to_state", "attempt", "error")
+                if ev.get(k) is not None
+            )
+            lines.append(f"event {ev.get('kind', '-')}: {detail}")
+        if len(events) > 12:
+            lines.append(f"... {len(events) - 12} more events")
+    lines.append("")
+    lines.append(f"VERDICT: {_explain_verdict(journey)['text']}")
+    return "\n".join(lines)
+
+
+def cmd_explain(args) -> int:
+    """Request-journey forensics: fetch one request's (fleet-joined)
+    journey from /debug/request/{id} and render the cross-process waterfall
+    + verdict; or list the worst retained journeys (--slowest / --breached
+    / --errored) from /debug/requests so an operator picks an offender."""
+    from urllib.parse import quote, urlencode
+
+    picked = [o for o, on in (("slowest", args.slowest),
+                              ("breached", args.breached),
+                              ("errored", args.errored)) if on]
+    if len(picked) > 1:
+        print("error: pick ONE of --slowest/--breached/--errored",
+              file=sys.stderr)
+        return 2
+    if picked:
+        query = {"outcome": picked[0], "limit": args.limit}
+        if args.klass:
+            query["klass"] = args.klass
+        rows = _http(args.server, "GET",
+                     f"/debug/requests?{urlencode(query)}")
+        if args.json:
+            print(json.dumps(rows, indent=1))
+        else:
+            print(render_request_index(rows))
+        return 0
+    if not args.request_id:
+        print("error: a request id (or --slowest/--breached/--errored) is "
+              "required", file=sys.stderr)
+        return 2
+    body = _http(args.server, "GET",
+                 f"/debug/request/{quote(args.request_id, safe='')}")
+    if args.json:
+        print(json.dumps(body, indent=1))
+    else:
+        print(render_explain(body))
+    return 0
+
+
 def render_profile(instances: list, top_n: int = 15) -> str:
     """One frame of `lws-tpu profile`: per-span self-time and top-of-stack
     tables folded from /debug/profile snapshots. `instances` is
@@ -1404,6 +1593,30 @@ def main(argv=None) -> int:
     mon.add_argument("--limit", type=int, default=512,
                      help="series to fetch from /debug/history")
     mon.set_defaults(fn=cmd_monitor)
+
+    ex = sub.add_parser("explain", help="request-journey forensics: one "
+                        "request's cross-process waterfall + verdict "
+                        "(from /debug/request/{id}), or the worst retained "
+                        "journeys (--slowest/--breached/--errored)")
+    ex.add_argument("request_id", nargs="?",
+                    help="request id (the KV frame meta id) or a trace id "
+                         "from an SLO exemplar")
+    ex.add_argument("--server", default="127.0.0.1:9443",
+                    help="API server (fleet-joined) or a worker telemetry "
+                         "host:port (local leg only)")
+    ex.add_argument("--slowest", action="store_true",
+                    help="list the slowest retained journeys instead")
+    ex.add_argument("--breached", action="store_true",
+                    help="list SLO-breaching retained journeys instead")
+    ex.add_argument("--errored", action="store_true",
+                    help="list errored retained journeys instead")
+    ex.add_argument("--klass", default="",
+                    help="filter the index by workload class")
+    ex.add_argument("--limit", type=int, default=10,
+                    help="index rows to fetch")
+    ex.add_argument("--json", action="store_true",
+                    help="emit the raw journey/index JSON")
+    ex.set_defaults(fn=cmd_explain)
 
     prf = sub.add_parser("profile", help="continuous-profiling view: per-span "
                          "and top-of-stack self-time (from /debug/profile)")

@@ -63,6 +63,21 @@ class FlightRecorder:
         self._ring: "deque[dict]" = deque(maxlen=ring)  # guarded-by: _lock
         self._beats: dict[str, Heartbeat] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
+        # Event observers: called with every recorded event — the journey
+        # vault's resilience feed (lws_tpu/obs/journey.py). The ring stays
+        # the source of truth; observers only mirror.
+        self._observers: list = []
+
+    def add_observer(self, fn) -> None:
+        """Register `fn(event)` to observe every recorded event (idempotent
+        per function) — how the journey vault attaches retries, breaker
+        transitions, deadline trips, and fault injections to requests."""
+        if fn not in self._observers:
+            self._observers.append(fn)
+
+    def remove_observer(self, fn) -> None:
+        if fn in self._observers:
+            self._observers.remove(fn)
 
     # ---- feeds -----------------------------------------------------------
     def record(self, kind: str, **fields) -> dict:
@@ -76,6 +91,11 @@ class FlightRecorder:
         with self._lock:
             self._ring.append(event)
         metrics.inc("lws_flightrecorder_events_total", {"kind": kind})
+        for observer in self._observers:
+            try:
+                observer(event)
+            except Exception:  # vet: ignore[hazard-exception-swallow]: a broken observer must never break event recording (BLE001 intended)
+                pass
         return event
 
     def beat(self, name: str, progress: Optional[float] = None,
@@ -140,12 +160,15 @@ class FlightRecorder:
         """The diagnostics bundle: everything an operator needs to explain
         the window that just went wrong, in one JSON-serializable dict —
         including the process profile, so a stall alert ships the collapsed
-        stacks of the window that stalled, and the process history ring, so
-        a burn-rate alert ships the series window that burned (local
-        imports: profile.py and obs/history.py are consumers of this
-        module's surfaces, not dependencies)."""
+        stacks of the window that stalled, the process history ring, so
+        a burn-rate alert ships the series window that burned, and the
+        journey vault's worst retained journeys, so the dump names the
+        requests the bad window actually hurt (local imports: profile.py
+        and the obs modules are consumers of this module's surfaces, not
+        dependencies)."""
         from lws_tpu.core import profile as profmod
         from lws_tpu.obs import history as historymod
+        from lws_tpu.obs import journey as journeymod
 
         exposition = (
             metrics.render_exposition(metrics.REGISTRY, *registries)
@@ -160,6 +183,7 @@ class FlightRecorder:
             "metrics": exposition,
             "profile": profmod.PROFILER.snapshot(limit=128),
             "history": historymod.HISTORY.snapshot(limit=64, max_points=256),
+            "journeys": journeymod.VAULT.worst(limit=8),
         }
 
 

@@ -531,3 +531,8 @@ describe("serving_slo_burn_rate",
          "Error-budget burn of the short window per tier (window=fast/slow), per engine and workload class — burn 1.0 exhausts the budget exactly at the SLO horizon; the fast tier pages at 14.4")
 describe("serving_scale_recommendation",
          "Dry-run desired replica count per DS role from the burn/occupancy signals (lws_tpu/obs/recommend.py) — published as a decision, actuated only through the opt-in annotation adapter")
+# --- request-journey forensics (lws_tpu/obs/journey.py) --------------------
+describe("serving_journeys_retained_total",
+         "Request journeys kept by the tail-sampling vault, per retention outcome (breached/errored/deadline_expired/retried/fault kept 100%; slowest = the slow-K window; sampled = the healthy reservoir)")
+describe("serving_journeys_dropped_total",
+         "Journey records lost, per reason (not_sampled healthy drops, budget/aged/displaced evictions, open_evicted in-flight trace buffers, journey_span_cap/journey_event_cap truncations) — every loss is accounted")

@@ -68,15 +68,21 @@ class DeadlineExceeded(RuntimeError):
         self.overdue_s = overdue_s
 
 
-def expire(site: str) -> None:
+def expire(site: str, request_id: str = "") -> None:
     """Record a deadline expiration (metric + trip heartbeat + ring event)
     WITHOUT raising — the drop-don't-crash paths (prefill skipping an
-    expired prompt) record the same way the raising paths do."""
+    expired prompt) record the same way the raising paths do. `request_id`
+    (when the site knows it — the worker admit paths do) joins the event
+    to its journey in the vault."""
     metrics.inc("serving_deadline_expirations_total", {"site": site})
     # TripRule feed: progress auto-increments, so the watchdog sees a
     # recent advance and alerts once per burst.
     flightrecorder.beat(f"deadline_trips:{site}")
-    flightrecorder.record("deadline_exceeded", site=site)
+    if request_id:
+        flightrecorder.record("deadline_exceeded", site=site,
+                              request_id=request_id)
+    else:
+        flightrecorder.record("deadline_exceeded", site=site)
 
 
 class Deadline:
@@ -258,6 +264,11 @@ def call(
                 raise
             metrics.inc("serving_retries_total",
                         {"site": site, "outcome": "retry"})
+            # Retries are notable, not hot (a retrying site is already
+            # paying a backoff sleep): the ring event carries the active
+            # trace ctx so the journey vault can pin the retry leg to the
+            # request it delayed.
+            flightrecorder.record("retry", site=site, attempt=attempt)
             # Decorrelated jitter: spreads a thundering herd of retriers
             # instead of synchronizing them onto the recovering peer.
             sleep_s = min(policy.cap_s, uniform(policy.base_s, prev_sleep * 3))
@@ -508,7 +519,7 @@ class SeenIds:
                 replay = False
                 self._record_locked(rid)
         if replay:
-            metrics.inc("serving_replays_deduped_total", {"site": self._site})
+            self._replayed(rid)
         return replay
 
     def contains(self, rid: str) -> bool:
@@ -518,8 +529,15 @@ class SeenIds:
         with self._lock:
             replay = rid in self._ids
         if replay:
-            metrics.inc("serving_replays_deduped_total", {"site": self._site})
+            self._replayed(rid)
         return replay
+
+    def _replayed(self, rid: str) -> None:
+        metrics.inc("serving_replays_deduped_total", {"site": self._site})
+        # Replays are rare and notable (an ack was lost somewhere): the
+        # ring event carries the id so the journey vault flags the leg.
+        flightrecorder.record("replay_deduped", site=self._site,
+                              request_id=rid)
 
     def record(self, rid: str) -> None:
         """Mark `rid` complete — call AFTER its side effects succeeded."""

@@ -135,15 +135,20 @@ class RequestTimeline:
     the attainment verdict folds whatever was recorded by finish() time."""
 
     __slots__ = (
-        "engine", "klass", "_rec", "_arrival", "_ttft_s", "_queue_wait_s",
-        "_worst_itl_s", "_last_token_t", "_finished",
+        "engine", "klass", "request_id", "_rec", "_arrival", "_ttft_s",
+        "_queue_wait_s", "_worst_itl_s", "_last_token_t", "_finished",
         "_cursor_s", "_tokens_total", "_good_tokens",
     )
 
     def __init__(self, recorder: "SLORecorder", engine: str,
-                 arrival_t: Optional[float] = None, klass: str = "") -> None:
+                 arrival_t: Optional[float] = None, klass: str = "",
+                 request_id: str = "") -> None:
         self.engine = engine
         self.klass = klass
+        # The cross-process request id (disagg frame meta `id`): the key
+        # the journey vault files this timeline's verdict under. Engines
+        # without one leave it empty — the journey falls back to trace id.
+        self.request_id = request_id
         self._rec = recorder
         self._arrival = time.perf_counter() if arrival_t is None else arrival_t
         self._ttft_s: Optional[float] = None
@@ -258,10 +263,16 @@ class SLORecorder:
             else class_targets_from_env(self.targets)
         )
         self._lock = threading.Lock()
+        # Journey sinks: called with each finished timeline's summary
+        # (phases + verdict + targets) — the journey vault's completion
+        # feed (lws_tpu/obs/journey.py install()). Per-instance, so tests'
+        # private recorders never leak into the process vault.
+        self.journey_sinks: list = []
 
     def request(self, engine: str, arrival_t: Optional[float] = None,
-                klass: str = "") -> RequestTimeline:
-        return RequestTimeline(self, engine, arrival_t, klass=klass)
+                klass: str = "", request_id: str = "") -> RequestTimeline:
+        return RequestTimeline(self, engine, arrival_t, klass=klass,
+                               request_id=request_id)
 
     def targets_for(self, klass: str) -> SLOTargets:
         """The effective targets for one workload class (the engine-wide
@@ -341,7 +352,8 @@ class SLORecorder:
 
     def _finish(self, tl: RequestTimeline) -> bool:
         now = time.monotonic()
-        ok = tl.attained(self.targets_for(tl.klass))
+        targets = self.targets_for(tl.klass)
+        ok = tl.attained(targets)
         key = (tl.engine, tl.klass)
         with self._lock:
             window = self._outcomes.get(key)
@@ -364,6 +376,30 @@ class SLORecorder:
                     "serving_goodput_tokens_total", labels,
                     float(tl._good_tokens),
                 )
+        # Journey completion feed: the vault joins this verdict with the
+        # request's buffered span subtree and resilience events, then
+        # decides tail-sampled retention. Captured HERE (finish runs inside
+        # the request's span on the disagg legs) so the trace ctx is live.
+        if self.journey_sinks:
+            summary = {
+                "engine": tl.engine,
+                "klass": tl.klass,
+                "request_id": tl.request_id,
+                "trace": trace.current_context(),
+                "queue_wait_s": tl._queue_wait_s,
+                "ttft_s": tl._ttft_s,
+                "worst_itl_s": tl._worst_itl_s,
+                "total_s": tl._cursor_s if tl._tokens_total else None,
+                "tokens": tl._tokens_total,
+                "good_tokens": tl._good_tokens,
+                "ok": ok,
+                "targets": dataclasses.asdict(targets),
+            }
+            for sink in self.journey_sinks:
+                try:
+                    sink(summary)
+                except Exception:  # vet: ignore[hazard-exception-swallow]: a broken journey sink must never fail a request's SLO accounting (BLE001 intended)
+                    pass
         return ok
 
 
@@ -373,5 +409,6 @@ RECORDER = SLORecorder()
 
 
 def request(engine: str, arrival_t: Optional[float] = None,
-            klass: str = "") -> RequestTimeline:
-    return RECORDER.request(engine, arrival_t, klass=klass)
+            klass: str = "", request_id: str = "") -> RequestTimeline:
+    return RECORDER.request(engine, arrival_t, klass=klass,
+                            request_id=request_id)

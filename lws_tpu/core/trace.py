@@ -196,6 +196,11 @@ class Tracer:
         # push/pop racing the copy (one sample mis-tagged by one frame).
         self._thread_stacks: dict[int, list] = {}
         self._prune_pending: set = set()  # idents absent from ONE live set
+        # Finish listeners: called with every finished span's record dict
+        # (the journey vault's feed, lws_tpu/obs/journey.py). Registered
+        # once per process; an empty list costs one truthiness check on
+        # the hot path (the <2% trace budget covers it).
+        self._finish_listeners: list = []
 
     # ---- span stack (thread-local: concurrent reconcile workers and
     # serving threads each nest independently) ----------------------------
@@ -253,9 +258,24 @@ class Tracer:
         elif span in stack:  # exited out of order: drop it wherever it sits
             stack.remove(span)
 
+    def add_finish_listener(self, fn: Callable[[dict], None]) -> None:
+        """Register `fn(record)` to observe every finished span — the
+        journey vault's span feed. Idempotent per function."""
+        if fn not in self._finish_listeners:
+            self._finish_listeners.append(fn)
+
+    def remove_finish_listener(self, fn: Callable[[dict], None]) -> None:
+        if fn in self._finish_listeners:
+            self._finish_listeners.remove(fn)
+
     def _finish(self, span: Span) -> None:
         record = span.to_dict()
         self._ring.append(record)
+        for listener in self._finish_listeners:
+            try:
+                listener(record)
+            except Exception:  # vet: ignore[hazard-exception-swallow]: a broken listener must never break span accounting (BLE001 intended)
+                pass
         if self._export_path:
             line = json.dumps(record, default=str)
             with self._export_lock:

@@ -167,6 +167,23 @@ def render_report(report: dict, fleet: Optional[dict] = None) -> str:
     all_stats = dict(total)
     all_stats.setdefault("queue_p95", None)
     lines.append(row("ALL", all_stats))
+    # Worst-K offenders per class, by journey id: a scenario run ends with
+    # requests an operator can explain directly (`lws-tpu explain <id>` —
+    # the tail vault retains every breached/errored/incomplete one).
+    worst_lines = []
+    for name, stats in report["classes"].items():
+        for w in stats.get("worst") or []:
+            state = ("incomplete" if not w.get("completed")
+                     else ("ok" if w.get("attained") else "MISS"))
+            worst_lines.append(
+                f"worst {name}: {w.get('id', '-')}"
+                f"  ttft={_fmt(w.get('ttft_s'), '{:.3f}s')}"
+                f"  total={_fmt(w.get('total_s'), '{:.3f}s')}"
+                f"  {state}"
+            )
+    if worst_lines:
+        lines.append("")
+        lines.extend(worst_lines)
     if fleet is not None:
         f = fold_fleet(fleet)
         lines.append("")

@@ -40,6 +40,9 @@ class RequestOutcome:
     index: int
     klass: str
     arrival_s: float
+    request_id: str = ""    # journey id (the disagg frame meta id; engine
+    #                         targets synthesize one from the index) — the
+    #                         key `lws-tpu explain` resolves offenders by
     queue_s: float = 0.0    # scheduled arrival -> admission accepted
     ttft_s: float = 0.0     # scheduled arrival -> first token
     itl_s: float = 0.0      # mean inter-token gap after the first token
@@ -311,6 +314,11 @@ def run_schedule(
             t_admit = clock()
             out = RequestOutcome(
                 index=req.index, klass=req.klass, arrival_s=req.arrival_s,
+                # A string handle IS the wire request id (DisaggTarget);
+                # in-process engines get a synthetic per-run id so the
+                # report's worst-K rows are still addressable.
+                request_id=(handle if isinstance(handle, str)
+                            else f"#{req.index}"),
                 queue_s=scen(max(0.0, t_admit - arrival_wall)),
                 shared_prefix=req.shared_prefix,
             )
@@ -410,18 +418,49 @@ def _bucket_stats(outs: list[RequestOutcome], targets: SLOTargets) -> dict:
     }
 
 
+def worst_requests(outs: list[RequestOutcome], targets: SLOTargets,
+                   k: int = 3) -> list[dict]:
+    """The class's worst-K offenders, each with its journey id so the
+    report row resolves straight to `lws-tpu explain <id>` (the tail
+    vault retains every breached/incomplete request). Incompletes rank
+    worst (they never finished), then misses, then the slowest hits."""
+    def key(o: RequestOutcome):
+        incomplete = not o.completed or o.failed
+        miss = not attained(o, targets)
+        return (incomplete, miss, o.ttft_s if o.completed else float("inf"),
+                o.total_s)
+
+    ranked = sorted(outs, key=key, reverse=True)
+    return [
+        {
+            "id": o.request_id or "-",
+            "ttft_s": round(o.ttft_s, 6) if o.completed else None,
+            "total_s": round(o.total_s, 6) if o.completed else None,
+            "completed": o.completed and not o.failed,
+            "attained": attained(o, targets),
+        }
+        for o in ranked[:max(0, k)]
+    ]
+
+
 def summarize(result: RunResult, targets_by_class: dict[str, SLOTargets],
               horizon_s: float, scenario_name: str = "",
-              seed: Optional[int] = None) -> dict:
+              seed: Optional[int] = None, worst_k: int = 3) -> dict:
     """RunResult -> the report dict `render_report` and the scenario bench
     consume: per-class and overall latency quantiles, attainment, the
-    goodput ledger, and offered vs achieved load."""
+    goodput ledger, offered vs achieved load, and the worst-K offenders
+    per class (journey ids — directly explainable)."""
     default = SLOTargets.from_env()
     by_class: dict[str, list[RequestOutcome]] = {}
     for o in result.outcomes:
         by_class.setdefault(o.klass, []).append(o)
     classes = {
-        name: _bucket_stats(outs, targets_by_class.get(name, default))
+        name: {
+            **_bucket_stats(outs, targets_by_class.get(name, default)),
+            "worst": worst_requests(
+                outs, targets_by_class.get(name, default), k=worst_k
+            ),
+        }
         for name, outs in sorted(by_class.items())
     }
     # Overall attainment/goodput grade each request against ITS class.

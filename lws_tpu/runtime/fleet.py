@@ -273,24 +273,19 @@ class FleetCollector:
         under the same per-instance scrape-error counter as /metrics; no
         flight-recorder edge event — profile scrapes are operator-driven
         one-shots, not the periodic refresh whose re-fire flood the
-        _failing edge logic exists to suppress)."""
-        import json
-
-        req = urllib.request.Request(
-            f"http://{host}:{port}/debug/profile?limit={int(limit)}",
-            headers=self._scrape_headers(),
+        _failing edge logic exists to suppress). The generic debug-JSON
+        scrape plus the profile shape check."""
+        snap = self._scrape_debug_json(
+            labels, host, port, f"/debug/profile?limit={int(limit)}",
+            missing_ok=False,
         )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                snap = json.loads(resp.read().decode())
-            if not isinstance(snap, dict) or "stacks" not in snap:
-                raise ValueError("malformed profile snapshot")
-            return snap
-        except (OSError, ValueError, HTTPException):
+        if snap is not None and (not isinstance(snap, dict)
+                                 or "stacks" not in snap):
             self._own_metrics.inc(
                 "lws_fleet_scrape_errors_total", {"instance": labels["instance"]},
             )
             return None
+        return snap
 
     def collect_profiles(self, limit: int = 512) -> list[tuple[dict, dict]]:
         """[(labels, profile snapshot)] over the ready fleet plus this
@@ -318,6 +313,167 @@ class FleetCollector:
                         if snap is not None
                     )
         return sources
+
+    # ---- request-journey fan-in (GET /debug/request[s]) ------------------
+    def _scrape_debug_json(self, labels: dict, host: str, port: int,
+                           path: str, missing_ok: bool = True):
+        """One worker's JSON debug body, or None when the worker has
+        nothing for it (with `missing_ok`, a 404 — a request that never
+        touched that instance — is an answer, not an error; real failures
+        count under the usual per-instance scrape-error counter)."""
+        import json
+        import urllib.error
+
+        req = urllib.request.Request(
+            f"http://{host}:{port}{path}", headers=self._scrape_headers(),
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            if missing_ok and e.code == 404:
+                return None
+            self._own_metrics.inc(
+                "lws_fleet_scrape_errors_total", {"instance": labels["instance"]},
+            )
+            return None
+        except (OSError, ValueError, HTTPException):
+            self._own_metrics.inc(
+                "lws_fleet_scrape_errors_total", {"instance": labels["instance"]},
+            )
+            return None
+
+    def collect_journeys(self, request_id: str) -> Optional[dict]:
+        """Fleet-join one request's journey legs: every ready worker's
+        `GET /debug/request/{id}` plus this process's local leg (the
+        client/reconcile spans live HERE), merged into one record whose
+        span set should form one connected tree — the trace ctx rode the
+        KV frame meta, so prefill's and decode's subtrees share the
+        client's trace id. None when no instance knows the id."""
+        from urllib.parse import quote
+
+        from lws_tpu.core import trace
+        from lws_tpu.core.trace import connected_tree
+        from lws_tpu.obs import journey as journeymod
+
+        legs: list[tuple[dict, dict]] = []
+        local = journeymod.local_journey(request_id)
+        if local is not None:
+            legs.append(({"instance": "control-plane"}, local))
+        targets = self.targets()
+        if targets:
+            from concurrent.futures import ThreadPoolExecutor
+
+            path = f"/debug/request/{quote(str(request_id), safe='')}"
+            with trace.span("fleet.journey_scrape", instances=len(targets)):
+                with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+                    scraped = pool.map(
+                        lambda t: self._scrape_debug_json(t[0], *t[1], path),
+                        targets,
+                    )
+                    legs.extend(
+                        (labels, leg)
+                        for (labels, _), leg in zip(targets, scraped)
+                        if isinstance(leg, dict)
+                    )
+        if not legs:
+            return None
+        trace_id = next(
+            (leg.get("trace_id") for _, leg in legs if leg.get("trace_id")),
+            None,
+        )
+        if local is None and trace_id:
+            # The workers named the trace: pull this process's leg of it
+            # (the request root + reconcile spans the client opened here).
+            extra = journeymod.VAULT.spans_for_trace(trace_id) or [
+                s for s in trace.TRACER.spans()
+                if s.get("trace_id") == trace_id
+            ]
+            if extra:
+                legs.insert(0, ({"instance": "control-plane"}, {
+                    "id": request_id, "trace_id": trace_id,
+                    "outcome": "open", "completed": False, "flags": [],
+                    "timeline": {}, "events": [], "annotations": {},
+                    "spans": extra,
+                }))
+        spans: list[dict] = []
+        seen_spans: set = set()
+        events: list[dict] = []
+        annotations: dict = {}
+        flags: set = set()
+        for labels, leg in legs:
+            for s in leg.get("spans") or []:
+                sid = s.get("span_id")
+                if sid in seen_spans:
+                    continue
+                seen_spans.add(sid)
+                spans.append({**s, "instance": labels.get("instance", "-")})
+            events.extend(leg.get("events") or [])
+            annotations.update(leg.get("annotations") or {})
+            flags.update(leg.get("flags") or [])
+        # Worst leg verdict wins the joined outcome label (a breached
+        # decode leg must not be masked by a healthy prefill leg).
+        outcome = "open"
+        for want in ("errored", "deadline_expired", "breached", "retried",
+                     "fault", "slowest", "sampled"):
+            if any(leg.get("outcome") == want for _, leg in legs):
+                outcome = want
+                break
+        return {
+            "id": request_id,
+            "trace_id": trace_id,
+            "outcome": outcome,
+            "flags": sorted(flags),
+            "spans": spans,
+            "events": sorted(events, key=lambda e: e.get("ts", 0.0)),
+            "annotations": annotations,
+            "legs": [
+                {"labels": labels,
+                 "journey": {k: v for k, v in leg.items() if k != "spans"}}
+                for labels, leg in legs
+            ],
+            "connected": connected_tree(spans) if spans else False,
+        }
+
+    def collect_request_index(self, outcome: str = "all", klass: str = "",
+                              limit: int = 32) -> list[dict]:
+        """Fleet-joined `/debug/requests` index: every ready worker's
+        retained-journey digests plus this process's, instance-labelled and
+        merged worst-first. Unknown outcomes raise ValueError BEFORE any
+        scrape (the caller answers 400)."""
+        from lws_tpu.obs import journey as journeymod
+
+        rows = [
+            {**row, "instance": "control-plane"}
+            for row in journeymod.VAULT.index(outcome=outcome, klass=klass,
+                                              limit=limit)
+        ]
+        targets = self.targets()
+        if targets:
+            from concurrent.futures import ThreadPoolExecutor
+            from urllib.parse import urlencode
+
+            query = urlencode({"outcome": outcome, "klass": klass,
+                               "limit": int(limit)})
+            path = f"/debug/requests?{query}"
+            with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+                scraped = pool.map(
+                    lambda t: self._scrape_debug_json(t[0], *t[1], path),
+                    targets,
+                )
+                for (labels, _), got in zip(targets, scraped):
+                    if isinstance(got, list):
+                        rows.extend(
+                            {**row, "instance": labels.get("instance", "-")}
+                            for row in got if isinstance(row, dict)
+                        )
+        if outcome == "slowest":
+            rows.sort(key=lambda r: -(r.get("latency_s") or 0.0))
+        else:
+            rows.sort(key=lambda r: -(r.get("completed_unix") or 0.0))
+        if limit >= 0:
+            rows = rows[:limit] if limit else []
+        return rows
 
     def render_fleet(self, force: bool = False) -> str:
         """The merged exposition, cached for `cache_ttl_s` (a dashboard

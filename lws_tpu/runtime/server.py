@@ -139,6 +139,14 @@ class ApiServer:
         self.auth = auth
         cp = control_plane
 
+        # Journey-vault feeds for THIS process (span buffering, resilience
+        # events, SLO completions): the API server's local leg of the
+        # cross-process /debug/request assembly. Idempotent; off with
+        # LWS_TPU_JOURNEYS=0.
+        from lws_tpu.obs import journey as journeymod
+
+        journeymod.install()
+
         # Watch plumbing (≈ the apiserver's watch cache): every store event
         # gets a server-local sequence number; /watch long-polls on it.
         events = collections.deque(maxlen=watch_buffer)
@@ -346,6 +354,53 @@ class ApiServer:
                         self._json(400, {"error": f"bad limit: {e}"})
                         return
                     self._json(200, historymod.HISTORY.snapshot(limit))
+                elif path == "/debug/requests":
+                    from urllib.parse import parse_qs, urlparse
+
+                    from lws_tpu.obs import journey as journeymod
+                    from lws_tpu.runtime.telemetry import parse_limit
+
+                    q = parse_qs(urlparse(self.path).query)
+                    outcome = q.get("outcome", ["all"])[0]
+                    klass = q.get("klass", [""])[0]
+                    fleet = getattr(cp, "fleet", None)
+                    try:
+                        limit = parse_limit(q, default=32)
+                        if fleet is not None:
+                            # Fleet-joined index: every ready worker's
+                            # retained journeys plus this process's, one
+                            # worst-first table (runtime/fleet.py).
+                            rows = fleet.collect_request_index(
+                                outcome, klass, limit
+                            )
+                        else:
+                            rows = journeymod.VAULT.index(
+                                outcome=outcome, klass=klass, limit=limit
+                            )
+                    except ValueError as e:
+                        # 400, never 500: bad limit/outcome are caller
+                        # errors (parse_limit contract, both servers).
+                        self._json(400, {"error": str(e)})
+                        return
+                    self._json(200, rows)
+                elif path.startswith("/debug/request/"):
+                    from urllib.parse import unquote
+
+                    from lws_tpu.obs import journey as journeymod
+
+                    key = unquote(path[len("/debug/request/"):])
+                    fleet = getattr(cp, "fleet", None)
+                    if fleet is not None:
+                        # Cross-process assembly: the trace ctx rode the KV
+                        # frame meta, so every worker's local leg joins by
+                        # request id into one connected tree.
+                        body = fleet.collect_journeys(key)
+                    else:
+                        body = journeymod.local_journey(key)
+                    if body is None:
+                        self._json(404, {"error": f"no journey for {key!r}"})
+                        return
+                    self._json(200, body)
                 elif path == "/debug/faults":
                     from lws_tpu.core import faults as faultsmod
 

@@ -86,6 +86,7 @@ class TelemetryServer:
         from lws_tpu.core import slo as slomod
         from lws_tpu.core import trace as tracemod
         from lws_tpu.obs import history as historymod
+        from lws_tpu.obs import journey as journeymod
 
         self.watchdog = watchdog
         outer = self
@@ -182,6 +183,39 @@ class TelemetryServer:
                     self._send(200,
                                json.dumps(historymod.HISTORY.snapshot(limit)),
                                "application/json")
+                elif path == "/debug/requests":
+                    # The journey index: tail-retained requests by outcome
+                    # (breached / slowest / errored / ...), worst first.
+                    try:
+                        limit = parse_limit(q, default=32)
+                        rows = journeymod.VAULT.index(
+                            outcome=q.get("outcome", ["all"])[0],
+                            klass=q.get("klass", [""])[0],
+                            limit=limit,
+                        )
+                    except ValueError as e:
+                        # 400, never 500: a bad limit or an unknown outcome
+                        # is a caller error (parse_limit contract).
+                        self._send(400, json.dumps({"error": str(e)}),
+                                   "application/json")
+                        return
+                    self._send(200, json.dumps(rows, default=str),
+                               "application/json")
+                elif path.startswith("/debug/request/"):
+                    # One request's LOCAL journey leg, by request OR trace
+                    # id: the tail-sampled vault first, the bounded span
+                    # ring second (lws_tpu/obs/journey.py).
+                    from urllib.parse import unquote
+
+                    key = unquote(path[len("/debug/request/"):])
+                    body = journeymod.local_journey(key)
+                    if body is None:
+                        self._send(404, json.dumps(
+                            {"error": f"no journey for {key!r}"}),
+                            "application/json")
+                        return
+                    self._send(200, json.dumps(body, default=str),
+                               "application/json")
                 elif path == "/debug/faults":
                     self._send(200, json.dumps(faultsmod.INJECTOR.snapshot()),
                                "application/json")
@@ -248,6 +282,12 @@ def start_from_env() -> Optional[TelemetryServer]:
     if not raw:
         return None
     profmod.start_from_env()
+    # Journey vault feeds (span buffering, resilience events, SLO
+    # completions) — the tail-sampled forensics plane every worker serves
+    # at /debug/request[s] (LWS_TPU_JOURNEYS=0 disables).
+    from lws_tpu.obs import journey as journey_env
+
+    journey_env.install()
     # History ring sampling thread (LWS_TPU_HISTORY_INTERVAL_S; 0 disables
     # — the /metrics handler still feeds the ring per scrape).
     from lws_tpu.obs import history as history_env

@@ -66,7 +66,7 @@ def build_engine(batch: int, max_len: int):
 
 def _decode_bundle(
     engine, payload, steps: int, gamma: int = 0, ngram: int = 3,
-    klass: str = "",
+    klass: str = "", request_id: str = "",
 ) -> tuple[np.ndarray, dict, list]:  # hot-path
     """Bundle (monolithic payload bytes, or a finished streamed
     `CacheAssembler`) -> ([B, steps+1] tokens, per-handoff stats, span
@@ -147,10 +147,17 @@ def _decode_bundle(
             first = np.asarray(token)  # vet: ignore[hotpath-host-sync]: overlaps the in-flight decode dispatch — the ring still owns the chunk
             pipe.flush()  # blocks: decode_s is the real dispatch time
     toks = out["toks"]
+    # Journey wire leg: a streamed handoff's per-chunk arrival timeline
+    # (collected by the stream receiver while the wire was still moving)
+    # attaches to this request's journey before the verdict folds.
+    if streamed and getattr(payload, "chunk_timeline", None) and request_id:
+        from lws_tpu.obs import journey as journeymod
+
+        journeymod.VAULT.annotate(request_id, chunks=payload.chunk_timeline)
     # SLO timeline, decode leg: the chunk's mean step gap is the ITL sample
     # (same per-dispatch discipline as the engines' commit paths). The
     # workload class rode the bundle meta from the submitting client.
-    timeline = slo.request("disagg", klass=klass)
+    timeline = slo.request("disagg", klass=klass, request_id=request_id)
     timeline.tokens(steps, s_decode.duration_s)
     timeline.finish()
     stats = {
@@ -252,7 +259,7 @@ def _prefill_streamed(
     server.offer_stream(bundle_meta, stream)
     try:
         with s_req:
-            timeline = slo.request("disagg", klass=klass)
+            timeline = slo.request("disagg", klass=klass, request_id=req_id)
             wait = float(meta.get("queue_wait_s", 0.0))
             timeline.queue_wait(wait)
             # kv.gather parents serve.prefill here: the two phases overlap
@@ -276,6 +283,13 @@ def _prefill_streamed(
                     chunks=pstats["chunks"],
                     gather_s=round(pstats["gather_s"], 4),
                 )
+            # Journey wire leg, produce side: when each chunk left prefill
+            # compute (the arrival twin lands on the decode journey).
+            from lws_tpu.obs import journey as journeymod
+
+            journeymod.VAULT.annotate(
+                req_id, chunks_produced=list(stream.chunk_timeline)
+            )
             timeline.first_token(wait + s_prefill.duration_s)
             timeline.finish()
     except Exception:
@@ -346,7 +360,16 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
         # dispatch on a request nobody is waiting for starves live ones.
         deadline = resilience.Deadline.from_wire(meta.get("deadline_s"))
         if deadline is not None and deadline.expired():
-            resilience.expire("prefill.admit")
+            resilience.expire("prefill.admit", request_id=req_id)
+            # The drop IS the request's ending here: complete its journey
+            # as deadline-expired so the vault retains the story.
+            from lws_tpu.obs import journey as journeymod
+
+            journeymod.VAULT.complete(
+                req_id, trace=meta.get("trace"), engine="disagg",
+                klass=str(meta.get("klass") or ""),
+                outcome="deadline_expired",
+            )
             print(f"[prefill] DROPPED {req_id}: deadline expired in queue",
                   flush=True)
             continue
@@ -370,7 +393,8 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
             # SLO timeline, prefill leg: the KVServer stamped the prompt at
             # enqueue, so queue wait is the REAL socket-to-worker wait; TTFT
             # covers queue + prefill (the token exists after this dispatch).
-            timeline = slo.request("disagg", klass=str(meta.get("klass") or ""))
+            timeline = slo.request("disagg", klass=str(meta.get("klass") or ""),
+                                   request_id=req_id)
             wait = float(meta.get("queue_wait_s", 0.0))
             timeline.queue_wait(wait)
             with trace.span("serve.prefill", chunked=False,
@@ -378,10 +402,13 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
                 token, cache = engine.prefill(prompt.reshape(1, -1))
                 np.asarray(token)  # block: prefill_s is the real dispatch time
             timeline.first_token(wait + s_prefill.duration_s)
-            timeline.finish()
             with trace.span("kv.gather", tp_gathered=engine.mesh is not None) as s_gather:
                 bundle = kt.cache_to_bundle(cache, token)  # pos-truncated (+gathered)
                 s_gather.set(pos=int(cache.pos), bundle_bytes=len(bundle))
+            # finish() completes the journey — it must run after kv.gather
+            # closes (like the streamed path) or the gather leg never joins
+            # the completed journey and orphans an open-trace bucket.
+            timeline.finish()
         handoff = {
             "pos": int(cache.pos),
             "bundle_bytes": len(bundle),
@@ -476,7 +503,14 @@ def run_decode_tcp(
             return
         deadline = resilience.Deadline.from_wire(meta.get("deadline_s"))
         if deadline is not None and deadline.expired():
-            resilience.expire("decode.admit")
+            resilience.expire("decode.admit", request_id=meta["id"])
+            from lws_tpu.obs import journey as journeymod
+
+            journeymod.VAULT.complete(
+                meta["id"], trace=meta.get("trace"), engine="disagg",
+                klass=str(meta.get("klass") or ""),
+                outcome="deadline_expired",
+            )
             server.post_result(
                 meta["id"],
                 {"id": meta["id"], "failed": "deadline exceeded before decode"},
@@ -496,13 +530,22 @@ def run_decode_tcp(
                 full, dstats, dspans = _decode_bundle(
                     engine, payload, steps, gamma=gamma, ngram=ngram,
                     klass=str(meta.get("klass") or ""),
+                    request_id=meta["id"],
                 )
         except Exception as e:  # noqa: BLE001
             # Poison-message guard: a bundle this engine can't process (e.g.
             # prompt longer than decode's max_len budget) must be CONSUMED
             # with a failed result, not crash the worker — an un-acked crash
             # would re-queue the same bundle forever and head-of-line block
-            # every request behind it.
+            # every request behind it. The failure is also the request's
+            # ending: its journey completes ERRORED (always retained).
+            from lws_tpu.obs import journey as journeymod
+
+            journeymod.VAULT.complete(
+                meta["id"], trace=meta.get("trace"), engine="disagg",
+                klass=str(meta.get("klass") or ""),
+                outcome="errored", error=repr(e),
+            )
             print(f"[decode] FAILED {meta['id']}: {e!r}", flush=True)
             server.post_result(meta["id"], {"id": meta["id"], "failed": repr(e)[:300]}, b"")
             seen.record(meta["id"])

@@ -369,6 +369,8 @@ class KVStream:
     at produce time is the END frame's torn-cache check."""
 
     def __init__(self, chunk_tokens: int = 0) -> None:
+        import time as _time
+
         self._cond = threading.Condition()
         self.chunk_tokens = int(chunk_tokens)
         self._chunks: list[tuple[dict, list, int]] = []  # guarded-by: _cond
@@ -377,6 +379,11 @@ class KVStream:
         self.checksum = 0                                # guarded-by: _cond
         self.payload_bytes = 0                           # guarded-by: _cond
         self._acked_hw = 0                               # guarded-by: _cond
+        # Produce-side chunk timeline (stream-relative seconds): when each
+        # position range left prefill compute — the journey vault's
+        # prefill-leg wire story (chunks_produced annotation).
+        self._t0 = _time.monotonic()
+        self.chunk_timeline: list[dict] = []             # guarded-by: _cond
 
     @property
     def failed(self) -> bool:
@@ -392,6 +399,8 @@ class KVStream:
         """Buffer one position range [lo, hi) for delivery. Called by the
         prefill loop while LATER chunks still compute — a blocked puller
         never blocks the producer."""
+        import time as _time
+
         bufs, _ = pack_payload(arrays)
         wire_len = _payload_len(bufs)  # incl. the spec header, like len(payload)
         # Gauge BEFORE the chunk becomes visible: a connection thread can
@@ -405,7 +414,18 @@ class KVStream:
                     raise RuntimeError("put_chunk on a finished KVStream")
                 for view in bufs:
                     self.checksum = zlib.crc32(view, self.checksum)
-                meta = {"chunk": len(self._chunks), "pos_range": [int(lo), int(hi)]}
+                produce_t = round(_time.monotonic() - self._t0, 6)
+                # t_produce_s rides the chunk meta over the wire: the
+                # receive-side timeline can then show produce-vs-arrival
+                # per chunk (the overlap the streamed handoff exists for).
+                meta = {"chunk": len(self._chunks),
+                        "pos_range": [int(lo), int(hi)],
+                        "t_produce_s": produce_t}
+                self.chunk_timeline.append({
+                    "chunk": meta["chunk"],
+                    "t_s": produce_t,
+                    "bytes": wire_len,
+                })
                 self._chunks.append((meta, bufs, wire_len))
                 self.payload_bytes += wire_len
                 self._cond.notify_all()
@@ -854,6 +874,14 @@ class KVServer:
                         0.0, float(bmeta["deadline_s"]) - (now - pop_t)
                     )
                     bmeta["_offered_t"] = now
+                # The re-queue is the server half of the retry story: the
+                # journey vault joins it to the request by id (this side
+                # has no live span ctx — the id is the only join key).
+                from lws_tpu.core import flightrecorder
+
+                flightrecorder.record(
+                    "kv_requeue", request_id=str(bmeta.get("id") or ""),
+                )
                 self._bundles.put((bmeta, bpayload))
                 self._backlog_beat()
         elif op == "pull_result":
@@ -1002,9 +1030,16 @@ def _recv_stream(sock: socket.socket, begin_meta: dict, receiver,
     return (merged meta, receiver.finish(...) result, payload bytes). Any
     mismatch raises OSError — no final ack, the server re-queues, the
     redelivery replays from chunk 0: a torn cache is impossible."""
+    import time as _time
+
     crc = 0
     n = 0
     nbytes = 0
+    t0 = _time.monotonic()
+    # Arrival-side chunk timeline (stream-relative seconds): when each
+    # chunk landed off the wire — attached to the END meta and the
+    # receiver so the journey vault can render the wire leg per chunk.
+    chunk_timeline: list[dict] = []
     poison: Optional[BaseException] = None
     while True:
         resilience.check("kv.stream.recv")
@@ -1030,6 +1065,7 @@ def _recv_stream(sock: socket.socket, begin_meta: dict, receiver,
             merged["payload_bytes"] = nbytes
             try:
                 receiver.payload_bytes = nbytes  # wire accounting for stats
+                receiver.chunk_timeline = chunk_timeline  # journey wire leg
             except AttributeError:
                 pass
             if poison is None:
@@ -1044,6 +1080,13 @@ def _recv_stream(sock: socket.socket, begin_meta: dict, receiver,
         if int(cmeta.get("chunk", -1)) != n:
             raise OSError("out-of-order kv stream chunk")
         crc = zlib.crc32(payload, crc)
+        chunk_timeline.append({
+            "chunk": n,
+            "t_s": round(_time.monotonic() - t0, 6),
+            "bytes": len(payload),
+            **({"t_produce_s": cmeta["t_produce_s"]}
+               if "t_produce_s" in cmeta else {}),
+        })
         # Ack on RECEIPT, then insert: the per-chunk ack is flow control
         # (it keeps the sender's window moving while this side uploads);
         # durability is the END checksum + the final process ack — a death
@@ -1121,9 +1164,23 @@ def pull_bundle(endpoint, timeout: float = 1.0, process=None,
         if meta.get("stream"):
             receiver = (receiver_factory(meta) if receiver_factory
                         else HostAssembler(meta))
-            meta, payload, rx_bytes = _recv_stream(
-                sock, meta, receiver, ack_timeout
-            )
+            try:
+                meta, payload, rx_bytes = _recv_stream(
+                    sock, meta, receiver, ack_timeout
+                )
+            except OSError as e:
+                # A torn stream is a NOTABLE event (the server re-queues
+                # the whole stream; redelivery replays from chunk 0) and
+                # carries the request id so the journey vault can flag the
+                # retried leg on the request it delayed.
+                from lws_tpu.core import flightrecorder
+
+                flightrecorder.record(
+                    "kv_stream_torn",
+                    request_id=str(meta.get("id") or ""),
+                    error=repr(e)[:200],
+                )
+                raise
         else:
             rx_bytes = len(payload)
         metrics.inc("serving_kv_transfer_bytes_total", {"role": "decode"},

@@ -316,6 +316,10 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
             f"{api_url}/metrics/fleet",
             headers={"Accept": "application/openmetrics-text"},
         )
+        # Control-plane registries ride the fleet view too, and the API
+        # server's dry-run recommender publishes role-labelled gauges
+        # (`serving_scale_recommendation{role=...}`) from the control-plane
+        # instance — the worker-pod assertions below must not count them.
         while time.time() < deadline:
             with urllib.request.urlopen(fleet_req, timeout=10) as resp:
                 fleet_text = resp.read().decode()
@@ -324,6 +328,7 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
                 labels.get("role")
                 for fam in fleet.values()
                 for _, labels, _ in fam["samples"]
+                if labels.get("instance") != "control-plane"
             }
             if {"prefill", "decode"} <= roles:
                 break
@@ -331,7 +336,8 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
         by_role = {}
         for fam in fleet.values():
             for _, labels, _ in fam["samples"]:
-                if labels.get("role"):
+                if labels.get("role") \
+                        and labels.get("instance") != "control-plane":
                     by_role.setdefault(labels["role"], set()).add(labels["instance"])
         assert {"prefill", "decode"} <= set(by_role), by_role
         assert by_role["prefill"].isdisjoint(by_role["decode"])  # distinct pods
@@ -347,6 +353,40 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
             and name.endswith("_count") and value > 0
             for name, labels, value in fleet["serving_itl_seconds"]["samples"]
         ), fleet["serving_itl_seconds"]["samples"]
+        if not expect_streamed:
+            # Monolithic-path journey regression: finish() must run AFTER
+            # kv.gather closes, or the gather leg never joins req1's vault
+            # journey on the prefill worker (the streamed path is covered
+            # by the forensic block below). req1 is healthy, so it rides
+            # the slowest-K healthy retention class.
+            import urllib.error as _urlerr
+
+            mono = mono_leg = None
+            mono_deadline = time.time() + 60
+            while time.time() < mono_deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"{api_url}/debug/request/req1", timeout=10
+                    ) as resp:
+                        mono = _json.loads(resp.read().decode())
+                except _urlerr.HTTPError:
+                    mono = None
+                if mono is not None:
+                    mono_leg = next(
+                        (leg for leg in mono.get("legs", [])
+                         if leg["labels"].get("role") == "prefill"
+                         and leg["journey"].get("completed")), None)
+                    if mono_leg is not None:
+                        break
+                time.sleep(0.5)
+            assert mono_leg is not None, "prefill leg journey never joined"
+            mono_gather = {
+                s.get("instance") for s in mono["spans"]
+                if s["name"] == "kv.gather"
+            }
+            assert mono_gather & by_role["prefill"], [
+                (s["name"], s.get("instance")) for s in mono["spans"]
+            ]
         if run_scenario:
             # ISSUE 11 acceptance: the goodput ledger and class-granular
             # attainment ride the MERGED fleet exposition during a live
@@ -406,6 +446,172 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
         assert frame.startswith("FLEET"), frame
         for instance in by_role["prefill"] | by_role["decode"]:
             assert instance in frame, frame
+
+        # ISSUE 13: request-journey forensics across the three REAL
+        # processes. Arm a one-shot receive-side stream tear on the DECODE
+        # worker (the at-least-once retry leg), then send one request of
+        # the env-targeted "forensic" class (TTFT budget = 1 microsecond,
+        # so the prefill leg ALWAYS breaches and the tail vault ALWAYS
+        # retains it). Assertions: one connected fleet-joined tree, the KV
+        # chunk timeline, the torn-stream/requeue retry events, breach
+        # exemplar -> retained journey resolution, and an `lws-tpu
+        # explain` render whose verdict names the breaching phase.
+        if run_scenario:
+            import urllib.error
+
+            arm_tear = _json.dumps(
+                {"arm": {"kv.stream.recv_chunk": "drop:1"}}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{decode_metrics}/debug/faults",
+                data=arm_tear, headers={"Content-Type": "application/json"},
+            ), timeout=10) as resp:
+                assert resp.status == 200
+            tail_prompt = np.array([7, 3, 9, 1, 4], dtype=np.int32)
+            # A fresh ROOT trace (req1 already proved reconcile grafting):
+            # the forensic request owns its trace id, so the SLO exemplar's
+            # trace id below resolves unambiguously to THIS journey.
+            tail_span = trace.TRACER.span(
+                "serve.request", role="client", request_id="req-tail",
+            )
+            with tail_span:
+                kt.submit_prompt(
+                    endpoints["prefill"], "req-tail",
+                    kt.arrays_to_bytes(prompt=tail_prompt), klass="forensic",
+                )
+            tail_result = None
+            # Fresh budget: the test-global deadline is mostly spent by now
+            # (startup + fleet waits + the scenario run above).
+            tail_deadline = time.time() + 60
+            while time.time() < tail_deadline and tail_result is None:
+                backend.poll_all()
+                try:
+                    got_tail = kt.pull_result(endpoints["decode"], "req-tail")
+                except OSError:
+                    got_tail = None
+                if got_tail is not None:
+                    tail_result = kt.bytes_to_arrays(got_tail[1])["tokens"]
+                    break
+                time.sleep(0.5)
+            assert tail_result is not None, \
+                "req-tail never completed across the torn-stream retry"
+
+            # The fleet-joined journey by REQUEST id: ONE connected tree
+            # across client + prefill + decode, with the wire chunk
+            # timeline and the retry leg's events.
+            joined = None
+            journey_deadline = time.time() + 60
+            while time.time() < journey_deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"{api_url}/debug/request/req-tail", timeout=10
+                    ) as resp:
+                        joined = _json.loads(resp.read().decode())
+                except urllib.error.HTTPError:
+                    joined = None
+                if joined is not None and joined.get("connected") and \
+                        "retried" in (joined.get("flags") or []):
+                    break
+                time.sleep(0.5)
+            assert joined is not None, "fleet join never found req-tail"
+            assert joined["connected"] is True, [
+                (s["name"], s.get("instance"), s["trace_id"], s["parent_id"])
+                for s in joined["spans"]
+            ]
+            leg_instances = {s.get("instance") for s in joined["spans"]}
+            assert by_role["prefill"] <= leg_instances, leg_instances
+            assert by_role["decode"] <= leg_instances, leg_instances
+            names = {s["name"] for s in joined["spans"]}
+            assert {"serve.request", "serve.prefill", "kv.gather",
+                    "kv.deserialize", "serve.decode_dispatch"} <= names, names
+            # Tail retention verdicts: breached (forensic TTFT budget) AND
+            # retried (the armed stream tear).
+            assert "breached" in joined["flags"], joined["flags"]
+            assert "retried" in joined["flags"], joined["flags"]
+            kinds = {e["kind"] for e in joined["events"]}
+            assert kinds & {"kv_stream_torn", "kv_requeue"}, kinds
+            # The KV chunk timeline rode the journey: 3 stream chunks
+            # (ceil(5 tokens / chunk=2)) with arrival stamps, plus the
+            # produce-side twin from the prefill leg.
+            chunks = joined["annotations"].get("chunks")
+            assert chunks is not None and len(chunks) == 3, chunks
+            assert all("t_s" in c and c["bytes"] > 0 for c in chunks), chunks
+            assert len(joined["annotations"].get("chunks_produced", [])) == 3
+            # The prefill leg's timeline carries the phase values + the
+            # forensic targets the verdict grades against.
+            prefill_leg = next(
+                leg for leg in joined["legs"]
+                if leg["labels"].get("role") == "prefill"
+            )
+            tlv = prefill_leg["journey"]["timeline"]
+            assert tlv["ttft_s"] > tlv["targets"]["ttft_s"], tlv
+
+            # The breach exemplar RESOLVES to the retained journey: pull a
+            # forensic-class TTFT exemplar trace id off the merged fleet
+            # exposition and ask the fleet-joined endpoint for it — the
+            # span ring may wrap, the vault must not.
+            forensic_ids = set()
+            while time.time() < journey_deadline and not forensic_ids:
+                with urllib.request.urlopen(fleet_req, timeout=10) as resp:
+                    tail_text = resp.read().decode()
+                tfams = parse_prod(tail_text)
+                forensic_ids = {
+                    ex.split('trace_id="')[1].split('"')[0]
+                    for name, labels, _, ex in
+                    tfams.get("serving_ttft_seconds", {}).get("samples", [])
+                    if labels.get("klass") == "forensic"
+                    and 'trace_id="' in ex
+                }
+                if not forensic_ids:
+                    time.sleep(1.1)  # collector cache TTL is 1s
+            assert forensic_ids, "forensic TTFT exemplar never scraped"
+            resolved = None
+            for ex_tid in forensic_ids:
+                try:
+                    with urllib.request.urlopen(
+                        f"{api_url}/debug/request/{ex_tid}", timeout=10
+                    ) as resp:
+                        cand = _json.loads(resp.read().decode())
+                except urllib.error.HTTPError:
+                    continue
+                if "breached" in (cand.get("flags") or []):
+                    resolved = cand
+                    break
+            assert resolved is not None, \
+                "breach exemplar did not resolve to a retained journey"
+            assert any(
+                leg["journey"].get("id") == "req-tail"
+                for leg in resolved["legs"]
+            ), resolved["legs"]
+
+            # `lws-tpu explain` renders the whole story: cross-process
+            # waterfall + wire chunks + retry events + a verdict naming
+            # the phase (ttft) that blew the budget.
+            import io as _io
+            from contextlib import redirect_stdout
+
+            from lws_tpu import cli as climod
+
+            buf = _io.StringIO()
+            with redirect_stdout(buf):
+                rc = climod.main([
+                    "explain", "req-tail",
+                    "--server", f"127.0.0.1:{api.port}",
+                ])
+            assert rc == 0
+            explain_frame = buf.getvalue()
+            assert "WATERFALL" in explain_frame, explain_frame
+            assert "wire chunks: 3" in explain_frame, explain_frame
+            assert "VERDICT: BREACHED" in explain_frame, explain_frame
+            assert "ttft" in explain_frame, explain_frame
+            # The index surface lists it among the breached worst.
+            buf = _io.StringIO()
+            with redirect_stdout(buf):
+                rc = climod.main([
+                    "explain", "--breached",
+                    "--server", f"127.0.0.1:{api.port}",
+                ])
+            assert rc == 0
+            assert "req-tail" in buf.getvalue(), buf.getvalue()
 
         # ISSUE 12 satellite: counter resets + series retirement across a
         # REAL worker restart, as seen by the history plane. Sample the
@@ -563,7 +769,16 @@ def test_disaggregated_prefill_decode_over_tcp_streamed(tmp_path):
     shapes run end to end across real processes.)"""
     _run_disagg_e2e(
         tmp_path,
-        extra_env=[EnvVar("LWS_TPU_KV_CHUNK", "2")],
+        extra_env=[
+            EnvVar("LWS_TPU_KV_CHUNK", "2"),
+            # ISSUE 13: the "forensic" class's 1-microsecond TTFT budget
+            # guarantees its one request breaches server-side and is
+            # retained by the tail vault (the scenario's premium/chat
+            # classes keep their generous targets — goodput asserts hold).
+            EnvVar("LWS_TPU_SLO_CLASS_TARGETS",
+                   '{"forensic": {"ttft_s": 0.000001, "itl_s": 30.0, '
+                   '"queue_wait_s": 30.0}}'),
+        ],
         expect_streamed=True,
         # ISSUE 11: a seeded two-class loadgen scenario runs over the live
         # pair mid-test; goodput + class-granular attainment must ride the

@@ -1,0 +1,709 @@
+"""Request-journey forensics (ISSUE 13): the tail-sampled trace vault,
+its three feeds (trace finish listener, flight-recorder observer, SLO
+sink), the /debug/request[s] surfaces on both servers, the fleet join, and
+the `lws-tpu explain` renderer.
+
+Every retention test drives the vault with injected rng/clock — no
+wall-clock sleeps, no probabilistic flake. The HTTP tests run real servers
+on ephemeral ports, the same localhost path the multi-process e2e
+(test_e2e_disagg) exercises with separate OS processes."""
+
+import io
+import json
+import urllib.error
+import urllib.request
+from contextlib import redirect_stdout
+
+from lws_tpu.core import flightrecorder, trace
+from lws_tpu.core.flightrecorder import FlightRecorder
+from lws_tpu.core.metrics import MetricsRegistry
+from lws_tpu.core.slo import SLORecorder, SLOTargets
+from lws_tpu.core.trace import Tracer, connected_tree
+from lws_tpu.obs import journey
+from lws_tpu.obs.journey import VAULT, JourneyVault, verdict
+
+
+def make_vault(**kw):
+    kw.setdefault("sample_rate", 0.0)
+    kw.setdefault("slowest_k", 0)
+    kw.setdefault("rng", lambda: 1.0)  # reservoir roll always loses
+    kw.setdefault("registry", MetricsRegistry())
+    return JourneyVault(**kw)
+
+
+def span_record(trace_id, span_id, parent=None, name="serve.request",
+                start=1.0, dur=0.5, attrs=None, status="ok"):
+    return {"name": name, "trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent, "start_unix": start, "duration_s": dur,
+            "status": status, "attrs": attrs or {}}
+
+
+TARGETS = {"ttft_s": 1.0, "itl_s": 0.1, "queue_wait_s": 0.5}
+
+
+# ---------------------------------------------------------------------------
+# Retention policy
+
+
+def test_breached_journey_retained_and_resolved_by_either_id():
+    v = make_vault()
+    v.on_span(span_record("t1", "s1"))
+    out = v.complete("r1", trace={"trace_id": "t1", "span_id": "s1"},
+                     engine="disagg", ok=False,
+                     phases={"ttft_s": 2.0}, targets=TARGETS)
+    assert out == "breached"
+    by_rid, by_tid = v.get("r1"), v.get("t1")
+    assert by_rid is not None and by_tid is not None
+    assert by_rid["id"] == by_tid["id"] == "r1"
+    assert len(by_rid["spans"]) == 1
+    assert v._registry.counter_value(
+        "serving_journeys_retained_total", {"outcome": "breached"}) == 1.0
+
+
+def test_healthy_request_not_sampled_is_dropped_and_counted():
+    v = make_vault()
+    v.on_span(span_record("t1", "s1"))
+    out = v.complete("r1", trace={"trace_id": "t1"}, ok=True,
+                     phases={"ttft_s": 0.1}, targets=TARGETS)
+    assert out is None and v.get("r1") is None
+    assert v._registry.counter_value(
+        "serving_journeys_dropped_total", {"reason": "not_sampled"}) == 1.0
+
+
+def test_reservoir_keeps_a_healthy_fraction():
+    rolls = iter([0.9, 0.001, 0.9])  # only the middle request wins
+    v = make_vault(sample_rate=0.02, rng=lambda: next(rolls))
+    for i in range(3):
+        v.complete(f"r{i}", trace={"trace_id": f"t{i}"}, ok=True,
+                   phases={"ttft_s": 0.1}, targets=TARGETS)
+    assert v.get("r0") is None and v.get("r2") is None
+    assert v.get("r1")["outcome"] == "sampled"
+
+
+def test_slowest_k_window_keeps_the_slow_tail():
+    v = make_vault(slowest_k=2)
+    for rid, ttft in (("a", 0.10), ("b", 0.30), ("c", 0.20)):
+        v.complete(rid, trace={"trace_id": "t" + rid}, ok=True,
+                   phases={"ttft_s": ttft}, targets=TARGETS)
+    # "a" (the fastest) was displaced when "c" beat it.
+    assert v.get("a") is None
+    assert v.get("b")["outcome"] == "slowest"
+    assert v.get("c")["outcome"] == "slowest"
+    assert v._registry.counter_value(
+        "serving_journeys_dropped_total", {"reason": "displaced"}) == 1.0
+    # A faster-than-floor newcomer is NOT kept (and displaces nothing).
+    assert v.complete("d", trace={"trace_id": "td"}, ok=True,
+                      phases={"ttft_s": 0.05}, targets=TARGETS) is None
+    assert v.get("b") is not None and v.get("c") is not None
+
+
+def test_must_keep_classes_always_retained():
+    v = make_vault()
+    assert v.complete("e1", outcome="errored", error="boom",
+                      trace={"trace_id": "te"}) == "errored"
+    assert v.complete("d1", outcome="deadline_expired",
+                      trace={"trace_id": "td"}) == "deadline_expired"
+    # A retried-but-healthy request: the event flags it before completion.
+    v.on_event({"kind": "kv_stream_torn", "request_id": "rt", "ts": 1.0})
+    assert v.complete("rt", trace={"trace_id": "tt"}, ok=True,
+                      phases={"ttft_s": 0.1}, targets=TARGETS) == "retried"
+    # A fault-touched healthy request is kept too (chaos forensics).
+    v.on_event({"kind": "fault_injected", "request_id": "rf",
+                "point": "kv.ack", "mode": "drop", "ts": 1.0})
+    assert v.complete("rf", trace={"trace_id": "tf"}, ok=True,
+                      phases={"ttft_s": 0.1}, targets=TARGETS) == "fault"
+    assert {row["id"] for row in v.index(outcome="all", limit=0) or []} == set()
+    assert {row["id"] for row in v.index(outcome="retried")} == {"rt"}
+    assert {row["id"] for row in v.index(outcome="errored")} == {"e1"}
+
+
+def test_healthy_flood_never_evicts_retained_breached_journey():
+    """The acceptance invariant: under a flood of retained-healthy traffic
+    the budget evicts sampled journeys first — a breached journey survives,
+    and the drop counters account for every loss."""
+    reg = MetricsRegistry()
+    v = make_vault(sample_rate=1.0, rng=lambda: 0.0,  # keep EVERY healthy
+                   budget_records=40, registry=reg)
+    v.on_span(span_record("tb", "sb"))
+    assert v.complete("bad", trace={"trace_id": "tb"}, ok=False,
+                      phases={"ttft_s": 5.0}, targets=TARGETS) == "breached"
+    for i in range(200):  # each journey carries one span record
+        v.on_span(span_record(f"t{i}", f"s{i}"))
+        v.complete(f"ok{i}", trace={"trace_id": f"t{i}"}, ok=True,
+                   phases={"ttft_s": 0.01}, targets=TARGETS)
+    assert v.stats()["records"] <= v.budget_records
+    assert v.get("bad") is not None, "healthy flood evicted a breached journey"
+    retained = sum(
+        reg.counter_value("serving_journeys_retained_total", {"outcome": o})
+        for o in journey.OUTCOMES if o != "all"
+    )
+    dropped_budget = reg.counter_value(
+        "serving_journeys_dropped_total", {"reason": "budget"})
+    assert retained == 201.0
+    # Everything retained beyond what fits was evicted under `budget`.
+    assert dropped_budget == retained - v.stats()["kept"]
+
+
+def test_aged_journeys_evicted_with_counter():
+    clock = {"t": 0.0}
+    v = make_vault(retention_s=10.0, clock=lambda: clock["t"])
+    v.complete("old", trace={"trace_id": "t1"}, ok=False,
+               phases={"ttft_s": 5.0}, targets=TARGETS)
+    clock["t"] = 100.0
+    v.complete("new", trace={"trace_id": "t2"}, ok=False,
+               phases={"ttft_s": 5.0}, targets=TARGETS)
+    assert v.get("old") is None and v.get("new") is not None
+    assert v._registry.counter_value(
+        "serving_journeys_dropped_total", {"reason": "aged"}) == 1.0
+
+
+def test_annotation_payloads_count_against_the_budget():
+    """KV chunk timelines attached to a KEPT journey are budget-tracked
+    records — a retained streamed journey can't hold unbounded uncounted
+    memory, and its eviction is accounted in the same record units."""
+    v = make_vault(budget_records=10)
+    v.complete("r1", trace={"trace_id": "t1"}, outcome="errored")
+    v.annotate("r1", chunks=[{"seq": i} for i in range(8)])
+    assert v.stats()["records"] == 8
+    v.annotate("r1", chunks_produced=[{"seq": i} for i in range(8)])
+    # 16 records under a 10-record budget: the must-keep class ALONE
+    # exceeds the budget, so the oldest-flagged pass reclaims it — counted.
+    assert v.get("r1") is None and v.stats()["records"] == 0
+    assert v._registry.counter_value(
+        "serving_journeys_dropped_total", {"reason": "budget"}) == 16.0
+
+
+def test_read_paths_age_out_retained_journeys_without_traffic():
+    """The age bound must hold on a QUIET process: with no further
+    completions, index()/get() themselves sweep — retained journeys do not
+    outlive LWS_TPU_JOURNEY_RETENTION_S just because traffic stopped."""
+    clock = {"t": 0.0}
+    v = make_vault(retention_s=10.0, clock=lambda: clock["t"])
+    v.complete("r1", trace={"trace_id": "t1"}, ok=False,
+               phases={"ttft_s": 9.0}, targets=TARGETS)
+    clock["t"] = 100.0
+    assert v.index(outcome="all") == []
+    assert v.get("r1") is None
+    assert v._registry.counter_value(
+        "serving_journeys_dropped_total", {"reason": "aged"}) == 1.0
+
+
+def test_second_engine_request_on_shared_trace_gets_its_own_verdict():
+    """Engine paths carry no wire request id, so complete() keys on the
+    trace id: two requests finishing on ONE shared trace must BOTH retain
+    their verdicts — the second is a new journey under a distinct key, not
+    an idempotent re-finish that silently discards a breach."""
+    v = make_vault()
+    v.complete("", trace={"trace_id": "T", "span_id": "r1-root"},
+               ok=False, phases={"ttft_s": 9.0}, targets=TARGETS)
+    out = v.complete("", trace={"trace_id": "T", "span_id": "r2-root"},
+                     ok=False, phases={"ttft_s": 3.0}, targets=TARGETS)
+    assert out == "breached"
+    assert v._registry.counter_value(
+        "serving_journeys_retained_total", {"outcome": "breached"}) == 2.0
+    # Trace-id lookup still resolves to the NEWEST shared-trace journey,
+    # even though the oldest one's key IS the trace id.
+    assert v.get("T")["timeline"]["ttft_s"] == 3.0
+
+
+def test_kill_switch_disables_direct_vault_entry_points(monkeypatch):
+    """LWS_TPU_JOURNEYS=0 must disable the PLANE, not just install(): the
+    disagg workers call VAULT.complete()/annotate() directly, so those
+    entry points gate on the env too."""
+    monkeypatch.setenv(journey.JOURNEYS_ENV, "0")
+    v = make_vault()
+    assert v.complete("r1", trace={"trace_id": "t1"},
+                      outcome="errored") is None
+    v.annotate("r1", chunks=[{"seq": 0}])
+    assert v.get("r1") is None
+    assert v.stats()["kept"] == 0 and v.stats()["pending"] == 0
+    assert v._registry.counter_value(
+        "serving_journeys_retained_total", {"outcome": "errored"}) == 0.0
+
+
+def test_open_trace_buffer_is_lru_bounded_and_counted():
+    v = make_vault(max_open_traces=4)
+    for i in range(8):
+        v.on_span(span_record(f"t{i}", f"s{i}"))
+    assert v.stats()["open_traces"] == 4
+    assert v._registry.counter_value(
+        "serving_journeys_dropped_total", {"reason": "open_evicted"}) >= 4.0
+
+
+def test_late_root_span_attaches_after_completion():
+    """The serve.request root closes AFTER the timeline finishes (finish
+    runs inside the span): a completed journey keeps absorbing its trace's
+    spans."""
+    v = make_vault()
+    v.on_span(span_record("t1", "child", parent="root", name="serve.prefill"))
+    v.complete("r1", trace={"trace_id": "t1"}, ok=False,
+               phases={"ttft_s": 9.0}, targets=TARGETS)
+    v.on_span(span_record("t1", "root", name="serve.request"))
+    got = v.get("r1")
+    assert {s["span_id"] for s in got["spans"]} == {"child", "root"}
+    assert connected_tree(got["spans"])
+
+
+# ---------------------------------------------------------------------------
+# The three feeds, wired like install() does — on PRIVATE instances
+
+
+def test_shared_trace_requests_do_not_steal_each_others_spans():
+    """Two sequential requests grafted onto ONE trace (a client parenting
+    both onto the same reconcile root — the e2e shape): the first retained
+    journey's trace claim must release once its own root span attaches, or
+    it would swallow the second request's spans forever."""
+    v = make_vault()
+    # Request 1: child, completion (ctx names the root), late root.
+    v.on_span(span_record("T", "r1-child", parent="r1-root",
+                          name="serve.prefill"))
+    v.complete("r1", trace={"trace_id": "T", "span_id": "r1-root"},
+               ok=False, phases={"ttft_s": 9.0}, targets=TARGETS)
+    v.on_span(span_record("T", "r1-root", name="serve.request"))
+    # Request 2 on the SAME trace id.
+    v.on_span(span_record("T", "r2-child", parent="r2-root",
+                          name="serve.prefill"))
+    v.complete("r2", trace={"trace_id": "T", "span_id": "r2-root"},
+               ok=False, phases={"ttft_s": 9.0}, targets=TARGETS)
+    v.on_span(span_record("T", "r2-root", name="serve.request"))
+    got1, got2 = v.get("r1"), v.get("r2")
+    assert {s["span_id"] for s in got1["spans"]} == {"r1-child", "r1-root"}
+    assert {s["span_id"] for s in got2["spans"]} == {"r2-child", "r2-root"}
+    # Trace-id lookup prefers the NEWEST journey on the shared trace.
+    assert v.get("T")["id"] == "r2"
+
+
+def test_mid_request_trace_only_retry_event_raises_retried_flag():
+    """resilience.call's `retry` events carry no request id — only the
+    live trace ctx. One recorded MID-REQUEST (before any completion names
+    the trace) must still join the journey at complete() and raise the
+    must-keep `retried` flag: an otherwise-healthy retried request is a
+    100%-retention class, not a reservoir roll."""
+    v = make_vault()  # sample_rate 0: only the retried flag can keep it
+    v.on_event({"kind": "retry", "site": "kv.pull_bundle",
+                "trace": {"trace_id": "T", "span_id": "s-mid"}})
+    v.on_span(span_record("T", "s1"))
+    out = v.complete("r1", trace={"trace_id": "T", "span_id": "s1"},
+                     ok=True, phases={"ttft_s": 0.1}, targets=TARGETS)
+    assert out == "retried"
+    got = v.get("r1")
+    assert "retried" in got["flags"]
+    assert any(e["kind"] == "retry" for e in got["events"])
+
+
+def test_completed_journey_never_steals_spans_when_root_never_closes():
+    """The worker deadline-drop shape: complete() against the CLIENT's
+    wire ctx, whose root span never closes in this process — the claim
+    can't release via the root-arrival path. A second request re-using
+    the trace must still get its spans buffered fresh, not grafted onto
+    the finished journey."""
+    v = make_vault()
+    v.complete("r1", trace={"trace_id": "T", "span_id": "remote-root"},
+               outcome="deadline_expired")
+    # Request 2's spans arrive on the same trace while r1 still "owns" it.
+    v.on_span(span_record("T", "r2-child", parent="r2-root",
+                          name="serve.prefill"))
+    v.complete("r2", trace={"trace_id": "T", "span_id": "r2-root"},
+               ok=False, phases={"ttft_s": 9.0}, targets=TARGETS)
+    v.on_span(span_record("T", "r2-root", name="serve.request"))
+    assert {s["span_id"] for s in v.get("r1")["spans"]} == set()
+    assert {s["span_id"] for s in v.get("r2")["spans"]} == \
+        {"r2-child", "r2-root"}
+
+
+def test_slo_sink_completes_journey_with_phases_targets_and_verdict():
+    v = make_vault()
+    rec = SLORecorder(SLOTargets(ttft_s=1.0, itl_s=1.0, queue_wait_s=1.0),
+                      registry=MetricsRegistry(), window=8)
+    rec.journey_sinks.append(v.on_timeline)
+    tl = rec.request("disagg", klass="premium", request_id="rq")
+    tl.queue_wait(0.2)
+    tl.first_token(2.5)  # breach
+    tl.tokens(4, 0.02)
+    assert tl.finish() is False
+    got = v.get("rq")
+    assert got is not None and got["outcome"] == "breached"
+    assert got["klass"] == "premium" and got["engine"] == "disagg"
+    assert got["timeline"]["ttft_s"] == 2.5
+    assert got["timeline"]["targets"]["ttft_s"] == 1.0
+    vd = verdict(got)
+    assert not vd["ok"] and vd["phase"] == "ttft"
+    assert "2.5000s" in vd["text"] and "1.0000s" in vd["text"]
+
+
+def test_ring_wrap_mid_request_resolved_via_vault_first():
+    """The exemplar dead-end regression: a long-lived request whose early
+    spans the bounded span ring evicts mid-request still resolves — the
+    vault buffered every span by trace id, and a breaching request is
+    retained, so lookup by the exemplar's trace id finds the WHOLE
+    subtree the ring already lost."""
+    tracer = Tracer(ring=4, enabled=True, sample_rate=1.0)
+    v = make_vault()
+    tracer.add_finish_listener(v.on_span)
+    with tracer.span("serve.request", request_id="long1") as root:
+        trace_id = root.trace_id
+        for i in range(16):  # wraps the 4-slot ring mid-request
+            with tracer.span("serve.decode_dispatch", step=i):
+                pass
+    ring_ids = {s["span_id"] for s in tracer.spans()}
+    assert len(ring_ids) == 4, "ring should have wrapped"
+    v.complete("long1", trace={"trace_id": trace_id, "span_id": root.span_id},
+               ok=False, phases={"ttft_s": 9.0}, targets=TARGETS)
+    got = v.get(trace_id)  # the exemplar carries the TRACE id
+    assert got is not None and got["id"] == "long1"
+    vault_ids = {s["span_id"] for s in got["spans"]}
+    assert len(vault_ids) == 17  # every dispatch + the root
+    assert not (vault_ids <= ring_ids), "vault must outlive the ring wrap"
+
+
+def test_flightrecorder_observer_joins_events_by_trace_ctx():
+    rec = FlightRecorder()
+    v = make_vault()
+    rec.add_observer(v.on_event)
+    tracer = Tracer(ring=64, enabled=True, sample_rate=1.0)
+    tracer.add_finish_listener(v.on_span)
+    # No way to fake trace.current_context() on a private tracer from the
+    # recorder: hand the ctx explicitly, like the torn-stream events do.
+    v.on_span(span_record("tr9", "s9"))
+    v.complete("r9", trace={"trace_id": "tr9"}, ok=False,
+               phases={"ttft_s": 9.0}, targets=TARGETS)
+    rec.record("retry", site="kv.pull_bundle", request_id="r9")
+    got = v.get("r9")
+    assert "retried" in got["flags"]
+    assert any(e["kind"] == "retry" for e in got["events"])
+
+
+def test_vault_annotations_ride_the_journey():
+    v = make_vault()
+    chunks = [{"chunk": 0, "t_s": 0.01, "bytes": 100},
+              {"chunk": 1, "t_s": 0.02, "bytes": 100}]
+    v.annotate("rq", chunks=chunks)
+    v.complete("rq", trace={"trace_id": "tq"}, ok=False,
+               phases={"ttft_s": 9.0}, targets=TARGETS)
+    assert v.get("rq")["annotations"]["chunks"] == chunks
+
+
+def test_watchdog_dump_embeds_worst_journeys():
+    VAULT.clear()
+    try:
+        VAULT.complete("dump-bad", trace={"trace_id": "tdump"},
+                       engine="disagg", ok=False,
+                       phases={"ttft_s": 9.0}, targets=TARGETS)
+        dump = flightrecorder.dump(reason="test")
+        assert any(j["id"] == "dump-bad" for j in dump["journeys"]), \
+            dump["journeys"]
+    finally:
+        VAULT.clear()
+
+
+# ---------------------------------------------------------------------------
+# Debug surfaces: worker telemetry server + API server (400/401 parity)
+
+
+def _get(url, token=None):
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _get_code(url, token=None):
+    try:
+        return _get(url, token)[0]
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_worker_journey_endpoints_gating_and_validation():
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    VAULT.clear()
+    server = TelemetryServer(port=0, token="s3cret")
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        VAULT.complete("w-bad", trace={"trace_id": "tw"}, engine="disagg",
+                       klass="chat", ok=False,
+                       phases={"ttft_s": 9.0}, targets=TARGETS)
+        # Bearer gating parity with the other debug surfaces.
+        assert _get_code(f"{base}/debug/request/w-bad") == 401
+        assert _get_code(f"{base}/debug/requests") == 401
+        status, body = _get(f"{base}/debug/request/w-bad", token="s3cret")
+        assert status == 200 and body["outcome"] == "breached"
+        assert body["source"] == "vault"
+        # Trace-id resolution (the exemplar path) works over HTTP too.
+        status, body = _get(f"{base}/debug/request/tw", token="s3cret")
+        assert status == 200 and body["id"] == "w-bad"
+        status, rows = _get(
+            f"{base}/debug/requests?outcome=breached&klass=chat",
+            token="s3cret")
+        assert status == 200 and [r["id"] for r in rows] == ["w-bad"]
+        # 400-parity: bad limit and unknown outcome are caller errors.
+        assert _get_code(f"{base}/debug/requests?limit=-1",
+                         token="s3cret") == 400
+        assert _get_code(f"{base}/debug/requests?limit=bogus",
+                         token="s3cret") == 400
+        assert _get_code(f"{base}/debug/requests?outcome=weird",
+                         token="s3cret") == 400
+        assert _get_code(f"{base}/debug/request/unknown-id",
+                         token="s3cret") == 404
+    finally:
+        server.stop()
+        VAULT.clear()
+
+
+def test_api_server_journey_endpoints_fleet_joined(tmp_path):
+    """The cross-process join, over real localhost HTTP: two stub 'worker'
+    servers each serve one leg of a request's journey; the API server's
+    /debug/request/{id} merges them (plus its own local spans for the
+    trace) into ONE connected tree, and /debug/requests merges the
+    instance-labelled indexes."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from lws_tpu.api.pod import PodPhase
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.server import ApiServer
+    from tests.test_telemetry_plane import _make_worker_pod
+
+    VAULT.clear()
+    # The client/reconcile leg lives in THIS process: a root span whose
+    # trace the workers' legs join (exactly how the e2e's client span
+    # parents the prefill/decode subtrees).
+    root = trace.TRACER.span("serve.request", role="client",
+                             request_id="j-1")
+    with root:
+        pass
+    tid = root.trace_id
+    legs = {
+        "prefill-pod": {
+            "id": "j-1", "trace_id": tid, "outcome": "breached",
+            "completed": True, "flags": ["breached"],
+            "timeline": {"ttft_s": 2.0,
+                         "targets": dict(TARGETS)},
+            "events": [], "annotations": {"chunks": [
+                {"chunk": 0, "t_s": 0.01, "bytes": 10}]},
+            "spans": [span_record(tid, "pf-root", parent=root.span_id,
+                                  name="serve.request"),
+                      span_record(tid, "pf-prefill", parent="pf-root",
+                                  name="serve.prefill")],
+        },
+        "decode-pod": {
+            "id": "j-1", "trace_id": tid, "outcome": "retried",
+            "completed": True, "flags": ["retried"],
+            "timeline": {"worst_itl_s": 0.01,
+                         "targets": dict(TARGETS)},
+            "events": [{"kind": "kv_stream_torn", "request_id": "j-1",
+                        "ts": 2.0, "error": "OSError('torn')"}],
+            "annotations": {},
+            "spans": [span_record(tid, "dc-root", parent="pf-root",
+                                  name="serve.request"),
+                      span_record(tid, "dc-dec", parent="dc-root",
+                                  name="serve.decode_dispatch")],
+        },
+    }
+
+    def make_stub(leg):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/debug/request/j-1"):
+                    body = json.dumps(leg).encode()
+                elif self.path.startswith("/debug/requests"):
+                    body = json.dumps([{
+                        "id": "j-1", "outcome": leg["outcome"],
+                        "klass": "", "engine": "disagg",
+                        "latency_s": 2.0, "completed_unix": 5.0,
+                    }]).encode()
+                elif self.path == "/metrics":
+                    body = b"# HELP x x\n# TYPE x counter\nx 1.0\n"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                else:
+                    self.send_response(404)
+                    body = b"{}"
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+
+    stubs = [make_stub(legs["prefill-pod"]), make_stub(legs["decode-pod"])]
+    cp = ControlPlane()
+    api = ApiServer(cp, port=0)
+    api.start()
+    try:
+        for name, httpd in zip(("prefill-pod", "decode-pod"), stubs):
+            pod = cp.store.create(_make_worker_pod(
+                name, httpd.server_port,
+                role="prefill" if "prefill" in name else "decode"))
+            pod.status.phase = PodPhase.RUNNING
+            pod.status.ready = True
+            pod.status.address = "127.0.0.1"
+            cp.store.update_status(pod)
+        status, joined = _get(
+            f"http://127.0.0.1:{api.port}/debug/request/j-1")
+        assert status == 200
+        assert joined["connected"] is True, joined["spans"]
+        instances = {s["instance"] for s in joined["spans"]}
+        assert {"control-plane", "prefill-pod", "decode-pod"} <= instances
+        assert set(joined["flags"]) == {"breached", "retried"}
+        assert joined["outcome"] == "breached"  # worst leg wins
+        assert joined["annotations"]["chunks"]
+        leg_instances = {
+            leg["labels"]["instance"] for leg in joined["legs"]
+        }
+        assert {"control-plane", "prefill-pod", "decode-pod"} <= leg_instances
+        # The fleet-joined index carries instance labels.
+        status, rows = _get(
+            f"http://127.0.0.1:{api.port}/debug/requests?outcome=breached")
+        assert status == 200
+        assert any(r["instance"] == "prefill-pod" for r in rows), rows
+        # 400 parity with the worker server.
+        assert _get_code(
+            f"http://127.0.0.1:{api.port}/debug/requests?outcome=weird"
+        ) == 400
+        assert _get_code(
+            f"http://127.0.0.1:{api.port}/debug/requests?limit=bogus"
+        ) == 400
+        assert _get_code(
+            f"http://127.0.0.1:{api.port}/debug/request/nobody"
+        ) == 404
+
+        # And the renderer consumes the joined record: the waterfall names
+        # the legs and the verdict names the breaching phase.
+        from lws_tpu.cli import render_explain
+
+        frame = render_explain(joined)
+        assert "WATERFALL" in frame and "serve.prefill" in frame
+        assert "wire chunks: 1" in frame
+        assert "kv_stream_torn" in frame
+        assert "VERDICT" in frame and "ttft" in frame and "BREACHED" in frame
+
+        # The CLI verb end to end against the live API server.
+        from lws_tpu import cli as climod
+
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = climod.main(["explain", "j-1",
+                              "--server", f"127.0.0.1:{api.port}"])
+        assert rc == 0
+        assert "VERDICT" in out.getvalue()
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = climod.main(["explain", "--breached",
+                              "--server", f"127.0.0.1:{api.port}"])
+        assert rc == 0
+        assert "j-1" in out.getvalue()
+    finally:
+        api.stop()
+        for httpd in stubs:
+            httpd.shutdown()
+        VAULT.clear()
+
+
+def test_local_journey_falls_back_to_span_ring():
+    """An unretained (healthy, unsampled) request is still explainable
+    while its spans survive in the ring: vault first, ring second."""
+    VAULT.clear()
+    with trace.TRACER.span("serve.request", request_id="fresh-1") as s:
+        tid = s.trace_id
+    # Pretend the vault dropped it (healthy): wipe the open buffers.
+    VAULT.clear()
+    got = journey.local_journey(tid)
+    assert got is not None and got["source"] == "ring"
+    assert any(sp["trace_id"] == tid for sp in got["spans"])
+    assert journey.local_journey("never-seen") is None
+
+
+# ---------------------------------------------------------------------------
+# loadgen worst-K offenders
+
+
+def test_loadgen_report_lists_worst_requests_with_journey_ids():
+    from lws_tpu.loadgen.report import render_report
+    from lws_tpu.loadgen.runner import (
+        RequestOutcome,
+        RunResult,
+        summarize,
+    )
+
+    targets = SLOTargets(ttft_s=0.5, itl_s=1.0, queue_wait_s=1.0)
+    outcomes = [
+        RequestOutcome(index=0, klass="chat", arrival_s=0.0,
+                       request_id="lg-0", ttft_s=0.1, total_s=0.2,
+                       n_tokens=4, completed=True),
+        RequestOutcome(index=1, klass="chat", arrival_s=0.1,
+                       request_id="lg-1", ttft_s=2.0, total_s=2.2,
+                       n_tokens=4, completed=True),  # breach
+        RequestOutcome(index=2, klass="chat", arrival_s=0.2,
+                       request_id="lg-2"),           # never finished
+    ]
+    report = summarize(RunResult(outcomes=outcomes, wall_s=3.0),
+                       {"chat": targets}, horizon_s=1.0, worst_k=2)
+    worst = report["classes"]["chat"]["worst"]
+    assert [w["id"] for w in worst] == ["lg-2", "lg-1"]
+    assert worst[0]["completed"] is False
+    assert worst[1]["attained"] is False
+    frame = render_report(report)
+    assert "worst chat: lg-2" in frame and "incomplete" in frame
+    assert "worst chat: lg-1" in frame and "MISS" in frame
+
+
+def test_run_schedule_stamps_request_ids():
+    from lws_tpu.loadgen.runner import run_schedule
+    from lws_tpu.loadgen.workload import ScheduledRequest
+
+    class Target:
+        def submit(self, req, arrival_wall_t):
+            return f"rid-{req.index}"
+
+        def step(self):
+            pass
+
+        def poll(self, handle):
+            return {"n_tokens": 2}
+
+    schedule = [
+        ScheduledRequest(index=i, klass="chat", arrival_s=0.0,
+                         prompt=[1, 2], max_new_tokens=2)
+        for i in range(2)
+    ]
+    result = run_schedule(schedule, Target(), max_wall_s=5.0)
+    assert [o.request_id for o in result.outcomes] == ["rid-0", "rid-1"]
+
+
+# ---------------------------------------------------------------------------
+# Renderer edge cases
+
+
+def test_render_request_index_empty():
+    from lws_tpu.cli import render_request_index
+
+    assert "no retained journeys" in render_request_index([])
+
+
+def test_verdict_shapes():
+    assert verdict({"flags": ["errored"],
+                    "timeline": {"error": "ValueError('x')"}})["phase"] == "error"
+    assert verdict({"flags": ["deadline_expired"],
+                    "timeline": {}})["phase"] == "deadline"
+    ok = verdict({"flags": [], "timeline": {
+        "ttft_s": 0.1, "targets": dict(TARGETS)}})
+    assert ok["ok"] is True and ok["phase"] is None
+    worst = verdict({"flags": ["breached"], "timeline": {
+        "queue_wait_s": 5.0, "ttft_s": 1.1, "targets": dict(TARGETS)}})
+    assert worst["phase"] == "queue_wait"  # 10x overrun beats 1.1x

@@ -240,6 +240,30 @@ def test_spans_rules_cover_obs_package():
         assert not f.detail.startswith("ok_"), f
 
 
+def test_spans_rules_cover_journey_vault():
+    """The journey vault (lws_tpu/obs/journey.py) is INSIDE the catalogue
+    scope: its retention-accounting names (`serving_journeys_*_total`) are
+    what the tail-latency runbook audits losses with — a vault minting
+    per-outcome/per-reason names dynamically would make the loss-accounting
+    surface itself uncatalogueable."""
+    found = run_pass(
+        "spans",
+        [FIXTURES / "lws_tpu" / "obs" / "journey_cases.py"],
+        root=FIXTURES,
+    )
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert any("bad_outcome_metric" in f.detail
+               for f in by_rule.get("metric-name-literal", [])), found
+    assert any("bad_reason_span" in f.detail
+               for f in by_rule.get("span-name-literal", [])), found
+    assert any("bad_unentered_span" in f.detail
+               for f in by_rule.get("span-context-manager", [])), found
+    for f in found:
+        assert not f.detail.startswith("ok_"), f
+
+
 def test_spans_name_rules_scoped_to_catalogue_source():
     """The same file OUTSIDE an lws_tpu/ root only keeps the context-
     manager rule — test code can't pollute the metrics catalogue."""

@@ -1,0 +1,42 @@
+"""Fixture: span/metric hygiene inside the journey vault. Lives under a
+fake lws_tpu/obs/ root (the self-tests pass root=tests/vet_fixtures)
+because the vault emits the retention-accounting metrics the tail-latency
+runbook is built on (`serving_journeys_retained_total`,
+`serving_journeys_dropped_total`) — a vault minting per-outcome or
+per-reason names dynamically would make the one surface that explains
+losses itself unauditable by the catalogue checker."""
+
+from lws_tpu.core import metrics, trace
+
+OUTCOME = "breached"
+REASON = "budget"
+
+
+def bad_outcome_metric():
+    # Building the counter name from the retention outcome would fragment
+    # the catalogue: every outcome would mint its own ungreppable family
+    # instead of riding the `outcome` label.
+    metrics.inc("serving_journeys_retained_" + OUTCOME)
+
+
+def bad_reason_span(name):
+    with trace.span(name):
+        return None
+
+
+def bad_unentered_span():
+    leak = trace.span("journey.join")
+    return leak is not None
+
+
+def ok_outcome_metric():
+    metrics.inc("serving_journeys_retained_total", {"outcome": OUTCOME})
+
+
+def ok_reason_metric():
+    metrics.inc("serving_journeys_dropped_total", {"reason": REASON})
+
+
+def ok_entered_span():
+    with trace.span("journey.join", outcome=OUTCOME):
+        return None
