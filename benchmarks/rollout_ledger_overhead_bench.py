@@ -1,0 +1,159 @@
+"""Rollout-ledger overhead microbench: the always-on guarantee for the
+timeline.
+
+The rollout ledger (lws_tpu/obs/rollout.py) observes every store mutation
+from inside the manager's notify path — it is only allowed there if the
+per-event diff is nearly free. The acceptance line is <2% added wall time
+on the reconcile loop. An end-to-end A/B (same rollout with and without
+the watch) flaps far above the effect on a busy machine, so this bench
+uses the deterministic decomposition the profile/history benches settled
+on:
+
+  * per-event cost — the median wall time of one `observe_store_event`
+    call, replayed over the REAL event stream a full rolling update
+    emits (create -> settle -> image flip -> settle), so the kind mix and
+    diff shapes are the production shape;
+  * events per update + update wall time — counted/timed from the same
+    driven rollout, giving the scale factor.
+
+  overhead_pct = (events_per_update x per_event_cost) / update_wall x 100
+
+Run:    python benchmarks/rollout_ledger_overhead_bench.py           # report
+CI:     python benchmarks/rollout_ledger_overhead_bench.py --check   # enforce
+The budget lives in benchmarks/rollout_ledger_overhead_budget.json (same
+contract shape as history_overhead_budget.json; wired into `make check`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from lws_tpu.core.metrics import MetricsRegistry  # noqa: E402
+from lws_tpu.obs.rollout import RolloutLedger  # noqa: E402
+from lws_tpu.runtime import ControlPlane  # noqa: E402
+from lws_tpu.testing import LWSBuilder, make_all_groups_ready  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "rollout_ledger_overhead_budget.json")
+
+
+class _Event:
+    __slots__ = ("type", "obj")
+
+    def __init__(self, ev_type, obj):
+        self.type = ev_type
+        self.obj = obj
+
+
+def _flip_image(cp, name, image):
+    lws = cp.store.get("LeaderWorkerSet", "default", name)
+    for c in lws.spec.leader_worker_template.worker_template.spec.containers:
+        c.image = image
+    cp.store.update(lws)
+
+
+def _drive_update(cp, image):
+    _flip_image(cp, "sample", image)
+    cp.run_until_stable()
+    make_all_groups_ready(cp, "sample")
+
+
+def median(xs: list) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--replicas", type=int, default=4,
+                        help="groups in the benched deployment")
+    parser.add_argument("--updates", type=int, default=4,
+                        help="image-flip rollouts to time for the scale row")
+    parser.add_argument("--replays", type=int, default=30,
+                        help="full event-stream replays to time per-event cost")
+    parser.add_argument("--check", action="store_true",
+                        help="enforce rollout_ledger_overhead_budget.json "
+                             "(CI mode)")
+    args = parser.parse_args()
+
+    # Capture the REAL event stream one rolling update emits (types +
+    # object references), with no ledger attached.
+    cp = ControlPlane()
+    captured: list = []
+    unsub = cp.store.watch(lambda ev: captured.append(_Event(ev.type, ev.obj)))
+    cp.create(LWSBuilder().replicas(args.replicas).size(2)
+              .image("img:v0").build())
+    make_all_groups_ready(cp, "sample")
+    _drive_update(cp, "img:v1")
+    unsub()
+    assert captured, "the driven rollout emitted no store events"
+
+    # Update wall time, for scale (no ledger attached — the baseline the
+    # overhead is measured against).
+    update_times = []
+    for i in range(args.updates):
+        t0 = time.perf_counter()
+        _drive_update(cp, f"img:v{i + 2}")
+        update_times.append(time.perf_counter() - t0)
+    update_s = median(update_times)
+
+    # Per-event observer cost over the captured production-shaped stream.
+    # A fresh ledger per replay keeps the diff base realistic (every
+    # replay walks the same cold -> warm state the live watch would).
+    replay_times = []
+    for _ in range(args.replays):
+        led = RolloutLedger(registry=MetricsRegistry())
+        t0 = time.perf_counter()
+        for ev in captured:
+            led.observe_store_event(ev)
+        replay_times.append(time.perf_counter() - t0)
+    per_event_s = median(replay_times) / len(captured)
+
+    overhead_pct = (len(captured) * per_event_s) / update_s * 100.0
+    print(json.dumps({
+        "metric": "rolling update wall time (scale reference)",
+        "updates": len(update_times),
+        "value": round(update_s * 1e3, 2),
+        "unit": "ms (median)",
+        "store_events": len(captured),
+    }))
+    print(json.dumps({
+        "metric": "ledger observe_store_event over the captured stream",
+        "replays": args.replays,
+        "value": round(per_event_s * 1e6, 2),
+        "unit": "us (median per event)",
+    }))
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    verdict = {
+        "metric": "rollout-ledger overhead on the reconcile loop "
+                  "(events_per_update x per-event cost / update wall)",
+        "value": round(overhead_pct, 4),
+        "unit": "% of update wall time",
+        "events_per_update": len(captured),
+        "per_event_us": round(per_event_s * 1e6, 2),
+        "budget_pct": budget["max_overhead_pct"],
+        "within_budget": overhead_pct < budget["max_overhead_pct"],
+    }
+    print(json.dumps(verdict), flush=True)
+    if args.check and not verdict["within_budget"]:
+        print(
+            f"[rollout-ledger-overhead] FAIL: {overhead_pct:.3f}% >= budget "
+            f"{budget['max_overhead_pct']}%",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,6 +59,12 @@ LWS_LEADER_ADDRESS = "LWS_LEADER_ADDRESS"
 LWS_GROUP_SIZE = "LWS_GROUP_SIZE"
 LWS_WORKER_INDEX = "LWS_WORKER_INDEX"
 
+# ---- serving observability env (new in this framework) ---------------------
+# The template-revision hash the pod was built from, injected so worker-side
+# SLO series and journey records carry the serving revision end-to-end
+# (core/slo.py reads it; obs/rollout.py folds fleet series by it).
+LWS_TPU_REVISION = "LWS_TPU_REVISION"
+
 # ---- TPU bootstrap env (byte-identical to reference; consumed by libtpu) ---
 TPU_RESOURCE_NAME = "google.com/tpu"
 TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"

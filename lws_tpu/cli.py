@@ -7,6 +7,7 @@
   python -m lws_tpu scale  NAME REPLICAS [--server HOST:PORT]
   python -m lws_tpu top    [--watch] [--server HOST:PORT]
   python -m lws_tpu monitor [FILTER] [--watch] [--server HOST:PORT]
+  python -m lws_tpu rollout [--watch] [--timeline-only] [--server HOST:PORT]
   python -m lws_tpu faults [point=spec ...] [--clear] [--drain] [--server HOST:PORT]
   python -m lws_tpu plan-steps --initial 4,4 --target 4,4 [--surge 1,1] [--unavailable 0,0]
 """
@@ -1053,7 +1054,7 @@ def render_request_index(rows: list) -> str:
     journeys worst-first, each row explainable by id."""
     lines = [
         f"{'REQUEST':<22}{'OUTCOME':<18}{'KLASS':<10}{'ENGINE':<8}"
-        f"{'TTFT':>9}{'TOTAL':>9}{'SPANS':>7}  INSTANCE",
+        f"{'TTFT':>9}{'TOTAL':>9}{'SPANS':>7}{'REVISION':>12}  INSTANCE",
     ]
 
     def fmt(v, pattern="{:.3f}s"):
@@ -1068,6 +1069,7 @@ def render_request_index(rows: list) -> str:
             f"{fmt(row.get('ttft_s')):>9}"
             f"{fmt(row.get('total_s')):>9}"
             f"{row.get('spans', 0):>7}"
+            f"{str(row.get('revision') or '-')[:11]:>12}"
             f"  {row.get('instance', '-')}"
         )
     if len(lines) == 1:
@@ -1193,6 +1195,8 @@ def cmd_explain(args) -> int:
         query = {"outcome": picked[0], "limit": args.limit}
         if args.klass:
             query["klass"] = args.klass
+        if args.revision:
+            query["revision"] = args.revision
         rows = _http(args.server, "GET",
                      f"/debug/requests?{urlencode(query)}")
         if args.json:
@@ -1211,6 +1215,127 @@ def cmd_explain(args) -> int:
     else:
         print(render_explain(body))
     return 0
+
+
+# ---------------------------------------------------------------------------
+# lws-tpu rollout: the rollout intelligence plane — the control-plane
+# timeline ledger (/debug/rollout) plus the per-revision SLO comparison and
+# dry-run canary verdicts the analyzer publishes on the fleet surface
+# (lws_tpu/obs/rollout.py).
+
+
+_VERDICT_NAMES = {1.0: "promote", 0.0: "hold", -1.0: "rollback"}
+
+
+def render_rollout(entries: list, fams: dict, alerts: dict,
+                   max_timeline: int = 32) -> str:
+    """One `lws-tpu rollout` frame: the per-revision comparison table
+    (verdict gauge + revision-scoped burn twins + goodput folded from the
+    fleet exposition's revision labels), firing alerts, and the ledger
+    timeline newest-last. Pure function of the fetched state so tests drive
+    it from canned data."""
+
+    def samples(family: str):
+        return [
+            (labels, value)
+            for name, labels, value, _ in fams.get(family, {}).get("samples", [])
+            if name == family
+        ]
+
+    revs: dict[str, dict] = {}
+    lws = "-"
+    for labels, value in samples("lws_rollout_canary_verdict"):
+        slot = revs.setdefault(labels.get("revision", "-"), {})
+        slot["verdict"] = _VERDICT_NAMES.get(value, f"{value:g}")
+        lws = labels.get("lws", lws)
+    for labels, value in samples("serving_slo_burn_rate_by_revision"):
+        slot = revs.setdefault(labels.get("revision", "-"), {})
+        key = f"burn_{labels.get('window', '-')}"
+        slot[key] = max(value, slot.get(key, float("-inf")))
+    totals: dict[str, float] = {}
+    goods: dict[str, float] = {}
+    for family, acc in (("serving_tokens_total", totals),
+                        ("serving_goodput_tokens_total", goods)):
+        for labels, value in samples(family):
+            rev = labels.get("revision") or "-"
+            acc[rev] = acc.get(rev, 0.0) + value
+    for rev, tok in totals.items():
+        slot = revs.setdefault(rev, {})
+        slot["tokens"] = tok
+        slot["good"] = goods.get(rev, 0.0) / tok if tok > 0 else None
+
+    def fmt(v, pattern="{:.1f}x"):
+        return pattern.format(v) if v is not None else "-"
+
+    lines = [
+        f"ROLLOUT  lws={lws}  revisions={len(revs)}",
+        "",
+        f"{'REVISION':<16}{'VERDICT':>10}{'FAST':>8}{'SLOW':>8}"
+        f"{'GOOD%':>8}{'TOKENS':>10}",
+    ]
+    for rev in sorted(revs):
+        s = revs[rev]
+        lines.append(
+            f"{rev[:15]:<16}{s.get('verdict', '-'):>10}"
+            f"{fmt(s.get('burn_fast')):>8}{fmt(s.get('burn_slow')):>8}"
+            f"{fmt(s.get('good'), '{:.0%}'):>8}"
+            f"{s.get('tokens', 0):>10.0f}"
+        )
+    if len(revs) == 0:
+        lines.append("(no revision-labelled serving series yet)")
+    if alerts:
+        lines.append("")
+        for name in sorted(alerts):
+            lines.append(f"ALERT {name}: {json.dumps(alerts[name], default=str)}")
+    lines.append("")
+    lines.append(f"TIMELINE (newest last, {min(len(entries), max_timeline)}"
+                 f" of {len(entries)})")
+    for e in entries[-max_timeline:]:
+        ts = time.strftime("%H:%M:%S", time.localtime(e.get("unix", 0.0)))
+        detail = " ".join(
+            f"{k}={v}" for k, v in sorted((e.get("detail") or {}).items())
+        )
+        lines.append(
+            f"{ts}  {str(e.get('kind', '-')):<22}"
+            f"{str(e.get('object') or '-'):<30}"
+            f"{str(e.get('revision') or '-')[:12]:<14}{detail}"
+        )
+    if not entries:
+        lines.append("(ledger empty — no control-plane transitions recorded)")
+    return "\n".join(lines)
+
+
+def cmd_rollout(args) -> int:
+    """Rollout intelligence: the control-plane transition timeline
+    (/debug/rollout), the per-revision SLO comparison table, and the
+    dry-run canary verdicts (`lws_rollout_canary_verdict`) the analyzer
+    refreshes on every fleet scrape. One-shot by default; --watch redraws
+    every --interval seconds; --timeline-only skips the metrics fetch."""
+    args.interval = max(args.interval, 1.0)
+    while True:
+        entries = _http(args.server, "GET",
+                        f"/debug/rollout?limit={args.limit}")
+        fams: dict = {}
+        alerts: dict = {}
+        if not args.timeline_only:
+            try:
+                fams, alerts = _fetch_monitor_state(args.server)
+            except urllib.error.URLError as e:
+                raise SystemExit(
+                    f"error: cannot reach server {args.server}: {e.reason}"
+                ) from None
+        if args.json:
+            print(json.dumps({"timeline": entries,
+                              "alerts": alerts}, indent=1, default=str))
+            return 0
+        frame = render_rollout(entries, fams, alerts,
+                               max_timeline=args.limit)
+        if not args.watch:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
 
 
 def render_profile(instances: list, top_n: int = 15) -> str:
@@ -1364,10 +1489,70 @@ def cmd_loadgen(args) -> int:
 
         ring.start(_fetch_fleet_text)
 
+    # The scenario's optional revision_bump stanza: at at_s scenario-seconds
+    # the driver flips the deployment's worker-template env through the live
+    # server — a real mid-run rollout. The apply runs on a background thread
+    # (the drive loop is open-loop: a slow server must never delay an
+    # arrival); on_tick only arms it once.
+    bump = loadgen.revision_bump(spec)
+    on_tick = None
+    bump_lws = bump["lws"] if bump else ""
+    if bump is not None and not args.server:
+        print("warning: scenario declares revision_bump but no --server; "
+              "skipping the bump", file=sys.stderr)
+    elif bump is not None:
+        import threading as _threading
+
+        def _do_bump():
+            try:
+                if bump["lws"]:
+                    ns, _, name = bump["lws"].partition("/")
+                    obj = _http(args.server, "GET",
+                                f"/apis/leaderworkersets/{ns}/{name}")
+                else:
+                    objs = _http(args.server, "GET", "/apis/leaderworkersets")
+                    if not objs:
+                        print("warning: revision_bump found no "
+                              "LeaderWorkerSets to bump", file=sys.stderr)
+                        return
+                    obj = min(objs, key=lambda o: (
+                        o["metadata"]["namespace"], o["metadata"]["name"]))
+                lwt = obj["spec"]["leader_worker_template"]
+                for tmpl_key in ("worker_template", "leader_template"):
+                    tmpl = lwt.get(tmpl_key)
+                    if not tmpl:
+                        continue
+                    for c in tmpl.get("spec", {}).get("containers", []):
+                        env = [e for e in c.get("env", [])
+                               if e.get("name") != bump["env"]["name"]]
+                        env.append(dict(bump["env"]))
+                        c["env"] = env
+                _http(args.server, "POST", "/apply",
+                      json.dumps(obj).encode())
+                print(f"# revision bump applied to "
+                      f"{obj['metadata']['namespace']}/"
+                      f"{obj['metadata']['name']} at t>={bump['at_s']:g}s "
+                      f"({bump['env']['name']}={bump['env']['value']})",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — a failed bump must
+                # not kill the run; the report just shows one revision.
+                print(f"warning: revision bump failed: {e}", file=sys.stderr)
+
+        bump_state = {"start": None, "fired": False}
+
+        def on_tick(now):
+            if bump_state["start"] is None:
+                bump_state["start"] = now
+            if (not bump_state["fired"]
+                    and now - bump_state["start"]
+                    >= bump["at_s"] * args.time_scale):
+                bump_state["fired"] = True
+                _threading.Thread(target=_do_bump, daemon=True).start()
+
     try:
         result = loadgen.run_schedule(
             schedule, target, time_scale=args.time_scale,
-            max_wall_s=args.max_wall,
+            max_wall_s=args.max_wall, on_tick=on_tick,
         )
     finally:
         if ring is not None:
@@ -1378,6 +1563,12 @@ def cmd_loadgen(args) -> int:
     )
     if ring is not None and ring.series():
         report["history"] = loadgen.fold_history(ring, targets)
+        # With revision-labelled series in the ring (a rollout happened
+        # during the run — bumped by the scenario or externally), the
+        # report appends the dry-run canary verdict trace.
+        canary = loadgen.fold_canary(ring, lws=bump_lws or "-")
+        if canary is not None:
+            report["canary"] = canary
     fleet = None
     if args.server:
         from lws_tpu.core.metrics import parse_exposition
@@ -1612,11 +1803,32 @@ def main(argv=None) -> int:
                     help="list errored retained journeys instead")
     ex.add_argument("--klass", default="",
                     help="filter the index by workload class")
+    ex.add_argument("--revision", default="",
+                    help="filter the index by serving template revision "
+                         "(the hash `lws-tpu rollout` shows)")
     ex.add_argument("--limit", type=int, default=10,
                     help="index rows to fetch")
     ex.add_argument("--json", action="store_true",
                     help="emit the raw journey/index JSON")
     ex.set_defaults(fn=cmd_explain)
+
+    ro = sub.add_parser("rollout", help="rollout intelligence: the "
+                        "control-plane transition timeline (/debug/rollout), "
+                        "per-revision SLO comparison, and dry-run canary "
+                        "verdicts")
+    ro.add_argument("--server", default="127.0.0.1:9443",
+                    help="API server host:port")
+    ro.add_argument("--watch", action="store_true",
+                    help="redraw every --interval seconds")
+    ro.add_argument("--interval", type=float, default=2.0)
+    ro.add_argument("--limit", type=int, default=32,
+                    help="timeline entries to fetch/render")
+    ro.add_argument("--timeline-only", action="store_true",
+                    dest="timeline_only",
+                    help="skip the metrics fetch; ledger timeline only")
+    ro.add_argument("--json", action="store_true",
+                    help="emit the raw timeline/alerts JSON")
+    ro.set_defaults(fn=cmd_rollout)
 
     prf = sub.add_parser("profile", help="continuous-profiling view: per-span "
                          "and top-of-stack self-time (from /debug/profile)")

@@ -169,6 +169,7 @@ class FlightRecorder:
         from lws_tpu.core import profile as profmod
         from lws_tpu.obs import history as historymod
         from lws_tpu.obs import journey as journeymod
+        from lws_tpu.obs import rollout as rolloutmod
 
         exposition = (
             metrics.render_exposition(metrics.REGISTRY, *registries)
@@ -184,6 +185,7 @@ class FlightRecorder:
             "profile": profmod.PROFILER.snapshot(limit=128),
             "history": historymod.HISTORY.snapshot(limit=64, max_points=256),
             "journeys": journeymod.VAULT.worst(limit=8),
+            "rollout": rolloutmod.LEDGER.snapshot(limit=64),
         }
 
 
@@ -312,6 +314,14 @@ def default_rules() -> list:
         # alert + diagnostics dump per burn episode, the dump's event ring
         # carrying the offending error-series window.
         BacklogRule("burn_rate", "burn_rate:*",
+                    depth_threshold=1.0, sustain_s=0.0),
+        # Rollout-plane rule (lws_tpu/obs/rollout.py feed): while a
+        # revision's canary verdict is `rollback`, the analyzer holds a
+        # `canary:{lws}/{revision}` heartbeat at depth 1 — one
+        # edge-triggered alert + dump per regression episode, the firing
+        # edge's ring event embedding the offending revision's error
+        # series and the rollout-ledger window.
+        BacklogRule("canary_regression", "canary:*",
                     depth_threshold=1.0, sustain_s=0.0),
     ]
 

@@ -536,3 +536,10 @@ describe("serving_journeys_retained_total",
          "Request journeys kept by the tail-sampling vault, per retention outcome (breached/errored/deadline_expired/retried/fault kept 100%; slowest = the slow-K window; sampled = the healthy reservoir)")
 describe("serving_journeys_dropped_total",
          "Journey records lost, per reason (not_sampled healthy drops, budget/aged/displaced evictions, open_evicted in-flight trace buffers, journey_span_cap/journey_event_cap truncations) — every loss is accounted")
+# --- rollout intelligence plane (lws_tpu/obs/rollout.py) -------------------
+describe("lws_rollout_ledger_events_total",
+         "Control-plane transitions recorded on the rollout timeline ledger, per kind (revision flips, partition moves, pod churn, drains, alerts)")
+describe("lws_rollout_canary_verdict",
+         "Dry-run canary verdict per (lws, revision): +1 promote, 0 hold, -1 rollback — insufficient data holds, never promotes; actuation only through the opt-in RolloutActuationAdapter")
+describe("serving_slo_burn_rate_by_revision",
+         "Revision-scoped twin of serving_slo_burn_rate: the worst instance's short-window burn per (engine, revision, window) — the baseline-vs-canary divergence signal")

@@ -62,6 +62,14 @@ from typing import Optional
 from lws_tpu.core import metrics, trace
 from lws_tpu.utils.common import env_float as _env_float
 
+# The serving template revision this worker process runs (injected into the
+# pod env by the admission webhook from the pod's revision labels —
+# utils/podutils.py). When set, every SLO series and journey summary this
+# process emits carries a `revision` label, so worker-local /metrics,
+# /debug/history, and /debug/requests are revision-scoped even before the
+# fleet scraper injects its own (identical) revision label.
+REVISION_ENV = "LWS_TPU_REVISION"
+
 
 @dataclass(frozen=True)
 class SLOTargets:
@@ -119,13 +127,18 @@ def token_deadline_s(targets: SLOTargets, cum_tokens: int) -> float:
     return targets.ttft_s + max(0, cum_tokens - 1) * targets.itl_s
 
 
-def _labels(engine: str, klass: str) -> dict[str, str]:
+def _labels(engine: str, klass: str, revision: str = "") -> dict[str, str]:
     """Label set for one timeline's series: the `klass` label rides only
     when a class was assigned — class-free deployments keep the exact
-    pre-class series identity (and tests their label-set lookups)."""
+    pre-class series identity (and tests their label-set lookups). The
+    `revision` label rides the same way: only when the process knows its
+    serving revision (LWS_TPU_REVISION)."""
+    out = {"engine": engine}
     if klass:
-        return {"engine": engine, "klass": klass}
-    return {"engine": engine}
+        out["klass"] = klass
+    if revision:
+        out["revision"] = revision
+    return out
 
 
 class RequestTimeline:
@@ -221,7 +234,7 @@ class RequestTimeline:
 
     # ---- verdict ---------------------------------------------------------
     def _labels_(self) -> dict[str, str]:
-        return _labels(self.engine, self.klass)
+        return _labels(self.engine, self.klass, self._rec.revision)
 
     def attained(self, targets: SLOTargets) -> bool:
         if self._queue_wait_s is not None and self._queue_wait_s > targets.queue_wait_s:
@@ -241,6 +254,7 @@ class SLORecorder:
         window: int = 256,
         max_age_s: Optional[float] = None,
         class_targets: Optional[dict[str, SLOTargets]] = None,
+        revision: Optional[str] = None,
     ) -> None:
         """`registry` defaults to the process metrics helpers; `window` is
         the trailing request count the attainment gauge averages over (a
@@ -248,8 +262,15 @@ class SLORecorder:
         `max_age_s` its AGE bound (entries older than this are evicted, so
         a quiet engine stops advertising stale attainment; env
         LWS_TPU_SLO_WINDOW_AGE_S, default 600s). `class_targets` overrides
-        targets per workload class (default: LWS_TPU_SLO_CLASS_TARGETS)."""
+        targets per workload class (default: LWS_TPU_SLO_CLASS_TARGETS).
+        `revision` stamps every series with the serving template revision
+        (default: LWS_TPU_REVISION; empty keeps the pre-revision series
+        identity)."""
         self.targets = targets if targets is not None else SLOTargets.from_env()
+        self.revision = (
+            revision if revision is not None
+            else os.environ.get(REVISION_ENV, "")
+        )
         self._registry = registry
         self._window = window
         self._max_age_s = (
@@ -314,7 +335,7 @@ class SLORecorder:
         with self._lock:
             for (engine, klass), window in list(self._outcomes.items()):
                 self._evict_locked(window, now)
-                labels = _labels(engine, klass)
+                labels = _labels(engine, klass, self.revision)
                 if not window:
                     del self._outcomes[(engine, klass)]
                     # exact: retiring the class-free {engine} series must
@@ -362,7 +383,7 @@ class SLORecorder:
             window.append((now, 1.0 if ok else 0.0))
             self._evict_locked(window, now)
             value = sum(o for _, o in window) / len(window)
-        labels = _labels(tl.engine, tl.klass)
+        labels = _labels(tl.engine, tl.klass, self.revision)
         reg = self._registry if self._registry is not None else metrics.REGISTRY
         reg.set("serving_slo_attainment", value, labels)
         reg.set("serving_slo_window_age_seconds", 0.0, labels)
@@ -384,6 +405,7 @@ class SLORecorder:
             summary = {
                 "engine": tl.engine,
                 "klass": tl.klass,
+                "revision": self.revision,
                 "request_id": tl.request_id,
                 "trace": trace.current_context(),
                 "queue_wait_s": tl._queue_wait_s,

@@ -27,7 +27,12 @@ from lws_tpu.loadgen.arrivals import (
     make_process,
     piecewise_poisson,
 )
-from lws_tpu.loadgen.report import fold_fleet, fold_history, render_report
+from lws_tpu.loadgen.report import (
+    fold_canary,
+    fold_fleet,
+    fold_history,
+    render_report,
+)
 from lws_tpu.loadgen.runner import (
     DisaggTarget,
     EngineTarget,
@@ -47,6 +52,7 @@ from lws_tpu.loadgen.scenario import (
     install_class_targets,
     load_scenario,
     offered_load_rps,
+    revision_bump,
     scenario_names,
     schedule_digest,
 )
@@ -79,6 +85,7 @@ __all__ = [
     "build_schedule",
     "class_targets",
     "describe_scenario",
+    "fold_canary",
     "fold_fleet",
     "fold_history",
     "goodput_tokens",
@@ -89,6 +96,7 @@ __all__ = [
     "pick_class",
     "piecewise_poisson",
     "render_report",
+    "revision_bump",
     "run_schedule",
     "scenario_names",
     "schedule_digest",

@@ -125,6 +125,57 @@ def fold_history(ring, targets_by_class: Optional[dict] = None,
     return {"classes": classes, "recommendation": trace}
 
 
+def fold_canary(ring, lws: str = "-",
+                attainment_target: Optional[float] = None,
+                windows: Optional[tuple] = None,
+                min_samples: Optional[float] = None,
+                min_duration_s: Optional[float] = None,
+                delta: Optional[float] = None,
+                max_steps: int = 64) -> Optional[dict]:
+    """Fold a run-sampled HistoryRing into the report's canary block: the
+    dry-run verdict trace a throwaway CanaryAnalyzer produces when replayed
+    at each retained sample time (every point any revision's verdict
+    changed, run-relative), plus the final per-revision verdict table.
+    Pure function of the ring — private registry/recorder, no ledger — so
+    it never leaks gauges or alerts into the driving process. None when the
+    ring carries no revision-labelled serving series (nothing to compare)."""
+    from lws_tpu.core.flightrecorder import FlightRecorder
+    from lws_tpu.core.metrics import MetricsRegistry
+    from lws_tpu.obs import rollout
+
+    if not rollout.revision_values(ring):
+        return None
+    analyzer = rollout.CanaryAnalyzer(
+        ring, lws=lws, attainment_target=attainment_target,
+        windows=windows, min_samples=min_samples,
+        min_duration_s=min_duration_s, delta=delta,
+        registry=MetricsRegistry(), recorder=FlightRecorder(),
+    )
+    times: set = set()
+    for _, _labels, _, pts, _ in ring.series("serving_tokens_total"):
+        times.update(t for t, _v in pts)
+    if not times:
+        return None
+    t0 = min(times)  # trace times are RUN-relative
+    trace: list = []
+    last: Optional[dict] = None
+    report = None
+    for t in sorted(times)[-max_steps:]:
+        report = analyzer.evaluate(now=t)
+        verdicts = {r: v.verdict for r, v in report.verdicts.items()}
+        if verdicts != last:
+            trace.append({"t": round(t - t0, 3), "baseline": report.baseline,
+                          "verdicts": dict(verdicts)})
+            last = dict(verdicts)
+    if report is None:
+        return None
+    return {
+        "baseline": report.baseline,
+        "revisions": {r: v.to_dict() for r, v in report.verdicts.items()},
+        "trace": trace,
+    }
+
+
 def _fmt(v, pattern: str = "{:.3f}", dash: str = "-") -> str:
     return pattern.format(v) if v is not None else dash
 
@@ -209,4 +260,26 @@ def render_report(report: dict, fleet: Optional[dict] = None) -> str:
                 f"{role}={n}" for role, n in sorted(step["desired"].items())
             )
             lines.append(f"recommendation @{step['t']:.2f}s: {desired}")
+    canary = report.get("canary")
+    if canary:
+        lines.append("")
+        lines.append(
+            f"{'CANARY':<14}{'VERDICT':>10}{'BURN':>8}{'SAMPLES':>9}"
+            f"{'SPAN':>8}  REASON"
+        )
+        base = canary.get("baseline") or ""
+        for rev, v in sorted(canary.get("revisions", {}).items()):
+            tag = rev + ("*" if rev == base else "")
+            lines.append(
+                f"{tag:<14}{v.get('verdict', '-'):>10}"
+                f"{_fmt(v.get('short_burn'), '{:.1f}x'):>8}"
+                f"{v.get('samples', 0):>9.0f}"
+                f"{_fmt(v.get('duration_s'), '{:.0f}s'):>8}"
+                f"  {v.get('reason', '')}"
+            )
+        for step in canary.get("trace", []):
+            verdicts = " ".join(
+                f"{r}={v}" for r, v in sorted(step["verdicts"].items())
+            )
+            lines.append(f"canary @{step['t']:.2f}s: {verdicts}")
     return "\n".join(lines)

@@ -104,6 +104,25 @@ SCENARIOS: dict[str, dict] = {
              "targets": {**_CPU_TARGETS, "ttft_s": 2.5, "queue_wait_s": 2.5}},
         ],
     },
+    "rolling_update": {
+        "name": "rolling_update",
+        "horizon_s": 2.0,
+        "max_len": 64,
+        "vocab": 256,
+        "arrivals": {"process": "poisson", "rate_rps": 12.0},
+        # Mid-run template bump: against a live server (`--server`), the
+        # driver flips the deployment's worker-template env at at_s, so the
+        # run exercises a real revision rollout under steady load and the
+        # report's canary block (fold_canary) grades old vs new revision.
+        "revision_bump": {"at_s": 1.0,
+                          "env": {"name": "LWS_TPU_CANARY_STAGE",
+                                  "value": "canary"}},
+        "classes": [
+            {"name": "chat", "weight": 1.0,
+             "prompt_len": {"kind": "uniform", "lo": 4, "hi": 12},
+             "output_len": 6, "targets": _CPU_TARGETS},
+        ],
+    },
     "diurnal": {
         "name": "diurnal",
         "horizon_s": 2.0,
@@ -180,6 +199,29 @@ def install_class_targets(spec: dict, recorder=None) -> dict[str, SLOTargets]:
     mapping = class_targets(spec)
     (recorder if recorder is not None else slo.RECORDER).set_class_targets(mapping)
     return mapping
+
+
+def revision_bump(spec: dict) -> Optional[dict]:
+    """The optional `revision_bump` stanza, validated: None when absent,
+    else `{"at_s": float, "lws": "ns/name" | "", "env": {"name", "value"}}`.
+    The stanza never touches the schedule (build_schedule ignores it —
+    committed digests stay stable); it drives the LIVE side of a run: the
+    CLI flips the target deployment's worker-template env at `at_s`
+    scenario-seconds, forcing a new template revision under load."""
+    raw = spec.get("revision_bump")
+    if raw is None:
+        return None
+    if not isinstance(raw, dict):
+        raise ValueError("revision_bump must be a JSON object")
+    env = raw.get("env") or {}
+    if not isinstance(env, dict):
+        raise ValueError("revision_bump.env must be a JSON object")
+    return {
+        "at_s": float(raw.get("at_s", 0.0)),
+        "lws": str(raw.get("lws", "")),
+        "env": {"name": str(env.get("name") or "LWS_TPU_CANARY_STAGE"),
+                "value": str(env.get("value") or "canary")},
+    }
 
 
 def build_schedule(spec: dict, seed: int) -> list[ScheduledRequest]:

@@ -14,6 +14,12 @@ Served at `GET /debug/history` on both the API server and the worker
 telemetry server; rendered by `lws-tpu monitor` and backing `lws-tpu top`'s
 rate columns. Docs: docs/observability.md ("History & burn-rate alerting"),
 docs/tasks/autoscaling.md (the recommender walkthrough).
+
+The rollout plane (lws_tpu/obs/rollout.py) rides the same ring: a bounded
+ledger of control-plane state transitions (`GET /debug/rollout`,
+`lws-tpu rollout`), per-revision folds of every SLO signal, and a dry-run
+`CanaryAnalyzer` publishing `lws_rollout_canary_verdict` — actuation stays
+opt-in via `RolloutActuationAdapter`. Docs: docs/tasks/rollout-analysis.md.
 """
 
 from lws_tpu.obs.history import (
@@ -27,6 +33,24 @@ from lws_tpu.obs.recommend import (
     AnnotationAdapter,
     Recommendation,
     ScaleRecommender,
+)
+from lws_tpu.obs.rollout import (
+    LEDGER,
+    CanaryAnalyzer,
+    CanaryReport,
+    RevisionVerdict,
+    RolloutActuationAdapter,
+    RolloutLedger,
+    default_canary_analyzer,
+    install,
+    revision_attainment,
+    revision_burn,
+    revision_good_fraction,
+    revision_prefix_fraction,
+    revision_quantile,
+    revision_samples,
+    revision_spec_fraction,
+    revision_values,
 )
 from lws_tpu.obs.signals import (
     DEFAULT_BURN_WINDOWS,
@@ -52,24 +76,40 @@ __all__ = [
     "DEFAULT_INTERVAL_S",
     "DEFAULT_RETENTION_S",
     "HISTORY",
+    "LEDGER",
     "AnnotationAdapter",
     "BurnVerdict",
     "BurnWindow",
+    "CanaryAnalyzer",
+    "CanaryReport",
     "HistoryRing",
     "Recommendation",
+    "RevisionVerdict",
+    "RolloutActuationAdapter",
+    "RolloutLedger",
     "ScaleRecommender",
     "breach_fraction",
     "burn_rate_from_counters",
     "burn_rate_from_gauge",
     "burn_windows",
+    "default_canary_analyzer",
     "error_series",
     "ewma",
     "histogram_quantile",
     "increase",
+    "install",
     "mean",
     "multiwindow_burn",
     "quantile_over_window",
     "rate",
+    "revision_attainment",
+    "revision_burn",
+    "revision_good_fraction",
+    "revision_prefix_fraction",
+    "revision_quantile",
+    "revision_samples",
+    "revision_spec_fraction",
+    "revision_values",
     "slope",
     "start_from_env",
 ]

@@ -89,9 +89,10 @@ _FLAG_PRIORITY = ("errored", "deadline_expired", "breached", "retried",
 
 class _Journey:
     __slots__ = (
-        "id", "trace_id", "root_span_id", "engine", "klass", "spans",
-        "events", "annotations", "timeline", "flags", "outcome", "completed",
-        "completed_unix", "completed_mono", "latency_s", "spans_dropped",
+        "id", "trace_id", "root_span_id", "engine", "klass", "revision",
+        "spans", "events", "annotations", "timeline", "flags", "outcome",
+        "completed", "completed_unix", "completed_mono", "latency_s",
+        "spans_dropped",
     )
 
     def __init__(self, rid: str) -> None:
@@ -105,6 +106,7 @@ class _Journey:
         self.root_span_id: Optional[str] = None
         self.engine = ""
         self.klass = ""
+        self.revision = ""
         self.spans: list[dict] = []
         self.events: list[dict] = []
         self.annotations: dict = {}
@@ -130,6 +132,7 @@ class _Journey:
             "trace_id": self.trace_id,
             "engine": self.engine,
             "klass": self.klass,
+            "revision": self.revision,
             "outcome": self.outcome,
             "flags": sorted(self.flags),
             "completed": self.completed,
@@ -149,6 +152,7 @@ class _Journey:
             "trace_id": self.trace_id,
             "engine": self.engine,
             "klass": self.klass,
+            "revision": self.revision,
             "outcome": self.outcome,
             "flags": sorted(self.flags),
             "latency_s": round(self.latency_s, 6),
@@ -424,6 +428,7 @@ class JourneyVault:
             trace=summary.get("trace"),
             engine=str(summary.get("engine") or ""),
             klass=str(summary.get("klass") or ""),
+            revision=str(summary.get("revision") or ""),
             ok=bool(summary.get("ok", True)),
             phases=phases,
             targets=summary.get("targets"),
@@ -457,6 +462,7 @@ class JourneyVault:
         trace: Optional[dict] = None,
         engine: str = "",
         klass: str = "",
+        revision: str = "",
         ok: bool = True,
         outcome: Optional[str] = None,
         error: Optional[str] = None,
@@ -527,6 +533,7 @@ class JourneyVault:
                 self._trace_owner[tid] = j
             j.engine = engine or j.engine
             j.klass = klass or j.klass
+            j.revision = revision or j.revision
             if phases:
                 j.timeline.update(phases)
             if targets:
@@ -683,10 +690,11 @@ class JourneyVault:
             return list(self._open_traces.get(trace_id, ()))
 
     def index(self, outcome: str = "all", klass: str = "",
-              limit: int = 32) -> list[dict]:
+              limit: int = 32, revision: str = "") -> list[dict]:
         """Digest rows for `/debug/requests`, worst-first: `slowest` sorts
-        by latency, everything else newest-first. Unknown outcomes raise
-        ValueError (the debug surfaces answer 400)."""
+        by latency, everything else newest-first. `revision` filters to one
+        serving revision's journeys (`explain --breached --revision`).
+        Unknown outcomes raise ValueError (the debug surfaces answer 400)."""
         if outcome not in OUTCOMES:
             raise ValueError(
                 f"outcome must be one of {', '.join(OUTCOMES)}, got {outcome!r}"
@@ -696,6 +704,8 @@ class JourneyVault:
             rows = [j for j in self._kept.values() if j.completed]
             if klass:
                 rows = [j for j in rows if j.klass == klass]
+            if revision:
+                rows = [j for j in rows if j.revision == revision]
             if outcome == "slowest":
                 rows.sort(key=lambda j: -j.latency_s)
             else:

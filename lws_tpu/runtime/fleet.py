@@ -436,17 +436,19 @@ class FleetCollector:
         }
 
     def collect_request_index(self, outcome: str = "all", klass: str = "",
-                              limit: int = 32) -> list[dict]:
+                              limit: int = 32,
+                              revision: str = "") -> list[dict]:
         """Fleet-joined `/debug/requests` index: every ready worker's
         retained-journey digests plus this process's, instance-labelled and
         merged worst-first. Unknown outcomes raise ValueError BEFORE any
-        scrape (the caller answers 400)."""
+        scrape (the caller answers 400). `revision` narrows every leg to
+        journeys that completed under that serving revision."""
         from lws_tpu.obs import journey as journeymod
 
         rows = [
             {**row, "instance": "control-plane"}
             for row in journeymod.VAULT.index(outcome=outcome, klass=klass,
-                                              limit=limit)
+                                              limit=limit, revision=revision)
         ]
         targets = self.targets()
         if targets:
@@ -454,7 +456,7 @@ class FleetCollector:
             from urllib.parse import urlencode
 
             query = urlencode({"outcome": outcome, "klass": klass,
-                               "limit": int(limit)})
+                               "limit": int(limit), "revision": revision})
             path = f"/debug/requests?{query}"
             with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
                 scraped = pool.map(

@@ -293,6 +293,16 @@ class ControlPlane:
         self.fleet = FleetCollector(self.store, control_registries=control_regs)
         self.watchdog = Watchdog(registries=(self.metrics,))
 
+        # Rollout intelligence plane: the process-default ledger observes
+        # this store's watch feed plus the process flight recorder, so
+        # every revision flip, partition move, DS lockstep step, drain,
+        # and pod churn the reconcile path produces lands on the timeline
+        # (`GET /debug/rollout`, watchdog dumps, `lws-tpu rollout`).
+        from lws_tpu.obs import rollout as rolloutmod
+
+        self.rollout = rolloutmod.LEDGER
+        rolloutmod.install(self.store)
+
     # ------------------------------------------------------------------
     def run_until_stable(self, max_iterations: int = 10000) -> int:
         if self.elector is not None:

@@ -264,6 +264,7 @@ class ApiServer:
                     # refresh-per-scrape gauge.
                     if historymod.HISTORY.ingest_if_due(text):
                         from lws_tpu.obs import recommend as recmod
+                        from lws_tpu.obs import rollout as rolloutmod
 
                         try:
                             # `current` re-syncs from the store's DS roles
@@ -271,6 +272,15 @@ class ApiServer:
                             # width, not a hardcoded baseline of 1.
                             recmod.default_recommender(cp.store).evaluate()
                         except Exception:  # vet: ignore[hazard-exception-swallow]: a recommender hiccup must never 500 the fleet scrape (BLE001 intended)
+                            pass
+                        try:
+                            # Same cadence for the canary analyzer: the
+                            # dry-run verdict/revision-burn gauges and the
+                            # `canary_regression` alert feed ride every
+                            # live deployment's fleet scrape.
+                            rolloutmod.default_canary_analyzer(
+                                cp.store).evaluate()
+                        except Exception:  # vet: ignore[hazard-exception-swallow]: an analyzer hiccup must never 500 the fleet scrape (BLE001 intended)
                             pass
                     self._send_exposition(text)
                 elif path == "/debug/traces":
@@ -354,6 +364,19 @@ class ApiServer:
                         self._json(400, {"error": f"bad limit: {e}"})
                         return
                     self._json(200, historymod.HISTORY.snapshot(limit))
+                elif path == "/debug/rollout":
+                    from urllib.parse import parse_qs, urlparse
+
+                    from lws_tpu.obs import rollout as rolloutmod
+                    from lws_tpu.runtime.telemetry import parse_limit
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad limit: {e}"})
+                        return
+                    self._json(200, rolloutmod.LEDGER.snapshot(limit))
                 elif path == "/debug/requests":
                     from urllib.parse import parse_qs, urlparse
 
@@ -363,6 +386,7 @@ class ApiServer:
                     q = parse_qs(urlparse(self.path).query)
                     outcome = q.get("outcome", ["all"])[0]
                     klass = q.get("klass", [""])[0]
+                    revision = q.get("revision", [""])[0]
                     fleet = getattr(cp, "fleet", None)
                     try:
                         limit = parse_limit(q, default=32)
@@ -371,11 +395,12 @@ class ApiServer:
                             # retained journeys plus this process's, one
                             # worst-first table (runtime/fleet.py).
                             rows = fleet.collect_request_index(
-                                outcome, klass, limit
+                                outcome, klass, limit, revision=revision
                             )
                         else:
                             rows = journeymod.VAULT.index(
-                                outcome=outcome, klass=klass, limit=limit
+                                outcome=outcome, klass=klass, limit=limit,
+                                revision=revision,
                             )
                     except ValueError as e:
                         # 400, never 500: bad limit/outcome are caller

@@ -192,6 +192,7 @@ class TelemetryServer:
                             outcome=q.get("outcome", ["all"])[0],
                             klass=q.get("klass", [""])[0],
                             limit=limit,
+                            revision=q.get("revision", [""])[0],
                         )
                     except ValueError as e:
                         # 400, never 500: a bad limit or an unknown outcome

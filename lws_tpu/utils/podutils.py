@@ -84,6 +84,17 @@ def add_lws_variables(pod: Pod) -> None:
         rest.append(EnvVar(contract.LWS_SUBGROUP_SIZE, sub_size))
         rest.append(EnvVar(contract.LWS_SUBGROUP_INDEX, sub_index))
 
+    # Serving revision for worker-side telemetry: DS revision first, then the
+    # template-revision hash — the same precedence the fleet scraper applies
+    # to pod labels (runtime/fleet.py), so worker-local and fleet-injected
+    # `revision` label values always agree.
+    from lws_tpu.api import disagg
+
+    revision = (labels.get(disagg.DS_REVISION_LABEL_KEY)
+                or labels.get(contract.REVISION_LABEL_KEY))
+    if revision:
+        rest.append(EnvVar(contract.LWS_TPU_REVISION, revision))
+
     for c in pod.spec.containers:
         add_env_vars_if_not_exists(c, leader_env, *rest)
     for c in pod.spec.init_containers:

@@ -1,0 +1,673 @@
+"""Rollout intelligence plane (ISSUE 15): the bounded rollout ledger fed by
+store/flight-recorder observers, revision-dimension folds over the history
+ring, the dry-run canary analyzer (verdict gauges + the edge-triggered
+`canary_regression` watchdog feed), the opt-in actuation adapter, the
+`/debug/rollout` surface, revision threading through pod env -> SLO series
+-> journeys, and the CLI/loadgen renders.
+
+Everything is deterministic: ledgers take injectable clocks, rings ingest
+at explicit `now=` stamps, analyzers evaluate at explicit times — no
+wall-clock sleeps."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lws_tpu import loadgen, obs
+from lws_tpu.api import contract, disagg
+from lws_tpu.api.meta import ObjectMeta
+from lws_tpu.api.pod import Container, Pod, PodSpec
+from lws_tpu.core import slo
+from lws_tpu.core.flightrecorder import FlightRecorder, Watchdog, default_rules
+from lws_tpu.core.metrics import MetricsRegistry, parse_exposition
+from lws_tpu.core.slo import SLORecorder, SLOTargets
+from lws_tpu.obs import rollout
+from lws_tpu.obs.history import HistoryRing
+from lws_tpu.obs.journey import JourneyVault
+from lws_tpu.obs.rollout import CanaryAnalyzer, CanaryReport, RolloutLedger
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder, make_all_groups_ready
+from lws_tpu.utils import revision as revisionutils
+from lws_tpu.utils.podutils import add_lws_variables
+
+# Second-scale twins of the SRE burn windows (same thresholds, 1/100th
+# wall) — the test rings below span ~195s, covering both tiers.
+WINDOWS = tuple(w.scaled(0.05) for w in obs.DEFAULT_BURN_WINDOWS)
+
+TARGETS = {"ttft_s": 1.0, "itl_s": 0.1, "queue_wait_s": 0.5}
+
+
+def update_image(cp, name, image):
+    lws = cp.store.get("LeaderWorkerSet", "default", name)
+    for c in lws.spec.leader_worker_template.worker_template.spec.containers:
+        c.image = image
+    cp.store.update(lws)
+
+
+def _canary_ring(now_span=195.0):
+    """A two-revision ring: baseline r1 delivers every token on time
+    (goodput == tokens), canary r2 delivers tokens with ZERO goodput (an
+    all-late canary never mints the goodput counter — absence is a 100%
+    error series, not a missing signal). r1 carries more tokens, so the
+    baseline pick is deterministic."""
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    acc = 0.0
+    for t in (0.0, 90.0, 180.0, now_span):
+        acc += 500.0
+        cum = MetricsRegistry()
+        cum.inc("serving_tokens_total",
+                {"engine": "paged", "revision": "r1"}, acc * 2)
+        cum.inc("serving_goodput_tokens_total",
+                {"engine": "paged", "revision": "r1"}, acc * 2)
+        cum.inc("serving_tokens_total",
+                {"engine": "paged", "revision": "r2"}, acc)
+        ring.ingest(cum.render(), now=t)
+    return ring
+
+
+# ---------------------------------------------------------------------------
+# RolloutLedger semantics
+
+
+def test_ledger_record_capacity_retention_and_counter():
+    reg = MetricsRegistry()
+    led = RolloutLedger(capacity=3, retention_s=100.0, registry=reg,
+                        clock=lambda: 0.0)
+    for i in range(5):
+        led.record("partition_move", obj=f"LeaderWorkerSet default/s{i}",
+                   now=float(i), to_partition=i, skipped=None)
+    # Capacity: only the newest 3 survive, oldest first.
+    snap = led.snapshot(limit=256, now=4.0)
+    assert [e["object"][-2:] for e in snap] == ["s2", "s3", "s4"]
+    # None-valued detail is dropped; scalars survive.
+    assert snap[-1]["detail"] == {"to_partition": 4}
+    assert snap[-1]["revision"] == ""
+    # limit picks the NEWEST entries; limit=0 keeps the body bounded.
+    assert [e["object"][-2:] for e in led.snapshot(limit=1, now=4.0)] == ["s4"]
+    assert led.snapshot(limit=0, now=4.0) == []
+    # Retention: entries older than now - retention_s sweep out.
+    assert len(led.snapshot(limit=256, now=103.5)) == 1  # only t=4.0 survives
+    assert reg.counter_value("lws_rollout_ledger_events_total",
+                             {"kind": "partition_move"}) == 5.0
+    # window() slices the trailing seconds.
+    led.record("scale", obj="x", now=200.0)
+    assert [e["kind"] for e in led.window(since_s=1.0, now=200.5)] == ["scale"]
+    led.clear()
+    assert led.snapshot(limit=256, now=200.0) == []
+
+
+def test_ledger_tracks_a_real_rolling_update():
+    """The store-watch feed, driven by the real controllers: create ->
+    roll the image -> the ledger carries creation, per-group revision
+    flips, partition staging, progress, and old-pod teardown — each entry
+    revision-stamped where the object carries one."""
+    cp = ControlPlane()
+    reg = MetricsRegistry()
+    led = RolloutLedger(registry=reg)
+    unsub = cp.store.watch(led.observe_store_event)
+    try:
+        cp.create(LWSBuilder().replicas(3).size(2).image("img:v1").build())
+        make_all_groups_ready(cp, "sample")
+        update_image(cp, "sample", "img:v2")
+        cp.run_until_stable()
+        make_all_groups_ready(cp, "sample")
+
+        entries = led.snapshot(limit=512)
+        kinds = {e["kind"] for e in entries}
+        assert {"created", "group_created", "pod_created", "revision_flip",
+                "partition_move", "rollout_progress",
+                "pod_deleted"} <= kinds, kinds
+        flips = [e for e in entries if e["kind"] == "revision_flip"]
+        assert flips  # the set-level GroupSet flipped to the new template
+        for e in flips:
+            assert e["revision"] and e["detail"]["from_revision"]
+            assert e["revision"] != e["detail"]["from_revision"]
+        # Partition staging walked 2 -> 0 (highest group first).
+        moves = [e["detail"]["to_partition"] for e in entries
+                 if e["kind"] == "partition_move"
+                 and e["object"].startswith("GroupSet")]
+        assert moves and moves[-1] == 0
+        # The counter and the timeline agree.
+        assert reg.counter_value("lws_rollout_ledger_events_total",
+                                 {"kind": "revision_flip"}) == float(len(flips))
+    finally:
+        unsub()
+
+
+def test_ledger_observer_never_breaks_the_store():
+    """A garbage event must be swallowed (the observer rides the
+    reconcile path's notify loop)."""
+
+    class Junk:
+        kind = "LeaderWorkerSet"  # routes to a handler, then explodes
+
+    led = RolloutLedger(registry=MetricsRegistry())
+    ev = type("Ev", (), {"type": "ADDED", "obj": Junk()})()
+    led.observe_store_event(ev)  # no raise
+    assert led.snapshot(limit=16) == []
+
+
+def test_ledger_recorder_feed_filters_kinds_and_bulky_payloads():
+    reg = MetricsRegistry()
+    led = RolloutLedger(registry=reg, clock=lambda: 10.0)
+    led.observe_recorder_event({
+        "kind": "drain_requested", "source": "node/a", "reason": "spot",
+        "ts": 1.0, "extra": {"nested": 1},
+    })
+    led.observe_recorder_event({"kind": "reconcile_tick", "source": "x"})
+    led.observe_recorder_event({
+        "kind": "canary_regression_fired", "lws": "default/s",
+        "revision": "r2", "short_burn": 55.0,
+        "error_window": [[0.0, 1.0]] * 64, "ledger_window": [{}] * 32,
+    })
+    entries = led.snapshot(limit=16, now=10.0)
+    assert [e["kind"] for e in entries] == ["drain_requested",
+                                           "canary_regression_fired"]
+    # Scalars ride along; ts/trace and the bulky windows do not.
+    assert entries[0]["detail"] == {"source": "node/a", "reason": "spot"}
+    assert entries[0]["object"] == "node/a"
+    assert entries[1]["revision"] == "r2"
+    assert "error_window" not in entries[1]["detail"]
+    assert "ledger_window" not in entries[1]["detail"]
+    assert entries[1]["detail"]["short_burn"] == 55.0
+
+
+# ---------------------------------------------------------------------------
+# Revision-dimension folds
+
+
+def test_revision_folds_over_a_two_revision_ring():
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    for t, tok, good in ((0.0, 100.0, 80.0), (60.0, 200.0, 160.0)):
+        cum = MetricsRegistry()
+        la = {"engine": "paged", "revision": "rA"}
+        cum.inc("serving_tokens_total", la, tok)
+        cum.inc("serving_goodput_tokens_total", la, good)
+        cum.inc("serving_tokens_total",
+                {"engine": "paged", "revision": "rB"}, tok / 2)
+        cum.set("serving_slo_attainment", 0.9 if t else 0.95, la)
+        cum.inc("serving_spec_tokens_total", {**la, "kind": "drafted"}, tok)
+        cum.inc("serving_spec_tokens_total", {**la, "kind": "accepted"}, good)
+        cum.inc("serving_prefix_cache_hits_total", la, 30.0 * (1 + (t > 0)))
+        cum.inc("serving_prefix_cache_misses_total", la, 10.0 * (1 + (t > 0)))
+        cum.observe("serving_ttft_seconds", 3.0, la)
+        if t:
+            cum.observe("serving_ttft_seconds", 0.2, la)
+        ring.ingest(cum.render(), now=t)
+
+    assert rollout.revision_values(ring) == ["rA", "rB"]
+    # GOOD%: rA delivered 80 of 100 new tokens on time; rB has no goodput
+    # twin at all — that is 100% late, not no-signal.
+    assert rollout.revision_good_fraction(ring, "rA") == pytest.approx(0.8)
+    assert rollout.revision_good_fraction(ring, "rB") == 0.0
+    assert rollout.revision_good_fraction(ring, "rZ") is None
+    tokens, span = rollout.revision_samples(ring, "rA")
+    assert tokens == pytest.approx(100.0) and span == pytest.approx(60.0)
+    assert rollout.revision_samples(ring, "rZ") == (0.0, 0.0)
+    att = rollout.revision_attainment(ring, "rA")
+    assert att is not None and 0.9 <= att <= 0.95
+    assert rollout.revision_attainment(ring, "rB") is None
+    assert rollout.revision_spec_fraction(ring, "rA") == pytest.approx(0.8)
+    assert rollout.revision_spec_fraction(ring, "rB") is None
+    assert rollout.revision_prefix_fraction(ring, "rA") == pytest.approx(0.75)
+    q = rollout.revision_quantile(ring, "serving_ttft_seconds_bucket",
+                                  0.5, "rA")
+    assert q is not None and q > 0.0
+    # Engine narrowing: a different engine sees nothing.
+    assert rollout.revision_good_fraction(ring, "rA", engine="other") is None
+
+
+def test_revision_burn_takes_the_worst_instance():
+    ring = _canary_ring()
+    verdicts = rollout.revision_burn(ring, "r2", 0.99, WINDOWS, now=195.0)
+    assert verdicts[0].window == "fast" and verdicts[0].firing
+    assert verdicts[0].short_burn >= 14.4
+    calm = rollout.revision_burn(ring, "r1", 0.99, WINDOWS, now=195.0)
+    assert not calm[0].firing
+    assert calm[0].short_burn == pytest.approx(0.0)
+    # Unseen revision: every tier present, nothing evaluable.
+    empty = rollout.revision_burn(ring, "rZ", 0.99, WINDOWS, now=195.0)
+    assert len(empty) == len(WINDOWS)
+    assert all(v.short_burn is None and not v.firing for v in empty)
+
+
+# ---------------------------------------------------------------------------
+# CanaryAnalyzer: guards, verdicts, alert feed
+
+
+def test_canary_no_data_is_not_promote():
+    """A thin canary holds — NEVER promotes — until the min-sample and
+    min-duration guards pass, and the verdict gauge says 0 (hold)."""
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    cum = MetricsRegistry()
+    cum.inc("serving_tokens_total", {"engine": "paged", "revision": "r9"}, 10.0)
+    cum.inc("serving_goodput_tokens_total",
+            {"engine": "paged", "revision": "r9"}, 10.0)
+    ring.ingest(cum.render(), now=0.0)
+    reg = MetricsRegistry()
+    an = CanaryAnalyzer(ring, lws="default/s", attainment_target=0.99,
+                        windows=WINDOWS, min_samples=50.0,
+                        min_duration_s=60.0, delta=2.0,
+                        registry=reg, recorder=FlightRecorder())
+    report = an.evaluate(now=1.0)
+    v = report.verdicts["r9"]
+    assert v.verdict == "hold"
+    assert v.reason.startswith("insufficient data")
+    assert report.baseline == ""  # nothing judgeable, no incumbent
+    assert reg.gauge_value("lws_rollout_canary_verdict",
+                           {"lws": "default/s", "revision": "r9"}) == 0.0
+
+
+def test_canary_burning_without_baseline_holds():
+    """Every revision burning means the regression is not
+    revision-attributable — hold, don't roll back to another bad build."""
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    acc = 0.0
+    for t in (0.0, 90.0, 180.0, 195.0):
+        acc += 500.0
+        cum = MetricsRegistry()
+        cum.inc("serving_tokens_total",
+                {"engine": "paged", "revision": "r2"}, acc)
+        ring.ingest(cum.render(), now=t)
+    an = CanaryAnalyzer(ring, attainment_target=0.99, windows=WINDOWS,
+                        min_samples=100.0, min_duration_s=50.0, delta=2.0,
+                        registry=MetricsRegistry(), recorder=FlightRecorder())
+    report = an.evaluate(now=195.0)
+    v = report.verdicts["r2"]
+    assert v.verdict == "hold" and v.firing
+    assert "not revision-attributable" in v.reason
+
+
+def test_canary_e2e_rollback_verdict_edge_alert_and_recovery():
+    """The PR's end-to-end proof: a degraded canary against a calm
+    baseline -> revision-scoped burn diverges -> `rollback` for the canary
+    while the baseline stays `promote` -> ONE `canary_regression` watchdog
+    alert whose dump embeds the offending revision's error window AND the
+    rollout-ledger window -> the ring emptying retires every gauge and
+    clears the alert."""
+    ring = _canary_ring()
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    wd = Watchdog(recorder=fr, rules=default_rules())
+    rollout.LEDGER.clear()
+    try:
+        # Seed the process ledger so the alert's evidence window has the
+        # control-plane context an operator would expect.
+        rollout.LEDGER.record("partition_move",
+                              obj="LeaderWorkerSet default/sample",
+                              now=190.0, from_partition=3, to_partition=2)
+        an = CanaryAnalyzer(ring, lws="default/sample",
+                            attainment_target=0.99, windows=WINDOWS,
+                            min_samples=100.0, min_duration_s=50.0,
+                            delta=2.0, ledger=rollout.LEDGER,
+                            registry=reg, recorder=fr)
+        report = an.evaluate(now=195.0)
+        assert report.baseline == "r1"
+        assert report.verdicts["r1"].verdict == "promote"
+        rv = report.verdicts["r2"]
+        assert rv.verdict == "rollback" and rv.firing
+        assert rv.baseline_burn == pytest.approx(0.0)
+        assert rv.short_burn >= 14.4
+        # Published surfaces: the verdict gauge pair + the burn twin.
+        assert reg.gauge_value("lws_rollout_canary_verdict",
+                               {"lws": "default/sample",
+                                "revision": "r2"}) == -1.0
+        assert reg.gauge_value("lws_rollout_canary_verdict",
+                               {"lws": "default/sample",
+                                "revision": "r1"}) == 1.0
+        burn = reg.gauge_value(
+            "serving_slo_burn_rate_by_revision",
+            {"engine": "paged", "revision": "r2", "window": "fast"})
+        assert burn is not None and burn >= 14.4
+        # Verdict changes land on the timeline.
+        verdict_entries = [e for e in rollout.LEDGER.snapshot(limit=64,
+                                                              now=195.0)
+                           if e["kind"] == "canary_verdict"]
+        assert {e["revision"]: e["detail"]["verdict"]
+                for e in verdict_entries} == {"r1": "promote",
+                                              "r2": "rollback"}
+
+        # The watchdog fires ONCE per episode, dump carrying the evidence.
+        firing = wd.check_now(now=196.0)
+        assert "canary_regression" in firing
+        dump = wd.last_dump
+        assert dump is not None
+        assert dump["reason"] == "watchdog:canary_regression"
+        assert "rollout" in dump  # every dump embeds the process timeline
+        fired = [e for e in dump["events"]
+                 if e["kind"] == "canary_regression_fired"]
+        assert fired, dump["events"]
+        assert fired[0]["revision"] == "r2"
+        assert fired[0]["lws"] == "default/sample"
+        assert fired[0]["error_window"], fired[0]
+        assert all(v >= 0.99 for _, v in fired[0]["error_window"])
+        ledger_kinds = [e["kind"] for e in fired[0]["ledger_window"]]
+        assert "partition_move" in ledger_kinds
+        # Steady firing: no second edge event, no second alert.
+        an.evaluate(now=200.0)
+        wd.check_now(now=201.0)
+        assert len([e for e in fr.events()
+                    if e["kind"] == "canary_regression_fired"]) == 1
+
+        # Recovery: the canary's series leave the ring -> gauges retire
+        # (a frozen rollback verdict is a phantom incident), alert clears.
+        ring.clear()
+        report = an.evaluate(now=205.0)
+        assert report.verdicts == {}
+        assert reg.gauge_value("lws_rollout_canary_verdict",
+                               {"lws": "default/sample",
+                                "revision": "r2"}) is None
+        assert reg.gauge_value(
+            "serving_slo_burn_rate_by_revision",
+            {"engine": "paged", "revision": "r2", "window": "fast"}) is None
+        assert "canary_regression" not in wd.check_now(now=206.0)
+    finally:
+        rollout.LEDGER.clear()
+
+
+# ---------------------------------------------------------------------------
+# The opt-in actuation adapter
+
+
+def test_actuation_adapter_pauses_and_rolls_back_mid_update():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(3).size(2).image("img:v1").build())
+    make_all_groups_ready(cp, "sample")
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()  # mid-rollout: both revisions exist
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    revs = revisionutils.list_revisions(cp.store, lws)
+    assert len(revs) == 2
+    old_key = revisionutils.get_revision_key(revs[0])
+    new_key = revisionutils.get_revision_key(revs[-1])
+
+    adapter = rollout.RolloutActuationAdapter(cp.store, "default", "sample")
+    report = CanaryReport(at=0.0, lws="default/sample", baseline=old_key)
+    report.verdicts[new_key] = rollout.RevisionVerdict(
+        new_key, "rollback", "fast burn 55.0x vs baseline 0.0x")
+    report.verdicts[old_key] = rollout.RevisionVerdict(
+        old_key, "promote", "within budget")
+    out = adapter.apply(report)
+    assert out["acted"] and out["paused"]
+    assert out["rolled_back_to"] == old_key
+    assert out["offenders"] == [new_key]
+
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    tpl = lws.spec.leader_worker_template.worker_template
+    assert tpl.spec.containers[0].image == "img:v1"
+    ru = lws.spec.rollout_strategy.rolling_update_configuration
+    assert ru.partition == 0  # rollback releases the pause
+    # The stock controller walks the fleet back to v1.
+    cp.run_until_stable()
+    make_all_groups_ready(cp, "sample")
+    for pod in cp.store.list("Pod"):
+        assert pod.spec.containers[0].image == "img:v1", pod.meta.name
+
+
+def test_actuation_adapter_is_inert_without_rollback_or_baseline():
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(2).size(2).image("img:v1").build())
+    make_all_groups_ready(cp, "sample")
+    adapter = rollout.RolloutActuationAdapter(cp.store, "default", "sample")
+    # All-promote report: nothing to act on.
+    report = CanaryReport(at=0.0, lws="default/sample", baseline="k1")
+    report.verdicts["k1"] = rollout.RevisionVerdict("k1", "promote", "ok")
+    assert adapter.apply(report) == {"acted": False, "offenders": []}
+    # Rollback verdict but NO judged baseline: acting would be a guess.
+    report = CanaryReport(at=0.0, lws="default/sample", baseline="")
+    report.verdicts["k2"] = rollout.RevisionVerdict("k2", "rollback", "burn")
+    out = adapter.apply(report)
+    assert out["acted"] is False and out["offenders"] == ["k2"]
+
+
+# ---------------------------------------------------------------------------
+# The /debug/rollout surface + fleet-scrape evaluation
+
+
+def test_api_server_rollout_endpoint_and_fleet_scrape_evaluation():
+    from lws_tpu.runtime.server import ApiServer
+
+    cp = ControlPlane(auto_ready=True)
+    cp.create(LWSBuilder().replicas(1).size(2).image("img:v1").build())
+    make_all_groups_ready(cp, "sample")
+    api = ApiServer(cp, port=0)
+    api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    try:
+        # The harness wired the process ledger to this store: the create
+        # above is already on the timeline.
+        with urllib.request.urlopen(f"{base}/debug/rollout", timeout=10) as r:
+            body = json.loads(r.read().decode())
+        assert isinstance(body, list)
+        assert any(e["kind"] == "created" for e in body)
+        with urllib.request.urlopen(f"{base}/debug/rollout?limit=1",
+                                    timeout=10) as r:
+            assert len(json.loads(r.read().decode())) == 1
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/rollout?limit=-1",
+                                   timeout=10)
+        assert err.value.code == 400
+        # The fleet scrape evaluates the default analyzer (dry-run, no
+        # revision series yet -> no verdicts) without failing the scrape,
+        # and its lws target syncs to the store's deployment.
+        with urllib.request.urlopen(f"{base}/metrics/fleet", timeout=10) as r:
+            assert r.status == 200
+        assert rollout.ANALYZER is not None
+        assert rollout.ANALYZER.lws == "default/sample"
+        # The revision-scoped request index rides the same 400-never-500
+        # contract as the other debug surfaces.
+        with urllib.request.urlopen(
+                f"{base}/debug/requests?revision=zzz", timeout=10) as r:
+            assert json.loads(r.read().decode()) == []
+    finally:
+        api.stop()
+        rollout.LEDGER.clear()
+
+
+# ---------------------------------------------------------------------------
+# Revision threading: pod env -> SLO series -> journeys
+
+
+def _make_pod(labels_extra=None):
+    labels = {
+        contract.SET_NAME_LABEL_KEY: "lws",
+        contract.GROUP_INDEX_LABEL_KEY: "1",
+        contract.WORKER_INDEX_LABEL_KEY: "0",
+    }
+    labels.update(labels_extra or {})
+    return Pod(
+        meta=ObjectMeta(
+            name="lws-1", namespace="ns1", labels=labels,
+            annotations={contract.SIZE_ANNOTATION_KEY: "2"},
+        ),
+        spec=PodSpec(containers=[Container(env=[])], subdomain="svc"),
+    )
+
+
+def test_pod_env_injects_revision_with_ds_precedence():
+    pod = _make_pod({contract.REVISION_LABEL_KEY: "tmplhash"})
+    add_lws_variables(pod)
+    values = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert values[contract.LWS_TPU_REVISION] == "tmplhash"
+    # The DS per-role revision outranks the template hash — the same
+    # precedence the fleet scraper applies to pod labels.
+    pod = _make_pod({contract.REVISION_LABEL_KEY: "tmplhash",
+                     disagg.DS_REVISION_LABEL_KEY: "dsrev"})
+    add_lws_variables(pod)
+    values = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert values[contract.LWS_TPU_REVISION] == "dsrev"
+    # No revision labels: the variable is simply absent (pre-revision
+    # series identity preserved).
+    pod = _make_pod()
+    add_lws_variables(pod)
+    assert contract.LWS_TPU_REVISION not in {
+        e.name for e in pod.spec.containers[0].env}
+
+
+def test_slo_recorder_stamps_revision_on_series_and_journeys(monkeypatch):
+    reg = MetricsRegistry()
+    rec = SLORecorder(targets=SLOTargets(ttft_s=10.0, itl_s=10.0,
+                                         queue_wait_s=10.0),
+                      registry=reg, revision="r9")
+    summaries = []
+    rec.journey_sinks.append(summaries.append)
+    tl = rec.request("paged", klass="chat")
+    tl.queue_wait(0.01)
+    tl.first_token(0.1)
+    tl.tokens(5, elapsed_s=0.2)
+    assert tl.finish()
+    labels = {"engine": "paged", "klass": "chat", "revision": "r9"}
+    # 6 = the first token + the 5-token tail, all on time.
+    assert reg.counter_value("serving_tokens_total", labels) == 6.0
+    assert reg.gauge_value("serving_slo_attainment", labels) == 1.0
+    assert summaries and summaries[0]["revision"] == "r9"
+    # Default: the pod env the webhook injected.
+    monkeypatch.setenv(slo.REVISION_ENV, "renv")
+    assert SLORecorder(registry=MetricsRegistry()).revision == "renv"
+    monkeypatch.delenv(slo.REVISION_ENV)
+    assert SLORecorder(registry=MetricsRegistry()).revision == ""
+
+
+def test_journey_vault_index_filters_by_revision():
+    v = JourneyVault(sample_rate=0.0, slowest_k=0, rng=lambda: 1.0,
+                     registry=MetricsRegistry())
+    v.complete("q-1", engine="paged", ok=False, revision="abc",
+               phases={"ttft_s": 2.0}, targets=dict(TARGETS))
+    v.complete("q-2", engine="paged", ok=False, revision="def",
+               phases={"ttft_s": 3.0}, targets=dict(TARGETS))
+    rows = v.index(outcome="all", revision="abc")
+    assert [r["id"] for r in rows] == ["q-1"]
+    assert rows[0]["revision"] == "abc"
+    assert len(v.index(outcome="all")) == 2
+    assert v.index(outcome="all", revision="zzz") == []
+    assert v.get("q-1")["revision"] == "abc"
+
+
+# ---------------------------------------------------------------------------
+# CLI renders (pure functions over canned state)
+
+
+def test_render_rollout_table_alerts_and_timeline():
+    from lws_tpu.cli import render_rollout
+
+    reg = MetricsRegistry()
+    reg.set("lws_rollout_canary_verdict", 1.0,
+            {"lws": "default/sample", "revision": "r1"})
+    reg.set("lws_rollout_canary_verdict", -1.0,
+            {"lws": "default/sample", "revision": "r2"})
+    reg.set("serving_slo_burn_rate_by_revision", 55.0,
+            {"engine": "paged", "revision": "r2", "window": "fast"})
+    reg.inc("serving_tokens_total",
+            {"engine": "paged", "revision": "r1"}, 1000.0)
+    reg.inc("serving_goodput_tokens_total",
+            {"engine": "paged", "revision": "r1"}, 900.0)
+    fams = parse_exposition(reg.render())
+    entries = [{"at": 1.0, "unix": 0.0, "kind": "partition_move",
+                "object": "LeaderWorkerSet default/sample", "revision": "",
+                "detail": {"from_partition": 3, "to_partition": 2}}]
+    alerts = {"canary_regression": {"series": "canary:default/sample/r2"}}
+    out = render_rollout(entries, fams, alerts)
+    assert "ROLLOUT  lws=default/sample  revisions=2" in out
+    assert "rollback" in out and "promote" in out
+    assert "55.0x" in out and "90%" in out
+    assert "ALERT canary_regression" in out
+    assert "partition_move" in out and "to_partition=2" in out
+
+    empty = render_rollout([], {}, {})
+    assert "(no revision-labelled serving series yet)" in empty
+    assert "(ledger empty" in empty
+
+
+def test_render_request_index_carries_revision_column():
+    from lws_tpu.cli import render_request_index
+
+    out = render_request_index([
+        {"id": "q-1", "outcome": "breached", "klass": "chat",
+         "engine": "paged", "ttft_s": 2.0, "total_s": 2.5, "spans": 3,
+         "revision": "abcdef123456789", "instance": "pod-0"},
+    ])
+    assert "REVISION" in out
+    assert "abcdef12345" in out  # truncated to the column
+    out = render_request_index([{"id": "q-2", "outcome": "retried"}])
+    assert out.splitlines()[1].split()[-2] == "-"  # no revision -> dash
+
+
+# ---------------------------------------------------------------------------
+# Loadgen: the canary report block + the revision-bump scenario hook
+
+
+def test_fold_canary_replays_the_run_and_traces_verdict_flips():
+    ring = _canary_ring()
+    canary = loadgen.fold_canary(ring, lws="default/sample",
+                                 attainment_target=0.99, windows=WINDOWS,
+                                 min_samples=100.0, min_duration_s=50.0,
+                                 delta=2.0)
+    assert canary is not None
+    assert canary["baseline"] == "r1"
+    assert canary["revisions"]["r2"]["verdict"] == "rollback"
+    assert canary["revisions"]["r1"]["verdict"] == "promote"
+    # The trace replays run-relative: starts at t=0 (everything holds on
+    # thin data), ends with the regression called.
+    assert canary["trace"][0]["t"] == 0.0
+    assert canary["trace"][-1]["verdicts"]["r2"] == "rollback"
+    # No revision-labelled series -> no block at all.
+    assert loadgen.fold_canary(HistoryRing(interval_s=0.0,
+                                           retention_s=60.0)) is None
+
+
+def test_render_report_canary_block():
+    report = {
+        "scenario": "rolling_update", "seed": 1, "horizon_s": 1.5,
+        "wall_s": 1.6, "offered_rps": 12.0, "achieved_rps": 11.5,
+        "classes": {},
+        "all": {"count": 10, "completed": 10, "attainment": 0.9,
+                "goodput_fraction": 0.8, "tokens": 60, "good_tokens": 48,
+                "ttft_p50": 0.01, "ttft_p95": 0.05, "ttft_p99": 0.06,
+                "itl_p50": 0.001, "itl_p95": 0.002, "itl_p99": 0.003},
+        "canary": {
+            "baseline": "r1",
+            "revisions": {
+                "r1": {"verdict": "promote", "short_burn": 0.0,
+                       "samples": 3000.0, "duration_s": 195.0,
+                       "reason": "within budget (fast burn 0.00x)"},
+                "r2": {"verdict": "rollback", "short_burn": 100.0,
+                       "samples": 1500.0, "duration_s": 195.0,
+                       "reason": "fast burn 100.0x vs baseline 0.0x"},
+            },
+            "trace": [{"t": 0.0, "baseline": "",
+                       "verdicts": {"r1": "hold", "r2": "hold"}},
+                      {"t": 195.0, "baseline": "r1",
+                       "verdicts": {"r1": "promote", "r2": "rollback"}}],
+        },
+    }
+    frame = loadgen.render_report(report)
+    assert "CANARY" in frame
+    assert "r1*" in frame  # baseline marker
+    assert "rollback" in frame and "100.0x" in frame
+    assert "canary @195.00s: r1=promote r2=rollback" in frame
+
+
+def test_revision_bump_stanza_validation():
+    spec = loadgen.load_scenario("rolling_update")
+    bump = loadgen.revision_bump(spec)
+    assert bump == {"at_s": 1.0, "lws": "",
+                    "env": {"name": "LWS_TPU_CANARY_STAGE",
+                            "value": "canary"}}
+    # Absent stanza: None — every pre-existing scenario is bump-free.
+    assert loadgen.revision_bump(loadgen.load_scenario("steady_poisson")) \
+        is None
+    # Defaults fill in; bad shapes fail loudly.
+    assert loadgen.revision_bump({"revision_bump": {}})["env"]["name"] == \
+        "LWS_TPU_CANARY_STAGE"
+    with pytest.raises(ValueError):
+        loadgen.revision_bump({"revision_bump": 5})
+    with pytest.raises(ValueError):
+        loadgen.revision_bump({"revision_bump": {"env": "canary"}})
+    # The stanza never touches the schedule: digests are bump-invariant.
+    with_bump = dict(spec)
+    without = {k: v for k, v in spec.items() if k != "revision_bump"}
+    assert loadgen.schedule_digest(loadgen.build_schedule(with_bump, 7)) == \
+        loadgen.schedule_digest(loadgen.build_schedule(without, 7))

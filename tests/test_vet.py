@@ -264,6 +264,31 @@ def test_spans_rules_cover_journey_vault():
         assert not f.detail.startswith("ok_"), f
 
 
+def test_spans_rules_cover_rollout_plane():
+    """The rollout plane (lws_tpu/obs/rollout.py) is INSIDE the catalogue
+    scope: its decision surface (`lws_rollout_canary_verdict`,
+    `serving_slo_burn_rate_by_revision`, `lws_rollout_ledger_events_total`)
+    is what rollback automation and rollout dashboards key on — an
+    analyzer minting per-revision names dynamically would make the one
+    surface that gates promotions uncatalogueable."""
+    found = run_pass(
+        "spans",
+        [FIXTURES / "lws_tpu" / "obs" / "rollout_cases.py"],
+        root=FIXTURES,
+    )
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert any("bad_revision_metric" in f.detail
+               for f in by_rule.get("metric-name-literal", [])), found
+    assert any("bad_verdict_span" in f.detail
+               for f in by_rule.get("span-name-literal", [])), found
+    assert any("bad_unentered_span" in f.detail
+               for f in by_rule.get("span-context-manager", [])), found
+    for f in found:
+        assert not f.detail.startswith("ok_"), f
+
+
 def test_spans_name_rules_scoped_to_catalogue_source():
     """The same file OUTSIDE an lws_tpu/ root only keeps the context-
     manager rule — test code can't pollute the metrics catalogue."""

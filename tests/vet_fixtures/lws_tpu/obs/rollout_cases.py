@@ -1,0 +1,48 @@
+"""Fixture: span/metric hygiene inside the rollout plane. Lives under a
+fake lws_tpu/obs/ root (the self-tests pass root=tests/vet_fixtures)
+because the canary analyzer emits the rollout decision surface
+(`lws_rollout_canary_verdict`, `serving_slo_burn_rate_by_revision`,
+`lws_rollout_ledger_events_total`) — an analyzer minting per-revision or
+per-verdict names dynamically would make the one surface rollback
+automation keys on uncatalogueable."""
+
+from lws_tpu.core import metrics, trace
+
+REVISION = "64d5ae4edd"
+VERDICT = "rollback"
+
+
+def bad_revision_metric():
+    # Building the gauge name from the revision hash would mint one
+    # ungreppable family per rollout instead of riding the `revision`
+    # label — dashboards and the actuation seam key on the literal name.
+    metrics.set("lws_rollout_canary_verdict_" + REVISION, -1.0)
+
+
+def bad_verdict_span(name):
+    with trace.span(name):
+        return None
+
+
+def bad_unentered_span():
+    leak = trace.span("rollout.evaluate")
+    return leak is not None
+
+
+def ok_verdict_metric():
+    metrics.set("lws_rollout_canary_verdict", -1.0,
+                {"lws": "default/sample", "revision": REVISION})
+
+
+def ok_burn_metric():
+    metrics.set("serving_slo_burn_rate_by_revision", 55.0,
+                {"engine": "paged", "revision": REVISION, "window": "fast"})
+
+
+def ok_ledger_metric():
+    metrics.inc("lws_rollout_ledger_events_total", {"kind": "revision_flip"})
+
+
+def ok_entered_span():
+    with trace.span("rollout.evaluate", verdict=VERDICT):
+        return None
