@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock clean
 
 test:
 	python -m pytest tests/ -q
@@ -39,7 +39,10 @@ bench-journey-overhead:  ## the journey vault's span listener must cost <2% deco
 bench-rollout-overhead:  ## the rollout ledger's store observer must cost <2% of reconcile-loop wall (budget json)
 	python benchmarks/rollout_ledger_overhead_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead  ## what CI would run (vet gates before tests)
+bench-vet-wallclock:  ## the full whole-program vet suite must stay under its wall-clock budget (budget json)
+	python benchmarks/vet_wallclock_bench.py --check
+
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

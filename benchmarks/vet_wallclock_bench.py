@@ -1,0 +1,112 @@
+"""Vet wall-clock bench: the whole-program analyses must stay cheap
+enough to gate every `make check` run.
+
+PR 16 moved the vet suite from per-function lint to whole-program
+analysis: a shared call graph over every module, transitive lock-hold
+summaries, reconcile-path reachability, and metric label-value tracing.
+Each of those is worst-case super-linear in program size, and all of
+them run on EVERY `make vet` — so a quadratic resolver regression or an
+unmemoised summary would silently turn the pre-test gate from seconds
+into minutes. This bench pins the ceiling: it times the full suite
+(`python -m tools.vet`, all passes, default baseline handling) end to
+end — interpreter start, module parse, call-graph build, every pass —
+exactly as `make check` invokes it, and fails if the median run
+exceeds the committed budget.
+
+The budget is deliberately loose (~5x the observed median) so it never
+flakes on a busy CI box but still catches the failure mode that
+matters: an accidental O(n^2) walk over the ~200-module program, which
+shows up as a 10x+ jump, not a 20% one.
+
+Run:    python benchmarks/vet_wallclock_bench.py            # report only
+CI:     python benchmarks/vet_wallclock_bench.py --check    # enforce
+The budget lives in benchmarks/vet_wallclock_budget.json (same contract
+shape as the other *_budget.json files; wired into `make check`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "vet_wallclock_budget.json")
+
+
+def median(xs: list) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def run_suite() -> tuple[float, str]:
+    """One full-suite run; returns (wall seconds, vet summary line)."""
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.vet"],
+        cwd=_ROOT, capture_output=True, text=True,
+    )
+    elapsed = time.perf_counter() - t0
+    if proc.returncode != 0:
+        # The bench measures a GREEN suite; a red one is a vet failure,
+        # not a perf regression — surface it verbatim and bail.
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise SystemExit(f"[vet-wallclock] vet exited {proc.returncode}; "
+                         "fix findings before benchmarking")
+    # The "vet: N files, ..." summary goes wherever vet's stream points;
+    # take the last non-empty line from either stream.
+    text = (proc.stdout + proc.stderr).strip()
+    summary = text.splitlines()[-1] if text else ""
+    return elapsed, summary
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--runs", type=int, default=3,
+                        help="full-suite runs to time (median is gated)")
+    parser.add_argument("--check", action="store_true",
+                        help="enforce vet_wallclock_budget.json (CI mode)")
+    args = parser.parse_args()
+
+    times = []
+    summary = ""
+    for _ in range(max(1, args.runs)):
+        elapsed, summary = run_suite()
+        times.append(elapsed)
+    wall_s = median(times)
+
+    print(json.dumps({
+        "metric": "vet full suite (all passes, python -m tools.vet)",
+        "runs": len(times),
+        "value": round(wall_s, 2),
+        "unit": "s (median wall clock)",
+        "suite": summary,
+    }))
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    verdict = {
+        "metric": "vet wall-clock budget (whole-program analyses must "
+                  "stay cheap enough to gate every check run)",
+        "value": round(wall_s, 2),
+        "unit": "s",
+        "budget_s": budget["max_wallclock_s"],
+        "within_budget": wall_s < budget["max_wallclock_s"],
+    }
+    print(json.dumps(verdict), flush=True)
+    if args.check and not verdict["within_budget"]:
+        print(
+            f"[vet-wallclock] FAIL: {wall_s:.2f}s >= budget "
+            f"{budget['max_wallclock_s']}s",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

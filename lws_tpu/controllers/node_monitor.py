@@ -27,8 +27,10 @@ def evict_pods_on_node(store: Store, node_name: str, message: str, recorder=None
 
     evicted: list[str] = []
     contended: list[str] = []
-    for pod in store.list("Pod"):
-        if pod.spec.node_name != node_name or pod.status.phase in (
+    # Node binding index, not a fleet scan: this runs per NotReady node on
+    # the reconcile path, and only the node's own pods matter.
+    for pod in store.bound_to_node(node_name):
+        if pod.status.phase in (
             PodPhase.FAILED, PodPhase.SUCCEEDED,  # kubectl drain ignores completed pods
         ):
             continue

@@ -281,7 +281,7 @@ class FaultInjector:
         if fault.mode in _RAISING_MODES:
             raise getattr(fault, "_exc")(f"injected fault at {point}")
         if fault.mode == "delay":
-            time.sleep(fault.arg)
+            time.sleep(fault.arg)  # vet: ignore[hotpath-blocking-call]: sleeping IS the delay fault mode being injected
             return None
         return fault  # drop / partial_write: cooperative
 

@@ -111,7 +111,10 @@ class Manager:
         self._stop = threading.Event()
         store.watch(self._on_event)
 
-    def _timed_reconcile(self, reg: _Registration, key: Key):
+    def _timed_reconcile(self, reg: _Registration, key: Key):  # reconcile-path
+        # ^ explicit purity-pass root: every registered reconciler dispatches
+        # through here (register()-discovery also finds typed reconcilers,
+        # but the mark anchors the loop itself).
         # Every reconcile runs inside a root span: the controller-layer
         # anchor of the trace spine (child spans live in the reconcilers;
         # serving subtrees graft on via propagated span contexts).
@@ -194,6 +197,10 @@ class Manager:
                 self._on_event(WatchEvent("MODIFIED", obj))
 
     def _on_event(self, event: WatchEvent) -> None:
+        # Store-watch observer: runs synchronously on the COMMITTING writer's
+        # thread. A key_fn is user-supplied mapping code — if it raises, the
+        # exception must degrade to a missed requeue (re-covered by the next
+        # resync sweep), not kill whichever thread happened to commit.
         for reg in self._registrations:
             fn = reg.watches.get(event.obj.kind)
             if fn is None:
@@ -201,8 +208,11 @@ class Manager:
             types = getattr(fn, "_event_types", None)
             if types is not None and event.type not in types:
                 continue
-            for key in fn(event.obj):
-                reg.enqueue(key)
+            try:
+                for key in fn(event.obj):
+                    reg.enqueue(key)
+            except Exception:  # vet: ignore[hazard-exception-swallow]: a broken key_fn must not kill the committing writer's thread (purity-observer-raise)
+                continue
 
     # ---- deterministic mode ------------------------------------------------
     def run_until_stable(self, max_iterations: int = 10000) -> int:

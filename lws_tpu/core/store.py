@@ -206,6 +206,12 @@ class Store:
         # delete-cascade were full-store scans; at fleet scale (512+ pods)
         # those scans — each cloning every object — dominated convergence.
         self._owner_index: dict[str, set[Key]] = {}  # guarded-by: _lock
+        # Node binding index: node name -> keys of objects bound to it
+        # (spec.node_name). Node drain/eviction used to scan-and-filter the
+        # whole Pod fleet per NotReady node; at slice-preemption scale that
+        # is O(fleet) work on the reconcile path for an O(pods-per-node)
+        # answer.
+        self._node_index: dict[str, set[Key]] = {}  # guarded-by: _lock
         # Per-kind mutation counter: lets read-heavy consumers (scheduler)
         # cache derived views and invalidate them precisely.
         self._kind_version: dict[str, int] = {}  # guarded-by: _lock
@@ -261,10 +267,12 @@ class Store:
         if prev is not None:
             self._unindex_labels(key, prev)
             self._unindex_owners(key, prev)
+            self._unindex_node(key, prev)
         self._objects[key] = obj
         self._by_kind.setdefault(key[0], {})[key] = obj
         self._index_labels(key, obj)
         self._index_owners(key, obj)
+        self._index_node(key, obj)
         self._record_fingerprint(key, obj)
         self._bump_kind(key[0])  # invalidate kind_version-keyed caches
 
@@ -277,6 +285,7 @@ class Store:
             self._by_kind.get(key[0], {}).pop(key, None)
             self._unindex_labels(key, obj)
             self._unindex_owners(key, obj)
+            self._unindex_node(key, obj)
             self._fingerprints.pop(key, None)
             self._bump_kind(key[0])
 
@@ -314,6 +323,20 @@ class Store:
                     bucket.discard(key)
                     if not bucket:
                         del self._owner_index[ref.uid]
+
+    def _index_node(self, key: Key, obj: TypedObject) -> None:  # holds-lock: _lock
+        node = getattr(getattr(obj, "spec", None), "node_name", "")
+        if node:
+            self._node_index.setdefault(node, set()).add(key)
+
+    def _unindex_node(self, key: Key, obj: TypedObject) -> None:  # holds-lock: _lock
+        node = getattr(getattr(obj, "spec", None), "node_name", "")
+        if node:
+            bucket = self._node_index.get(node)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._node_index[node]
 
     def watch(self, fn: Callable[[WatchEvent], None]) -> Callable[[], None]:
         """Subscribe to all mutations; returns an unsubscribe handle."""
@@ -469,6 +492,7 @@ class Store:
                 self._by_kind.setdefault(key[0], {})[key] = obj
                 self._index_labels(key, obj)
                 self._index_owners(key, obj)
+                self._index_node(key, obj)
                 self._record_fingerprint(key, obj)
                 self._bump_kind(key[0])
                 stored = _clone(obj)
@@ -543,10 +567,12 @@ class Store:
                 self._journal("update", obj)
             self._unindex_labels(key, current)
             self._unindex_owners(key, current)
+            self._unindex_node(key, current)
             self._objects[key] = obj
             self._by_kind.setdefault(key[0], {})[key] = obj
             self._index_labels(key, obj)
             self._index_owners(key, obj)
+            self._index_node(key, obj)
             self._record_fingerprint(key, obj)
             self._bump_kind(key[0])
             stored = _clone(obj)
@@ -577,6 +603,7 @@ class Store:
         self._by_kind.get(key[0], {}).pop(key, None)
         self._unindex_labels(key, obj)
         self._unindex_owners(key, obj)
+        self._unindex_node(key, obj)
         self._fingerprints.pop(key, None)
         self._bump_kind(key[0])
         # Cascade: anything whose controller owner is this object (same
@@ -781,6 +808,20 @@ class Store:
                 _clone(self._objects[k])
                 for k in self._owner_index.get(owner_uid, ())
                 if k[0] == kind and k[1] == namespace and k in self._objects
+            ]
+        out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
+        return out
+
+    def bound_to_node(self, node_name: str) -> list[TypedObject]:
+        """Objects whose spec.node_name binds them to `node_name` (pods, in
+        practice), via the node binding index. Node drain/eviction used to
+        scan-and-filter the whole Pod fleet per NotReady node — O(fleet)
+        reconcile work for an O(pods-per-node) answer."""
+        with self._lock:
+            out = [
+                _clone(self._objects[k])
+                for k in self._node_index.get(node_name, ())
+                if k in self._objects
             ]
         out.sort(key=lambda o: (o.meta.namespace, o.meta.name))
         return out

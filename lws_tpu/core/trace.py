@@ -278,14 +278,24 @@ class Tracer:
                 pass
         if self._export_path:
             line = json.dumps(record, default=str)
+            # One append handle for the tracer's lifetime: per-span
+            # open/close syscalls would tax exactly the hot dispatch
+            # loop the <2% budget protects. The open itself happens
+            # OUTSIDE _export_lock (file creation can block on the host
+            # and would convoy every concurrently finishing span); the
+            # first finisher to publish wins, a losing handle is closed.
+            f = self._export_file
+            if f is None:
+                handle = open(self._export_path, "a")
+                with self._export_lock:
+                    if self._export_file is None:
+                        self._export_file = handle
+                    f = self._export_file
+                if f is not handle:
+                    handle.close()
             with self._export_lock:
-                # One append handle for the tracer's lifetime: per-span
-                # open/close syscalls would tax exactly the hot dispatch
-                # loop the <2% budget protects.
-                if self._export_file is None:
-                    self._export_file = open(self._export_path, "a")
-                self._export_file.write(line + "\n")
-                self._export_file.flush()
+                f.write(line + "\n")
+                f.flush()
 
     # ---- public API ------------------------------------------------------
     def span(self, name: str, parent: Optional[dict] = None, **attrs):

@@ -222,7 +222,7 @@ class StateDir:
         line = (json.dumps(record) + "\n").encode()
         with self._mutex:
             if self._wal_f is None:
-                self._wal_f = open(self.wal_path, "ab")
+                self._wal_f = open(self.wal_path, "ab")  # vet: ignore[lock-held-blocking]: WAL appends must serialize under _mutex — the durable write IS the critical section
             self._wal_f.write(line)
             self._wal_f.flush()
             if self.fsync:
@@ -237,7 +237,7 @@ class StateDir:
                 # in-flight write is NOT yet in the store maps, but its WAL
                 # record precedes the truncate only logically — it re-lands in
                 # the fresh journal below, keeping snapshot+WAL complete.
-                self._compact_locked(pending=line)
+                self._compact_locked(pending=line)  # vet: ignore[lock-held-blocking]: snapshot+truncate must be atomic vs concurrent appends — compaction I/O belongs under _mutex
 
     def _compact_locked(self, pending: bytes = b"") -> None:
         """Write a durable snapshot, then reset the journal (in that order:
@@ -261,7 +261,7 @@ class StateDir:
         if self._store is None:
             raise RuntimeError("attach() a store first")
         with self._store._lock, self._mutex:
-            self._compact_locked()
+            self._compact_locked()  # vet: ignore[lock-held-blocking]: manual compaction — same atomic snapshot+truncate contract as the journal hook
 
     def close(self, final_snapshot: bool = True) -> None:
         """Clean shutdown: optional final compaction, detach, release lock."""
@@ -270,7 +270,7 @@ class StateDir:
                 self._store._journal = None
                 if final_snapshot:
                     with self._mutex:
-                        self._compact_locked()
+                        self._compact_locked()  # vet: ignore[lock-held-blocking]: shutdown snapshot — single-threaded teardown, atomicity still required
             self._store = None
         with self._mutex:
             if self._wal_f is not None:

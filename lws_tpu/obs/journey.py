@@ -315,7 +315,15 @@ class JourneyVault:
         attach it straight to the journey that already claimed the trace).
         This is the decode hot path's recurring cost — one lock, one dict
         lookup, one append (`benchmarks/journey_overhead_bench.py` budgets
-        it under 2% of decode throughput)."""
+        it under 2% of decode throughput). Listener contract: runs on the
+        finishing span's own thread, so a vault bug must surface as a lost
+        journey record, never as an exception into that thread."""
+        try:
+            self._on_span(record)
+        except Exception:  # vet: ignore[hazard-exception-swallow]: a vault bug must never break span accounting on the finishing thread (purity-observer-raise)
+            pass
+
+    def _on_span(self, record: dict) -> None:
         tid = record.get("trace_id")
         if not tid:
             return
@@ -370,7 +378,14 @@ class JourneyVault:
         """Flight-recorder observer: attach resilience/chaos events to the
         journey they belong to — by explicit `request_id` field first, by
         the event's recorded trace ctx second. Unjoinable events are
-        ignored (the ring still has them)."""
+        ignored (the ring still has them). Same containment contract as
+        on_span: the recording thread never sees a vault exception."""
+        try:
+            self._on_event(event)
+        except Exception:  # vet: ignore[hazard-exception-swallow]: a vault bug must cost one journey join, not the recording thread (purity-observer-raise)
+            pass
+
+    def _on_event(self, event: dict) -> None:
         flag = _EVENT_FLAGS.get(event.get("kind", ""))
         if flag is None:
             return
@@ -416,7 +431,15 @@ class JourneyVault:
 
     def on_timeline(self, summary: dict) -> None:
         """SLO sink (`SLORecorder.journey_sinks`): a request timeline
-        finished — complete the journey with its phase values and verdict."""
+        finished — complete the journey with its phase values and verdict.
+        Contained like the other feeds (the sink loop in slo.py also
+        wraps, but the vault does not rely on every dispatcher doing so)."""
+        try:
+            self._on_timeline(summary)
+        except Exception:  # vet: ignore[hazard-exception-swallow]: a vault bug must not fail a request's SLO completion (purity-observer-raise)
+            pass
+
+    def _on_timeline(self, summary: dict) -> None:
         phases = {
             k: summary.get(k)
             for k in ("queue_wait_s", "ttft_s", "worst_itl_s", "total_s",

@@ -494,7 +494,7 @@ class FleetCollector:
                         and time.monotonic() - self._cached_at < self.cache_ttl_s):
                     return self._cached
             merged = metrics.merge_expositions(
-                self.collect(), max_label_sets=self.max_label_sets
+                self.collect(), max_label_sets=self.max_label_sets  # vet: ignore[lock-held-blocking]: single-flight by design — _refill_lock exists so ONE scrape runs while concurrent misses wait on it
             )
             with self._lock:
                 self._cached = merged

@@ -232,7 +232,7 @@ class LocalBackend:
 
     def _kill_orphans(self) -> None:
         """Kill processes whose pods no longer exist (group teardown)."""
-        live_uids = {p.meta.uid for p in self.store.list("Pod")}
+        live_uids = {p.meta.uid for p in self.store.list("Pod")}  # vet: ignore[purity-fleet-scan]: the orphan sweep needs the COMPLETE live-uid set by definition; runs on the slow poll ticker
         with self._lock:
             dead = [uid for uid in self._procs if uid not in live_uids]
             for uid in dead:

@@ -154,12 +154,18 @@ class ApiServer:
         seq_box = {"seq": 0}
 
         def _record_event(ev) -> None:
-            with events_cond:
-                seq_box["seq"] += 1
-                events.append(
-                    {"seq": seq_box["seq"], "type": ev.type, "object": to_manifest(ev.obj)}
-                )
-                events_cond.notify_all()
+            # Store-watch observer on the committing writer's thread: a
+            # manifest-encoding bug must cost one watch event, not the
+            # writer. Long-pollers resync from a LIST on reconnect anyway.
+            try:
+                with events_cond:
+                    seq_box["seq"] += 1
+                    events.append(
+                        {"seq": seq_box["seq"], "type": ev.type, "object": to_manifest(ev.obj)}
+                    )
+                    events_cond.notify_all()
+            except Exception:  # vet: ignore[hazard-exception-swallow]: a broken watch-cache append must not kill the committing writer (purity-observer-raise)
+                pass
 
         self._unwatch = cp.store.watch(_record_event)
         self._events, self._events_cond, self._seq_box = events, events_cond, seq_box

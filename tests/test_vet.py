@@ -83,6 +83,64 @@ def test_locks_false_positive_guards_stay_silent():
     assert findings_for("locks", "lock_guards_ok.py") == []
 
 
+def test_callgraph_resolution_shapes():
+    """The shared call graph (tools/vet/callgraph.py): every provable
+    shape resolves (self-calls, ctor-typed locals, annotations, module
+    globals, attr inference, IfExp receivers) and every dynamic shape
+    conservatively does NOT."""
+    from tools.vet import callgraph
+    from tools.vet.core import load_modules
+
+    mods = load_modules([FIXTURES / "callgraph_cases.py"], FIXTURES)
+    graph = callgraph.build(mods)
+    rel = "callgraph_cases.py"
+
+    def callees_of(qual):
+        info = graph.funcs[(rel, qual)]
+        return {k for k, _ in graph.callees(info)}
+
+    # Plain call + ctor-typed local + module-global instance.
+    assert callees_of("root") == {
+        (rel, "helper"), (rel, "Worker.__init__"), (rel, "Worker.step"),
+    }
+    # self-method, annotated param, attr-inferred type.
+    assert (rel, "Worker._locked_inner") in callees_of("Worker.step")
+    assert (rel, "Worker.step") in callees_of("typed_param")
+    assert (rel, "Other.poke") in callees_of("Worker._locked_inner")
+    # IfExp receiver: both branches resolve to Worker.
+    assert (rel, "Worker.step") in callees_of("conditional")
+    # Conservatism: untyped callables/receivers make NO edge.
+    assert callees_of("dynamic") == set()
+    assert callees_of("duck") == set()
+    # Reachability closes over the chain.
+    reached = graph.reachable([(rel, "root")])
+    assert (rel, "Other.poke") in reached
+    # resolve_callable: a function-valued Name resolves without a call.
+    import ast
+
+    info = graph.funcs[(rel, "observer_ref")]
+    ret = info.node.body[-1]
+    assert isinstance(ret, ast.Return)
+    assert graph.resolve_callable(info, ret.value) == (rel, "helper")
+
+
+def test_locks_interprocedural_blocking_and_cross_class_order():
+    """lock-held-blocking direct + through a resolvable callee, and the
+    cross-class ABBA inversion only the call graph can see; the released/
+    unresolvable shapes stay silent."""
+    found = findings_for("locks", "lock_interproc.py")
+    pairs = {(f.rule, f.detail) for f in found}
+    assert ("lock-held-blocking", "bad_direct:time.sleep") in pairs, found
+    assert ("lock-held-blocking",
+            "bad_transitive->Blocker._helper") in pairs, found
+    order = [f for f in found if f.rule == "lock-order"]
+    assert len(order) == 1, found
+    assert order[0].detail == "Left._l_lock<->Right._r_lock"
+    assert "across classes" in order[0].message
+    # ok_outside (lock released) and ok_unresolvable produce nothing.
+    assert not any(f.detail.startswith("ok_") for f in found), found
+
+
 # ---------------------------------------------------------------------------
 # hotpath pass
 
@@ -332,7 +390,144 @@ def test_style_trailing_ws_tabs_and_malformed_suppression():
 
 
 # ---------------------------------------------------------------------------
-# baseline machinery
+# purity pass
+
+
+def test_purity_observer_containment_and_fleet_scans():
+    found = run_pass(
+        "purity", [FIXTURES / "lws_tpu" / "purity_cases.py"], root=FIXTURES
+    )
+    pairs = {(f.rule, f.detail) for f in found}
+    # Uncontained observer flagged AT THE REGISTRATION SITE; the broad-
+    # try-contained one and the suppressed registration stay silent.
+    assert ("purity-observer-raise", "wire:bad_observer") in pairs, found
+    assert not any("good_observer" in d for _, d in pairs), found
+    assert not any("wire_suppressed" in d for _, d in pairs), found
+    # Whole-fleet scan, per-item fan-out (loop), and the name-fallback
+    # receiver; filtered/suppressed/unreachable scans stay silent.
+    assert ("purity-fleet-scan", "Ctl.reconcile:list(Pod)") in pairs, found
+    assert ("purity-fleet-scan",
+            "Ctl.reconcile:list(Node)@loop") in pairs, found
+    assert ("purity-fleet-scan", "untyped_helper:list(Pod)") in pairs, found
+    assert not any("ok_filtered" in d or "ok_suppressed" in d
+                   or "cold_scan" in d for _, d in pairs), found
+
+
+def test_purity_scoped_to_lws_tpu_paths():
+    """The same fixture rooted so its rel path is NOT under lws_tpu/
+    produces nothing — tests may register throwaway callbacks."""
+    found = run_pass(
+        "purity", [FIXTURES / "lws_tpu" / "purity_cases.py"],
+        root=FIXTURES / "lws_tpu",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# cardinality pass
+
+
+def test_cardinality_traces_derived_labels_against_catalogue():
+    found = run_pass(
+        "cardinality", [FIXTURES / "lws_tpu" / "cardinality_cases.py"],
+        root=FIXTURES,
+    )
+    pairs = {(f.rule, f.detail) for f in found}
+    # Derived values (f-string identity, str(.request_id), via a local
+    # binding) on an UNCATALOGUED metric are findings.
+    assert ("cardinality-unbounded", "fixture_requests_total:pod") in pairs
+    assert ("cardinality-unbounded",
+            "fixture_latency_seconds:request") in pairs
+    lines = sorted(f.line for f in found
+                   if f.detail == "fixture_requests_total:pod")
+    assert len(lines) == 2, found  # f-string site AND the binding site
+    # The committed catalogue declares lws_rollout_progress `lws`: capped —
+    # the sanctioned escape hatch stays silent; so do bounded/opaque values
+    # and the suppressed site.
+    assert not any("lws_rollout_progress" in d for _, d in pairs), found
+    assert not any("outcome" in d for _, d in pairs), found
+    assert not any(f.detail.endswith(":uid") for f in found), found
+
+
+def test_cardinality_bound_cell_grammar():
+    """parse_bound_cell is the ONE grammar both the vet pass and
+    check_metrics_catalogue.py enforce."""
+    from tools.vet.cardinality import catalogue_bounds, parse_bound_cell
+
+    assert parse_bound_cell("—") == {}
+    assert parse_bound_cell("") == {}
+    assert parse_bound_cell("`engine`: enum") == {"engine": "enum"}
+    assert parse_bound_cell("`lws`: capped, `revision`: capped") == {
+        "lws": "capped", "revision": "capped",
+    }
+    assert parse_bound_cell("engine: enum") == {"engine": "enum"}  # unticked ok
+    assert parse_bound_cell("`engine`: bogus") is None  # unknown class
+    assert parse_bound_cell("garbage") is None
+    table = (
+        "## Metrics\n\n"
+        "| Name | Type | Labels | Bound | Layer |\n"
+        "|---|---|---|---|---|\n"
+        "| `m_total` | counter | `a` | `a`: enum | x |\n"
+        "| `g` | gauge | — | — | x |\n\n"
+        "## Spans\n"
+    )
+    assert catalogue_bounds(table) == {"m_total": {"a": "enum"}, "g": {}}
+
+
+def test_metrics_catalogue_checker_enforces_bound_shape(tmp_path):
+    """tools/check_metrics_catalogue.py (the SHAPE side of the contract):
+    the committed catalogue passes; a malformed Bound cell, a Labels/Bound
+    set mismatch, and an undeclared source label each fail."""
+    import tools.check_metrics_catalogue as checker
+
+    catalogue = checker.CATALOGUE.read_text()
+    rows = checker.metrics_rows(catalogue)
+    assert len(rows) >= 30
+    for name, labels, bound_cell in rows:
+        bound = checker.parse_bound_cell(bound_cell)
+        assert bound is not None, (name, bound_cell)
+        assert set(bound) == labels, (name, bound, labels)
+    # Synthetic violations exercise each error branch of the row checks.
+    bad_rows = checker.metrics_rows(
+        "## Metrics\n\n"
+        "| Name | Type | Labels | Bound |\n"
+        "|---|---|---|---|\n"
+        "| `m1` | counter | `a` | `a`: nonsense |\n"
+        "| `m2` | counter | `a`, `b` | `a`: enum |\n"
+    )
+    m1 = next(r for r in bad_rows if r[0] == "m1")
+    m2 = next(r for r in bad_rows if r[0] == "m2")
+    assert checker.parse_bound_cell(m1[2]) is None
+    assert set(checker.parse_bound_cell(m2[2])) != m2[1]
+
+
+def test_new_rule_suppressions_and_baseline_keys(tmp_path):
+    """Per new rule id: the inline suppression is honored (asserted via
+    the fixtures above) and findings round-trip through the baseline
+    machinery with line-stable keys."""
+    found = run_pass(
+        "locks", [FIXTURES / "lock_interproc.py"], root=FIXTURES
+    ) + run_pass(
+        "purity", [FIXTURES / "lws_tpu" / "purity_cases.py"], root=FIXTURES
+    ) + run_pass(
+        "cardinality", [FIXTURES / "lws_tpu" / "cardinality_cases.py"],
+        root=FIXTURES,
+    )
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    for rule in ("lock-held-blocking", "lock-order",
+                 "purity-observer-raise", "purity-fleet-scan",
+                 "cardinality-unbounded"):
+        assert by_rule.get(rule), f"no {rule} findings to baseline"
+    counts: dict[str, int] = {}
+    for f in found:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    new, old, orphans = apply_baseline(found, counts)
+    assert new == [] and orphans == [] and len(old) == len(found)
+    # Key shape: path::qual::rule::detail — scope+detail, never the line.
+    for f in found:
+        assert f.key() == f"{f.path}::{f.qual}::{f.rule}::{f.detail}"
 
 
 def test_baseline_allows_known_and_errors_on_orphans(tmp_path):
@@ -410,6 +605,71 @@ def test_partial_run_keeps_baseline_allowance():
         cwd=ROOT, capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_only_rejects_unknown_pass_with_valid_list():
+    """--only with an unknown pass name fails fast (exit 2) and the error
+    names every valid pass — no silent no-op runs."""
+    from tools.vet import PASSES
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.vet", "--only", "nosuchpass"],
+        cwd=ROOT, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "unknown pass(es): nosuchpass" in proc.stderr
+    for name in PASSES:
+        assert name in proc.stderr, (name, proc.stderr)
+
+
+def test_format_json_and_sarif_are_stable_machine_output():
+    """--format json/sarif emit ONE parseable document with the stable
+    keys (file/line/rule/reason; SARIF ruleId/uri/startLine/message) and
+    the same exit semantics as text."""
+    fixture = str(FIXTURES / "lock_interproc.py")
+    jproc = subprocess.run(
+        [sys.executable, "-m", "tools.vet", "--format", "json",
+         "--only", "locks", fixture],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert jproc.returncode == 1  # findings present
+    doc = json.loads(jproc.stdout)
+    assert doc and all(
+        set(d) >= {"file", "line", "rule", "reason"} for d in doc
+    ), doc
+    assert any(d["rule"] == "lock-held-blocking" for d in doc)
+    assert all(isinstance(d["line"], int) for d in doc)
+    # Sorted deterministically by (file, line, rule).
+    assert doc == sorted(doc, key=lambda d: (d["file"], d["line"], d["rule"]))
+
+    sproc = subprocess.run(
+        [sys.executable, "-m", "tools.vet", "--format", "sarif",
+         "--only", "locks", fixture],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert sproc.returncode == 1
+    sarif = json.loads(sproc.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "lws-tpu-vet"
+    results = run["results"]
+    assert len(results) == len(doc)
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    for res, j in zip(results, doc):
+        assert res["ruleId"] == j["rule"] and res["ruleId"] in rule_ids
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == j["file"]
+        assert loc["region"]["startLine"] == j["line"]
+        assert res["message"]["text"] == j["reason"]
+
+    # Clean repo run in json mode: an empty array, still exit 0.
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.vet", "--format", "json",
+         "--only", "style"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert json.loads(clean.stdout) == []
 
 
 def test_lint_alias_is_style_only_pass():

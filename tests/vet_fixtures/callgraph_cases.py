@@ -1,0 +1,61 @@
+"""Call-graph resolution fixture (tools/vet/callgraph.py): plain calls,
+self-methods, constructor-typed locals, annotation-typed params,
+module-global instances, conditional receivers, and the dynamic shapes
+that must produce NO edge (conservatism is the contract)."""
+
+import threading
+
+
+def helper():
+    return 1
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = Other()  # attr type inference: self.peer -> Other
+
+    def step(self):
+        self._locked_inner()
+
+    def _locked_inner(self):
+        return self.peer.poke()
+
+
+class Other:
+    def poke(self):
+        return 2
+
+
+SHARED = Worker()  # module-global instance: SHARED.step() resolves
+
+
+def root():
+    helper()
+    w = Worker()  # constructor-typed local
+    w.step()
+    SHARED.step()
+
+
+def typed_param(w: Worker):
+    w.step()
+
+
+def conditional(flag: bool, a: Worker):
+    # IfExp receiver: both branches the same class -> still resolves.
+    target = a if flag else SHARED
+    target.step()
+
+
+def observer_ref():
+    # Function-valued expression (resolve_callable): passing a function,
+    # not calling it — an edge only through explicit registration logic.
+    return helper
+
+
+def dynamic(fn):
+    fn()  # untyped callable param: NO edge
+
+
+def duck(obj):
+    obj.step()  # untyped receiver: NO edge, even though Worker.step exists

@@ -1,0 +1,59 @@
+"""Interprocedural lock fixture: blocking under a declared lock (direct
+and through a resolvable callee), the cross-class ABBA inversion the
+call graph exposes, and the conservative shapes that must stay silent
+(blocking outside locks, unresolvable callees)."""
+
+import time
+import threading
+
+
+class Blocker:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_direct(self):
+        with self._lock:
+            time.sleep(0.1)  # blocking while holding a declared lock
+
+    def bad_transitive(self):
+        with self._lock:
+            self._helper()  # resolvable callee that blocks
+
+    def _helper(self):
+        time.sleep(0.1)
+
+    def ok_outside(self):
+        with self._lock:
+            x = 1
+        time.sleep(0.1)  # lock already released
+        return x
+
+    def ok_unresolvable(self, fn):
+        with self._lock:
+            fn()  # untyped callable: conservatively no propagation
+
+
+class Left:
+    def __init__(self):
+        self._l_lock = threading.Lock()
+
+    def fwd(self, r: "Right"):
+        with self._l_lock:
+            r.take()  # acquires Right._r_lock under Left._l_lock
+
+    def take(self):
+        with self._l_lock:
+            return 1
+
+
+class Right:
+    def __init__(self):
+        self._r_lock = threading.Lock()
+
+    def take(self):
+        with self._r_lock:
+            return 2
+
+    def back(self, left: Left):
+        with self._r_lock:
+            left.take()  # acquires Left._l_lock under Right._r_lock: ABBA

@@ -1,0 +1,44 @@
+"""Cardinality fixture (rooted under lws_tpu/): derived label values
+against the REAL committed catalogue — an uncatalogued metric with an
+identity-derived label is flagged, `lws_rollout_progress`'s `lws` label
+(declared `capped`) is the sanctioned escape hatch, bounded/opaque
+values stay silent, and one site carries a suppression."""
+
+
+def bad_identity_fstring(metrics, pod):
+    metrics.inc(
+        "fixture_requests_total",
+        {"pod": f"{pod.meta.namespace}/{pod.meta.name}"},
+    )
+
+
+def bad_str_of_object(metrics, req):
+    metrics.observe(
+        "fixture_latency_seconds", 0.1, {"request": str(req.request_id)}
+    )
+
+
+def bad_via_binding(metrics, pod):
+    who = f"{pod.meta.namespace}/{pod.meta.name}"
+    metrics.inc("fixture_requests_total", {"pod": who})
+
+
+def ok_declared_capped(metrics, lws):
+    # The real catalogue declares `lws`: capped on this metric — riding
+    # the registry's max_label_sets cap is the sanctioned design.
+    metrics.set(
+        "lws_rollout_progress", 0.5,
+        {"lws": f"{lws.meta.namespace}/{lws.meta.name}", "revision": "r1"},
+    )
+
+
+def ok_enum_literal(metrics):
+    metrics.inc("fixture_requests_total", {"outcome": "success"})
+
+
+def ok_opaque_value(metrics, label):
+    metrics.inc("fixture_requests_total", {"pod": label})  # unknown: silent
+
+
+def ok_suppressed(metrics, pod):
+    metrics.inc("fixture_requests_total", {"pod": str(pod.meta.uid)})  # vet: ignore[cardinality-unbounded]: fixture — suppression semantics under test

@@ -1,0 +1,71 @@
+"""Purity fixture (rooted under lws_tpu/ so the scoped pass sees it):
+observer registrations with and without exception containment, a marked
+reconcile path doing whole-fleet and per-item store scans, the filtered/
+suppressed shapes that stay silent, and a suppressed registration."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self):
+        self._observers = []
+
+    def add_observer(self, fn):
+        self._observers.append(fn)
+
+
+class Store:  # receiver typing keys on the class NAME (exactly "Store")
+    def list(self, kind, namespace=None, labels=None):
+        return []
+
+
+def do_thing(event):
+    raise ValueError(event)
+
+
+def bad_observer(event):
+    do_thing(event)  # can raise on the committing writer's thread
+
+
+def good_observer(event):
+    try:
+        do_thing(event)
+    except Exception:  # broad containment: the observer contract
+        pass
+
+
+def wire(rec: Recorder):
+    rec.add_observer(bad_observer)
+    rec.add_observer(good_observer)
+
+
+def wire_suppressed(rec: Recorder):
+    rec.add_observer(bad_observer)  # vet: ignore[purity-observer-raise]: fixture — suppression semantics under test
+
+
+def untyped_helper(store):  # reconcile-path
+    # Name-fallback receiver: an unannotated param literally named `store`.
+    return store.list("Pod")
+
+
+class Ctl:
+    def __init__(self):
+        self.store = Store()
+
+    def reconcile(self, key):  # reconcile-path
+        pods = self.store.list("Pod")  # whole-fleet scan
+        for p in pods:
+            self.store.list("Node")  # per-item fan-out
+        return None
+
+    def ok_filtered(self, key):  # reconcile-path
+        self.store.list("Pod", "default", labels={"app": "x"})
+        return None
+
+    def ok_suppressed(self, key):  # reconcile-path
+        self.store.list("Pod")  # vet: ignore[purity-fleet-scan]: fixture — suppression semantics under test
+        return None
+
+    def cold_scan(self):
+        # NOT a reconcile root and unreachable from one: scans are fine.
+        return self.store.list("Pod")

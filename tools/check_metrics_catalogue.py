@@ -20,6 +20,14 @@ or the orphaned table rows (reverse), so drift in either direction breaks
 `make check` — the catalogue is the contract that dashboards and scrape
 configs are built against.
 
+The Metrics table also carries the **Bound** column — the per-label
+cardinality contract (`label: enum|config|capped`, grammar owned by
+tools/vet/cardinality.py, which enforces its MEANING against traced
+label values). This checker enforces its SHAPE: every row's Bound cell
+parses, the Bound and Labels columns name the same label set, and every
+label key used at an emitting call site (a dict-literal labels argument)
+is declared for its metric.
+
 Run: `make metrics-catalogue` or `python tools/check_metrics_catalogue.py`.
 """
 
@@ -27,13 +35,21 @@ from __future__ import annotations
 
 import ast
 import re
+import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))  # tools.vet import when run as a script
+
+from tools.vet.cardinality import parse_bound_cell  # noqa: E402
+
 SOURCE_DIR = ROOT / "lws_tpu"
 CATALOGUE = ROOT / "docs" / "observability.md"
 
 METRIC_METHODS = {"inc", "observe", "set"}
+# Labels-arg position per method (lws_tpu.core.metrics signatures);
+# a `labels=` keyword wins. Mirrors tools/vet/cardinality.py.
+LABELS_ARG_INDEX = {"inc": 1, "observe": 2, "set": 2}
 
 
 def _is_metrics_receiver(node: ast.expr) -> bool:
@@ -46,12 +62,33 @@ def _is_metrics_receiver(node: ast.expr) -> bool:
     return False
 
 
-def collect(path: Path) -> list[tuple[str, str, int]]:
-    """[(kind, name, lineno)] for one file; kind in {metric, span,
-    declared}. `declared` rows are describe() declarations — they anchor
-    the reverse (orphan) check but are not themselves emissions."""
+def _label_keys(node: ast.Call) -> set[str]:
+    """Literal label KEYS of one metric call's dict-literal labels
+    argument; empty for dynamic/absent labels (can't be checked)."""
+    labels = None
+    for kw in node.keywords:
+        if kw.arg == "labels":
+            labels = kw.value
+    if labels is None:
+        idx = LABELS_ARG_INDEX[node.func.attr]
+        if len(node.args) > idx:
+            labels = node.args[idx]
+    if not isinstance(labels, ast.Dict):
+        return set()
+    return {
+        k.value for k in labels.keys
+        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+    }
+
+
+def collect(path: Path) -> list[tuple[str, str, int, set[str]]]:
+    """[(kind, name, lineno, label_keys)] for one file; kind in {metric,
+    span, declared}. `declared` rows are describe() declarations — they
+    anchor the reverse (orphan) check but are not themselves emissions.
+    `label_keys` is non-empty only for metric calls with dict-literal
+    labels."""
     tree = ast.parse(path.read_text(), filename=str(path))
-    out: list[tuple[str, str, int]] = []
+    out: list[tuple[str, str, int, set[str]]] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -60,22 +97,59 @@ def collect(path: Path) -> list[tuple[str, str, int]]:
             continue
         name = node.args[0].value
         if isinstance(node.func, ast.Name) and node.func.id == "describe":
-            out.append(("declared", name, node.lineno))
+            out.append(("declared", name, node.lineno, set()))
             continue
         if not isinstance(node.func, ast.Attribute):
             continue
         if node.func.attr == "describe":
-            out.append(("declared", name, node.lineno))
+            out.append(("declared", name, node.lineno, set()))
         elif node.func.attr == "span":
-            out.append(("span", name, node.lineno))
+            out.append(("span", name, node.lineno, set()))
         elif node.func.attr in METRIC_METHODS and _is_metrics_receiver(node.func.value):
-            out.append(("metric", name, node.lineno))
+            out.append(("metric", name, node.lineno, _label_keys(node)))
     return out
 
 
 # Catalogue table rows: `| `name` | ...` under the ## Metrics / ## Spans
 # headings — the set the reverse check validates against the source.
 _ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|")
+
+# Inside a Labels cell, parenthetical asides carry enum values/examples
+# (`result` (`success`/`conflict`/`error`)); strip them before reading the
+# backticked label NAMES.
+_PAREN_RE = re.compile(r"\([^)]*\)")
+
+
+def metrics_rows(text: str) -> list[tuple[str, set[str], str]]:
+    """[(metric name, labels-cell label names, raw Bound cell)] from the
+    catalogue's ## Metrics table, by header-column position."""
+    out: list[tuple[str, set[str], str]] = []
+    section = None
+    columns: list[str] = []
+    for line in text.splitlines():
+        if line.startswith("## "):
+            section = line[3:].strip().lower()
+            columns = []
+            continue
+        if section != "metrics" or not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if not columns:
+            columns = [c.lower() for c in cells]
+            continue
+        if cells and set(cells[0]) <= {"-", " ", ":"}:
+            continue  # the |---|---| separator row
+        m = re.match(r"`([^`]+)`", cells[0])
+        if m is None or "labels" not in columns or "bound" not in columns:
+            continue
+
+        def cell(col: str) -> str:
+            idx = columns.index(col)
+            return cells[idx] if idx < len(cells) else ""
+
+        labels = set(re.findall(r"`([A-Za-z_]\w*)`", _PAREN_RE.sub("", cell("labels"))))
+        out.append((m.group(1), labels, cell("bound")))
+    return out
 
 
 def catalogue_tables(text: str) -> dict[str, set[str]]:
@@ -100,14 +174,20 @@ def main() -> int:
     missing: list[str] = []
     seen: set[tuple[str, str]] = set()
     emitted: dict[str, set[str]] = {"metric": set(), "span": set()}
+    # metric name -> (label key, first call site) from dict-literal labels
+    # arguments — the source side of the Bound declaration check.
+    used_labels: dict[str, dict[str, str]] = {}
     for path in sorted(SOURCE_DIR.rglob("*.py")):
-        for kind, name, lineno in collect(path):
+        for kind, name, lineno, label_keys in collect(path):
             if kind == "declared":
                 # describe() anchors the orphan check (metrics emitted
                 # through indirection) but needs no catalogue row itself.
                 emitted["metric"].add(name)
                 continue
             emitted[kind].add(name)
+            for key in label_keys:
+                used_labels.setdefault(name, {}).setdefault(
+                    key, f"{path.relative_to(ROOT)}:{lineno}")
             # Exact backticked mention only: a bare-substring fallback would
             # let `serving_requests` pass inside `serving_requests_total`.
             if f"`{name}`" in catalogue:
@@ -135,6 +215,34 @@ def main() -> int:
         print("\n".join(orphans))
         print(f"\n{len(orphans)} orphaned catalogue row(s); delete them or "
               f"restore the emitting code")
+        return 1
+    # Bound contract SHAPE (the meaning — traced label VALUES — lives in
+    # `python -m tools.vet --only cardinality`): every Metrics row's Bound
+    # cell parses, names exactly the row's Labels, and covers every label
+    # key the source actually passes for that metric.
+    bound_errors: list[str] = []
+    for name, labels, bound_cell in metrics_rows(catalogue):
+        bound = parse_bound_cell(bound_cell)
+        if bound is None:
+            bound_errors.append(
+                f"docs/observability.md: metric {name!r} has a malformed "
+                f"Bound cell {bound_cell!r} (grammar: `label`: "
+                f"enum|config|capped, comma-separated, or `—`)")
+            continue
+        if set(bound) != labels:
+            bound_errors.append(
+                f"docs/observability.md: metric {name!r} Bound column "
+                f"declares {sorted(bound)} but the Labels column names "
+                f"{sorted(labels)} — the two must cover the same label set")
+        for key, site in sorted(used_labels.get(name, {}).items()):
+            if key not in bound:
+                bound_errors.append(
+                    f"{site}: metric {name!r} is emitted with label {key!r} "
+                    f"but the catalogue's Bound column does not declare it")
+    if bound_errors:
+        print("\n".join(bound_errors))
+        print(f"\n{len(bound_errors)} Bound-contract violation(s); every "
+              f"label needs a cardinality class in {CATALOGUE.relative_to(ROOT)}")
         return 1
     metrics_n = len({n for k, n in seen if k == "metric"})
     spans_n = len({n for k, n in seen if k == "span"})

@@ -1,13 +1,20 @@
 """`lws-tpu vet`: project-aware static analysis suite.
 
-Six passes over the repo (see docs/static-analysis.md for the rule
+Eight passes over the repo (see docs/static-analysis.md for the rule
 catalogue): `style` (the old tools/lint.py, folded in), `locks` (guarded
-attributes + lock acquisition order), `hotpath` (no blocking or
-host-sync calls on the decode dispatch path), `resources` (sockets/
-files/executors must be closed, including on error paths), `spans`
-(spans entered via context manager, metric/span names literal), and
+attributes + lock acquisition order + interprocedural lock-held-blocking
+and cross-class lock-order via the shared call graph), `hotpath` (no
+blocking or host-sync calls on the decode dispatch path), `resources`
+(sockets/files/executors must be closed, including on error paths),
+`spans` (spans entered via context manager, metric/span names literal),
 `hazards` (no silent `except Exception: pass` swallows, no socket or
-urlopen calls without an explicit timeout in lws_tpu/).
+urlopen calls without an explicit timeout in lws_tpu/), `purity`
+(observer callbacks contain their exceptions; reconcile paths avoid
+unfiltered fleet scans), and `cardinality` (metric label values traced
+against the catalogue's per-label Bound contract).
+
+The interprocedural passes share ONE conservative call graph
+(tools/vet/callgraph.py), built once per run and cached.
 
 Entry points: `make vet`, `python -m tools.vet`, or programmatically
 `run_vet(...)` (the analyzer self-tests drive passes through
@@ -18,12 +25,22 @@ it too (the file may only shrink).
 
 from __future__ import annotations
 
+import json
 import sys
 from pathlib import Path
 from typing import Optional
 
 from tools.vet import core as _core
-from tools.vet import hazards, hotpath, locks, resources, spans, style
+from tools.vet import (
+    cardinality,
+    hazards,
+    hotpath,
+    locks,
+    purity,
+    resources,
+    spans,
+    style,
+)
 from tools.vet.core import (  # noqa: F401 — re-exported for tests
     BASELINE_PATH,
     Finding,
@@ -43,6 +60,8 @@ PASSES = {
     resources.PASS_NAME: resources.run,
     spans.PASS_NAME: spans.run,
     hazards.PASS_NAME: hazards.run,
+    purity.PASS_NAME: purity.run,
+    cardinality.PASS_NAME: cardinality.run,
 }
 
 
@@ -81,19 +100,92 @@ def collect_findings(
     return findings, suppressed
 
 
+def _render_json(findings: list[Finding]) -> str:
+    """Machine-readable findings. The four keys `file`/`line`/`rule`/
+    `reason` are a STABLE contract (CI annotators parse them); additions
+    are allowed, renames are not."""
+    return json.dumps(
+        [
+            {
+                "file": f.path,
+                "line": f.line,
+                "rule": f.rule,
+                "reason": f.message,
+                "qual": f.qual,
+                "detail": f.detail,
+            }
+            for f in findings
+        ],
+        indent=2,
+    )
+
+
+def _render_sarif(findings: list[Finding]) -> str:
+    """SARIF 2.1.0 (the format code-review UIs ingest). Same stability
+    contract as the json format: ruleId/uri/startLine/message map 1:1 to
+    rule/file/line/reason."""
+    return json.dumps(
+        {
+            "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+            "version": "2.1.0",
+            "runs": [
+                {
+                    "tool": {
+                        "driver": {
+                            "name": "lws-tpu-vet",
+                            "informationUri": "docs/static-analysis.md",
+                            "rules": [
+                                {"id": rule}
+                                for rule in sorted({f.rule for f in findings})
+                            ],
+                        }
+                    },
+                    "results": [
+                        {
+                            "ruleId": f.rule,
+                            "level": "error",
+                            "message": {"text": f.message},
+                            "locations": [
+                                {
+                                    "physicalLocation": {
+                                        "artifactLocation": {"uri": f.path},
+                                        "region": {"startLine": f.line},
+                                    }
+                                }
+                            ],
+                        }
+                        for f in findings
+                    ],
+                }
+            ],
+        },
+        indent=2,
+    )
+
+
 def run_vet(
     only: Optional[list[str]] = None,
     paths: Optional[list[Path]] = None,
     use_baseline: bool = True,
     out=sys.stdout,
+    fmt: str = "text",
 ) -> int:
     """Full vet run. Returns the process exit code: 0 clean, 1 findings
     outside the baseline, 2 orphaned baseline entries (the baseline may
-    only shrink — mirroring check_metrics_catalogue.py's orphan rule)."""
+    only shrink — mirroring check_metrics_catalogue.py's orphan rule).
+
+    `fmt`: "text" (one render() line per finding), "json", or "sarif" —
+    the machine formats write ONE document to `out` (orphan complaints go
+    to stderr so the document stays parseable); exit codes are identical
+    across formats."""
     pass_names = list(PASSES) if not only else only
     unknown = [p for p in pass_names if p not in PASSES]
     if unknown:
-        print(f"vet: unknown pass(es): {', '.join(unknown)}", file=sys.stderr)
+        print(
+            f"vet: unknown pass(es): {', '.join(unknown)} "
+            f"(valid: {', '.join(PASSES)})",
+            file=sys.stderr,
+        )
         return 2
     files = paths if paths is not None else iter_source_files()
     modules = load_modules(files)
@@ -108,13 +200,20 @@ def run_vet(
     if set(pass_names) != set(PASSES):
         orphans = []
 
-    for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
-        print(f.render(), file=out)
+    ordered = sorted(new, key=lambda f: (f.path, f.line, f.rule))
+    if fmt == "json":
+        print(_render_json(ordered), file=out)
+    elif fmt == "sarif":
+        print(_render_sarif(ordered), file=out)
+    else:
+        for f in ordered:
+            print(f.render(), file=out)
     for key in orphans:
         print(
             f"tools/vet/baseline.json: orphaned entry `{key}` — the finding "
             "(or its full allowed count) no longer exists; shrink the file "
-            "(python -m tools.vet --write-baseline)", file=out,
+            "(python -m tools.vet --write-baseline)",
+            file=(sys.stderr if fmt in ("json", "sarif") else out),
         )
     print(
         f"vet: {len(modules)} files, {len(pass_names)} pass(es), "

@@ -1,5 +1,6 @@
-"""CLI for the vet suite: `python -m tools.vet [--only a,b] [--write-baseline]
-[paths...]`. See tools/vet/__init__.py and docs/static-analysis.md."""
+"""CLI for the vet suite: `python -m tools.vet [--only a,b] [--format
+json|sarif] [--write-baseline] [paths...]`. See tools/vet/__init__.py and
+docs/static-analysis.md."""
 
 from __future__ import annotations
 
@@ -26,6 +27,11 @@ def main(argv: list[str] | None = None) -> int:
         help=f"comma-separated pass subset (of: {', '.join(PASSES)})",
     )
     parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="finding output format; json/sarif emit one machine-readable "
+             "document with stable file/line/rule/reason keys (default: text)",
+    )
+    parser.add_argument(
         "--no-baseline", action="store_true",
         help="report every finding, ignoring tools/vet/baseline.json",
     )
@@ -48,7 +54,10 @@ def main(argv: list[str] | None = None) -> int:
               f"to {BASELINE_PATH}", file=sys.stderr)
         return 0
 
-    return run_vet(only=only, paths=paths, use_baseline=not args.no_baseline)
+    return run_vet(
+        only=only, paths=paths, use_baseline=not args.no_baseline,
+        fmt=args.format,
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,489 @@
+"""Shared whole-program call-graph engine for the vet passes.
+
+Extracted and generalized from the resolver that used to live privately
+in hotpath.py, so the interprocedural passes (hotpath reachability,
+lock-hold propagation, reconcile purity, label-source tracing) analyze
+the SAME graph instead of four divergent approximations.
+
+The resolver is deliberately conservative — it never guesses a call
+target into a false positive. An edge exists only when the target is
+provable from local syntax:
+
+  * `f(...)`             -> a function of the same module (nested defs of
+    the caller shadow module-level names), or a symbol imported via
+    `from lws_tpu.x.y import f`;
+  * `Class(...)`         -> `Class.__init__`, when `Class` is a project
+    class of the same module or imported by name;
+  * `self.m(...)`        -> a method of the enclosing class (single-level
+    resolvable project bases included);
+  * `alias.f(...)`       -> a module-level function (or class ctor) of a
+    module imported as `from lws_tpu.x import alias` / `import
+    lws_tpu.x.alias`;
+  * `<recv>.m(...)`      -> a method, when the receiver's class is
+    inferred: `self.attr` assigned `ClassName(...)` in any method (or
+    annotated), a module-level `NAME = ClassName(...)` global (same
+    module or via alias), a local `x = ClassName(...)` assignment, or a
+    parameter annotation (`Optional[X]`/`X | None` unwrap to `X`).
+
+Anything else — callables passed as values, ambiguous names, attributes
+on untyped receivers — has NO outgoing edge by design. Containment is a
+separate edge kind: nested defs belong to their enclosing function
+(pipeline commit callbacks run inside the consume path), lambdas are not
+graph nodes at all and are scanned inline by the passes.
+
+`resolve_callable` additionally resolves a *function-valued expression*
+(`self.on_span`, `target.on_event`, a bare name) to its graph node — the
+purity pass uses it on `add_observer(...)` arguments.
+
+Scope/limits contract (docs/static-analysis.md#call-graph): one level of
+base-class lookup, one level of import indirection, no flow through
+containers, dicts, or re-bound callables. When the engine cannot prove a
+target it stays silent, so every interprocedural finding downstream is
+anchored on provable edges only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.vet.core import Module
+
+# (module rel path, qualname) — the identity of every graph node.
+Key = tuple[str, str]
+
+
+class FuncInfo:
+    """One function/method definition — a call-graph node."""
+
+    def __init__(self, mod: Module, qual: str, cls: Optional[str],
+                 node: ast.FunctionDef) -> None:
+        self.mod = mod
+        self.qual = qual  # e.g. "DecodePipeline.push" or "beat"
+        self.cls = cls    # enclosing class qualname, if any
+        self.node = node
+
+    @property
+    def key(self) -> Key:
+        return (self.mod.rel, self.qual)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+class ClassInfo:
+    """One class definition plus its inferred attribute types."""
+
+    def __init__(self, mod: Module, qual: str, node: ast.ClassDef) -> None:
+        self.mod = mod
+        self.qual = qual
+        self.node = node
+        self.methods: dict[str, str] = {}  # method name -> qualname
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[child.name] = f"{qual}.{child.name}"
+        # attr -> class Key, filled by CallGraph._infer_attr_types once
+        # every class is known (self.attr = ClassName(...) / annotations).
+        self.attr_types: dict[str, Key] = {}
+
+    @property
+    def key(self) -> Key:
+        return (self.mod.rel, self.qual)
+
+
+class _ImportEntry:
+    """One resolved project import: a whole module or one of its symbols."""
+
+    __slots__ = ("module_rel", "symbol")
+
+    def __init__(self, module_rel: str, symbol: Optional[str] = None) -> None:
+        self.module_rel = module_rel  # repo-relative .py path
+        self.symbol = symbol          # None for whole-module aliases
+
+
+class CallGraph:
+    """The project graph: functions, classes, imports, inferred types."""
+
+    def __init__(self, modules: list[Module]) -> None:
+        self.modules = modules
+        self.funcs: dict[Key, FuncInfo] = {}
+        self.classes: dict[Key, ClassInfo] = {}
+        self._known_rels = {m.rel for m in modules}
+        for mod in modules:
+            self._collect_defs(mod)
+        self.imports: dict[str, dict[str, _ImportEntry]] = {
+            mod.rel: self._module_imports(mod) for mod in modules
+        }
+        # Module-level globals holding a class instance: rel -> name -> Key.
+        self.globals: dict[str, dict[str, Key]] = {}
+        for mod in modules:
+            self.globals[mod.rel] = self._module_globals(mod)
+        for cls in self.classes.values():
+            self._infer_attr_types(cls)
+        # Containment: nested defs of a function (qualname-prefix children).
+        self.children: dict[Key, list[Key]] = {}
+        by_mod: dict[str, list[FuncInfo]] = {}
+        for f in self.funcs.values():
+            by_mod.setdefault(f.mod.rel, []).append(f)
+        for peers in by_mod.values():
+            for f in peers:
+                prefix = f.qual + "."
+                kids = [g.key for g in peers if g.qual.startswith(prefix)]
+                if kids:
+                    self.children[f.key] = kids
+        self._locals_cache: dict[Key, dict[str, Key]] = {}
+        self._callees_cache: dict[Key, list[tuple[Key, ast.Call]]] = {}
+
+    # ---- collection -------------------------------------------------------
+    def _collect_defs(self, mod: Module) -> None:
+        def walk(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    self.funcs[(mod.rel, qual)] = FuncInfo(mod, qual, cls, child)
+                    walk(child, qual, cls)
+                elif isinstance(child, ast.ClassDef):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    self.classes[(mod.rel, qual)] = ClassInfo(mod, qual, child)
+                    walk(child, qual, qual)
+                else:
+                    walk(child, prefix, cls)
+
+        if mod.tree is not None:
+            walk(mod.tree, "", None)
+
+    def _module_imports(self, mod: Module) -> dict[str, _ImportEntry]:
+        """alias -> project import entry. `from lws_tpu.x import y` is a
+        MODULE import when lws_tpu/x/y.py exists, else a SYMBOL of
+        lws_tpu/x.py (or the package __init__)."""
+        out: dict[str, _ImportEntry] = {}
+        if mod.tree is None:
+            return out
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.startswith("lws_tpu"):
+                base = node.module.replace(".", "/")
+                for a in node.names:
+                    alias = a.asname or a.name
+                    as_mod = f"{base}/{a.name}.py"
+                    if as_mod in self._known_rels:
+                        out[alias] = _ImportEntry(as_mod)
+                    elif f"{base}.py" in self._known_rels:
+                        out[alias] = _ImportEntry(f"{base}.py", a.name)
+                    elif f"{base}/__init__.py" in self._known_rels:
+                        out[alias] = _ImportEntry(f"{base}/__init__.py", a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if not a.name.startswith("lws_tpu."):
+                        continue
+                    rel = a.name.replace(".", "/") + ".py"
+                    if rel in self._known_rels:
+                        out[a.asname or a.name.split(".")[-1]] = _ImportEntry(rel)
+        return out
+
+    def _module_globals(self, mod: Module) -> dict[str, Key]:
+        """Top-level `NAME = ClassName(...)` instances (the obs planes'
+        VAULT/LEDGER/RECORDER singletons)."""
+        out: dict[str, Key] = {}
+        if mod.tree is None:
+            return out
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                cls = self._ctor_class(mod.rel, stmt.value)
+                if cls is not None:
+                    out[stmt.targets[0].id] = cls
+        return out
+
+    # ---- type resolution --------------------------------------------------
+    def lookup_class(self, mod_rel: str, name: str) -> Optional[Key]:
+        """A class name visible in `mod_rel`: same module or imported."""
+        if (mod_rel, name) in self.classes:
+            return (mod_rel, name)
+        entry = self.imports.get(mod_rel, {}).get(name)
+        if entry is not None:
+            symbol = entry.symbol or name
+            if (entry.module_rel, symbol) in self.classes:
+                return (entry.module_rel, symbol)
+        return None
+
+    def _ctor_class(self, mod_rel: str, value: ast.expr) -> Optional[Key]:
+        """`ClassName(...)` / `alias.ClassName(...)` -> the class Key.
+        An IfExp whose branches construct the SAME class keeps the type."""
+        if isinstance(value, ast.IfExp):
+            a = self._ctor_class(mod_rel, value.body)
+            b = self._ctor_class(mod_rel, value.orelse)
+            return a if a is not None and a == b else None
+        if not isinstance(value, ast.Call):
+            return None
+        fn = value.func
+        if isinstance(fn, ast.Name):
+            return self.lookup_class(mod_rel, fn.id)
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            entry = self.imports.get(mod_rel, {}).get(fn.value.id)
+            if entry is not None and entry.symbol is None \
+                    and (entry.module_rel, fn.attr) in self.classes:
+                return (entry.module_rel, fn.attr)
+        return None
+
+    def _annotation_class(self, mod_rel: str, ann: Optional[ast.expr]) -> Optional[Key]:
+        """`X` / `"X"` / `Optional[X]` / `X | None` -> X's class Key."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.strip()
+            return self.lookup_class(mod_rel, name) if name.isidentifier() else None
+        if isinstance(ann, ast.Name):
+            return self.lookup_class(mod_rel, ann.id)
+        if isinstance(ann, ast.Subscript):  # Optional[X] — unwrap one level
+            base = ann.value
+            if isinstance(base, ast.Name) and base.id == "Optional":
+                return self._annotation_class(mod_rel, ann.slice)
+            if isinstance(base, ast.Attribute) and base.attr == "Optional":
+                return self._annotation_class(mod_rel, ann.slice)
+            return None
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            for side in (ann.left, ann.right):
+                if isinstance(side, ast.Constant) and side.value is None:
+                    continue
+                return self._annotation_class(mod_rel, side)
+        return None
+
+    def _infer_attr_types(self, cls: ClassInfo) -> None:
+        """`self.attr = ClassName(...)` (or annotated) anywhere in the
+        class's methods -> attr type. Conflicting assignments erase the
+        entry — an attr rebound to two classes has no single type."""
+        conflicted: set[str] = set()
+        for fn in cls.node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                for tgt in targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    attr = tgt.attr
+                    typ = None
+                    if stmt.value is not None:
+                        typ = self._ctor_class(cls.mod.rel, stmt.value)
+                    if typ is None and isinstance(stmt, ast.AnnAssign):
+                        typ = self._annotation_class(cls.mod.rel, stmt.annotation)
+                    if typ is None:
+                        # Unknown re-assignment poisons a previously inferred
+                        # type only if it's a Call (could be anything); plain
+                        # None/flag writes don't.
+                        if isinstance(stmt.value, ast.Call) and attr in cls.attr_types:
+                            conflicted.add(attr)
+                        continue
+                    if attr in cls.attr_types and cls.attr_types[attr] != typ:
+                        conflicted.add(attr)
+                    else:
+                        cls.attr_types[attr] = typ
+        for attr in conflicted:
+            cls.attr_types.pop(attr, None)
+
+    def _fn_locals(self, info: FuncInfo) -> dict[str, Key]:
+        """name -> class Key for a function's provably-typed locals:
+        annotated parameters and `x = ClassName(...)` assignments (nested
+        defs excluded). A re-binding to an unknown type erases the name."""
+        cached = self._locals_cache.get(info.key)
+        if cached is not None:
+            return cached
+        env: dict[str, Key] = {}
+        args = info.node.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            typ = self._annotation_class(info.mod.rel, a.annotation)
+            if typ is not None:
+                env[a.arg] = typ
+
+        def scan(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Assign) and len(child.targets) == 1 \
+                        and isinstance(child.targets[0], ast.Name):
+                    name = child.targets[0].id
+                    typ = self._ctor_class(info.mod.rel, child.value)
+                    if typ is None:
+                        typ = self.resolve_receiver_type(info, child.value, env)
+                    if typ is not None:
+                        env[name] = typ
+                    else:
+                        env.pop(name, None)
+                elif isinstance(child, ast.AnnAssign) \
+                        and isinstance(child.target, ast.Name):
+                    typ = self._annotation_class(info.mod.rel, child.annotation)
+                    if typ is not None:
+                        env[child.target.id] = typ
+                scan(child)
+
+        scan(info.node)
+        self._locals_cache[info.key] = env
+        return env
+
+    def resolve_receiver_type(
+        self, info: FuncInfo, expr: ast.expr,
+        env: Optional[dict[str, Key]] = None,
+    ) -> Optional[Key]:
+        """The class of a receiver expression, when provable: a typed
+        local/param, `self.attr` with an inferred type, a module global
+        (same module or `alias.NAME`). An IfExp whose branches resolve to
+        the SAME class keeps the type (`vault if vault else VAULT`)."""
+        if isinstance(expr, ast.IfExp):
+            a = self.resolve_receiver_type(info, expr.body, env)
+            b = self.resolve_receiver_type(info, expr.orelse, env)
+            return a if a is not None and a == b else None
+        if isinstance(expr, ast.Name):
+            if env is not None and expr.id in env:
+                return env[expr.id]
+            g = self.globals.get(info.mod.rel, {}).get(expr.id)
+            if g is not None:
+                return g
+            entry = self.imports.get(info.mod.rel, {}).get(expr.id)
+            if entry is not None and entry.symbol is not None:
+                return self.globals.get(entry.module_rel, {}).get(entry.symbol)
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and info.cls:
+                cls = self.classes.get((info.mod.rel, info.cls))
+                if cls is not None:
+                    return cls.attr_types.get(expr.attr)
+                return None
+            entry = self.imports.get(info.mod.rel, {}).get(expr.value.id)
+            if entry is not None and entry.symbol is None:
+                return self.globals.get(entry.module_rel, {}).get(expr.attr)
+        return None
+
+    def method_of(self, cls_key: Key, name: str) -> Optional[Key]:
+        """`name` on `cls_key`, checking one level of resolvable bases."""
+        cls = self.classes.get(cls_key)
+        if cls is None:
+            return None
+        if name in cls.methods:
+            return (cls_key[0], cls.methods[name])
+        for base in cls.node.bases:
+            base_key = None
+            if isinstance(base, ast.Name):
+                base_key = self.lookup_class(cls.mod.rel, base.id)
+            elif isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name):
+                entry = self.imports.get(cls.mod.rel, {}).get(base.value.id)
+                if entry is not None and entry.symbol is None \
+                        and (entry.module_rel, base.attr) in self.classes:
+                    base_key = (entry.module_rel, base.attr)
+            if base_key is not None:
+                parent = self.classes.get(base_key)
+                if parent is not None and name in parent.methods:
+                    return (base_key[0], parent.methods[name])
+        return None
+
+    # ---- call resolution --------------------------------------------------
+    def resolve_call(self, info: FuncInfo, call: ast.Call) -> Optional[Key]:
+        """The single provable target of one call expression, or None."""
+        return self.resolve_callable(info, call.func)
+
+    def resolve_callable(self, info: FuncInfo, fn: ast.expr) -> Optional[Key]:
+        """A function-valued expression -> its graph node. Used both for
+        call sites and for callables passed by value (observer args)."""
+        mod_rel = info.mod.rel
+        if isinstance(fn, ast.Name):
+            # Nested def of this function (or an enclosing one) shadows
+            # module scope.
+            qual = info.qual
+            while qual:
+                key = (mod_rel, f"{qual}.{fn.id}")
+                if key in self.funcs:
+                    return key
+                qual = qual.rpartition(".")[0]
+            if (mod_rel, fn.id) in self.funcs:
+                return (mod_rel, fn.id)
+            cls_key = self.lookup_class(mod_rel, fn.id)
+            if cls_key is not None:
+                return self.method_of(cls_key, "__init__")
+            entry = self.imports.get(mod_rel, {}).get(fn.id)
+            if entry is not None and entry.symbol is not None \
+                    and (entry.module_rel, entry.symbol) in self.funcs:
+                return (entry.module_rel, entry.symbol)
+            return None
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name):
+                if recv.id == "self" and info.cls:
+                    target = self.method_of((mod_rel, info.cls), fn.attr)
+                    if target is not None:
+                        return target
+                entry = self.imports.get(mod_rel, {}).get(recv.id)
+                if entry is not None and entry.symbol is None:
+                    if (entry.module_rel, fn.attr) in self.funcs:
+                        return (entry.module_rel, fn.attr)
+                    if (entry.module_rel, fn.attr) in self.classes:
+                        return self.method_of((entry.module_rel, fn.attr), "__init__")
+            recv_type = self.resolve_receiver_type(info, recv, self._fn_locals(info))
+            if recv_type is not None:
+                return self.method_of(recv_type, fn.attr)
+        return None
+
+    def callees(self, info: FuncInfo) -> list[tuple[Key, ast.Call]]:
+        """Every resolvable (callee key, call node) in one function body,
+        nested defs excluded (they are containment children)."""
+        cached = self._callees_cache.get(info.key)
+        if cached is not None:
+            return cached
+        out: list[tuple[Key, ast.Call]] = []
+
+        def scan(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # containment edge; lambdas stay inline
+                if isinstance(child, ast.Call):
+                    target = self.resolve_call(info, child)
+                    if target is not None and target != info.key:
+                        out.append((target, child))
+                scan(child)
+
+        scan(info.node)
+        self._callees_cache[info.key] = out
+        return out
+
+    def reachable(self, roots: Iterable[Key]) -> set[Key]:
+        """BFS closure over call + containment edges."""
+        seen: set[Key] = set()
+        frontier = [k for k in roots]
+        while frontier:
+            key = frontier.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            info = self.funcs.get(key)
+            if info is None:
+                continue
+            for kid in self.children.get(key, ()):
+                if kid not in seen:
+                    frontier.append(kid)
+            for callee, _ in self.callees(info):
+                if callee not in seen:
+                    frontier.append(callee)
+        return seen
+
+
+# One vet run parses the repo once and hands the SAME module list to every
+# pass (tools/vet/__init__.collect_findings) — four interprocedural passes
+# must not build four graphs. Identity-keyed, tiny, and dropped with the
+# list: exactly the shape the wallclock bench budgets.
+_GRAPH_CACHE: list[tuple[int, list[Module], CallGraph]] = []
+_GRAPH_CACHE_MAX = 4
+
+
+def build(modules: list[Module]) -> CallGraph:
+    for ident, held, graph in _GRAPH_CACHE:
+        if ident == id(modules) and held is modules:
+            return graph
+    graph = CallGraph(modules)
+    _GRAPH_CACHE.append((id(modules), modules, graph))
+    del _GRAPH_CACHE[:-_GRAPH_CACHE_MAX]
+    return graph

@@ -1,0 +1,277 @@
+"""Metric label-cardinality pass + the catalogue's bound contract.
+
+The 1,000-instance fleet surface dies first by label cardinality: one
+per-request label value turns a bounded metric into an allocator of
+label sets, the registry's `max_label_sets` cap starts eating samples
+(`lws_metric_label_sets_dropped_total`), and the dashboards built on the
+metric silently go blind. The runtime cap bounds the damage; THIS pass
+bounds the cause, statically, before the series ever exist.
+
+Contract (docs/observability.md, the Metrics table's **Bound** column):
+every label of every metric declares a cardinality class —
+
+  * `enum`   — a closed literal set in code (`engine`, `role`, `state`);
+  * `config` — bounded by registered components/configuration, not by
+    workload (`controller`, `watchdog`, `site`, `endpoint`);
+  * `capped` — legitimately workload- or fleet-derived (`instance`,
+    `lws`, `revision`, `device`): the series population rides the
+    registry's `max_label_sets` cap BY DESIGN, and the emitting site
+    owns a retirement story (clear_gauge on supersede, scrape-cache
+    eviction, ...).
+
+`tools/check_metrics_catalogue.py` enforces the contract's SHAPE (every
+catalogued metric has a well-formed Bound cell; every label key used at
+an emitting call site is declared). This pass enforces its MEANING:
+
+  * `cardinality-unbounded` — a label VALUE at an `inc`/`set`/`observe`
+    site traces back to per-request/per-object identity (an f-string
+    embedding non-literal data, `str(...)` of a non-literal, an
+    attribute chain ending in `.name`/`.uid`/`.namespace`/`.id`/
+    `request_id`/`trace_id`, or a local assigned from one of those) while
+    the catalogue declares the label `enum`/`config` — or does not
+    declare it at all. Declaring the label `capped` is the sanctioned
+    escape hatch, and it is a DOCS change reviewers see, not a source
+    suppression.
+
+Value tracing is conservative: literals and literal-conditional locals
+are bounded, the identity patterns above are derived, and everything
+else (opaque names, parameters, dict lookups) is UNKNOWN and stays
+silent — the pass never guesses a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Optional
+
+from tools.vet.core import ROOT, Finding, Module
+
+PASS_NAME = "cardinality"
+
+CATALOGUE_PATH = ROOT / "docs" / "observability.md"
+
+BOUND_CLASSES = ("enum", "config", "capped")
+
+# Identity patterns. `<x>.meta.name/uid/namespace` is the store's object
+# identity (TypedObject metadata — bare `.name` alone also names REGISTERED
+# components, a closed config set, so it does NOT count); `request_id`/
+# `trace_id`/`span_id`/`.id`/`.uid` are request/object identity anywhere.
+META_IDENTITY_ATTRS = {"name", "uid", "namespace"}
+IDENTITY_ATTRS = {"request_id", "trace_id", "span_id", "id", "uid"}
+
+METRIC_METHODS = {"inc", "observe", "set"}
+# Positional index of the labels argument per method, mirroring
+# lws_tpu.core.metrics: inc(name, labels, value), observe(name, value,
+# labels), set(name, value, labels). A `labels=` keyword always wins.
+LABELS_ARG_INDEX = {"inc": 1, "observe": 2, "set": 2}
+
+_BOUND_ENTRY_RE = re.compile(r"`?([A-Za-z_][\w]*)`?\s*:\s*([a-z]+)")
+
+
+def _is_metrics_receiver(node: ast.expr) -> bool:
+    """Same receiver shapes tools/check_metrics_catalogue.py accepts:
+    `metrics`, `self.metrics`, `cp.metrics`, a registry object."""
+    if isinstance(node, ast.Name):
+        return node.id in ("metrics", "metricsmod", "REGISTRY")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("metrics", "REGISTRY")
+    return False
+
+
+def parse_bound_cell(cell: str) -> Optional[dict[str, str]]:
+    """One Bound-column cell -> {label: class}, {} for `—`/empty, or None
+    when malformed (unparseable entries or an unknown class). Shared with
+    tools/check_metrics_catalogue.py — the contract has ONE grammar."""
+    text = cell.strip()
+    if text in ("", "—", "-", "–"):
+        return {}
+    out: dict[str, str] = {}
+    for part in text.split(","):
+        m = _BOUND_ENTRY_RE.fullmatch(part.strip())
+        if m is None or m.group(2) not in BOUND_CLASSES:
+            return None
+        out[m.group(1)] = m.group(2)
+    return out
+
+
+def catalogue_bounds(text: str) -> dict[str, dict[str, str]]:
+    """metric name -> {label: bound class}, parsed from the ## Metrics
+    table's Bound column. Malformed cells parse as {} here — the shape
+    check (check_metrics_catalogue.py) owns rejecting them loudly; this
+    pass then treats the metric's labels as undeclared."""
+    bounds: dict[str, dict[str, str]] = {}
+    section = None
+    columns: list[str] = []
+    for line in text.splitlines():
+        if line.startswith("## "):
+            section = line[3:].strip().lower()
+            columns = []
+            continue
+        if section != "metrics" or not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if not columns:
+            columns = [c.lower() for c in cells]
+            continue
+        if cells and set(cells[0]) <= {"-", " ", ":"}:
+            continue  # the |---|---| separator row
+        m = re.match(r"`([^`]+)`", cells[0])
+        if m is None or "bound" not in columns:
+            continue
+        idx = columns.index("bound")
+        cell = cells[idx] if idx < len(cells) else ""
+        bounds[m.group(1)] = parse_bound_cell(cell) or {}
+    return bounds
+
+
+class _ValueTracer:
+    """Classifies a label-value expression as 'bounded' (a closed literal
+    set), 'derived' (per-request/object identity), or 'unknown'."""
+
+    def __init__(self, fn_node: ast.AST) -> None:
+        # name -> every expression assigned to it in this function; a name
+        # is derived if ANY of its bindings is.
+        self.bindings: dict[str, list[ast.expr]] = {}
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and node.value is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.bindings.setdefault(tgt.id, []).append(node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and isinstance(node.target, ast.Name):
+                self.bindings.setdefault(node.target.id, []).append(node.value)
+
+    def classify(self, expr: ast.expr, depth: int = 0) -> str:
+        if depth > 4:  # binding chains deeper than this are not provable
+            return "unknown"
+        if isinstance(expr, ast.Constant):
+            return "bounded"
+        if isinstance(expr, ast.IfExp):
+            a = self.classify(expr.body, depth + 1)
+            b = self.classify(expr.orelse, depth + 1)
+            if "derived" in (a, b):
+                return "derived"
+            return "bounded" if a == b == "bounded" else "unknown"
+        if isinstance(expr, ast.JoinedStr):
+            # An f-string embedding anything non-literal mints a new label
+            # value per distinct datum — the classic cardinality leak.
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue) \
+                        and not isinstance(v.value, ast.Constant):
+                    return "derived"
+            return "bounded"
+        if isinstance(expr, ast.Call):
+            fn = expr.func
+            if isinstance(fn, ast.Name) and fn.id in ("str", "repr", "format") \
+                    and expr.args and not isinstance(expr.args[0], ast.Constant):
+                return "derived"
+            if isinstance(fn, ast.Attribute) and fn.attr == "format":
+                return "derived"
+            return "unknown"
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in IDENTITY_ATTRS:
+                return "derived"
+            if expr.attr in META_IDENTITY_ATTRS \
+                    and isinstance(expr.value, ast.Attribute) \
+                    and expr.value.attr == "meta":
+                return "derived"
+            return "unknown"
+        if isinstance(expr, ast.Name):
+            if expr.id in IDENTITY_ATTRS:
+                return "derived"
+            values = self.bindings.get(expr.id)
+            if not values:
+                return "unknown"
+            classes = {self.classify(v, depth + 1) for v in values}
+            if "derived" in classes:
+                return "derived"
+            return "bounded" if classes == {"bounded"} else "unknown"
+        if isinstance(expr, ast.BinOp):  # "a" + x, "%"-format
+            a = self.classify(expr.left, depth + 1)
+            b = self.classify(expr.right, depth + 1)
+            if "derived" in (a, b):
+                return "derived"
+            return "bounded" if a == b == "bounded" else "unknown"
+        return "unknown"
+
+
+def _labels_arg(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "labels":
+            return kw.value
+    method = call.func.attr  # caller guarantees Attribute
+    idx = LABELS_ARG_INDEX[method]
+    if len(call.args) > idx:
+        return call.args[idx]
+    return None
+
+
+def metric_sites(mod: Module):
+    """Yield (call, metric name, enclosing function node) for every
+    literal-named inc/set/observe in one module."""
+    if mod.tree is None:
+        return
+    # Enclosing function for each call, so the tracer sees its bindings.
+    def walk(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else fn
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr in METRIC_METHODS \
+                    and _is_metrics_receiver(child.func.value) \
+                    and child.args \
+                    and isinstance(child.args[0], ast.Constant) \
+                    and isinstance(child.args[0].value, str):
+                yield_sites.append((child, child.args[0].value, inner))
+            walk(child, inner)
+
+    yield_sites: list = []
+    walk(mod.tree, mod.tree)
+    return yield_sites
+
+
+def load_bounds(path: Path = CATALOGUE_PATH) -> dict[str, dict[str, str]]:
+    if not path.exists():
+        return {}
+    return catalogue_bounds(path.read_text())
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    bounds = load_bounds()
+    findings: list[Finding] = []
+    for mod in modules:
+        if not mod.rel.startswith("lws_tpu/"):
+            continue  # the contract governs the shipped control plane
+        for call, metric, fn_node in metric_sites(mod) or []:
+            labels = _labels_arg(call)
+            if not isinstance(labels, ast.Dict):
+                continue  # opaque labels object: unknown, stay silent
+            tracer = _ValueTracer(fn_node)
+            declared = bounds.get(metric, {})
+            for key_node, value_node in zip(labels.keys, labels.values):
+                if not (isinstance(key_node, ast.Constant)
+                        and isinstance(key_node.value, str)):
+                    continue
+                label = key_node.value
+                if tracer.classify(value_node) != "derived":
+                    continue
+                klass = declared.get(label)
+                if klass == "capped":
+                    continue  # sanctioned: rides max_label_sets by design
+                where = (
+                    f"declared `{klass}` in the catalogue" if klass
+                    else "not declared in the catalogue's Bound column"
+                )
+                findings.append(mod.finding(
+                    "cardinality-unbounded", call.lineno,
+                    f"{metric}:{label}",
+                    f"label {label!r} of metric {metric!r} takes a "
+                    f"per-request/object-derived value but is {where} — "
+                    "bound the value to a closed set, or declare the label "
+                    "`capped` in docs/observability.md with a retirement "
+                    "story",
+                ))
+    return findings

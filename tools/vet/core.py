@@ -51,6 +51,7 @@ MALFORMED_SUPPRESS_RE = re.compile(r"#\s*vet:\s*ignore\b")
 # _lock`), so they match anywhere after the `#`, not only right behind it.
 GUARDED_BY_RE = re.compile(r"#.*?\bguarded-by:\s*([A-Za-z_]\w*)")
 HOT_PATH_RE = re.compile(r"#.*?\bhot-path\b")
+RECONCILE_PATH_RE = re.compile(r"#.*?\breconcile-path\b")
 HOLDS_LOCK_RE = re.compile(r"#.*?\bholds-lock:\s*([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)")
 
 
@@ -105,6 +106,16 @@ class Module:
         return bool(
             HOT_PATH_RE.search(self.line(lineno))
             or HOT_PATH_RE.search(self.line(lineno - 1))
+        )
+
+    def has_reconcile_mark(self, node: ast.AST) -> bool:
+        """`# reconcile-path` on the def line or the line directly above —
+        an explicit purity-pass root where register()-discovery can't see
+        the loop (the manager's own dispatch bodies)."""
+        lineno = getattr(node, "lineno", 0)
+        return bool(
+            RECONCILE_PATH_RE.search(self.line(lineno))
+            or RECONCILE_PATH_RE.search(self.line(lineno - 1))
         )
 
     def holds_locks(self, node: ast.AST) -> set[str]:

@@ -3,20 +3,15 @@ decode dispatch path.
 
 Roots are functions whose def line carries `# hot-path` (the dispatch
 bodies of `DecodePipeline` and `PagedBatchEngine.step_n` are annotated
-in source). Reachability closes over the roots through a conservative
-intra-project call graph:
+in source). Reachability closes over the roots through the shared
+conservative call graph (tools/vet/callgraph.py): self-methods, module
+functions, cross-module aliases, typed receivers, plus containment —
+nested defs of a hot function are hot (pipeline commit callbacks run
+inside the consume path).
 
-  * `self.m(...)`        -> a method of the same class, when it exists;
-  * `f(...)`             -> a top-level function of the same module;
-  * `alias.f(...)`       -> a top-level function of another lws_tpu
-    module imported as `from lws_tpu.x import alias` / `import
-    lws_tpu.x.alias`;
-  * nested defs of a hot function are hot (pipeline commit callbacks
-    run inside the consume path).
-
-Anything the resolver can't see (callables passed as values, methods on
-other objects) is out of scope by design — the pass must never guess a
-call target into a false positive.
+Anything the resolver can't prove (callables passed as values, methods
+on untyped objects) is out of scope by design — the pass must never
+guess a call target into a false positive.
 
 Rules:
 
@@ -44,6 +39,7 @@ from __future__ import annotations
 import ast
 from typing import Optional
 
+from tools.vet import callgraph
 from tools.vet.core import Finding, Module, dotted_name
 
 PASS_NAME = "hotpath"
@@ -73,93 +69,10 @@ SERIALIZE_COPY_DOTTED = {
 SERVING_PREFIX = "lws_tpu/serving/"
 
 
-class _FuncInfo:
-    def __init__(self, mod: Module, qual: str, cls: Optional[str],
-                 node: ast.FunctionDef) -> None:
-        self.mod = mod
-        self.qual = qual  # e.g. "DecodePipeline.push" or "beat"
-        self.cls = cls    # enclosing class qualname, if any
-        self.node = node
-        self.hot_mark = mod.has_hot_path_mark(node)
-
-    @property
-    def key(self) -> tuple[str, str]:
-        return (self.mod.rel, self.qual)
-
-
-def _collect_functions(mod: Module) -> list[_FuncInfo]:
-    out: list[_FuncInfo] = []
-
-    def walk(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                qual = f"{prefix}.{child.name}" if prefix else child.name
-                out.append(_FuncInfo(mod, qual, cls, child))
-                walk(child, qual, cls)
-            elif isinstance(child, ast.ClassDef):
-                qual = f"{prefix}.{child.name}" if prefix else child.name
-                walk(child, qual, qual)
-            else:
-                walk(child, prefix, cls)
-
-    if mod.tree is not None:
-        walk(mod.tree, "", None)
-    return out
-
-
-def _module_imports(mod: Module) -> dict[str, str]:
-    """alias -> repo-relative module path, for lws_tpu imports only."""
-    aliases: dict[str, str] = {}
-    if mod.tree is None:
-        return aliases
-    for node in ast.walk(mod.tree):
-        if isinstance(node, ast.ImportFrom) and node.module \
-                and node.module.startswith("lws_tpu"):
-            base = node.module.replace(".", "/")
-            for a in node.names:
-                aliases[a.asname or a.name] = f"{base}/{a.name}.py"
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name.startswith("lws_tpu."):
-                    aliases[a.asname or a.name.split(".")[-1]] = \
-                        a.name.replace(".", "/") + ".py"
-    return aliases
-
-
-def _direct_calls(info: _FuncInfo, funcs_by_key: dict, aliases: dict[str, str]) -> list[tuple[str, str]]:
-    """Resolvable callee keys of one function (excluding nested defs —
-    those are separate graph nodes marked hot by containment)."""
-    out: list[tuple[str, str]] = []
-    mod_rel = info.mod.rel
-
-    def scan(node: ast.AST) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue  # nested defs resolve via containment edges; lambdas stay inline
-            if isinstance(child, ast.Call):
-                fn = child.func
-                if isinstance(fn, ast.Name):
-                    key = (mod_rel, fn.id)
-                    if key in funcs_by_key:
-                        out.append(key)
-                elif isinstance(fn, ast.Attribute):
-                    if isinstance(fn.value, ast.Name):
-                        if fn.value.id == "self" and info.cls:
-                            key = (mod_rel, f"{info.cls}.{fn.attr}")
-                            if key in funcs_by_key:
-                                out.append(key)
-                        elif fn.value.id in aliases:
-                            key = (aliases[fn.value.id], fn.attr)
-                            if key in funcs_by_key:
-                                out.append(key)
-            scan(child)
-
-    scan(info.node)
-    return out
-
-
-def _banned(call: ast.Call) -> Optional[tuple[str, str, str]]:
-    """-> (rule, detail, description) when the call is banned on a hot path."""
+def banned(call: ast.Call) -> Optional[tuple[str, str, str]]:
+    """-> (rule, detail, description) when the call is banned on a hot
+    path. Shared with locks.py's interprocedural lock-held-blocking rule:
+    the SAME deny-list applies under a held lock."""
     fn = call.func
     dotted = dotted_name(fn)
     if isinstance(fn, ast.Name) and fn.id == "open":
@@ -173,70 +86,49 @@ def _banned(call: ast.Call) -> Optional[tuple[str, str, str]]:
     return None
 
 
-def run(modules: list[Module]) -> list[Finding]:
-    funcs: list[_FuncInfo] = []
-    for mod in modules:
-        funcs.extend(_collect_functions(mod))
-    funcs_by_key = {f.key: f for f in funcs}
-    aliases_by_mod = {mod.rel: _module_imports(mod) for mod in modules}
+def scan_banned(info: callgraph.FuncInfo) -> list[tuple[ast.Call, tuple[str, str, str]]]:
+    """Banned calls lexically inside one function body, nested defs
+    excluded (each is its own graph node), lambdas scanned inline."""
+    hits: list[tuple[ast.Call, tuple[str, str, str]]] = []
 
-    # Containment: nested defs of a hot function are hot (qualname prefix
-    # == containment here). Applied to every function entering the hot set
-    # — BFS-reached callees included, not just annotated roots — so a
-    # blocking call hidden in a helper's closure is still found.
-    by_mod: dict[str, list[_FuncInfo]] = {}
-    for f in funcs:
-        by_mod.setdefault(f.mod.rel, []).append(f)
-    children: dict[tuple[str, str], list[tuple[str, str]]] = {}
-    for peers in by_mod.values():
-        for f in peers:
-            prefix = f.qual + "."
-            kids = [g.key for g in peers if g.qual.startswith(prefix)]
-            if kids:
-                children[f.key] = kids
+    def scan(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate node (containment edge); scanned on its own
+            # Lambdas are NOT separate nodes — a commit callback like
+            # `lambda h: np.asarray(h)` is scanned as part of its
+            # containing function.
+            if isinstance(child, ast.Call):
+                hit = banned(child)
+                if hit is not None:
+                    hits.append((child, hit))
+            scan(child)
 
-    # BFS over the conservative call graph + containment edges.
-    hot: set[tuple[str, str]] = {f.key for f in funcs if f.hot_mark}
-    frontier = list(hot)
-    while frontier:
-        key = frontier.pop()
-        info = funcs_by_key.get(key)
-        if info is None:
+    for stmt in info.node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
-        edges = list(children.get(key, ()))
-        edges += _direct_calls(info, funcs_by_key, aliases_by_mod[info.mod.rel])
-        for callee in edges:
-            if callee not in hot:
-                hot.add(callee)
-                frontier.append(callee)
+        scan(stmt)
+    return hits
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    graph = callgraph.build(modules)
+    roots = [
+        key for key, info in graph.funcs.items()
+        if info.mod.has_hot_path_mark(info.node)
+    ]
+    hot = graph.reachable(roots)
 
     findings: list[Finding] = []
     for key in sorted(hot):
-        info = funcs_by_key.get(key)
+        info = graph.funcs.get(key)
         if info is None:
             continue
-
-        def scan(node: ast.AST) -> None:
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    continue  # separate hot node (containment edge); scanned on its own
-                # Lambdas are NOT separate nodes — a commit callback like
-                # `lambda h: np.asarray(h)` is scanned as part of its
-                # containing hot function.
-                if isinstance(child, ast.Call):
-                    hit = _banned(child)
-                    if hit is not None:
-                        rule, detail, desc = hit
-                        findings.append(info.mod.finding(
-                            rule, child.lineno, f"{info.qual}:{detail}",
-                            f"{desc} on the hot path (in {info.qual})",
-                        ))
-                scan(child)
-
-        for stmt in info.node.body:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            scan(stmt)
+        for call, (rule, detail, desc) in scan_banned(info):
+            findings.append(info.mod.finding(
+                rule, call.lineno, f"{info.qual}:{detail}",
+                f"{desc} on the hot path (in {info.qual})",
+            ))
 
     # Serving-wide serialization-copy sweep: lexical, independent of the
     # hot-root reachability above — `np.savez`/`BytesIO` in

@@ -1,4 +1,6 @@
-"""Lock-discipline pass: guarded attributes and lock acquisition order.
+"""Lock-discipline pass: guarded attributes, lock acquisition order, and
+interprocedural blocking-under-lock — on the shared call graph
+(tools/vet/callgraph.py).
 
 `lock-guarded-attr` — an instance attribute whose initializer carries a
 `# guarded-by: _lock` annotation may only be touched (read OR written)
@@ -16,14 +18,35 @@ through `self.<attr>` while `self.<lock>` is held. Held means:
   * `__init__`/`__del__` are exempt (construction and teardown are
     single-threaded by contract).
 
+The check FOLLOWS calls into `_locked` helpers: calling a same-class
+`*_locked` method (or one annotated `# holds-lock:`) without holding the
+locks its body needs — the guards of the guarded attrs it touches, plus
+its declared holds-locks — is flagged at the CALL SITE.
+
 Accesses inside nested function defs and lambdas are NOT checked: those
 bodies run later, under whatever discipline their call site owns (the
 engines' pipeline commit callbacks run under the pipeline's consume
 lock, which this pass cannot see lexically).
 
-`lock-order` — for each class, every nested acquisition `A then B` of
-two of its own locks is recorded; observing both `A->B` and `B->A`
-anywhere in the project is a potential deadlock and flags both sites.
+`lock-held-blocking` — a blocking or host-syncing call (the hotpath
+pass's deny-lists: `time.sleep`, sockets, `subprocess`, `open()`,
+`np.asarray`, `.block_until_ready()`) executed while a DECLARED lock is
+held — directly, or transitively through any resolvable call chain.
+Declared means the class constructs the lock (`threading.Lock()` etc.),
+a `# guarded-by:` annotation names it, or it is a module-level lock
+global. Blocking under a store or registry lock convoys every other
+thread behind host latency — the exact shape `go vet`-era reviews catch
+by hand. A deny-listed call that is itself suppressed at source (e.g.
+the fault injector's deliberate delay-mode sleep) is not propagated.
+
+`lock-order` — every nested acquisition `A then B` is recorded as an
+edge between GLOBAL lock identities (module, class, attr — two
+same-named classes in different files never merge), including
+acquisitions reached through resolvable calls while a lock is held.
+Observing both `A->B` and `B->A` anywhere in the project — now across
+classes and modules, not only within one class — flags both sites; a
+longer cycle (A->B->C->A) is reported once per strongly-connected
+component.
 
 The annotations this pass consumes live in core/store.py,
 core/metrics.py, core/flightrecorder.py, core/slo.py,
@@ -35,12 +58,16 @@ from __future__ import annotations
 import ast
 from typing import Optional
 
-from tools.vet.core import Finding, Module
+from tools.vet import callgraph, hotpath
+from tools.vet.core import SUPPRESS_RE, Finding, Module
 
 PASS_NAME = "locks"
 
 LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
 EXEMPT_METHODS = {"__init__", "__del__", "__post_init__"}
+
+# A held lock inside a function body: ("self", attr) or ("mod", global name).
+LockRef = tuple[str, str]
 
 
 def _self_attr(node: ast.expr) -> Optional[str]:
@@ -51,11 +78,11 @@ def _self_attr(node: ast.expr) -> Optional[str]:
     return None
 
 
-def _lock_call_attr(node: ast.expr, op: str) -> Optional[str]:
-    """`self.X.acquire()` / `.release()` (as an expression) -> "X"."""
+def _lock_call_target(node: ast.expr, op: str) -> Optional[ast.expr]:
+    """`<target>.acquire()` / `.release()` (as an expression) -> target."""
     if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
             and node.func.attr == op:
-        return _self_attr(node.func.value)
+        return node.func.value
     return None
 
 
@@ -92,24 +119,241 @@ class _ClassInfo:
                     if guard:
                         self.guarded[attr] = guard
 
+    def declared(self, name: str) -> bool:
+        return name in self.locks or name in set(self.guarded.values())
 
-class _MethodChecker:
-    """Walks one method's statements tracking the set of held self-locks."""
 
-    def __init__(self, cls: _ClassInfo, fn: ast.FunctionDef,
-                 findings: list[Finding], edges: dict) -> None:
-        self.cls = cls
-        self.fn = fn
+def _module_locks(mod: Module) -> set[str]:
+    """Top-level `NAME = threading.Lock()` (etc.) globals."""
+    out: set[str] = set()
+    if mod.tree is None:
+        return out
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            ctor = stmt.value.func
+            name = ctor.attr if isinstance(ctor, ast.Attribute) else (
+                ctor.id if isinstance(ctor, ast.Name) else None
+            )
+            if name in LOCK_CTORS:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+class _Analysis:
+    """Whole-project lock analysis state: the call graph, per-class lock
+    tables, and memoized per-function summaries (transitive blocking
+    calls / transitively acquired locks)."""
+
+    def __init__(self, modules: list[Module]) -> None:
+        self.graph = callgraph.build(modules)
+        self.classes: dict[tuple[str, str], _ClassInfo] = {}
+        for key, cg_cls in self.graph.classes.items():
+            self.classes[key] = _ClassInfo(cg_cls.mod, cg_cls.qual, cg_cls.node)
+        self.module_locks: dict[str, set[str]] = {
+            mod.rel: _module_locks(mod) for mod in modules
+        }
+        self._blocking_memo: dict[callgraph.Key, Optional[tuple[str, str, str, str]]] = {}
+        self._acquired_memo: dict[callgraph.Key, frozenset[tuple[str, str]]] = {}
+        self._required_memo: dict[callgraph.Key, set[str]] = {}
+        self._required_inprogress: set[callgraph.Key] = set()
+
+    # ---- lock identity ----------------------------------------------------
+    def class_of(self, info: callgraph.FuncInfo) -> Optional[_ClassInfo]:
+        if info.cls is None:
+            return None
+        return self.classes.get((info.mod.rel, info.cls))
+
+    def lock_id(self, info: callgraph.FuncInfo, ref: LockRef) -> tuple[str, str]:
+        """-> (global id, short label). Keyed by (module, class): a class
+        lives in exactly one module, and two same-named classes in
+        different files must not merge into one phantom ABBA pair."""
+        if ref[0] == "self" and info.cls is not None:
+            return (f"{info.mod.rel}::{info.cls}::{ref[1]}",
+                    f"{info.cls}.{ref[1]}")
+        return (f"{info.mod.rel}::<module>::{ref[1]}", ref[1])
+
+    def is_lock(self, info: callgraph.FuncInfo, ref: LockRef) -> bool:
+        if ref[0] == "self":
+            cls = self.class_of(info)
+            if cls is None:
+                return False
+            return cls.declared(ref[1]) or ref[1].endswith(("lock", "mutex", "cond"))
+        return ref[1] in self.module_locks.get(info.mod.rel, set())
+
+    def is_declared(self, info: callgraph.FuncInfo, ref: LockRef) -> bool:
+        """Constructed or guarded-by-named locks only — the suffix
+        heuristic tracks the held set but never anchors a blocking
+        finding."""
+        if ref[0] == "self":
+            cls = self.class_of(info)
+            return cls is not None and cls.declared(ref[1])
+        return ref[1] in self.module_locks.get(info.mod.rel, set())
+
+    def as_lockref(self, info: callgraph.FuncInfo, expr: ast.expr) -> Optional[LockRef]:
+        attr = _self_attr(expr)
+        if attr is not None:
+            ref = ("self", attr)
+            return ref if self.is_lock(info, ref) else None
+        if isinstance(expr, ast.Name):
+            ref = ("mod", expr.id)
+            return ref if self.is_lock(info, ref) else None
+        return None
+
+    # ---- summaries --------------------------------------------------------
+    def _source_sanctioned(self, mod: Module, lineno: int, rule: str) -> bool:
+        """A deny-listed call whose own line suppresses its hotpath rule
+        (or lock-held-blocking) is sanctioned at source — don't propagate
+        it into callers' lock regions."""
+        m = SUPPRESS_RE.search(mod.line(lineno))
+        if not m:
+            return False
+        rules = {part.strip() for part in m.group(1).split(",")}
+        return rule in rules or "lock-held-blocking" in rules
+
+    def blocking_summary(
+        self, key: callgraph.Key, _stack: Optional[set] = None,
+    ) -> Optional[tuple[str, str, str, str]]:
+        """-> (rule, detail, description, qual where it happens) for the
+        first deny-listed call this function transitively executes, or
+        None. Nested defs and lambdas are skipped — defining a closure
+        under a lock does not run it."""
+        if key in self._blocking_memo:
+            return self._blocking_memo[key]
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return None  # recursion cycle
+        stack.add(key)
+        info = self.graph.funcs.get(key)
+        result: Optional[tuple[str, str, str, str]] = None
+        if info is not None:
+            hits: list[tuple[ast.Call, tuple[str, str, str]]] = []
+
+            def scan(node: ast.AST) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                        continue
+                    if isinstance(child, ast.Call):
+                        hit = hotpath.banned(child)
+                        if hit is not None and not self._source_sanctioned(
+                                info.mod, child.lineno, hit[0]):
+                            hits.append((child, hit))
+                    scan(child)
+
+            for stmt in info.node.body:
+                if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan(stmt)
+            if hits:
+                _, (rule, detail, desc) = hits[0]
+                result = (rule, detail, desc, info.qual)
+            else:
+                for callee, _ in self.graph.callees(info):
+                    sub = self.blocking_summary(callee, stack)
+                    if sub is not None:
+                        result = sub
+                        break
+        stack.discard(key)
+        self._blocking_memo[key] = result
+        return result
+
+    def acquired_summary(
+        self, key: callgraph.Key, _stack: Optional[set] = None,
+    ) -> frozenset[tuple[str, str]]:
+        """(lock id, label) pairs this function transitively acquires."""
+        cached = self._acquired_memo.get(key)
+        if cached is not None:
+            return cached
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return frozenset()
+        stack.add(key)
+        acc: set[tuple[str, str]] = set()
+        info = self.graph.funcs.get(key)
+        if info is not None:
+            def scan(node: ast.AST) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                        continue
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        for item in child.items:
+                            ref = self.as_lockref(info, item.context_expr)
+                            if ref is not None:
+                                acc.add(self.lock_id(info, ref))
+                    target = _lock_call_target(child, "acquire") \
+                        if isinstance(child, ast.Call) else None
+                    if target is not None:
+                        ref = self.as_lockref(info, target)
+                        if ref is not None:
+                            acc.add(self.lock_id(info, ref))
+                    scan(child)
+
+            # Scan from the function NODE so a `with lock:` that IS a
+            # top-level body statement still registers (scan only matches
+            # With nodes seen as children).
+            scan(info.node)
+            for callee, _ in self.graph.callees(info):
+                acc |= self.acquired_summary(callee, stack)
+        stack.discard(key)
+        frozen = frozenset(acc)
+        self._acquired_memo[key] = frozen
+        return frozen
+
+    def required_locks(self, callee: callgraph.FuncInfo) -> set[str]:
+        """Locks a helper's CALLER must hold: its `# holds-lock:`
+        declaration, plus — for `*_locked`-suffix helpers — the guards of
+        every guarded attr its body touches OUTSIDE its own lock regions
+        (a `_locked` method that takes the lock itself, like the store's
+        write path, imposes nothing on callers)."""
+        key = callee.key
+        cached = self._required_memo.get(key)
+        if cached is not None:
+            return cached
+        if key in self._required_inprogress:
+            return set()  # mutual-recursion cycle: no extra requirement
+        self._required_inprogress.add(key)
+        required = set(callee.mod.holds_locks(callee.node))
+        cls = self.class_of(callee)
+        if cls is not None and callee.name.endswith("_locked"):
+            missing: set[str] = set()
+            _FuncChecker(self, callee, [], {}, collect_missing=missing)
+            required |= missing
+        self._required_inprogress.discard(key)
+        self._required_memo[key] = required
+        return required
+
+
+class _FuncChecker:
+    """Walks one function's statements tracking the set of held locks."""
+
+    def __init__(self, analysis: _Analysis, info: callgraph.FuncInfo,
+                 findings: list[Finding], edges: dict,
+                 collect_missing: Optional[set[str]] = None) -> None:
+        self.analysis = analysis
+        self.info = info
+        self.cls = analysis.class_of(info)
+        self.fn = info.node
         self.findings = findings
-        self.edges = edges  # (class_qual, lockA, lockB) -> first site
-        held = set(cls.mod.holds_locks(fn))
-        if fn.name.endswith("_locked"):
-            # store.py convention: the caller holds every guard lock.
-            held |= set(cls.guarded.values())
-        self.walk_block(fn.body, held)
+        self.edges = edges  # (outer id, inner id) -> (mod, line, out lbl, in lbl)
+        # Collect mode (required_locks): record which locks the body NEEDS
+        # from its caller instead of reporting findings — the walk starts
+        # from the annotation only, without the `_locked` assumption.
+        self.collect_missing = collect_missing
+        held: set[LockRef] = {("self", name)
+                              for name in info.mod.holds_locks(info.node)}
+        if collect_missing is None and self.cls is not None \
+                and info.name.endswith("_locked"):
+            # store.py convention: the caller holds every guard lock the
+            # body actually needs (required_locks computes that set; the
+            # body's own check assumes the convention was honored).
+            held |= {("self", lock)
+                     for lock in analysis.required_locks(info)}
+        self.walk_block(self.fn.body, held)
 
     # ---- statement walk ---------------------------------------------------
-    def walk_block(self, stmts: list[ast.stmt], held: set[str]) -> set[str]:
+    def walk_block(self, stmts: list[ast.stmt], held: set[LockRef]) -> set[LockRef]:
         """Walk statements sequentially; returns the held set at block end
         (so a release inside a try's finally ends the region for the
         statements AFTER the try)."""
@@ -118,29 +362,33 @@ class _MethodChecker:
             cur = self.walk_stmt(stmt, cur)
         return cur
 
-    def walk_stmt(self, stmt: ast.stmt, held: set[str]) -> set[str]:
+    def walk_stmt(self, stmt: ast.stmt, held: set[LockRef]) -> set[LockRef]:
         if isinstance(stmt, (ast.With, ast.AsyncWith)):
             acquired = []
             for item in stmt.items:
-                attr = _self_attr(item.context_expr)
-                if attr is not None and self._is_lock(attr):
-                    acquired.append(attr)
+                ref = self.analysis.as_lockref(self.info, item.context_expr)
+                if ref is not None:
+                    acquired.append(ref)
                 else:
                     self.check_expr(item.context_expr, held)
                 if item.optional_vars is not None:
                     self.check_expr(item.optional_vars, held)
-            for lock in acquired:
-                self._record_order(held, lock, stmt.lineno)
+            for ref in acquired:
+                self._record_order(held, ref, stmt.lineno)
             self.walk_block(stmt.body, held | set(acquired))
             return held
         if isinstance(stmt, ast.Expr):
-            acq = _lock_call_attr(stmt.value, "acquire")
-            if acq is not None and self._is_lock(acq):
-                self._record_order(held, acq, stmt.lineno)
-                return held | {acq}
-            rel = _lock_call_attr(stmt.value, "release")
-            if rel is not None and self._is_lock(rel):
-                return held - {rel}
+            acq_target = _lock_call_target(stmt.value, "acquire")
+            if acq_target is not None:
+                ref = self.analysis.as_lockref(self.info, acq_target)
+                if ref is not None:
+                    self._record_order(held, ref, stmt.lineno)
+                    return held | {ref}
+            rel_target = _lock_call_target(stmt.value, "release")
+            if rel_target is not None:
+                ref = self.analysis.as_lockref(self.info, rel_target)
+                if ref is not None:
+                    return held - {ref}
             self.check_expr(stmt.value, held)
             return held
         if isinstance(stmt, ast.Try):
@@ -177,83 +425,249 @@ class _MethodChecker:
         return held
 
     # ---- expression scan --------------------------------------------------
-    def check_expr(self, node: ast.AST, held: set[str]) -> None:
+    def check_expr(self, node: ast.AST, held: set[LockRef]) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
             return  # nested scope
-        attr = _self_attr(node) if isinstance(node, ast.Attribute) else None
-        if attr is not None and attr in self.cls.guarded:
-            lock = self.cls.guarded[attr]
-            if lock not in held:
-                self.findings.append(self.cls.mod.finding(
-                    "lock-guarded-attr", node.lineno, f"{self.fn.name}.{attr}",
-                    f"self.{attr} is `# guarded-by: {lock}` but accessed in "
-                    f"{self.cls.qual}.{self.fn.name} without holding "
-                    f"self.{lock}",
-                ))
+        if self.cls is not None:
+            attr = _self_attr(node) if isinstance(node, ast.Attribute) else None
+            if attr is not None and attr in self.cls.guarded:
+                lock = self.cls.guarded[attr]
+                if ("self", lock) not in held:
+                    if self.collect_missing is not None:
+                        self.collect_missing.add(lock)
+                    else:
+                        self.findings.append(self.info.mod.finding(
+                            "lock-guarded-attr", node.lineno,
+                            f"{self.fn.name}.{attr}",
+                            f"self.{attr} is `# guarded-by: {lock}` but "
+                            f"accessed in {self.cls.qual}.{self.fn.name} "
+                            f"without holding self.{lock}",
+                        ))
+        if isinstance(node, ast.Call):
+            self.check_call(node, held)
         for child in ast.iter_child_nodes(node):
             self.check_expr(child, held)
 
+    def check_call(self, call: ast.Call, held: set[LockRef]) -> None:
+        analysis = self.analysis
+        if self.collect_missing is not None:
+            # Collect mode: only requirement propagation — a _locked helper
+            # calling another helper needs whatever that helper needs.
+            target = analysis.graph.resolve_call(self.info, call)
+            if target is not None and target != self.info.key:
+                callee = analysis.graph.funcs.get(target)
+                if callee is not None and callee.cls == self.info.cls \
+                        and callee.mod.rel == self.info.mod.rel:
+                    held_names = {name for kind, name in held if kind == "self"}
+                    self.collect_missing |= (
+                        analysis.required_locks(callee) - held_names
+                    )
+            return
+        declared_held = [ref for ref in held
+                         if self.analysis.is_declared(self.info, ref)]
+        # Direct deny-listed call under a declared lock.
+        if declared_held:
+            hit = hotpath.banned(call)
+            if hit is not None:
+                rule, detail, desc = hit
+                _, label = analysis.lock_id(self.info, declared_held[0])
+                self.findings.append(self.info.mod.finding(
+                    "lock-held-blocking", call.lineno,
+                    f"{self.fn.name}:{detail}",
+                    f"{desc} while holding {label} (in "
+                    f"{self.info.qual}) — blocking under a lock convoys "
+                    "every waiter",
+                ))
+        target = analysis.graph.resolve_call(self.info, call)
+        if target is None or target == self.info.key:
+            return
+        callee = analysis.graph.funcs.get(target)
+        if callee is None:
+            return
+        # Blocking reached through the call chain.
+        if declared_held:
+            summary = analysis.blocking_summary(target)
+            if summary is not None:
+                _, _, desc, where = summary
+                _, label = analysis.lock_id(self.info, declared_held[0])
+                self.findings.append(self.info.mod.finding(
+                    "lock-held-blocking", call.lineno,
+                    f"{self.fn.name}->{callee.qual}",
+                    f"call to {callee.qual} reaches {desc} (in {where}) "
+                    f"while holding {label} — blocking under a lock convoys "
+                    "every waiter",
+                ))
+        # Lock-order edges through the call chain.
+        if held:
+            inner = analysis.acquired_summary(target)
+            if inner:
+                outer_ids = [analysis.lock_id(self.info, ref) for ref in held]
+                for outer_id, outer_label in outer_ids:
+                    for inner_id, inner_label in inner:
+                        if inner_id == outer_id:
+                            continue  # re-entrant re-acquire
+                        self.edges.setdefault(
+                            (outer_id, inner_id),
+                            (self.info.mod, call.lineno, outer_label, inner_label),
+                        )
+        # Guarded-attr discipline follows calls into _locked helpers:
+        # the call site must hold what the helper's body needs.
+        if self.cls is not None and callee.cls == self.info.cls \
+                and callee.mod.rel == self.info.mod.rel \
+                and callee.name not in EXEMPT_METHODS:
+            required = analysis.required_locks(callee)
+            missing = sorted(required - {name for kind, name in held
+                                         if kind == "self"})
+            if missing:
+                self.findings.append(self.info.mod.finding(
+                    "lock-guarded-attr", call.lineno,
+                    f"{self.fn.name}->{callee.name}",
+                    f"{self.cls.qual}.{callee.name} requires the caller to "
+                    f"hold self.{', self.'.join(missing)} but "
+                    f"{self.fn.name} calls it without",
+                ))
+
     # ---- helpers ----------------------------------------------------------
-    def _is_lock(self, attr: str) -> bool:
-        return attr in self.cls.locks or attr in set(self.cls.guarded.values()) \
-            or attr.endswith(("lock", "mutex", "cond"))
-
-    def _record_order(self, held: set[str], acquired: str, lineno: int) -> None:
+    def _record_order(self, held: set[LockRef], acquired: LockRef,
+                      lineno: int) -> None:
+        acq_id, acq_label = self.analysis.lock_id(self.info, acquired)
         for outer in held:
-            if outer == acquired:
+            out_id, out_label = self.analysis.lock_id(self.info, outer)
+            if out_id == acq_id:
                 continue  # re-entrant RLock re-acquire: not an order edge
-            # Keyed by (module, class): a class lives in exactly one module,
-            # and two same-named classes in different files must not merge
-            # into one phantom ABBA pair.
-            key = (self.cls.mod.rel, self.cls.qual, outer, acquired)
-            self.edges.setdefault(key, (self.cls.mod, lineno))
+            self.edges.setdefault(
+                (out_id, acq_id), (self.info.mod, lineno, out_label, acq_label)
+            )
 
 
-def _classes(mod: Module) -> list[_ClassInfo]:
-    out: list[_ClassInfo] = []
-
-    def walk(node: ast.AST, prefix: str) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.ClassDef):
-                qual = f"{prefix}.{child.name}" if prefix else child.name
-                out.append(_ClassInfo(mod, qual, child))
-                walk(child, qual)
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                walk(child, f"{prefix}.{child.name}" if prefix else child.name)
-            else:
-                walk(child, prefix)
-
-    if mod.tree is not None:
-        walk(mod.tree, "")
+def _checked_functions(analysis: _Analysis) -> list[callgraph.FuncInfo]:
+    """Methods of classes that declare locks or guarded attrs, plus
+    module-level functions of modules with module-level lock globals —
+    NOT every function in the repo (a test's local lock is its own
+    business)."""
+    out: list[callgraph.FuncInfo] = []
+    for info in analysis.graph.funcs.values():
+        if info.name in EXEMPT_METHODS:
+            continue
+        if info.cls is not None:
+            cls = analysis.class_of(info)
+            if cls is not None and (cls.locks or cls.guarded):
+                # Direct class-body methods only — nested defs run later,
+                # under their call site's discipline.
+                if info.qual == f"{info.cls}.{info.name}":
+                    out.append(info)
+        elif analysis.module_locks.get(info.mod.rel) and "." not in info.qual:
+            out.append(info)
     return out
 
 
-def run(modules: list[Module]) -> list[Finding]:
+def _cycle_findings(edges: dict) -> list[Finding]:
+    """ABBA pairs first (both directions observed), then longer cycles
+    via strongly-connected components of the remaining order graph."""
     findings: list[Finding] = []
-    edges: dict[tuple[str, str, str, str], tuple[Module, int]] = {}
-    for mod in modules:
-        for cls in _classes(mod):
-            if not cls.guarded and not cls.locks:
-                continue
-            for fn in cls.node.body:
-                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    continue
-                if fn.name in EXEMPT_METHODS:
-                    continue
-                if cls.guarded or cls.locks:
-                    _MethodChecker(cls, fn, findings, edges)
-    # Inconsistent acquisition order: both A->B and B->A observed for the
-    # same class's locks (the classic ABBA deadlock shape).
-    reported: set[tuple[str, str, str, str]] = set()
-    for (rel, qual, a, b), (mod, lineno) in sorted(
+    reported: set[tuple[str, str]] = set()
+    for (a, b), (mod, lineno, a_label, b_label) in sorted(
         edges.items(), key=lambda kv: (kv[1][0].rel, kv[1][1])
     ):
-        if (rel, qual, b, a) in edges and (rel, qual, b, a) not in reported:
-            reported.add((rel, qual, a, b))
-            other_mod, other_line = edges[(rel, qual, b, a)]
+        if (b, a) in edges and (b, a) not in reported:
+            reported.add((a, b))
+            other_mod, other_line, _, _ = edges[(b, a)]
+            same_class = a.rsplit("::", 1)[0] == b.rsplit("::", 1)[0]
+            if same_class:
+                cls_qual = a.split("::")[1]
+                detail = f"{cls_qual}:{a.rsplit('::', 1)[1]}<->{b.rsplit('::', 1)[1]}"
+                scope = f"in {cls_qual}"
+            else:
+                detail = f"{a_label}<->{b_label}"
+                scope = "across classes"
             findings.append(mod.finding(
-                "lock-order", lineno, f"{qual}:{a}<->{b}",
-                f"inconsistent lock order in {qual}: {a} -> {b} here but "
-                f"{b} -> {a} at {other_mod.rel}:{other_line} (ABBA deadlock)",
+                "lock-order", lineno, detail,
+                f"inconsistent lock order {scope}: {a_label} -> {b_label} "
+                f"here but {b_label} -> {a_label} at "
+                f"{other_mod.rel}:{other_line} (ABBA deadlock)",
             ))
+    # Longer cycles: Tarjan SCC over edges not already part of a 2-cycle.
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        if (b, a) in edges:
+            continue
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = [0]
+    sccs: list[list[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    for comp in sccs:
+        # Anchor the finding at the first edge inside the component.
+        site = None
+        labels = []
+        for (a, b), (mod, lineno, a_label, b_label) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].rel, kv[1][1])
+        ):
+            if a in comp and b in comp:
+                if site is None:
+                    site = (mod, lineno)
+                for lbl in (a_label, b_label):
+                    if lbl not in labels:
+                        labels.append(lbl)
+        if site is not None:
+            mod, lineno = site
+            findings.append(mod.finding(
+                "lock-order", lineno, "cycle:" + "->".join(sorted(labels)),
+                f"lock acquisition cycle across {len(comp)} locks: "
+                f"{' -> '.join(labels)} -> {labels[0]} (deadlock shape)",
+            ))
+    return findings
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    analysis = _Analysis(modules)
+    findings: list[Finding] = []
+    edges: dict[tuple[str, str], tuple[Module, int, str, str]] = {}
+    for info in _checked_functions(analysis):
+        _FuncChecker(analysis, info, findings, edges)
+    findings.extend(_cycle_findings(edges))
     return findings

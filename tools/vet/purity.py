@@ -1,0 +1,389 @@
+"""Reconcile/observer purity pass, on the shared call graph
+(tools/vet/callgraph.py).
+
+The control plane's dispatchers run observer callbacks SYNCHRONOUSLY on
+the committing thread: `Store._drain_events` calls watchers unwrapped
+under the dispatch lock, `Manager._on_event` maps watch events through
+user-provided key functions, and the tracer/recorder/SLO feeds fan out
+to the obs planes. A raising observer therefore propagates straight into
+whichever reconcile (or serving) thread committed the write — the
+invariant "observers never raise into reconcile" was prose until this
+pass.
+
+Rules (scoped to lws_tpu/ — tests may register throwaway callbacks):
+
+  * `purity-observer-raise` — the callable registered at an observer
+    registration site (`add_observer(fn)`, `add_finish_listener(fn)`,
+    `store.watch(fn)`, `journey_sinks.append(fn)`) must be
+    EXCEPTION-CONTAINED: every statement of its body either provably
+    cannot raise (constants, name/attribute reads, calls on a small
+    safe-builtin allowlist, resolvable calls whose targets are
+    themselves contained) or sits inside a `try` with a broad
+    (`except Exception`/bare) handler whose handler body is itself safe.
+    Subscript reads, unresolvable calls, `raise`, `assert`, and
+    non-trivial context managers count as "can raise". Lambda observers
+    are out of scope (the resolver never guesses).
+
+  * `purity-fleet-scan` — functions reachable from the reconcile roots
+    must not scan the whole fleet per reconcile: a store `.list(<kind>)`
+    with no namespace and no label/field filter is an unbounded
+    whole-fleet scan, and any store `.list(...)` INSIDE a for/while body
+    is per-item fan-out (O(items x objects) per tick — the serial
+    fraction that dominates at the 1,000-instance regime). Roots are
+    functions annotated `# reconcile-path` plus the `reconcile` methods
+    of every object passed to a `register(...)` call with a resolvable
+    type, plus registered observers (watch callbacks run inside the
+    commit path). A scan that is genuinely unavoidable (no index exists
+    and the path is rare) carries an inline
+    `# vet: ignore[purity-fleet-scan]: reason`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.vet import callgraph
+from tools.vet.core import Finding, Module
+
+PASS_NAME = "purity"
+
+LWS_PREFIX = "lws_tpu/"
+REGISTRATION_METHODS = {"add_observer", "add_finish_listener", "watch"}
+SINK_LIST_ATTRS = {"journey_sinks"}
+
+# Calls assumed non-raising on well-formed inputs — kept deliberately
+# small; anything outside it needs a broad try or a resolvable, contained
+# target. (getattr is only safe with an explicit default.)
+SAFE_BUILTINS = {
+    "len", "str", "int", "float", "bool", "repr", "id", "type",
+    "isinstance", "hasattr", "callable", "round", "abs",
+    "sorted", "list", "dict", "set", "tuple", "frozenset",
+    "min", "max", "sum", "enumerate", "zip", "range", "format",
+}
+SAFE_METHODS = {
+    "get", "items", "keys", "values", "copy", "append", "add",
+    "discard", "setdefault", "update", "clear", "strip", "split",
+    "join", "startswith", "endswith", "lower", "upper", "format",
+    "monotonic", "time", "perf_counter", "notify_all", "notify",
+}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    names = []
+    if isinstance(handler.type, ast.Name):
+        names = [handler.type.id]
+    elif isinstance(handler.type, ast.Tuple):
+        names = [e.id for e in handler.type.elts if isinstance(e, ast.Name)]
+    return bool({"Exception", "BaseException"} & set(names))
+
+
+class _Containment:
+    """Memoized is-this-function-exception-contained check."""
+
+    def __init__(self, graph: callgraph.CallGraph) -> None:
+        self.graph = graph
+        self.memo: dict[callgraph.Key, bool] = {}
+        self._stack: set[callgraph.Key] = set()
+
+    def contained(self, key: callgraph.Key) -> bool:
+        cached = self.memo.get(key)
+        if cached is not None:
+            return cached
+        if key in self._stack:
+            return True  # recursion cycle: optimistic (the outer frame decides)
+        info = self.graph.funcs.get(key)
+        if info is None:
+            return False
+        self._stack.add(key)
+        ok = all(self.stmt_ok(info, s) for s in info.node.body)
+        self._stack.discard(key)
+        self.memo[key] = ok
+        return ok
+
+    # ---- statements -------------------------------------------------------
+    def stmt_ok(self, info: callgraph.FuncInfo, stmt: ast.stmt) -> bool:
+        if isinstance(stmt, ast.Try):
+            handlers_ok = all(
+                all(self.stmt_ok(info, s) for s in h.body) for h in stmt.handlers
+            )
+            broad = any(_is_broad_handler(h) for h in stmt.handlers)
+            final_ok = all(self.stmt_ok(info, s) for s in stmt.finalbody)
+            orelse_ok = all(self.stmt_ok(info, s) for s in stmt.orelse)
+            if broad and handlers_ok and final_ok and orelse_ok:
+                return True  # the wrapper pattern: body may do anything
+            body_ok = all(self.stmt_ok(info, s) for s in stmt.body)
+            return body_ok and handlers_ok and final_ok and orelse_ok
+        if isinstance(stmt, (ast.Pass, ast.Break, ast.Continue,
+                             ast.Global, ast.Nonlocal,
+                             ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Import, ast.ImportFrom)):
+            return True
+        if isinstance(stmt, ast.Return):
+            return self.expr_ok(info, stmt.value)
+        if isinstance(stmt, ast.Expr):
+            return self.expr_ok(info, stmt.value)
+        if isinstance(stmt, ast.Assign):
+            return all(self.target_ok(info, t) for t in stmt.targets) \
+                and self.expr_ok(info, stmt.value)
+        if isinstance(stmt, ast.AnnAssign):
+            return self.target_ok(info, stmt.target) \
+                and self.expr_ok(info, stmt.value)
+        if isinstance(stmt, ast.AugAssign):
+            # Aug-assign READS the target first — a Subscript target is a
+            # subscript read (`seq["n"] += 1` raises KeyError).
+            return isinstance(stmt.target, (ast.Name, ast.Attribute)) \
+                and self.expr_ok(info, stmt.value)
+        if isinstance(stmt, ast.If):
+            return self.expr_ok(info, stmt.test) \
+                and all(self.stmt_ok(info, s) for s in stmt.body) \
+                and all(self.stmt_ok(info, s) for s in stmt.orelse)
+        if isinstance(stmt, ast.While):
+            return self.expr_ok(info, stmt.test) \
+                and all(self.stmt_ok(info, s) for s in stmt.body) \
+                and all(self.stmt_ok(info, s) for s in stmt.orelse)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self.expr_ok(info, stmt.iter) \
+                and self.target_ok(info, stmt.target) \
+                and all(self.stmt_ok(info, s) for s in stmt.body) \
+                and all(self.stmt_ok(info, s) for s in stmt.orelse)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # Lock-like context managers (a plain name/attribute, e.g.
+            # `with self._lock:`) don't raise on enter; anything fancier
+            # (a call returning a CM) is opaque and counts as risky.
+            for item in stmt.items:
+                if not isinstance(item.context_expr, (ast.Name, ast.Attribute)):
+                    return False
+            return all(self.stmt_ok(info, s) for s in stmt.body)
+        return False  # raise, assert, delete, match, ... — can raise
+
+    def target_ok(self, info: callgraph.FuncInfo, target: ast.expr) -> bool:
+        if isinstance(target, (ast.Name, ast.Attribute)):
+            return True
+        if isinstance(target, ast.Subscript):
+            # A subscript WRITE (`d[k] = v`) is a plain setitem; the
+            # container read underneath must still be safe.
+            return self.expr_ok(info, target.value) \
+                and self.expr_ok(info, target.slice)
+        if isinstance(target, (ast.Tuple, ast.List)):
+            return all(self.target_ok(info, t) for t in target.elts)
+        return False
+
+    # ---- expressions ------------------------------------------------------
+    def expr_ok(self, info: callgraph.FuncInfo, expr: Optional[ast.expr]) -> bool:
+        if expr is None or isinstance(expr, (ast.Constant, ast.Name, ast.Lambda)):
+            return True
+        if isinstance(expr, ast.Attribute):
+            return self.expr_ok(info, expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return all(self.expr_ok(info, e) for e in expr.elts)
+        if isinstance(expr, ast.Dict):
+            return all(self.expr_ok(info, k) for k in expr.keys if k is not None) \
+                and all(self.expr_ok(info, v) for v in expr.values)
+        if isinstance(expr, ast.BoolOp):
+            return all(self.expr_ok(info, v) for v in expr.values)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_ok(info, expr.operand)
+        if isinstance(expr, ast.BinOp):
+            return self.expr_ok(info, expr.left) and self.expr_ok(info, expr.right)
+        if isinstance(expr, ast.Compare):
+            return self.expr_ok(info, expr.left) \
+                and all(self.expr_ok(info, c) for c in expr.comparators)
+        if isinstance(expr, ast.IfExp):
+            return self.expr_ok(info, expr.test) and self.expr_ok(info, expr.body) \
+                and self.expr_ok(info, expr.orelse)
+        if isinstance(expr, ast.JoinedStr):
+            return all(self.expr_ok(info, v) for v in expr.values)
+        if isinstance(expr, ast.FormattedValue):
+            return self.expr_ok(info, expr.value)
+        if isinstance(expr, ast.Starred):
+            return self.expr_ok(info, expr.value)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self.expr_ok(info, expr.elt) \
+                and all(self._comp_ok(info, g) for g in expr.generators)
+        if isinstance(expr, ast.DictComp):
+            return self.expr_ok(info, expr.key) and self.expr_ok(info, expr.value) \
+                and all(self._comp_ok(info, g) for g in expr.generators)
+        if isinstance(expr, ast.Call):
+            return self.call_ok(info, expr)
+        return False  # Subscript (Load), Await, Yield, ... — can raise
+
+    def _comp_ok(self, info: callgraph.FuncInfo, gen: ast.comprehension) -> bool:
+        return self.expr_ok(info, gen.iter) and self.target_ok(info, gen.target) \
+            and all(self.expr_ok(info, c) for c in gen.ifs)
+
+    def call_ok(self, info: callgraph.FuncInfo, call: ast.Call) -> bool:
+        args_ok = all(self.expr_ok(info, a) for a in call.args) \
+            and all(self.expr_ok(info, kw.value) for kw in call.keywords)
+        if not args_ok:
+            return False
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id in SAFE_BUILTINS:
+                return True
+            if fn.id == "getattr" and len(call.args) == 3:
+                return True
+        if isinstance(fn, ast.Attribute) and fn.attr in SAFE_METHODS \
+                and self.expr_ok(info, fn.value):
+            return True
+        target = self.graph.resolve_call(info, call)
+        if target is not None:
+            return self.contained(target)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Registration-site + root discovery
+
+
+def _function_calls(info: callgraph.FuncInfo) -> list[ast.Call]:
+    """Calls lexically in one function body (nested defs excluded — each
+    is scanned as its own function)."""
+    out: list[ast.Call] = []
+
+    def scan(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Call):
+                out.append(child)
+            scan(child)
+
+    for stmt in info.node.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan(stmt)
+    return out
+
+
+def _registration_arg(call: ast.Call) -> Optional[ast.expr]:
+    """The observer callable of a registration call, or None."""
+    fn = call.func
+    if not isinstance(fn, ast.Attribute) or not call.args:
+        return None
+    if fn.attr in REGISTRATION_METHODS:
+        return call.args[0]
+    if fn.attr == "append" and isinstance(fn.value, ast.Attribute) \
+            and fn.value.attr in SINK_LIST_ATTRS:
+        return call.args[0]
+    return None
+
+
+def _store_receiver(graph: callgraph.CallGraph, info: callgraph.FuncInfo,
+                    recv: ast.expr) -> bool:
+    """True when `recv.list(...)` targets the object store: the receiver's
+    inferred class is named Store, or — fallback for unannotated params —
+    the receiver is literally named `store`/`*_store`."""
+    typ = graph.resolve_receiver_type(info, recv, graph._fn_locals(info))
+    if typ is not None:
+        return typ[1].rsplit(".", 1)[-1] == "Store"
+    name = None
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    return name is not None and (name == "store" or name.endswith("_store"))
+
+
+def _scan_fleet(graph: callgraph.CallGraph, info: callgraph.FuncInfo,
+                findings: list[Finding]) -> None:
+    def walk(node: ast.AST, in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, (ast.For, ast.AsyncFor)):
+                walk(child.iter, in_loop)
+                for s in child.body + child.orelse:
+                    walk(s, True)
+                continue
+            if isinstance(child, ast.While):
+                walk(child.test, in_loop)
+                for s in child.body + child.orelse:
+                    walk(s, True)
+                continue
+            if isinstance(child, ast.Call) and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr == "list" \
+                    and _store_receiver(graph, info, child.func.value):
+                kind = "?"
+                if child.args and isinstance(child.args[0], ast.Constant) \
+                        and isinstance(child.args[0].value, str):
+                    kind = child.args[0].value
+                unfiltered = len(child.args) == 1 and not child.keywords \
+                    and kind != "?"
+                if in_loop:
+                    findings.append(info.mod.finding(
+                        "purity-fleet-scan", child.lineno,
+                        f"{info.qual}:list({kind})@loop",
+                        f"store.list({kind!r}) inside a loop on the "
+                        f"reconcile path (in {info.qual}) — per-item "
+                        "fan-out multiplies into O(items x objects) per "
+                        "tick; hoist one scan and group locally",
+                    ))
+                elif unfiltered:
+                    findings.append(info.mod.finding(
+                        "purity-fleet-scan", child.lineno,
+                        f"{info.qual}:list({kind})",
+                        f"unfiltered store.list({kind!r}) on the reconcile "
+                        f"path (in {info.qual}) — a whole-fleet scan per "
+                        "reconcile; scope it by namespace or label, or "
+                        "index what you need",
+                    ))
+            walk(child, in_loop)
+
+    # Walk from the function NODE (not per body statement) so a for/while
+    # at the top level of the body still marks its own body as in-loop.
+    walk(info.node, False)
+
+
+def run(modules: list[Module]) -> list[Finding]:
+    graph = callgraph.build(modules)
+    containment = _Containment(graph)
+    findings: list[Finding] = []
+    observer_keys: set[callgraph.Key] = set()
+    roots: set[callgraph.Key] = set()
+
+    for key, info in graph.funcs.items():
+        if info.mod.has_reconcile_mark(info.node):
+            roots.add(key)
+        if not info.mod.rel.startswith(LWS_PREFIX):
+            continue
+        for call in _function_calls(info):
+            arg = _registration_arg(call)
+            if arg is not None:
+                target = graph.resolve_callable(info, arg)
+                if target is not None:
+                    observer_keys.add(target)
+                    if not containment.contained(target):
+                        findings.append(info.mod.finding(
+                            "purity-observer-raise", call.lineno,
+                            f"{info.qual}:{target[1]}",
+                            f"observer {target[1]} (registered in "
+                            f"{info.qual}) can raise into the dispatching "
+                            "reconcile/serving thread — wrap its body in a "
+                            "broad try/except or make it provably "
+                            "non-raising",
+                        ))
+            # Reconcile roots: `<manager>.register(reconciler, ...)` with a
+            # resolvable reconciler type.
+            fn = call.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "register" \
+                    and call.args:
+                typ = graph.resolve_receiver_type(
+                    info, call.args[0], graph._fn_locals(info)
+                )
+                if typ is not None:
+                    method = graph.method_of(typ, "reconcile")
+                    if method is not None:
+                        roots.add(method)
+
+    # Watch observers run inside the commit path — their closure is part
+    # of the reconcile reachability for the fleet-scan rule.
+    roots |= observer_keys
+    for key in sorted(graph.reachable(roots)):
+        info = graph.funcs.get(key)
+        if info is None or not info.mod.rel.startswith(LWS_PREFIX):
+            continue
+        _scan_fleet(graph, info, findings)
+    return findings
