@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale clean
 
 test:
 	python -m pytest tests/ -q
@@ -42,7 +42,10 @@ bench-rollout-overhead:  ## the rollout ledger's store observer must cost <2% of
 bench-vet-wallclock:  ## the full whole-program vet suite must stay under its wall-clock budget (budget json)
 	python benchmarks/vet_wallclock_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock  ## what CI would run (vet gates before tests)
+bench-fleet-scale:  ## 1,000-instance sim fleet: tree scrape must beat flat, streaming merge must beat the dict oracle's peak byte-identically, 10,000-group reconcile under per-group budgets (budget json)
+	python benchmarks/fleet_scale_bench.py --check
+
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

@@ -1,0 +1,262 @@
+"""Fleet-scale bench: the observability plane's 1,000-instance claims,
+enforced.
+
+Three claims ride this bench, each against the committed budget in
+benchmarks/fleet_scale_budget.json (CI mode: `--check`, wired into
+`make check`):
+
+  * scrape fan-in — against a REAL 1,000-server simulated fleet
+    (runtime/simfleet.py, every instance an HTTP telemetry server with a
+    DCN-RTT stand-in handler delay), the two-tier shard tree
+    (shard_size=64: up to 8 shards x 8 members in flight) must beat the
+    flat scrape (one giant shard per role: 8 members in flight) by the
+    budgeted wall-clock ratio. The delay models the remote render+RTT a
+    one-host sim can't otherwise show; handler sleeps overlap, CPU work
+    doesn't, so the measured ratio UNDERSTATES the win on a real network.
+  * streaming merge memory — rendering the fleet view through
+    `StreamingMerger` (chunk by chunk, hashed and discarded) must peak
+    below the budgeted fraction of the dict-based `merge_expositions`
+    oracle's peak (which parses every shard into dicts and builds the
+    whole fleet string), while producing BYTE-IDENTICAL output (hashes
+    compared; a mismatch fails regardless of --check).
+  * reconcile at 10,000 groups — materializing a 10,000-group fleet from
+    seeded specs, and re-walking it at steady state (`resync()` enqueues
+    every object to every controller), must stay under the budgeted
+    per-group latencies. The steady-state row is the O(delta) memo claim:
+    a full no-op pass is bounded by read work, not write work.
+
+Run:    python benchmarks/fleet_scale_bench.py           # report
+CI:     python benchmarks/fleet_scale_bench.py --check   # enforce
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+import tracemalloc
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from lws_tpu.core.metrics import StreamingMerger, merge_expositions  # noqa: E402
+from lws_tpu.core.store import Store  # noqa: E402
+from lws_tpu.runtime.fleet import FleetCollector  # noqa: E402
+from lws_tpu.runtime.simfleet import SimFleet, seed_groups  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fleet_scale_budget.json")
+
+
+def median(xs: list) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def bench_scrape(n_instances: int, delay_s: float, passes: int) -> dict:
+    store = Store()
+    with SimFleet(store=store, n_instances=n_instances, seed=17,
+                  respond_delay_s=delay_s) as fleet:
+        fleet.tick(1)
+        # Flat = one shard per role (8 scrapes in flight); tree = the
+        # production shard_size (up to 64 in flight). Generous timeout
+        # (fan-in shape is the subject, not timeout policy) and near-zero
+        # backoff: a single transient miss on a loaded box must not
+        # exclude the instance from every later pass.
+        flat = FleetCollector(store, shard_size=10 ** 9, cache_ttl_s=0.0,
+                              timeout_s=30.0, backoff_base_s=1e-6)
+        tree = FleetCollector(store, shard_size=64, cache_ttl_s=0.0,
+                              timeout_s=30.0, backoff_base_s=1e-6)
+        # One warmup pass each: thread pools, lazy imports, socket caches.
+        flat.collect()
+        tree.collect()
+        def timed_full_pass(label: str, fc) -> tuple:
+            # Only full-coverage passes are fair timing samples: a pass
+            # degraded by transient socket pressure (CI box settling after
+            # a heavy neighbor) is retried, and only a SYSTEMATIC coverage
+            # gap fails the bench.
+            for attempt in range(4):
+                t0 = time.perf_counter()
+                srcs = fc.collect()
+                dt = time.perf_counter() - t0
+                if len(srcs) >= n_instances - 5:
+                    return dt, srcs
+                print(f"[fleet-scale] retry {label}: pass covered "
+                      f"{len(srcs)}/{n_instances}", file=sys.stderr)
+            raise AssertionError(
+                f"{label} scrape never reached coverage: "
+                f"{len(srcs)}/{n_instances}")
+
+        times: dict = {"flat": [], "tree": []}
+        for _ in range(passes):  # alternate so drift hits both equally
+            for label, fc in (("tree", tree), ("flat", flat)):
+                dt, sources = timed_full_pass(label, fc)
+                times[label].append(dt)
+        # Reuse the last tree collection as the merge section's input.
+        return {
+            "flat_s": median(times["flat"]),
+            "tree_s": median(times["tree"]),
+            "sources": sources,
+        }
+
+
+def bench_merge(sources: list) -> dict:
+    # The exact two-tier shape /metrics/fleet streams: per-shard merged
+    # texts re-merged at the root.
+    shard_sources = []
+    for i in range(0, len(sources), 64):
+        shard_sources.append(({}, merge_expositions(sources[i:i + 64])))
+    largest = max(len(t.encode()) for _, t in shard_sources)
+    total_in = sum(len(t.encode()) for _, t in shard_sources)
+
+    tracemalloc.start()
+    h_stream = hashlib.sha256()
+    out_bytes = 0
+    for chunk in StreamingMerger().merge(shard_sources):
+        data = chunk.encode()
+        h_stream.update(data)
+        out_bytes += len(data)
+    _, stream_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    # Root merges are UNCAPPED in both paths (the per-shard merges above
+    # already applied the default cap), matching what /metrics/fleet
+    # streams — at 1,000 instances a capped root would drop real workers.
+    tracemalloc.start()
+    oracle = merge_expositions(shard_sources, max_label_sets=None)
+    h_oracle = hashlib.sha256(oracle.encode())
+    _, oracle_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    assert h_stream.hexdigest() == h_oracle.hexdigest(), (
+        "streaming merge is NOT byte-identical to merge_expositions"
+    )
+    return {
+        "shards": len(shard_sources),
+        "largest_shard_bytes": largest,
+        "total_input_bytes": total_in,
+        "output_bytes": out_bytes,
+        "stream_peak_bytes": stream_peak,
+        "oracle_peak_bytes": oracle_peak,
+    }
+
+
+def bench_reconcile(n_groups: int) -> dict:
+    from lws_tpu.runtime import ControlPlane
+
+    cp = ControlPlane()
+    seed_groups(cp.store, n_groups)
+    t0 = time.perf_counter()
+    cp.run_until_stable(max_iterations=100 * n_groups)
+    materialize_s = time.perf_counter() - t0
+    n_pods = len(cp.store.list("Pod"))
+    assert n_pods >= n_groups, f"materialized {n_pods} pods for {n_groups}"
+    t0 = time.perf_counter()
+    cp.resync()
+    cp.run_until_stable(max_iterations=100 * n_groups)
+    steady_s = time.perf_counter() - t0
+    return {
+        "groups": n_groups,
+        "pods": n_pods,
+        "materialize_s": materialize_s,
+        "steady_resync_s": steady_s,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--instances", type=int, default=1000,
+                        help="simulated telemetry servers in the scrape rows")
+    parser.add_argument("--delay-ms", type=float, default=100.0,
+                        help="per-scrape handler delay (DCN RTT stand-in)")
+    parser.add_argument("--passes", type=int, default=3,
+                        help="measured scrape passes per layout (median, "
+                             "odd count rejects one outlier pass)")
+    parser.add_argument("--groups", type=int, default=10000,
+                        help="simulated groups in the reconcile rows")
+    parser.add_argument("--check", action="store_true",
+                        help="enforce fleet_scale_budget.json (CI mode)")
+    args = parser.parse_args()
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+
+    scrape = bench_scrape(args.instances, args.delay_ms / 1e3, args.passes)
+    speedup = scrape["flat_s"] / scrape["tree_s"]
+    print(json.dumps({
+        "metric": "two-tier scrape fan-in vs flat scrape",
+        "instances": args.instances,
+        "delay_ms": args.delay_ms,
+        "flat_s": round(scrape["flat_s"], 3),
+        "tree_s": round(scrape["tree_s"], 3),
+        "value": round(speedup, 3),
+        "unit": "x wall-clock speedup (median)",
+        "budget_min": budget["min_scrape_speedup"],
+        "within_budget": speedup >= budget["min_scrape_speedup"],
+    }))
+
+    merge = bench_merge(scrape.pop("sources"))
+    peak_ratio = merge["stream_peak_bytes"] / merge["oracle_peak_bytes"]
+    print(json.dumps({
+        "metric": "streaming fleet merge peak memory vs dict oracle "
+                  "(byte-identical output, hashes compared)",
+        "shards": merge["shards"],
+        "largest_shard_kb": merge["largest_shard_bytes"] // 1024,
+        "output_kb": merge["output_bytes"] // 1024,
+        "stream_peak_kb": merge["stream_peak_bytes"] // 1024,
+        "oracle_peak_kb": merge["oracle_peak_bytes"] // 1024,
+        "value": round(peak_ratio, 3),
+        "unit": "stream peak / oracle peak",
+        "budget_max": budget["max_stream_peak_ratio"],
+        "within_budget": peak_ratio <= budget["max_stream_peak_ratio"],
+    }))
+
+    rec = bench_reconcile(args.groups)
+    mat_us = rec["materialize_s"] / rec["groups"] * 1e6
+    steady_us = rec["steady_resync_s"] / rec["groups"] * 1e6
+    print(json.dumps({
+        "metric": "reconcile latency at scale (materialize from seeded "
+                  "specs; steady-state full resync = the O(delta) memo row)",
+        "groups": rec["groups"],
+        "pods": rec["pods"],
+        "materialize_s": round(rec["materialize_s"], 2),
+        "steady_resync_s": round(rec["steady_resync_s"], 2),
+        "materialize_us_per_group": round(mat_us, 1),
+        "steady_us_per_group": round(steady_us, 1),
+        "budget_max_materialize_us": budget["max_materialize_us_per_group"],
+        "budget_max_steady_us": budget["max_steady_resync_us_per_group"],
+        "within_budget": (
+            mat_us <= budget["max_materialize_us_per_group"]
+            and steady_us <= budget["max_steady_resync_us_per_group"]
+        ),
+    }), flush=True)
+
+    failures = []
+    if speedup < budget["min_scrape_speedup"]:
+        failures.append(
+            f"scrape speedup {speedup:.2f}x < {budget['min_scrape_speedup']}x")
+    if peak_ratio > budget["max_stream_peak_ratio"]:
+        failures.append(
+            f"stream peak ratio {peak_ratio:.2f} > "
+            f"{budget['max_stream_peak_ratio']}")
+    if mat_us > budget["max_materialize_us_per_group"]:
+        failures.append(
+            f"materialize {mat_us:.0f}us/group > "
+            f"{budget['max_materialize_us_per_group']}")
+    if steady_us > budget["max_steady_resync_us_per_group"]:
+        failures.append(
+            f"steady resync {steady_us:.0f}us/group > "
+            f"{budget['max_steady_resync_us_per_group']}")
+    if args.check and failures:
+        for f_ in failures:
+            print(f"[fleet-scale] FAIL: {f_}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

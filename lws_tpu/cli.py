@@ -651,7 +651,7 @@ def history_rates(ring, now: float | None = None, window_s: float = 30.0,
 def render_top(fams: dict, alerts: dict | None = None,
                prev: dict | None = None, dt_s: float | None = None,
                rows: dict | None = None, by_class: bool = False,
-               rates: dict | None = None) -> str:
+               rates: dict | None = None, top_k: int = 40) -> str:
     """One frame of `lws-tpu top`. `rates` (a `history_rates` fold over the
     HistoryRing) supplies the DISP/S, KV_MB/S, and windowed GOOD% cells —
     present from the very first frame when the ring was seeded from
@@ -661,7 +661,11 @@ def render_top(fams: dict, alerts: dict | None = None,
     precomputed _top_rows fold so --watch folds each frame once, not
     twice. With `by_class` (`--by-class`), class-labelled series split
     into one row per (instance, engine, klass) — `rows`/`prev`/`rates`
-    must then be by-class folds too."""
+    must then be by-class folds too. `top_k` bounds the table to the
+    worst rows (lowest SLO attainment first; rows without an attainment
+    gauge sort after the judged ones) with a truncation footer — at 1,000
+    instances an unbounded frame is a scroll buffer, not a view. 0 means
+    unbounded."""
     if rows is None:
         rows = _top_rows(fams, by_class=by_class)
     instances = None
@@ -687,14 +691,28 @@ def render_top(fams: dict, alerts: dict | None = None,
         return pattern.format(v) if v is not None else dash
 
     blank_key = (lambda i: (i, "-", "-")) if by_class else (lambda i: (i, "-"))
-    for key, r in sorted(rows.items()):
+    table = [
+        (key, r) for key, r in sorted(rows.items())
+        if not (key[1] == "-" and "requests" not in r and "slo" not in r)
+    ]  # drop fleet-plumbing rows without serving data
+    # Worst first: burning/missing-attainment rows must survive the bound.
+    table.sort(key=lambda kr: (kr[1].get("slo") is None,
+                               kr[1].get("slo") or 0.0, kr[0]))
+    hidden_instances: set = set()
+    hidden_rows = 0
+    if top_k and len(table) > top_k:
+        hidden_rows = len(table) - top_k
+        shown_instances = {key[0] for key, _ in table[:top_k]}
+        hidden_instances = {
+            key[0] for key, _ in table[top_k:]
+        } - shown_instances
+        table = table[:top_k]
+    for key, r in table:
         if by_class:
             instance, engine, klass = key
         else:
             instance, engine = key
             klass = None
-        if engine == "-" and "requests" not in r and "slo" not in r:
-            continue  # fleet-plumbing rows without serving data
         rr = (rates or {}).get(key, {})
         rate = rr.get("disp_rate")
         if rate is None and prev is not None and dt_s:
@@ -751,6 +769,10 @@ def render_top(fams: dict, alerts: dict | None = None,
             f"{fmt(rate, '{:.1f}'):>8}"
             f"{fmt(kv_rate, '{:.1f}'):>9}"
         )
+    if hidden_rows:
+        what = (f"{len(hidden_instances)} more instances"
+                if hidden_instances else f"{hidden_rows} more rows")
+        lines.append(f"… {what} (raise --top-k)")
     return "\n".join(lines)
 
 
@@ -838,6 +860,7 @@ def cmd_top(args) -> int:
             fams, alerts, prev=prev,
             dt_s=(now - prev_t) if prev_t is not None else None,
             rows=rows, by_class=by_class, rates=rates,
+            top_k=getattr(args, "top_k", 40),
         )
         if not args.watch:
             print(frame)
@@ -887,12 +910,15 @@ def _series_cells(kind: str, points: list) -> tuple[list, str]:
 
 def render_monitor(snapshot: dict, fams: dict | None = None,
                    alerts: dict | None = None, now: float | None = None,
-                   top_n: int = 24, name_filter: str = "") -> str:
+                   top_n: int = 24, name_filter: str = "",
+                   top_k: int = 40) -> str:
     """One frame of `lws-tpu monitor`: the /debug/history snapshot's series
     as sparklines (counters as rates, gauges raw), the burn-rate and
     scale-recommendation gauges folded from the metrics surface, and the
     firing alerts. Pure function of its inputs so tests drive it from
-    canned data."""
+    canned data. `top_k` bounds the burn table to the hottest rows
+    (highest burn first, truncation footer; 0 unbounded) — the fleet
+    surface carries one burn row per (instance, engine, window) at scale."""
     series = snapshot.get("series") or []
     header = (
         f"MONITOR  series={snapshot.get('series_total', len(series))}"
@@ -926,10 +952,14 @@ def render_monitor(snapshot: dict, fams: dict | None = None,
         if burns:
             lines.append("")
             lines.append(f"{'BURN SERIES':<28}{'WINDOW':<8}{'BURN':>8}")
-            for labels, value in sorted(
-                    burns, key=lambda b: (b[0].get("engine", ""),
-                                          b[0].get("klass", ""),
-                                          b[0].get("window", ""))):
+            # Hottest first, bounded: the burning rows must survive the
+            # bound, the calm tail is what the footer elides.
+            burns.sort(key=lambda b: (-b[1],
+                                      b[0].get("engine", ""),
+                                      b[0].get("klass", ""),
+                                      b[0].get("window", "")))
+            hidden = burns[top_k:] if top_k else []
+            for labels, value in (burns[:top_k] if top_k else burns):
                 key = labels.get("engine", "-")
                 if labels.get("klass"):
                     key += "/" + labels["klass"]
@@ -938,6 +968,16 @@ def render_monitor(snapshot: dict, fams: dict | None = None,
                 lines.append(
                     f"{key:<28}{labels.get('window', '-'):<8}{value:>7.1f}x"
                 )
+            if hidden:
+                shown_inst = {
+                    l.get("instance", "-") for l, _ in burns[:top_k]
+                }
+                hidden_inst = {
+                    l.get("instance", "-") for l, _ in hidden
+                } - shown_inst
+                what = (f"{len(hidden_inst)} more instances"
+                        if hidden_inst else f"{len(hidden)} more rows")
+                lines.append(f"… {what} (raise --top-k)")
     lines.append("")
     lines.append(f"{'SERIES':<58}{'LAST':>12}  TREND")
     shown = 0
@@ -1016,7 +1056,8 @@ def cmd_monitor(args) -> int:
                 f"error: cannot reach server {args.server}: {e.reason}"
             ) from None
         frame = render_monitor(snap, fams, alerts, top_n=args.top,
-                               name_filter=args.filter or "")
+                               name_filter=args.filter or "",
+                               top_k=getattr(args, "top_k", 40))
         if not args.watch:
             print(frame)
             return 0
@@ -1766,6 +1807,9 @@ def main(argv=None) -> int:
                     help="split class-labelled series into one row per "
                          "(instance, engine, class) — SLO/GOOD% per "
                          "workload class")
+    tp.add_argument("--top-k", type=int, default=40, dest="top_k",
+                    help="instance rows to render, worst SLO first "
+                         "(0 = unbounded)")
     tp.set_defaults(fn=cmd_top)
 
     mon = sub.add_parser("monitor", help="history-plane view: retained series "
@@ -1783,6 +1827,9 @@ def main(argv=None) -> int:
                      help="series rows to render")
     mon.add_argument("--limit", type=int, default=512,
                      help="series to fetch from /debug/history")
+    mon.add_argument("--top-k", type=int, default=40, dest="top_k",
+                     help="burn-table rows to render, hottest first "
+                          "(0 = unbounded)")
     mon.set_defaults(fn=cmd_monitor)
 
     ex = sub.add_parser("explain", help="request-journey forensics: one "

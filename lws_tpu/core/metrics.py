@@ -310,25 +310,34 @@ _SAMPLE_RE = re.compile(
 )
 
 
-def parse_exposition(text: str) -> dict:
-    """Prometheus text -> {family: {"type": t, "help": h, "samples":
-    [(sample_name, labels_dict, value, exemplar_suffix)]}}. Lenient enough
-    for production use (the fleet merger and `lws-tpu top` consume scraped
-    worker output); tests/test_dns_metrics.py keeps the strict
-    scraper-semantics validator."""
-    families: dict = {}
-    for line in text.strip().split("\n"):
+def parse_exposition_lines(lines):
+    """Incremental twin of parse_exposition: consume exposition lines one at
+    a time and yield parse events without materializing a families dict —
+    the building block StreamingMerger uses to merge shard expositions with
+    peak memory bounded by one family of one source, not the whole fleet.
+
+    Events:
+      ("help", family, help_text)
+      ("type", family, type)
+      ("sample", family, sample_name, labels_dict, value, exemplar_suffix)
+
+    Grammar and leniency match parse_exposition exactly (same sample regex,
+    same _bucket/_sum/_count folding against family names seen so far, other
+    comment lines skipped); a malformed sample line raises ValueError at the
+    line that fails."""
+    seen: dict[str, None] = {}
+    for line in lines:
         if not line.strip():
             continue
         if line.startswith("# HELP "):
             _, _, name, help_text = line.split(" ", 3)
-            families.setdefault(name, {"type": "untyped", "help": "", "samples": []})
-            families[name]["help"] = help_text
+            seen.setdefault(name)
+            yield ("help", name, help_text)
             continue
         if line.startswith("# TYPE "):
             _, _, name, ftype = line.split(" ", 3)
-            families.setdefault(name, {"type": "untyped", "help": "", "samples": []})
-            families[name]["type"] = ftype
+            seen.setdefault(name)
+            yield ("type", name, ftype)
             continue
         if line.startswith("#"):
             continue
@@ -338,24 +347,41 @@ def parse_exposition(text: str) -> dict:
         name = m.group("name")
         base = name
         for suffix in ("_bucket", "_sum", "_count"):
-            if base.endswith(suffix) and base[: -len(suffix)] in families:
+            if base.endswith(suffix) and base[: -len(suffix)] in seen:
                 base = base[: -len(suffix)]
                 break
-        if base not in families:
-            families[base] = {"type": "untyped", "help": "", "samples": []}
+        seen.setdefault(base)
         labels = {}
         for kv in (m.group("labels") or "").split(","):
             if kv:
                 k, _, v = kv.partition("=")
                 labels[k.strip()] = v.strip().strip('"')
-        families[base]["samples"].append(
-            (name, labels, float(m.group("value")), m.group("exemplar") or "")
-        )
+        yield ("sample", base, name, labels, float(m.group("value")),
+               m.group("exemplar") or "")
+
+
+def parse_exposition(text: str) -> dict:
+    """Prometheus text -> {family: {"type": t, "help": h, "samples":
+    [(sample_name, labels_dict, value, exemplar_suffix)]}}. Lenient enough
+    for production use (the fleet merger and `lws-tpu top` consume scraped
+    worker output); tests/test_dns_metrics.py keeps the strict
+    scraper-semantics validator. Built on parse_exposition_lines so the
+    batch and streaming parsers cannot drift."""
+    families: dict = {}
+    for ev in parse_exposition_lines(text.strip().split("\n")):
+        fam = ev[1]
+        slot = families.setdefault(fam, {"type": "untyped", "help": "", "samples": []})
+        if ev[0] == "help":
+            slot["help"] = ev[2]
+        elif ev[0] == "type":
+            slot["type"] = ev[2]
+        else:
+            slot["samples"].append((ev[2], ev[3], ev[4], ev[5]))
     return families
 
 
 def merge_expositions(
-    sources: list[tuple[dict, str]], max_label_sets: int = 512
+    sources: list[tuple[dict, str]], max_label_sets: int | None = 512
 ) -> str:
     """Merge scraped expositions into ONE valid fleet view: `sources` is
     [(extra_labels, exposition_text)] — each instance's samples get its
@@ -363,7 +389,10 @@ def merge_expositions(
     HELP/TYPE block, and the same per-family label-set cardinality cap as a
     registry applies (drops counted under the usual dropped-sample metric,
     labeled with the offending family). Exemplar suffixes survive the merge
-    verbatim."""
+    verbatim. The drop-accounting family renders LAST (not at its sorted
+    position): a single-pass streaming merge cannot know the drop counts of
+    families sorted after it, and StreamingMerger's output is contractually
+    byte-identical to this function's."""
     merged: dict[str, dict] = {}
     dropped: dict[str, int] = {}
     # Inner dicts as ordered sets (the module-level `set` gauge helper
@@ -380,13 +409,14 @@ def merge_expositions(
                 slot["help"] = data["help"]
             for name, labels, value, exemplar in data["samples"]:
                 labels = {**labels, **extra}
-                key = _lk({k: v for k, v in labels.items() if k != "le"})
-                sets = seen_sets[fam]
-                if key not in sets:
-                    if len(sets) >= max_label_sets:
-                        dropped[fam] = dropped.get(fam, 0) + 1
-                        continue
-                    sets[key] = None
+                if max_label_sets is not None:  # None: uncapped root merge
+                    key = _lk({k: v for k, v in labels.items() if k != "le"})
+                    sets = seen_sets[fam]
+                    if key not in sets:
+                        if len(sets) >= max_label_sets:
+                            dropped[fam] = dropped.get(fam, 0) + 1
+                            continue
+                        sets[key] = None
                 slot["lines"].append(f"{name}{_fmt(_lk(labels))} {value}{exemplar}")
     if dropped:
         slot = merged.setdefault(
@@ -399,13 +429,242 @@ def merge_expositions(
                 f'{DROPPED_METRIC}{_fmt(_lk({"metric": fam, "scope": "fleet"}))} {float(n)}'
             )
     lines: list[str] = []
-    for fam in sorted(merged):
+    fams = sorted(merged)
+    if DROPPED_METRIC in merged:  # drop accounting renders last (see docstring)
+        fams.remove(DROPPED_METRIC)
+        fams.append(DROPPED_METRIC)
+    for fam in fams:
         slot = merged[fam]
         ftype = slot["type"] if slot["type"] != "untyped" else "gauge"
         lines.append(f"# HELP {fam} {slot['help'] or _HELP.get(fam, fam)}")
         lines.append(f"# TYPE {fam} {ftype}")
         lines.extend(slot["lines"])
     return "\n".join(lines) + "\n"
+
+
+def _iter_exposition_lines(text: str):
+    """Yield exactly the sequence ``text.strip().split("\\n")`` yields,
+    WITHOUT materializing every line object up front: a 1,000-instance
+    fleet render walks megabytes of shard text per pass, and the split
+    lists (one str object per line, for every source at once) would cost
+    more than the dict-based oracle — the streaming bound lives here."""
+    start, end = 0, len(text)
+    while start < end and text[start].isspace():
+        start += 1
+    while end > start and text[end - 1].isspace():
+        end -= 1
+    pos = start
+    while True:
+        nl = text.find("\n", pos, end)
+        if nl < 0:
+            yield text[pos:end]
+            return
+        yield text[pos:nl]
+        pos = nl + 1
+
+
+class _FamilyCursor:
+    """One source's exposition as a cursor over per-family event runs.
+    `fam`/`ftype`/`help` describe the current family; `drain()` yields its
+    samples ONE at a time (folding HELP/TYPE into the cursor as they pass),
+    and `advance()` positions at the next family once drained — so live
+    parsed state never exceeds one sample per source. Enforces the streaming
+    contract — families contiguous and sorted — which every producer in this
+    codebase satisfies (registry renders and merge_expositions output both
+    sort families)."""
+
+    __slots__ = ("extra", "fam", "ftype", "help", "_events", "_pending",
+                 "_prev")
+
+    def __init__(self, extra: dict, lines) -> None:
+        self.extra = extra
+        self._events = parse_exposition_lines(lines)
+        self._pending = next(self._events, None)
+        self._prev: str | None = None
+        self.fam: str | None = None
+        self.advance()
+
+    def advance(self) -> None:
+        """Enter the family of the pending event (drain() must have been
+        exhausted first, or the remainder of the old family is skipped)."""
+        ev = self._pending
+        if ev is None:
+            self.fam = None
+            return
+        fam = ev[1]
+        # The drop-accounting family is exempt from the ordering contract:
+        # merge_expositions output (i.e. every shard text) renders it LAST,
+        # while a plain registry render has it at its sorted position.
+        if fam != DROPPED_METRIC:
+            if self._prev is not None and fam <= self._prev:
+                raise ValueError(
+                    f"source families not contiguous+sorted: {fam!r} after {self._prev!r}"
+                )
+            self._prev = fam
+        self.fam, self.ftype, self.help = fam, "untyped", ""
+
+    def drain(self):
+        """Yield (name, labels, value, exemplar) for the current family's
+        samples; on return, `ftype`/`help` hold the family's folded
+        metadata and the pending event is the next family's first."""
+        fam = self.fam
+        ev = self._pending
+        while ev is not None and ev[1] == fam:
+            if ev[0] == "help":
+                self.help = ev[2]
+            elif ev[0] == "type":
+                self.ftype = ev[2]
+            else:
+                yield (ev[2], ev[3], ev[4], ev[5])
+            ev = next(self._events, None)
+        self._pending = ev
+
+
+def _wellformed(lines) -> bool:
+    """Regex-only pre-validation scan (O(1) memory) for drop_malformed: True
+    iff every sample line parses and the family sequence is contiguous and
+    sorted, i.e. a _FamilyCursor would traverse the source without raising."""
+    cur = None
+    prev_ordered = None
+    try:
+        for ev in parse_exposition_lines(lines):
+            fam = ev[1]
+            if fam != cur:
+                if fam != DROPPED_METRIC:  # exempt, same as _FamilyCursor
+                    if prev_ordered is not None and fam <= prev_ordered:
+                        return False
+                    prev_ordered = fam
+                cur = fam
+    except ValueError:
+        return False
+    return True
+
+
+class StreamingMerger:
+    """Streaming twin of merge_expositions: a k-way per-family merge over
+    shard expositions that yields exposition text chunk by chunk, so
+    /metrics/fleet can write the fleet view to the wire without ever holding
+    it in one string — peak merge memory is O(largest shard), not O(fleet).
+
+    Byte identity: ``"".join(StreamingMerger(max_label_sets=n).merge(srcs))``
+    equals ``merge_expositions(srcs, max_label_sets=n)`` — same label
+    injection, HELP/TYPE dedup (first non-untyped type, first non-empty help,
+    in source order), per-family cardinality cap with drops counted under
+    the scope="fleet" drop lines, and the drop-accounting family last.
+    tests/test_streaming_merge.py pins the equivalence property.
+
+    With ``max_label_sets=None`` the merge is uncapped and keeps NO
+    fleet-wide seen-label-set state — the configuration the fleet server
+    streams with (per-shard merges are already capped upstream; a root cap
+    would need O(total label sets) memory and void the streaming bound).
+
+    Sources must have families contiguous and sorted (true of every registry
+    render and merge_expositions output). A violating or malformed source
+    raises ValueError mid-stream — or, with ``drop_malformed=True``, is
+    pre-validated with a cheap second scan and dropped whole (its index
+    recorded in ``dropped_sources``) so one bad shard never poisons the
+    fleet view."""
+
+    def __init__(self, max_label_sets: int | None = None,
+                 drop_malformed: bool = False) -> None:
+        self.max_label_sets = max_label_sets
+        self.drop_malformed = drop_malformed
+        self.dropped_sources: list[int] = []
+
+    def merge(self, sources: list[tuple[dict, str]]):
+        """Yield exposition chunks (one per family block). `sources` is
+        [(extra_labels, exposition_text)], same shape as merge_expositions."""
+        self.dropped_sources = []
+        cursors: list[_FamilyCursor] = []
+        for i, (extra, text) in enumerate(sources):
+            # Fresh lazy line iterators for each pass: the validation scan
+            # consumes one, the cursor walks another — never a split list.
+            if self.drop_malformed and not _wellformed(
+                    _iter_exposition_lines(text)):
+                self.dropped_sources.append(i)
+                continue
+            cursors.append(_FamilyCursor(extra, _iter_exposition_lines(text)))
+        # Inner dicts as ordered sets, same shadowed-builtin trick as above.
+        seen_sets: dict[str, dict] = defaultdict(dict)
+        dropped: dict[str, int] = {}
+        # Deferred drop-accounting records, (cursor_index, extra, type, help,
+        # samples): sources reach the family at different walk times, but the
+        # oracle admits + renders its lines in SOURCE order, so admission is
+        # replayed index-ordered at the end.
+        trail: list[tuple] = []
+        emitted = False
+        while True:
+            for ci, c in enumerate(cursors):
+                while c.fam == DROPPED_METRIC:
+                    samples = list(c.drain())  # tiny: drop-counter lines
+                    trail.append((ci, c.extra, c.ftype, c.help, samples))
+                    c.advance()
+            live = [c.fam for c in cursors if c.fam is not None]
+            if not live:
+                break
+            fam = min(live)
+            ftype, fhelp, out = "untyped", "", []
+            for c in cursors:
+                if c.fam != fam:
+                    continue
+                # drain() folds HELP/TYPE as a side effect, so the block
+                # metadata is read AFTER the samples stream through — same
+                # first-non-untyped/first-non-empty source-order fold as
+                # the oracle (metadata only renders in the block header).
+                for name, labels, value, exemplar in c.drain():
+                    labels = {**labels, **c.extra}
+                    if self.max_label_sets is not None:
+                        key = _lk({k: v for k, v in labels.items() if k != "le"})
+                        sets = seen_sets[fam]
+                        if key not in sets:
+                            if len(sets) >= self.max_label_sets:
+                                dropped[fam] = dropped.get(fam, 0) + 1
+                                continue
+                            sets[key] = None
+                    out.append(f"{name}{_fmt(_lk(labels))} {value}{exemplar}")
+                if ftype == "untyped" and c.ftype != "untyped":
+                    ftype = c.ftype
+                if not fhelp:
+                    fhelp = c.help
+                c.advance()
+            emitted = True
+            yield self._block(fam, ftype, fhelp, out)
+        ttype, thelp, tlines = "untyped", "", []
+        for _, extra, ftype, fhelp, samples in sorted(trail, key=lambda t: t[0]):
+            if ttype == "untyped" and ftype != "untyped":
+                ttype = ftype
+            if not thelp:
+                thelp = fhelp
+            for name, labels, value, exemplar in samples:
+                labels = {**labels, **extra}
+                if self.max_label_sets is not None:
+                    key = _lk({k: v for k, v in labels.items() if k != "le"})
+                    sets = seen_sets[DROPPED_METRIC]
+                    if key not in sets:
+                        if len(sets) >= self.max_label_sets:
+                            dropped[DROPPED_METRIC] = dropped.get(DROPPED_METRIC, 0) + 1
+                            continue
+                        sets[key] = None
+                tlines.append(f"{name}{_fmt(_lk(labels))} {value}{exemplar}")
+        if dropped:
+            if ttype == "untyped" and not trail:
+                ttype = "counter"
+            for fam, n in sorted(dropped.items()):
+                tlines.append(
+                    f'{DROPPED_METRIC}'
+                    f'{_fmt(_lk({"metric": fam, "scope": "fleet"}))} {float(n)}'
+                )
+        if trail or dropped:
+            emitted = True
+            yield self._block(DROPPED_METRIC, ttype, thelp, tlines)
+        if not emitted:
+            yield "\n"  # empty merge: byte-identical to merge_expositions
+
+    @staticmethod
+    def _block(fam: str, ftype: str, fhelp: str, sample_lines: list[str]) -> str:
+        shown = ftype if ftype != "untyped" else "gauge"
+        head = f"# HELP {fam} {fhelp or _HELP.get(fam, fam)}\n# TYPE {fam} {shown}\n"
+        return head + "".join(line + "\n" for line in sample_lines)
 
 
 # Process-default registry + conveniences: the serving data plane reports
@@ -500,8 +759,22 @@ describe("lws_watchdog_alerts_total", "Watchdog alert transitions (inactive -> f
 describe("lws_watchdog_active", "1 while the named watchdog alert is firing, else 0")
 describe("lws_flightrecorder_events_total", "Structured events appended to the flight-recorder ring")
 # --- fleet aggregation (runtime/fleet.py) ----------------------------------
-describe("lws_fleet_instances", "Ready workers the fleet scraper merged on the last pass")
+describe("lws_fleet_instances",
+         "Ready workers the fleet scraper merged on the last pass (unlabeled "
+         "= merged total, the historical series; state=scraped/failed/backoff "
+         "breaks the discovered fleet down by scrape outcome)")
 describe("lws_fleet_scrape_errors_total", "Worker telemetry scrapes (/metrics or /debug/profile) that failed, per instance")
+describe(
+    "lws_fleet_shard_scrape_seconds",
+    "Wall-clock of one shard collector's scrape pass (fan-out + per-shard "
+    "merge) in the two-tier fleet scrape tree, per shard — the tree keeps "
+    "this near-constant as the fleet widens",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+)
+describe("lws_fleet_shards_dropped_total",
+         "Shard expositions dropped whole by the streaming fleet merge "
+         "because the shard text failed validation (one bad shard never "
+         "poisons /metrics/fleet)")
 # --- continuous profiling + capacity accounting (core/profile.py) ----------
 describe("lws_profile_samples_total", "Thread samples folded into the collapsed-stack table by the wall-clock sampler")
 describe("lws_profile_stacks_dropped_total", "Samples whose NOVEL stack was dropped by the bounded collapsed-stack table")
@@ -539,6 +812,10 @@ describe("serving_journeys_dropped_total",
 # --- rollout intelligence plane (lws_tpu/obs/rollout.py) -------------------
 describe("lws_rollout_ledger_events_total",
          "Control-plane transitions recorded on the rollout timeline ledger, per kind (revision flips, partition moves, pod churn, drains, alerts)")
+describe("lws_rollout_ledger_dropped_total",
+         "Ledger entries evicted before retention expiry, per kind — by the "
+         "global capacity ring or the per-kind budget (a churn-noisy kind at "
+         "fleet scale must not push revision flips off the timeline)")
 describe("lws_rollout_canary_verdict",
          "Dry-run canary verdict per (lws, revision): +1 promote, 0 hold, -1 rollback — insufficient data holds, never promotes; actuation only through the opt-in RolloutActuationAdapter")
 describe("serving_slo_burn_rate_by_revision",

@@ -51,10 +51,16 @@ from lws_tpu.utils.common import env_float as _env_float
 
 HISTORY_INTERVAL_ENV = "LWS_TPU_HISTORY_INTERVAL_S"
 HISTORY_RETENTION_ENV = "LWS_TPU_HISTORY_RETENTION_S"
+HISTORY_SOURCE_SERIES_ENV = "LWS_TPU_HISTORY_SOURCE_SERIES"
 
 DEFAULT_INTERVAL_S = 5.0
 DEFAULT_RETENTION_S = 900.0
 DEFAULT_MAX_SERIES = 4096
+# Per-SOURCE series budget (series whose labels carry `instance`): at 1,000
+# instances the global cap alone would let the first few chatty workers own
+# the whole ring and starve every later one; the per-source budget keeps
+# admission fair. 0 disables.
+DEFAULT_MAX_SERIES_PER_SOURCE = 256
 
 # Sample-name suffixes that are cumulative by construction (histogram
 # decompositions): they get the same reset adjustment as counters.
@@ -110,13 +116,18 @@ class HistoryRing:
         retention_s: Optional[float] = None,
         max_series: int = DEFAULT_MAX_SERIES,
         metrics_registry=None,
+        max_series_per_source: Optional[int] = None,
     ) -> None:
         """`interval_s` gates `ingest_if_due` and the sampling thread
         (env LWS_TPU_HISTORY_INTERVAL_S, default 5s; 0 disables the
         thread); `retention_s` bounds every series' points (env
         LWS_TPU_HISTORY_RETENTION_S, default 900s). `metrics_registry`
         receives the ring's own health counters (defaults to the process
-        registry)."""
+        registry). `max_series_per_source` (env LWS_TPU_HISTORY_SOURCE_SERIES,
+        default 256, 0 disables) additionally budgets series per scrape
+        SOURCE — the `instance` label — so one chatty worker in a
+        1,000-instance fleet view cannot claim the global cap for itself;
+        budget refusals count under the same dropped-series counter."""
         self.interval_s = (
             interval_s if interval_s is not None
             else _env_float(HISTORY_INTERVAL_ENV, DEFAULT_INTERVAL_S)
@@ -126,15 +137,55 @@ class HistoryRing:
             else _env_float(HISTORY_RETENTION_ENV, DEFAULT_RETENTION_S)
         )
         self.max_series = max_series
+        self.max_series_per_source = (
+            max_series_per_source if max_series_per_source is not None
+            else int(_env_float(HISTORY_SOURCE_SERIES_ENV,
+                                DEFAULT_MAX_SERIES_PER_SOURCE))
+        )
         self._own_metrics = metrics_registry
         self._lock = threading.Lock()
         # (sample_name, sorted label tuple) -> _Series
         self._series: dict[tuple[str, tuple], _Series] = {}  # guarded-by: _lock
+        # instance label -> admitted series count (per-source budget ledger;
+        # decremented when the retention sweep deletes a series).
+        self._per_source: dict[str, int] = {}  # guarded-by: _lock
         self._last_ingest_t: Optional[float] = None  # guarded-by: _lock
         self._last_ingest_keys: set = set()  # guarded-by: _lock
         self._dropped = 0  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    # ---- admission -------------------------------------------------------
+    @staticmethod
+    def _source_of(label_items: tuple) -> Optional[str]:
+        for k, v in label_items:
+            if k == "instance":
+                return v
+        return None
+
+    def _admit_locked(self, key: tuple) -> bool:  # holds-lock: _lock
+        """Global cap + per-source budget gate for a NEW series key; charges
+        the source ledger on admission, counts the refusal otherwise."""
+        if len(self._series) >= self.max_series:
+            self._dropped += 1
+            return False
+        src = self._source_of(key[1])
+        if src is not None and self.max_series_per_source > 0:
+            if self._per_source.get(src, 0) >= self.max_series_per_source:
+                self._dropped += 1
+                return False
+            self._per_source[src] = self._per_source.get(src, 0) + 1
+        return True
+
+    def _forget_locked(self, key: tuple) -> None:  # holds-lock: _lock
+        """Release a deleted series' per-source budget slot."""
+        src = self._source_of(key[1])
+        if src is not None and src in self._per_source:
+            n = self._per_source[src] - 1
+            if n <= 0:
+                del self._per_source[src]
+            else:
+                self._per_source[src] = n
 
     # ---- ingestion -------------------------------------------------------
     def _inc_own(self, name: str, value: float = 1.0) -> None:
@@ -159,8 +210,7 @@ class HistoryRing:
                     key = (name, tuple(sorted(labels.items())))
                     series = self._series.get(key)
                     if series is None:
-                        if len(self._series) >= self.max_series:
-                            self._dropped += 1
+                        if not self._admit_locked(key):
                             continue
                         series = self._series[key] = _Series(
                             _series_kind(name, ftype)
@@ -175,6 +225,7 @@ class HistoryRing:
             for key in [k for k, s in self._series.items()
                         if s.last_t < cutoff]:
                 del self._series[key]
+                self._forget_locked(key)
             self._last_ingest_t = now
             self._last_ingest_keys = seen
             dropped = self._dropped
@@ -327,8 +378,7 @@ class HistoryRing:
                 key = (s["name"], tuple(sorted((s.get("labels") or {}).items())))
                 if key in self._series:
                     continue  # local observations win over seeded history
-                if len(self._series) >= self.max_series:
-                    self._dropped += 1
+                if not self._admit_locked(key):
                     continue
                 dest = self._series[key] = _Series(s.get("kind", "gauge"))
                 for t, v in pts:
@@ -354,6 +404,7 @@ class HistoryRing:
     def clear(self) -> None:
         with self._lock:
             self._series.clear()
+            self._per_source.clear()
             self._last_ingest_t = None
             self._last_ingest_keys = set()
 

@@ -56,10 +56,12 @@ from lws_tpu.utils.common import env_float as _env_float
 JOURNEYS_ENV = "LWS_TPU_JOURNEYS"          # "0" disables install()
 SAMPLE_ENV = "LWS_TPU_JOURNEY_SAMPLE"      # healthy reservoir fraction
 BUDGET_ENV = "LWS_TPU_JOURNEY_BUDGET"      # total retained span+event records
+SOURCE_BUDGET_ENV = "LWS_TPU_JOURNEY_SOURCE_BUDGET"  # per (klass, revision)
 RETENTION_ENV = "LWS_TPU_JOURNEY_RETENTION_S"
 
 DEFAULT_SAMPLE_RATE = 0.02
 DEFAULT_BUDGET_RECORDS = 8192
+DEFAULT_SOURCE_BUDGET_RECORDS = 2048
 DEFAULT_SLOWEST_K = 16
 DEFAULT_RETENTION_S = 900.0
 DEFAULT_MAX_OPEN_TRACES = 512
@@ -240,6 +242,7 @@ class JourneyVault:
     def __init__(
         self,
         budget_records: Optional[int] = None,
+        source_budget_records: Optional[int] = None,
         slowest_k: int = DEFAULT_SLOWEST_K,
         sample_rate: Optional[float] = None,
         retention_s: Optional[float] = None,
@@ -250,7 +253,11 @@ class JourneyVault:
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         """`budget_records` bounds the TOTAL retained span/event/annotation
-        records (env LWS_TPU_JOURNEY_BUDGET); `slowest_k` the healthy slow set;
+        records (env LWS_TPU_JOURNEY_BUDGET); `source_budget_records` bounds
+        each (klass, revision) source's share of it (env
+        LWS_TPU_JOURNEY_SOURCE_BUDGET, 0 disables) so one hot class at
+        fleet scale cannot evict every other source's tail evidence through
+        the global budget; `slowest_k` the healthy slow set;
         `sample_rate` the healthy reservoir fraction (env
         LWS_TPU_JOURNEY_SAMPLE); `retention_s` ages completed journeys out
         (env LWS_TPU_JOURNEY_RETENTION_S). `rng`/`clock` are injectable so
@@ -258,6 +265,10 @@ class JourneyVault:
         self.budget_records = int(
             budget_records if budget_records is not None
             else _env_float(BUDGET_ENV, DEFAULT_BUDGET_RECORDS)
+        )
+        self.source_budget_records = int(
+            source_budget_records if source_budget_records is not None
+            else _env_float(SOURCE_BUDGET_ENV, DEFAULT_SOURCE_BUDGET_RECORDS)
         )
         self.slowest_k = max(0, int(slowest_k))
         self.sample_rate = (
@@ -293,6 +304,9 @@ class JourneyVault:
         self._pending: "OrderedDict[str, _Journey]" = OrderedDict()  # guarded-by: _lock
         self._kept: "OrderedDict[str, _Journey]" = OrderedDict()  # guarded-by: _lock
         self._records = 0  # guarded-by: _lock — span+event records in _kept
+        # (klass, revision) -> retained records charged to that source; the
+        # fairness ledger behind source_budget_records.
+        self._source_records: dict = {}  # guarded-by: _lock
         # Disambiguates trace-derived keys when several requests complete
         # on one shared trace (engine paths have no wire request id).
         self._trace_seq = 0  # guarded-by: _lock
@@ -308,6 +322,25 @@ class JourneyVault:
     def _dropped(self, reason: str, n: int = 1) -> None:  # holds-lock: _lock
         self._inc("serving_journeys_dropped_total", {"reason": reason},
                   float(n))
+
+    # ---- source ledger ---------------------------------------------------
+    @staticmethod
+    def _source_of(j: _Journey) -> tuple:
+        return (j.klass or "", j.revision or "")
+
+    def _bump_source_locked(self, j: _Journey, n: int) -> None:  # holds-lock: _lock
+        """Adjust the (klass, revision) ledger by `n` records. klass and
+        revision are fixed at complete() before retention, so post-retention
+        record growth (late spans, events, annotations) charges the same
+        bucket the retention charge opened."""
+        if self.source_budget_records <= 0 or n == 0:
+            return
+        key = self._source_of(j)
+        total = self._source_records.get(key, 0) + n
+        if total > 0:
+            self._source_records[key] = total
+        else:
+            self._source_records.pop(key, None)
 
     # ---- feeds -----------------------------------------------------------
     def on_span(self, record: dict) -> None:
@@ -348,6 +381,7 @@ class JourneyVault:
                     owner.spans.append(record)
                     if owner.completed:
                         self._records += 1
+                        self._bump_source_locked(owner, 1)
                         self._enforce_budget_locked()
                 else:
                     owner.spans_dropped += 1
@@ -423,6 +457,7 @@ class JourneyVault:
             j.flags.add(flag)
             if j.completed:
                 self._records += 1
+                self._bump_source_locked(j, 1)
                 # A must-keep signal arriving after a sampled/slowest
                 # retention upgrades the journey's eviction class.
                 if j.outcome in ("sampled", "slowest"):
@@ -475,7 +510,9 @@ class JourneyVault:
             if tracked:
                 # Kept journeys are budget-tracked: annotation payloads
                 # attached after retention adjust the record count.
-                self._records += j.records() - before
+                delta = j.records() - before
+                self._records += delta
+                self._bump_source_locked(j, delta)
                 self._enforce_budget_locked()
 
     # ---- completion + retention ------------------------------------------
@@ -587,6 +624,7 @@ class JourneyVault:
             j.outcome = verdict_outcome
             self._kept[rid] = j
             self._records += j.records()
+            self._bump_source_locked(j, j.records())
             self._retained(verdict_outcome)
             self._enforce_budget_locked()
             return verdict_outcome
@@ -612,6 +650,7 @@ class JourneyVault:
             if j.latency_s > self._kept[floor_key].latency_s:
                 evicted = self._kept.pop(floor_key)
                 self._records -= evicted.records()
+                self._bump_source_locked(evicted, -evicted.records())
                 self._release_locked(evicted)
                 self._dropped("displaced", max(evicted.records(), 1))
                 return "slowest"
@@ -655,6 +694,7 @@ class JourneyVault:
                     if j.completed_mono < cutoff]:
             evicted = self._kept.pop(rid)
             self._records -= evicted.records()
+            self._bump_source_locked(evicted, -evicted.records())
             self._release_locked(evicted)
             self._dropped("aged", max(evicted.records(), 1))
 
@@ -663,21 +703,55 @@ class JourneyVault:
         healthy journeys, then the slowest set, and only then — when the
         must-keep class ALONE exceeds the budget — the oldest flagged
         journeys. A healthy-request flood can therefore never evict a
-        retained breached journey."""
-        if self._records <= self.budget_records:
-            return
-        for klass_pass in ("sampled", "slowest", None):
-            victims = [
-                rid for rid, j in self._kept.items()
-                if klass_pass is None or j.outcome == klass_pass
-            ]
-            for rid in victims:
+        retained breached journey. The per-source fairness bound is
+        enforced after the global one with the same pass order."""
+        if self._records > self.budget_records:
+            for klass_pass in ("sampled", "slowest", None):
+                victims = [
+                    rid for rid, j in self._kept.items()
+                    if klass_pass is None or j.outcome == klass_pass
+                ]
+                for rid in victims:
+                    if self._records <= self.budget_records:
+                        break
+                    evicted = self._kept.pop(rid)
+                    self._records -= evicted.records()
+                    self._bump_source_locked(evicted, -evicted.records())
+                    self._release_locked(evicted)
+                    self._dropped("budget", max(evicted.records(), 1))
                 if self._records <= self.budget_records:
-                    return
-                evicted = self._kept.pop(rid)
-                self._records -= evicted.records()
-                self._release_locked(evicted)
-                self._dropped("budget", max(evicted.records(), 1))
+                    break
+        self._enforce_source_budget_locked()
+
+    def _enforce_source_budget_locked(self) -> None:  # holds-lock: _lock
+        """Per-(klass, revision) fairness: at 1,000 instances × classes ×
+        revisions one hot source can stay under the GLOBAL budget while
+        monopolising it. Sources over their share evict within-source in
+        the same cheapest-truth-first order; losses count under the
+        existing drop convention as reason="source_budget"."""
+        if self.source_budget_records <= 0:
+            return
+        over = [key for key, n in self._source_records.items()
+                if n > self.source_budget_records]
+        for key in over:
+            for klass_pass in ("sampled", "slowest", None):
+                if (self._source_records.get(key, 0)
+                        <= self.source_budget_records):
+                    break
+                victims = [
+                    rid for rid, j in self._kept.items()
+                    if self._source_of(j) == key
+                    and (klass_pass is None or j.outcome == klass_pass)
+                ]
+                for rid in victims:
+                    if (self._source_records.get(key, 0)
+                            <= self.source_budget_records):
+                        break
+                    evicted = self._kept.pop(rid)
+                    self._records -= evicted.records()
+                    self._bump_source_locked(evicted, -evicted.records())
+                    self._release_locked(evicted)
+                    self._dropped("source_budget", max(evicted.records(), 1))
 
     # ---- views -----------------------------------------------------------
     def get(self, key: str) -> Optional[dict]:
@@ -763,6 +837,8 @@ class JourneyVault:
                 "kept": len(self._kept),
                 "records": self._records,
                 "budget_records": self.budget_records,
+                "source_budget_records": self.source_budget_records,
+                "sources": len(self._source_records),
                 "open_traces": len(self._open_traces),
                 "pending": len(self._pending),
             }
@@ -775,6 +851,7 @@ class JourneyVault:
             self._pending.clear()
             self._kept.clear()
             self._records = 0
+            self._source_records.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -98,9 +98,22 @@ class RolloutLedger:
 
     def __init__(self, capacity: int = DEFAULT_LEDGER_CAPACITY,
                  retention_s: float = DEFAULT_LEDGER_RETENTION_S,
-                 clock=time.monotonic, registry=None) -> None:
+                 clock=time.monotonic, registry=None,
+                 capacity_per_kind: Optional[int] = None) -> None:
+        """`capacity` bounds the whole timeline; `capacity_per_kind`
+        (default capacity // 4, floor 64) bounds any ONE kind's share so
+        fleet-scale pod churn cannot flush the partition moves and
+        revision flips out of the window. Capacity evictions are counted
+        (`lws_rollout_ledger_dropped_total{kind}`) — a silently shortened
+        timeline reads as a quiet rollout."""
         self.retention_s = retention_s
-        self._entries: deque = deque(maxlen=capacity)  # guarded-by: _lock
+        self.capacity = max(1, int(capacity))
+        self.capacity_per_kind = (
+            int(capacity_per_kind) if capacity_per_kind is not None
+            else max(64, self.capacity // 4)
+        )
+        self._entries: deque = deque()  # guarded-by: _lock
+        self._per_kind: dict = {}  # guarded-by: _lock — entry count by kind
         self._lock = threading.Lock()
         self._clock = clock
         self._registry = registry
@@ -127,8 +140,39 @@ class RolloutLedger:
         }
         with self._lock:
             self._entries.append(entry)
+            self._per_kind[kind] = self._per_kind.get(kind, 0) + 1
+            dropped = self._evict_locked(kind)
         self._reg().inc("lws_rollout_ledger_events_total", {"kind": kind})
+        for dkind, n in dropped.items():
+            self._reg().inc("lws_rollout_ledger_dropped_total",
+                            {"kind": dkind}, float(n))
         return entry
+
+    def _evict_locked(self, kind: str) -> dict:  # holds-lock: _lock
+        """Enforce the per-kind then the global capacity, oldest first;
+        returns {kind: evicted count} for the caller to count OUTSIDE the
+        lock (registry has its own lock — no nesting)."""
+        dropped: dict = {}
+
+        def _forget(victim: dict) -> None:
+            vkind = victim["kind"]
+            left = self._per_kind.get(vkind, 0) - 1
+            if left > 0:
+                self._per_kind[vkind] = left
+            else:
+                self._per_kind.pop(vkind, None)
+            dropped[vkind] = dropped.get(vkind, 0) + 1
+
+        if (self.capacity_per_kind > 0
+                and self._per_kind.get(kind, 0) > self.capacity_per_kind):
+            for victim in self._entries:
+                if victim["kind"] == kind:
+                    self._entries.remove(victim)
+                    _forget(victim)
+                    break
+        while len(self._entries) > self.capacity:
+            _forget(self._entries.popleft())
+        return dropped
 
     def observe_store_event(self, ev) -> None:
         """Store watch feed: diff the tracked fields of rollout-relevant
@@ -314,7 +358,12 @@ class RolloutLedger:
         cutoff = now - self.retention_s
         with self._lock:
             while self._entries and self._entries[0]["at"] < cutoff:
-                self._entries.popleft()
+                aged = self._entries.popleft()
+                left = self._per_kind.get(aged["kind"], 0) - 1
+                if left > 0:
+                    self._per_kind[aged["kind"]] = left
+                else:
+                    self._per_kind.pop(aged["kind"], None)
 
     def snapshot(self, limit: int = 256,
                  now: Optional[float] = None) -> list:
@@ -338,6 +387,7 @@ class RolloutLedger:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._per_kind.clear()
             self._state.clear()
 
 

@@ -72,13 +72,18 @@ class FleetCollector:
         metrics_registry=None,
         backoff_base_s: float = 2.0,
         backoff_cap_s: float = 60.0,
+        shard_size: int = 64,
     ) -> None:
         """`control_registries` join the merge as instance "control-plane";
         `metrics_registry` receives the collector's own health metrics
         (defaults to the first control registry, else the process one).
         `backoff_base_s`/`backoff_cap_s` shape the per-instance scrape
         backoff: a failing instance doubles its skip window per consecutive
-        miss up to the cap — the collector's circuit-breaker-lite."""
+        miss up to the cap — the collector's circuit-breaker-lite.
+        `shard_size` bounds one shard collector's member count in the
+        two-tier scrape tree (one shard per role-slice of at most this many
+        instances): scrape wall-clock then grows with shard depth, not
+        fleet width."""
         self.store = store
         self.control_registries = control_registries
         self.timeout_s = timeout_s
@@ -86,14 +91,20 @@ class FleetCollector:
         self.max_label_sets = max_label_sets
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
+        self.shard_size = max(1, shard_size)
         self._own_metrics = (
             metrics_registry if metrics_registry is not None
             else (control_registries[0] if control_registries else metrics.REGISTRY)
         )
         self._lock = threading.Lock()
         self._refill_lock = threading.Lock()
-        self._cached: Optional[str] = None  # guarded-by: _lock
-        self._cached_at = 0.0  # guarded-by: _lock
+        # Per-shard merged expositions, shard_id -> {"text", "at" (monotonic),
+        # "members" (instance-name tuple, so membership churn invalidates),
+        # "scraped"/"failed"/"skipped" counts}: the TTL cache now lives at
+        # shard granularity — a dashboard refresh re-renders the fleet view
+        # from cached shard texts without re-dialing anyone, and the fleet
+        # text itself is never cached whole (streaming bound).
+        self._shard_cache: dict[str, dict] = {}  # guarded-by: _lock
         # Instances currently failing to scrape, with per-instance backoff
         # state ({"failures": n, "until": monotonic}): a down worker is
         # SKIPPED until its backoff expires instead of being re-dialed (and
@@ -208,6 +219,116 @@ class FleetCollector:
                 )
             return None
 
+    # ---- two-tier scrape tree --------------------------------------------
+    def _shards(self, discovered) -> list[tuple[str, list]]:
+        """Partition discovered targets into shard collectors: role-major,
+        then slices of at most `shard_size` instances, members name-sorted
+        so a stable fleet yields stable shard membership (and the per-shard
+        cache actually hits). Shard ids are `{role}-{slice_index}`."""
+        by_role: dict[str, list] = {}
+        for labels, endpoint in discovered:
+            by_role.setdefault(labels.get("role") or "default", []).append(
+                (labels, endpoint)
+            )
+        shards: list[tuple[str, list]] = []
+        for role in sorted(by_role):
+            members = sorted(by_role[role], key=lambda t: t[0]["instance"])
+            for i in range(0, len(members), self.shard_size):
+                shards.append(
+                    (f"{role}-{i // self.shard_size}",
+                     members[i:i + self.shard_size])
+                )
+        return shards
+
+    def _prune_backoff(self, discovered) -> None:
+        """Prune backoff state for instances that LEFT the ready set: a pod
+        that restarted under the same name re-enters with a clean slate
+        (it went unready in between), and names that never return must
+        not accumulate in _failing forever."""
+        live_names = {labels["instance"] for labels, _ in discovered}
+        with self._lock:
+            for stale in [i for i in self._failing if i not in live_names]:
+                del self._failing[stale]
+
+    def _scrape_shard(self, shard_id: str, members: list,
+                      now: float) -> tuple[list, int, int]:
+        """One shard collector's pass: backoff-filter its members, scrape
+        the rest concurrently, time the whole thing. Returns
+        ([(labels, text)], n_failed, n_skipped). Failure isolation stays
+        per shard: a shard of timing-out instances burns ITS wall-clock
+        budget while its siblings proceed on the root pool."""
+        live = []
+        skipped = 0
+        for labels, endpoint in members:
+            if self.in_backoff(labels["instance"], now):
+                self._own_metrics.inc(
+                    "lws_fleet_scrape_skipped_total",
+                    {"instance": labels["instance"]},
+                )
+                skipped += 1
+                continue
+            live.append((labels, endpoint))
+        sources: list[tuple[dict, str]] = []
+        started = time.perf_counter()
+        if live:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(live))) as pool:
+                scraped = pool.map(
+                    lambda t: self._scrape_target(t[0], *t[1], now=now),
+                    live,
+                )
+                sources = [
+                    (labels, text)
+                    for (labels, _), text in zip(live, scraped)
+                    if text is not None
+                ]
+        self._own_metrics.observe(
+            "lws_fleet_shard_scrape_seconds",
+            time.perf_counter() - started,
+            {"shard": shard_id},
+        )
+        return sources, len(live) - len(sources), skipped
+
+    def _scrape_tree(self, now: float) -> list[tuple[str, list]]:
+        """The full two-tier pass: discovery, backoff pruning, shard
+        fan-out on a root pool (each shard fans out to its members on its
+        own pool), fleet gauges. Returns [(shard_id, [(labels, text)])]."""
+        discovered = self.targets()
+        self._prune_backoff(discovered)
+        shards = self._shards(discovered)
+        results: list[tuple[str, list]] = []
+        n_scraped = n_failed = n_backoff = 0
+        with trace.span("fleet.scrape", instances=len(discovered),
+                        shards=len(shards)):
+            if shards:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=min(8, len(shards))) as root:
+                    out = root.map(
+                        lambda s: self._scrape_shard(s[0], s[1], now), shards,
+                    )
+                    for (shard_id, _), (sources, failed, skipped) in zip(shards, out):
+                        results.append((shard_id, sources))
+                        n_scraped += len(sources)
+                        n_failed += failed
+                        n_backoff += skipped
+        self._set_fleet_gauges(n_scraped, n_failed, n_backoff)
+        return results
+
+    def _set_fleet_gauges(self, n_scraped: int, n_failed: int,
+                          n_backoff: int) -> None:
+        # Unlabeled total = merged instance count (the historical series
+        # dashboards already watch); the state breakdown rides alongside,
+        # zeros included so a recovering fleet visibly drains failed/backoff.
+        self._own_metrics.set("lws_fleet_instances", float(n_scraped))
+        self._own_metrics.set("lws_fleet_instances", float(n_scraped),
+                              {"state": "scraped"})
+        self._own_metrics.set("lws_fleet_instances", float(n_failed),
+                              {"state": "failed"})
+        self._own_metrics.set("lws_fleet_instances", float(n_backoff),
+                              {"state": "backoff"})
+
     def collect(self, now: Optional[float] = None) -> list[tuple[dict, str]]:
         """One scrape pass over the ready fleet: [(labels, exposition)].
         Control-plane registries ride along as instance "control-plane" so
@@ -217,45 +338,18 @@ class FleetCollector:
         expires (each consecutive miss doubles the window up to the cap),
         so a dead pod costs one timeout per backoff window, not one per
         cache refill. `now` (monotonic seconds) is injectable so the
-        backoff regression tests drive time deterministically. Targets are
-        scraped concurrently: a partitioned worker costs one timeout of
-        wall clock, not one per victim."""
+        backoff regression tests drive time deterministically. The pass
+        runs the two-tier shard tree under the hood (a partitioned worker
+        costs one timeout of SHARD wall clock, overlapped with its sibling
+        shards) and flattens the result for callers that want per-instance
+        sources."""
         if now is None:
             now = time.monotonic()
-        sources: list[tuple[dict, str]] = []
-        targets = []
-        discovered = self.targets()
-        # Prune backoff state for instances that LEFT the ready set: a pod
-        # that restarted under the same name re-enters with a clean slate
-        # (it went unready in between), and names that never return must
-        # not accumulate in _failing forever.
-        live_names = {labels["instance"] for labels, _ in discovered}
-        with self._lock:
-            for stale in [i for i in self._failing if i not in live_names]:
-                del self._failing[stale]
-        for labels, endpoint in discovered:
-            if self.in_backoff(labels["instance"], now):
-                self._own_metrics.inc(
-                    "lws_fleet_scrape_skipped_total",
-                    {"instance": labels["instance"]},
-                )
-                continue
-            targets.append((labels, endpoint))
-        with trace.span("fleet.scrape", instances=len(targets)):
-            if targets:
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
-                    scraped = pool.map(
-                        lambda t: self._scrape_target(t[0], *t[1], now=now),
-                        targets,
-                    )
-                    sources = [
-                        (labels, text)
-                        for (labels, _), text in zip(targets, scraped)
-                        if text is not None
-                    ]
-        self._own_metrics.set("lws_fleet_instances", float(len(sources)))
+        sources: list[tuple[dict, str]] = [
+            src
+            for _, shard_sources in self._scrape_tree(now)
+            for src in shard_sources
+        ]
         # Render the control plane LAST: this pass's own health metrics
         # (instance gauge, scrape-error counts) must appear in THIS pass's
         # merged view, not trail one scrape behind.
@@ -477,26 +571,108 @@ class FleetCollector:
             rows = rows[:limit] if limit else []
         return rows
 
-    def render_fleet(self, force: bool = False) -> str:
-        """The merged exposition, cached for `cache_ttl_s` (a dashboard
-        polling loop must not multiply into per-worker scrape storms).
-        Refills are single-flight: concurrent cache misses wait for the one
-        in-progress scrape instead of each launching their own pass."""
-        with self._lock:
-            if (not force and self._cached is not None
-                    and time.monotonic() - self._cached_at < self.cache_ttl_s):
-                return self._cached
+    def collect_shard_texts(self, force: bool = False,
+                            now: Optional[float] = None) -> list[tuple[str, str]]:
+        """[(shard_id, merged shard exposition)] over the ready fleet, the
+        control plane first as pseudo-shard "control-plane" (rendered fresh
+        every call: this pass's own health metrics must appear in this
+        pass's view). Shard texts are cached for `cache_ttl_s` keyed by
+        shard membership, and refills are single-flight: concurrent cache
+        misses wait for the one in-progress pass instead of each launching
+        their own scrape storm. Only STALE shards are re-scraped. The
+        per-family cardinality cap applies HERE, per shard — the root
+        streaming merge runs uncapped, because a fleet-wide cap would need
+        fleet-wide seen-label-set memory and void the O(largest shard)
+        streaming bound."""
+        if now is None:
+            now = time.monotonic()
         with self._refill_lock:
-            # Re-check under the refill lock: the scraper we waited on has
-            # just filled the cache for us.
+            discovered = self.targets()  # vet: ignore[lock-held-blocking]: single-flight by design — _refill_lock exists so ONE scrape pass runs while concurrent misses wait on it
+            self._prune_backoff(discovered)
+            shards = self._shards(discovered)
+            wall = time.monotonic()
+            stale: list[tuple[str, list]] = []
             with self._lock:
-                if (not force and self._cached is not None
-                        and time.monotonic() - self._cached_at < self.cache_ttl_s):
-                    return self._cached
-            merged = metrics.merge_expositions(
-                self.collect(), max_label_sets=self.max_label_sets  # vet: ignore[lock-held-blocking]: single-flight by design — _refill_lock exists so ONE scrape runs while concurrent misses wait on it
+                live_ids = {shard_id for shard_id, _ in shards}
+                for gone in [s for s in self._shard_cache if s not in live_ids]:
+                    del self._shard_cache[gone]
+                for shard_id, members in shards:
+                    names = tuple(labels["instance"] for labels, _ in members)
+                    entry = self._shard_cache.get(shard_id)
+                    if (force or entry is None or entry["members"] != names
+                            or wall - entry["at"] >= self.cache_ttl_s):
+                        stale.append((shard_id, members))
+            if stale:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with trace.span("fleet.scrape", instances=sum(
+                        len(m) for _, m in stale), shards=len(stale)):
+                    with ThreadPoolExecutor(
+                            max_workers=min(8, len(stale))) as root:
+                        out = root.map(  # vet: ignore[lock-held-blocking]: same single-flight refill — the scrape tree runs once under _refill_lock
+                            lambda s: self._scrape_shard(s[0], s[1], now),
+                            stale,
+                        )
+                        refreshed = {
+                            shard_id: (sources, failed, skipped)
+                            for (shard_id, _), (sources, failed, skipped)
+                            in zip(stale, out)
+                        }
+                refreshed_at = time.monotonic()
+                with self._lock:
+                    for (shard_id, members) in stale:
+                        sources, failed, skipped = refreshed[shard_id]
+                        self._shard_cache[shard_id] = {
+                            "text": metrics.merge_expositions(
+                                sources, max_label_sets=self.max_label_sets),
+                            "at": refreshed_at,
+                            "members": tuple(
+                                labels["instance"] for labels, _ in members),
+                            "scraped": len(sources),
+                            "failed": failed,
+                            "skipped": skipped,
+                        }
+            with self._lock:
+                entries = [(shard_id, self._shard_cache[shard_id])
+                           for shard_id, _ in shards
+                           if shard_id in self._shard_cache]
+                texts = [(shard_id, e["text"]) for shard_id, e in entries]
+                counts = [(e["scraped"], e["failed"], e["skipped"])
+                          for _, e in entries]
+            # Gauges reflect the whole tree — cached shards included —
+            # so a partial refresh never under-reports fleet size.
+            totals = [sum(c) for c in zip(*counts)] if counts else [0, 0, 0]
+            self._set_fleet_gauges(*totals)
+        if self.control_registries:
+            # The pseudo-shard goes through the same per-shard merge as a
+            # real one so its samples carry instance="control-plane" (the
+            # root streaming merge injects nothing).
+            texts.insert(0, ("control-plane", metrics.merge_expositions(
+                [({"instance": "control-plane"},
+                  metrics.render_exposition(*self.control_registries))],
+                max_label_sets=self.max_label_sets,
+            )))
+        return texts
+
+    def render_fleet_chunks(self, force: bool = False):
+        """The fleet exposition as a chunk generator: shard texts (cached,
+        single-flight — collect_shard_texts) fed through an UNCAPPED
+        streaming merge, so /metrics/fleet writes to the wire with peak
+        merge memory O(largest shard) and the whole-fleet text never
+        materializes. A shard whose cached text fails validation is dropped
+        whole (counted) instead of poisoning the view."""
+        shard_texts = self.collect_shard_texts(force=force)
+        merger = metrics.StreamingMerger(drop_malformed=True)
+        yield from merger.merge([({}, text) for _, text in shard_texts])
+        if merger.dropped_sources:
+            self._own_metrics.inc(
+                "lws_fleet_shards_dropped_total",
+                value=float(len(merger.dropped_sources)),
             )
-            with self._lock:
-                self._cached = merged
-                self._cached_at = time.monotonic()
-            return merged
+
+    def render_fleet(self, force: bool = False) -> str:
+        """The merged exposition as ONE string — the convenience join of
+        render_fleet_chunks for callers that genuinely need the whole text
+        (history-ring ingest, CLI one-shots, tests). The serving path
+        (runtime/server.py /metrics/fleet) streams the chunks instead."""
+        return "".join(self.render_fleet_chunks(force=force))

@@ -198,6 +198,39 @@ class ApiServer:
                 )
                 self._send(200, body, ctype)
 
+            def _stream_exposition(self, chunks) -> None:
+                """The streaming twin of _send_exposition: write exposition
+                chunks to the wire as they merge, close-delimited (HTTP/1.0,
+                no Content-Length) — the whole fleet text never exists
+                server-side. Per-chunk exemplar stripping and the trailing
+                `# EOF` replicate negotiate_exposition byte-for-byte (chunks
+                hold whole lines, so the line-anchored strip regex composes)."""
+                from lws_tpu.core import metrics as metricsmod
+
+                om = metricsmod.wants_openmetrics(self.headers.get("Accept"))
+                chunks = iter(chunks)
+                # Pull the first chunk BEFORE committing headers: a scrape
+                # pass that dies whole must 500, not truncate a 200.
+                try:
+                    first = next(chunks)
+                except StopIteration:
+                    first = "\n"
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    metricsmod.OPENMETRICS_CONTENT_TYPE if om else "text/plain",
+                )
+                self.end_headers()
+                import itertools
+
+                for chunk in itertools.chain((first,), chunks):
+                    if not om:
+                        chunk = metricsmod.strip_exemplars(chunk)
+                    if chunk:
+                        self.wfile.write(chunk.encode())
+                if om:
+                    self.wfile.write(b"# EOF\n")
+
             def _authorized(self) -> bool:
                 if auth is None:
                     return True
@@ -259,16 +292,20 @@ class ApiServer:
                         return
                     from lws_tpu.obs import history as historymod
 
-                    text = fleet.render_fleet()
                     # The instance-labelled fleet view is the control
                     # plane's history source: per-worker series ride the
-                    # process ring (interval-gated). Each fresh ingest also
-                    # evaluates the process-default dry-run recommender, so
+                    # process ring (interval-gated). The thunk keeps the
+                    # streaming bound honest: the whole-fleet text
+                    # materializes only when an ingest interval is actually
+                    # due (at most once per interval), never per scrape.
+                    # Each fresh ingest also evaluates the process-default
+                    # dry-run recommender, so
                     # `serving_scale_recommendation`/`serving_slo_burn_rate`
                     # and the `burn_rate` alert feed exist on every live
                     # deployment — published on the NEXT scrape, like every
                     # refresh-per-scrape gauge.
-                    if historymod.HISTORY.ingest_if_due(text):
+                    if historymod.HISTORY.ingest_if_due(
+                            lambda: fleet.render_fleet()):
                         from lws_tpu.obs import recommend as recmod
                         from lws_tpu.obs import rollout as rolloutmod
 
@@ -288,7 +325,7 @@ class ApiServer:
                                 cp.store).evaluate()
                         except Exception:  # vet: ignore[hazard-exception-swallow]: an analyzer hiccup must never 500 the fleet scrape (BLE001 intended)
                             pass
-                    self._send_exposition(text)
+                    self._stream_exposition(fleet.render_fleet_chunks())
                 elif path == "/debug/traces":
                     from urllib.parse import parse_qs, urlparse
 

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -74,10 +75,22 @@ def parse_profile_format(query: dict) -> str:
 
 class TelemetryServer:
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
-                 watchdog=None, token: Optional[str] = None) -> None:
+                 watchdog=None, token: Optional[str] = None,
+                 registry=None, respond_delay_s: float = 0.0) -> None:
         """`watchdog` (a flightrecorder.Watchdog) contributes alerts and the
         last diagnostics dump to /debug/flightrecorder; `token` gates every
-        path except /healthz behind `Authorization: Bearer <token>`."""
+        path except /healthz behind `Authorization: Bearer <token>`.
+
+        `registry` overrides the process-default metrics surface: /metrics
+        serves `registry.render()` and skips the process-global side effects
+        (device-memory refresh, SLO window refresh, history-ring feed) so
+        hundreds of simulated instances (runtime/simfleet.py) can serve
+        disjoint expositions from ONE process without cross-polluting the
+        process registries. `respond_delay_s` sleeps in the handler thread
+        before answering /metrics — the simulation's stand-in for DCN RTT +
+        remote render time, which is what makes flat-vs-tree scrape
+        wall-clock measurable on one host (sleeps overlap; GIL-bound CPU
+        work would not)."""
         from lws_tpu.core import faults as faultsmod
         from lws_tpu.core import flightrecorder as frmod
         from lws_tpu.core import metrics as metricsmod
@@ -123,17 +136,22 @@ class TelemetryServer:
                                "application/json")
                     return
                 if path == "/metrics":
-                    # Device-memory gauges are state, not a feed: refresh
-                    # them per scrape (guarded no-op on CPU backends). The
-                    # SLO attainment windows age-evict the same way — a
-                    # quiet engine must not advertise stale attainment.
-                    profmod.record_device_memory()
-                    slomod.RECORDER.refresh()
-                    text = metricsmod.REGISTRY.render()
-                    # The scrape opportunistically feeds the history ring
-                    # (interval-gated), so history accrues at scrape
-                    # cadence even without the sampling thread.
-                    historymod.HISTORY.ingest_if_due(text)
+                    if respond_delay_s > 0.0:
+                        time.sleep(respond_delay_s)  # simulated remote RTT
+                    if registry is not None:
+                        text = registry.render()
+                    else:
+                        # Device-memory gauges are state, not a feed: refresh
+                        # them per scrape (guarded no-op on CPU backends). The
+                        # SLO attainment windows age-evict the same way — a
+                        # quiet engine must not advertise stale attainment.
+                        profmod.record_device_memory()
+                        slomod.RECORDER.refresh()
+                        text = metricsmod.REGISTRY.render()
+                        # The scrape opportunistically feeds the history ring
+                        # (interval-gated), so history accrues at scrape
+                        # cadence even without the sampling thread.
+                        historymod.HISTORY.ingest_if_due(text)
                     body, ctype = metricsmod.negotiate_exposition(
                         text, self.headers.get("Accept")
                     )
@@ -253,11 +271,37 @@ class TelemetryServer:
                     self._send(404, json.dumps({"error": "unknown path"}),
                                "application/json")
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # Default backlog (5) drops SYNs when a fleet scraper's burst
+            # lands while the accept loop waits on the GIL; the kernel's
+            # 1s/2s/4s retransmit ladder then turns a 50ms scrape into
+            # seconds. Queue the burst instead.
+            request_queue_size = 128
+
+            def handle_error(self, request, client_address):
+                # A scraper hanging up mid-response (its timeout fired, the
+                # pool was torn down) is the CLIENT's failure accounting —
+                # `lws_fleet_scrape_errors_total` — not a server traceback;
+                # everything else keeps the stock stderr report.
+                import sys as _sys
+
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+                    return
+                super().handle_error(request, client_address)
+
+        self._httpd = _Server((host, port), Handler)
         self.port = self._httpd.server_port
 
     def start(self) -> None:
-        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        # 0.1s poll (not serve_forever's 0.5s default): shutdown() blocks
+        # until the serve loop's next poll, and simfleet stops hundreds of
+        # these — 0.5s apiece turns fleet teardown into minutes.
+        threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.1),
+            daemon=True,
+        ).start()
         if self.watchdog is not None:
             self.watchdog.start()
 

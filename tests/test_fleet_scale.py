@@ -1,0 +1,288 @@
+"""Fleet-scale telemetry (ISSUE 17): the simulation harness, the two-tier
+scrape tree, per-source series budgets, the O(delta) reconcile write
+contract, and the bounded CLI renders.
+
+Scale *claims* live in benchmarks/fleet_scale_bench.py (flat-vs-tree
+wall-clock, merge peak memory, 10,000-group reconcile latency); these
+tests pin the *semantics* at sizes tier-1 can afford: the harness is
+deterministic and discovery-faithful, the tree shards and degrades per
+shard, every budget drops loudly, and a steady-state reconcile writes
+nothing."""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+
+from lws_tpu.core.metrics import MetricsRegistry, parse_exposition
+from lws_tpu.core.store import Store
+from lws_tpu.runtime.fleet import FleetCollector
+from lws_tpu.runtime.simfleet import (
+    SimFleet,
+    SimFleetTarget,
+    SimInstance,
+    seed_groups,
+)
+
+# ---------------------------------------------------------------------------
+# The simulation harness
+
+
+def test_sim_instance_series_are_deterministic_and_schema_faithful():
+    a = SimInstance("sim-0000", "prefill", "rev-a", seed=42)
+    b = SimInstance("sim-0000", "prefill", "rev-a", seed=42)
+    other = SimInstance("sim-0000", "prefill", "rev-a", seed=43)
+    for inst in (a, b, other):
+        inst.tick(5)
+    assert a.registry.render() == b.registry.render()
+    assert a.registry.render() != other.registry.render()
+    fams = parse_exposition(a.registry.render())
+    # The SLO plane's families with the SLO plane's label composition —
+    # the canary/recommender folds key on exactly these.
+    assert fams["serving_tokens_total"]["type"] == "counter"
+    labels = dict(fams["serving_tokens_total"]["samples"][0][1])
+    assert labels == {"engine": "prefill", "klass": "chat",
+                      "revision": "rev-a"}
+    assert "serving_ttft_seconds" in fams
+    assert "serving_slo_attainment" in fams
+
+
+def test_sim_fleet_serves_real_telemetry_over_http():
+    with SimFleet(n_instances=2, seed=7) as fleet:
+        fleet.tick(2)
+        import urllib.request
+
+        port = fleet.instances[0].port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+        fams = parse_exposition(text)
+        assert fams["serving_requests_total"]["samples"]
+
+
+def test_sim_fleet_pods_discovered_and_sharded_by_tree_scrape():
+    store = Store()
+    own = MetricsRegistry()
+    with SimFleet(store=store, n_instances=10, seed=3) as fleet:
+        fleet.tick(1)
+        fc = FleetCollector(store, shard_size=4, metrics_registry=own,
+                            cache_ttl_s=0.0)
+        assert len(fc.targets()) == 10
+        text = fc.render_fleet(force=True)
+        fams = parse_exposition(text)
+        instances = {
+            dict(s[1]).get("instance")
+            for s in fams["serving_requests_total"]["samples"]
+        }
+        assert instances == {i.name for i in fleet.instances}
+        # 10 instances over 2 roles with shard_size=4: prefill 5 -> 2
+        # shards, decode 5 -> 2 shards; each observed its own latency.
+        shards = {
+            dict(labels)["shard"]
+            for name, labels, _, _ in parse_exposition(own.render()).get(
+                "lws_fleet_shard_scrape_seconds", {"samples": []})["samples"]
+            if name == "lws_fleet_shard_scrape_seconds_count"
+        }
+        assert shards == {"prefill-0", "prefill-1", "decode-0", "decode-1"}
+        assert own.gauge_value("lws_fleet_instances",
+                               {"state": "scraped"}) == 10.0
+        assert own.gauge_value("lws_fleet_instances",
+                               {"state": "failed"}) == 0.0
+
+
+def test_sim_fleet_target_speaks_the_loadgen_protocol():
+    with SimFleet(n_instances=3, seed=5) as fleet:
+        target = SimFleetTarget(fleet, seed=1)
+        req = SimpleNamespace(index=0, klass="chat", prompt=[1, 2],
+                              max_new_tokens=8)
+        handles = [target.submit(req, 0.0) for _ in range(6)]
+        target.step()
+        results = [target.poll(h) for h in handles]
+        assert all(r is not None and r["n_tokens"] == 8 for r in results)
+        assert all(target.poll(h) is None for h in handles)  # consumed
+        assert sum(i.requests for i in fleet.instances) == 6
+
+
+def test_seed_groups_totals_requested_group_count():
+    store = Store()
+    lwss = seed_groups(store, 1001, replicas_per_lws=500)
+    assert sum(l.spec.replicas for l in lwss) == 1001
+    assert len(store.list("LeaderWorkerSet")) == 3
+
+
+# ---------------------------------------------------------------------------
+# Per-source budgets (tentpole d): every bound drops loudly.
+
+
+def test_history_ring_per_source_budget_caps_one_instance():
+    from lws_tpu.obs.history import HistoryRing
+
+    reg = MetricsRegistry()
+    ring = HistoryRing(interval_s=0.0, retention_s=600.0,
+                       metrics_registry=reg, max_series_per_source=2)
+    src = MetricsRegistry()
+    for i in range(5):
+        src.set("serving_active_slots", 1.0,
+                {"engine": f"e{i}", "instance": "w-hot"})
+    src.set("serving_active_slots", 1.0,
+            {"engine": "e0", "instance": "w-calm"})
+    ring.ingest(src.render(), now=1.0)
+    snap = ring.snapshot()
+    by_source: dict = {}
+    for s in snap["series"]:
+        inst = (s.get("labels") or {}).get("instance")
+        by_source[inst] = by_source.get(inst, 0) + 1
+    assert by_source["w-hot"] == 2  # capped at the per-source budget
+    assert by_source["w-calm"] == 1  # the calm source was not starved
+    assert reg.counter_value("lws_history_series_dropped_total") == 3.0
+
+
+def test_journey_vault_source_budget_is_fair_across_sources():
+    from lws_tpu.obs.journey import JourneyVault
+
+    reg = MetricsRegistry()
+    v = JourneyVault(budget_records=1000, source_budget_records=3,
+                     sample_rate=0.0, slowest_k=0, rng=lambda: 1.0,
+                     registry=reg)
+
+    def breach(rid: str, klass: str, revision: str) -> None:
+        v.on_span({"name": "serve.request", "trace_id": f"t{rid}",
+                   "span_id": f"s{rid}", "parent_id": None,
+                   "start_unix": 1.0, "duration_s": 0.5, "status": "ok",
+                   "attrs": {}})
+        v.complete(rid, trace={"trace_id": f"t{rid}"}, klass=klass,
+                   revision=revision, ok=False, phases={"ttft_s": 2.0},
+                   targets={"ttft_s": 1.0})
+
+    for i in range(6):
+        breach(f"hot{i}", "chat", "rev-hot")
+    for i in range(2):
+        breach(f"calm{i}", "batch", "rev-calm")
+    # The hot source held to its share; the calm one untouched.
+    assert reg.counter_value(
+        "serving_journeys_dropped_total", {"reason": "source_budget"}) == 3.0
+    assert v.get("hot0") is None and v.get("hot5") is not None
+    assert v.get("calm0") is not None and v.get("calm1") is not None
+    stats = v.stats()
+    assert stats["sources"] == 2
+    assert stats["source_budget_records"] == 3
+    # The global budget path still works above the per-source one.
+    assert stats["records"] == 5
+
+
+def test_rollout_ledger_per_kind_budget_and_counted_eviction():
+    from lws_tpu.obs.rollout import RolloutLedger
+
+    reg = MetricsRegistry()
+    ledger = RolloutLedger(capacity=100, capacity_per_kind=3, registry=reg,
+                           clock=lambda: 50.0)
+    for i in range(5):
+        ledger.record("pod_created", obj=f"Pod p{i}")
+    ledger.record("revision_flip", obj="GroupSet g")
+    entries = ledger.snapshot(limit=100)
+    pods = [e for e in entries if e["kind"] == "pod_created"]
+    assert len(pods) == 3
+    assert pods[0]["object"] == "Pod p2"  # oldest two evicted
+    assert [e["kind"] for e in entries][-1] == "revision_flip"
+    assert reg.counter_value("lws_rollout_ledger_dropped_total",
+                             {"kind": "pod_created"}) == 2.0
+
+
+def test_rollout_ledger_global_capacity_still_counts_drops():
+    from lws_tpu.obs.rollout import RolloutLedger
+
+    reg = MetricsRegistry()
+    ledger = RolloutLedger(capacity=4, capacity_per_kind=0, registry=reg,
+                           clock=lambda: 50.0)
+    for i in range(6):
+        ledger.record("scale", obj=f"LeaderWorkerSet l{i}")
+    assert len(ledger.snapshot(limit=100)) == 4
+    assert reg.counter_value("lws_rollout_ledger_dropped_total",
+                             {"kind": "scale"}) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Satellite: O(delta) reconcile — a steady-state pass writes NOTHING.
+
+
+def test_steady_state_reconcile_writes_nothing_at_200_groups():
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.testing import LWSBuilder, make_all_groups_ready
+
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(200).size(1).build())
+    cp.run_until_stable()
+    make_all_groups_ready(cp, "sample")
+    cp.run_until_stable()
+    kinds = ("LeaderWorkerSet", "GroupSet", "Pod", "Service",
+             "ControllerRevision", "Event", "PodGroup")
+    before = {k: cp.store.kind_version(k) for k in kinds}
+    started = time.perf_counter()
+    cp.resync()  # enqueue EVERY object to every controller: a full pass
+    cp.run_until_stable()
+    elapsed = time.perf_counter() - started
+    after = {k: cp.store.kind_version(k) for k in kinds}
+    assert after == before, {
+        k: (before[k], after[k]) for k in kinds if after[k] != before[k]
+    }
+    # The per-replica memo makes the pass cheap, not just write-free;
+    # generous ceiling so slow CI never flakes.
+    assert elapsed < 30.0
+
+
+# ---------------------------------------------------------------------------
+# Satellite: bounded CLI renders.
+
+
+def test_render_top_bounds_rows_worst_first_with_footer():
+    from lws_tpu.cli import render_top
+
+    rows = {}
+    for i in range(50):
+        rows[(f"w{i:03d}", "paged")] = {
+            "slo": 1.0 - i * 0.01, "requests": 10.0,
+        }
+    frame = render_top({}, rows=rows, top_k=5)
+    body = frame.splitlines()
+    # Worst attainment first: w049 (0.51) leads, healthy w000 elided.
+    assert body[2].startswith("w049")
+    assert not any(line.startswith("w000") for line in body)
+    assert body[-1] == "… 45 more instances (raise --top-k)"
+    # Unbounded renders everything, no footer.
+    full = render_top({}, rows=rows, top_k=0)
+    assert any(line.startswith("w000") for line in full.splitlines())
+    assert "more instances" not in full
+
+
+def test_render_top_default_bound_matches_issue_contract():
+    from lws_tpu.cli import render_top
+
+    rows = {
+        (f"i{n:04d}", "paged"): {"slo": 0.99, "requests": 1.0}
+        for n in range(1000)
+    }
+    frame = render_top({}, rows=rows)
+    lines = frame.splitlines()
+    assert lines[-1] == "… 960 more instances (raise --top-k)"
+    assert len([l for l in lines if l.startswith("i")]) == 40
+
+
+def test_render_monitor_bounds_burn_table_hottest_first():
+    from lws_tpu.cli import render_monitor
+
+    samples = [
+        ("serving_slo_burn_rate",
+         {"engine": "paged", "instance": f"w{i:03d}", "window": "fast"},
+         float(i), None)
+        for i in range(10)
+    ]
+    fams = {"serving_slo_burn_rate": {"type": "gauge", "help": "",
+                                      "samples": samples}}
+    frame = render_monitor({"series": []}, fams, top_k=3)
+    lines = frame.splitlines()
+    burn_rows = [l for l in lines if "@w" in l]
+    assert len(burn_rows) == 3
+    assert "@w009" in burn_rows[0]  # hottest first
+    assert any("… 7 more instances (raise --top-k)" in l for l in lines)
+    unbounded = render_monitor({"series": []}, fams, top_k=0)
+    assert len([l for l in unbounded.splitlines() if "@w" in l]) == 10

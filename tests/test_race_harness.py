@@ -320,7 +320,7 @@ def test_fleet_single_flight_cache_churn_is_clean():
     threads: cache fields and the _failing set must stay lock-covered."""
     det = RaceDetector()
     fc = _collector()
-    det.watch(fc, {"_cached", "_cached_at", "_failing"}, name="FleetCollector")
+    det.watch(fc, {"_shard_cache", "_failing"}, name="FleetCollector")
 
     def renderer():
         for _ in range(20):

@@ -1,0 +1,223 @@
+"""Streaming exposition merge: byte-equivalence against the dict-based
+oracle, and shard-failure isolation.
+
+The /metrics/fleet surface renders shard-by-shard through
+`metrics.StreamingMerger` with peak memory O(largest shard); the dict-based
+`merge_expositions` remains the oracle. These tests pin the contract the
+tentpole rests on: for ANY parser-valid source set the streamed
+concatenation is BYTE-identical to the oracle's output — across exemplars,
+awkward label values, HELP/TYPE dedup, and cardinality-cap drops — and a
+malformed shard costs that shard, not the fleet view.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from lws_tpu.core import metrics
+from lws_tpu.core.metrics import (
+    DROPPED_METRIC,
+    MetricsRegistry,
+    StreamingMerger,
+    merge_expositions,
+    parse_exposition,
+)
+
+# ---------------------------------------------------------------------------
+# Deterministic exposition generator (property-style: many seeds, same code
+# path a worker's registry render takes — generated through a REAL registry
+# so the inputs are exactly what production shards look like).
+
+_FAMILIES = (
+    ("serving_requests_total", "counter"),
+    ("serving_active_slots", "gauge"),
+    ("serving_ttft_seconds", "histogram"),
+    ("zz_custom_total", "counter"),
+    ("aa_first_total", "counter"),
+)
+
+# Awkward-but-legal label values: spaces and quotes never render (the
+# registry writes values verbatim inside quotes), but dots, slashes,
+# colons, dashes, and backslashes all appear in pod names, image refs, and
+# file paths — the parse/render round trip must keep them byte-stable.
+_VALUES = ("paged", "a.b-c", "ns/pod-0", "rev:12", "w\\x", "chat", "")
+
+
+def _random_source(rng: random.Random, i: int) -> tuple[dict, str]:
+    reg = MetricsRegistry(max_label_sets=64)
+    for _ in range(rng.randrange(1, 12)):
+        fam, kind = _FAMILIES[rng.randrange(len(_FAMILIES))]
+        labels = {}
+        for k in ("engine", "klass", "path")[: rng.randrange(3)]:
+            labels[k] = _VALUES[rng.randrange(len(_VALUES))]
+        if kind == "counter":
+            reg.inc(fam, labels, float(rng.randrange(1, 100)))
+        elif kind == "gauge":
+            reg.set(fam, rng.random() * 10, labels)
+        else:
+            exemplar = None
+            if rng.random() < 0.5:
+                exemplar = {"trace_id": f"t{i}-{rng.randrange(999)}"}
+            reg.observe(fam, rng.random() * 2, labels, exemplar=exemplar)
+    extra = {"instance": f"w{i}"}
+    if rng.random() < 0.5:
+        extra["role"] = "prefill" if rng.random() < 0.5 else "decode"
+    return extra, reg.render()
+
+
+def _stream(sources, **kw) -> str:
+    return "".join(StreamingMerger(**kw).merge(sources))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_streaming_merge_matches_oracle_bytes(seed):
+    rng = random.Random(f"merge:{seed}")
+    sources = [_random_source(rng, i) for i in range(rng.randrange(1, 7))]
+    assert _stream(sources) == merge_expositions(sources)
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("cap", [1, 2, 512])
+def test_streaming_merge_matches_oracle_under_cardinality_cap(seed, cap):
+    """Cap drops are the hard case: the drop counter family renders LAST
+    and its admission order is the oracle's source order, not k-way walk
+    order."""
+    rng = random.Random(f"cap:{seed}")
+    sources = [_random_source(rng, i) for i in range(rng.randrange(2, 6))]
+    assert (_stream(sources, max_label_sets=cap)
+            == merge_expositions(sources, max_label_sets=cap))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_streaming_merge_of_merge_outputs_matches_oracle(seed):
+    """The fleet path re-merges per-shard MERGE OUTPUTS (which may carry
+    their own trailing drop-counter families) — the exact two-tier shape
+    /metrics/fleet streams."""
+    rng = random.Random(f"tier:{seed}")
+    shards = []
+    for s in range(3):
+        members = [_random_source(rng, s * 10 + i) for i in range(3)]
+        shards.append(({}, merge_expositions(members, max_label_sets=2)))
+    assert _stream(shards) == merge_expositions(shards)
+    assert DROPPED_METRIC in _stream(shards)  # the case actually exercised
+
+
+def test_streaming_merge_dedups_help_and_type_blocks():
+    srcs = [({"instance": f"w{i}"},
+             "# HELP zz_custom_total custom\n# TYPE zz_custom_total counter\n"
+             "zz_custom_total 1.0\n") for i in range(4)]
+    out = _stream(srcs)
+    assert out == merge_expositions(srcs)
+    assert out.count("# TYPE zz_custom_total") == 1
+    assert out.count("# HELP zz_custom_total") == 1
+    assert out.count('zz_custom_total{instance="w2"} 1.0') == 1
+
+
+def test_streaming_merge_preserves_exemplars_and_escapish_values():
+    reg = MetricsRegistry()
+    reg.observe("serving_ttft_seconds", 0.07,
+                {"engine": "paged", "path": "a\\b/c.d:e"},
+                exemplar={"trace_id": "abc123"})
+    srcs = [({"instance": "w0"}, reg.render())]
+    out = _stream(srcs)
+    assert out == merge_expositions(srcs)
+    assert "# {" in out and "abc123" in out
+    assert 'path="a\\b/c.d:e"' in out
+    # And the merged text stays parser-valid end to end.
+    fams = parse_exposition(out)
+    assert "serving_ttft_seconds" in fams
+
+
+def test_uncapped_root_merge_matches_oracle_above_default_cap():
+    """The fleet root is UNCAPPED in both merge paths (shards cap
+    upstream): at 1,000 instances a capped root would drop real workers.
+    merge_expositions(max_label_sets=None) must mirror the streaming
+    default past the 512 default cap."""
+    srcs = [({"instance": f"w{i:04d}"}, "serving_requests_total 1.0\n")
+            for i in range(600)]
+    uncapped = _stream(srcs)
+    assert uncapped == merge_expositions(srcs, max_label_sets=None)
+    assert uncapped.count("serving_requests_total{") == 600
+    assert DROPPED_METRIC not in uncapped
+    # And the capped pair still agrees with itself.
+    assert (_stream(srcs, max_label_sets=512) == merge_expositions(srcs))
+
+
+def test_streaming_merge_empty_sources_render_empty_exposition():
+    assert _stream([]) == merge_expositions([])
+    assert _stream([({}, "")]) == merge_expositions([({}, "")])
+
+
+def test_streaming_merger_is_incremental_not_monolithic():
+    """The generator must yield one block per family, not buffer the whole
+    text — the O(largest shard) memory bound depends on it."""
+    rng = random.Random("chunks")
+    sources = [_random_source(rng, i) for i in range(4)]
+    chunks = list(StreamingMerger().merge(sources))
+    assert len(chunks) > 1
+    fam_count = len(parse_exposition("".join(chunks)))
+    assert len(chunks) == fam_count  # one yielded chunk per family block
+
+
+def test_malformed_shard_is_isolated_not_fatal():
+    """drop_malformed: a shard answering garbage costs THAT shard; the
+    remaining shards still merge byte-identically to the oracle over the
+    surviving sources."""
+    rng = random.Random("broken")
+    good = [_random_source(rng, i) for i in range(3)]
+    bad = ({"instance": "w-broken"},
+           "serving_requests_total{ 1.0\ntotal garbage }{\n")
+    merger = StreamingMerger(drop_malformed=True)
+    out = "".join(merger.merge([good[0], bad, good[1], good[2]]))
+    assert merger.dropped_sources == [1]
+    assert out == merge_expositions(good)
+
+
+def test_malformed_shard_without_drop_flag_raises():
+    with pytest.raises(ValueError):
+        _stream([({}, "not { valid\n")])
+
+
+def test_fleet_render_counts_dropped_shards():
+    """FleetCollector.render_fleet_chunks survives a poisoned (cached)
+    shard text and counts it via lws_fleet_shards_dropped_total — the
+    fleet view keeps serving the healthy shards."""
+    import time as _time
+
+    from lws_tpu.api.pod import Container, EnvVar, Pod, PodPhase, PodSpec
+    from lws_tpu.core.store import new_meta
+    from lws_tpu.runtime.fleet import FleetCollector
+
+    reg = MetricsRegistry()
+    reg.inc("racetest_control_total")
+    pod = Pod(
+        meta=new_meta("sim-poison-0"),
+        spec=PodSpec(containers=[Container(
+            name="w", command=["sleep", "1"],
+            env=[EnvVar("LWS_TPU_METRICS_PORT", "1")],
+        )]),
+    )
+    pod.status.phase = PodPhase.RUNNING
+    pod.status.ready = True
+    pod.status.address = "127.0.0.1"
+
+    class _OnePodStore:
+        def list(self, kind):
+            return [pod] if kind == "Pod" else []
+
+    fc = FleetCollector(_OnePodStore(), control_registries=(reg,),
+                        metrics_registry=reg, cache_ttl_s=3600.0)
+    # A fresh, member-matched cache entry whose TEXT is garbage: the shard
+    # is current (no re-scrape), so the streamed merge is what must cope.
+    fc._shard_cache["default-0"] = {
+        "text": "garbage { text\n", "at": _time.monotonic(),
+        "members": ("sim-poison-0",), "scraped": 1, "failed": 0, "skipped": 0,
+    }
+    text = fc.render_fleet()
+    assert "racetest_control_total" in text
+    fams = parse_exposition(text)
+    assert "racetest_control_total" in fams
+    assert metrics.render_exposition(reg).count(
+        "lws_fleet_shards_dropped_total 1.0") == 1
