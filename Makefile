@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy clean
 
 test:
 	python -m pytest tests/ -q
@@ -45,7 +45,10 @@ bench-vet-wallclock:  ## the full whole-program vet suite must stay under its wa
 bench-fleet-scale:  ## 1,000-instance sim fleet: tree scrape must beat flat, streaming merge must beat the dict oracle's peak byte-identically, 10,000-group reconcile under per-group budgets (budget json)
 	python benchmarks/fleet_scale_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale  ## what CI would run (vet gates before tests)
+bench-prefix-hierarchy:  ## host-arena prefix restore must cut cold-HBM shared-prefix TTFT >=30% vs recompute, byte-identical, pool conserved (budget json)
+	python benchmarks/prefix_hierarchy_bench.py --check
+
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

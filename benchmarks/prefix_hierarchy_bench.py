@@ -1,0 +1,213 @@
+"""Prefix-hierarchy bench: host-arena spill/restore vs recompute (ISSUE 18).
+
+Measures TTFT (submit wall through the first token's device value) for a
+shared-prefix workload against a COLD HBM cache — the regime the spill
+tier exists for: the prefix was computed before, but pool pressure evicted
+it. Two configurations of the same paged engine:
+
+  * spill OFF — today's retained oracle: eviction drops the parked prefix
+    blocks, so every admission re-prefills the full prompt (one 512-token
+    bucket dispatch).
+  * spill ON  — eviction spills the blocks into the host arena
+    (LWS_TPU_KV_HOST_ARENA_MB semantics, wired directly); admission
+    restores them with donated per-block uploads and prefills only the
+    ~17-token suffix — HOST-tier hits.
+
+Each measured iteration re-evicts the prefix first (one bulk allocation
+that drains free + parked, then returns the blocks), so the HBM tier is
+cold EVERY time and the on/off difference is exactly restore-vs-recompute.
+
+Checked invariants (budget in prefix_hierarchy_budget.json, enforced by
+--check in `make check`):
+
+  * median TTFT reduction >= `min_ttft_reduction` (0.30) spill-on vs off;
+  * every spill-on admission restores all `prefix_blocks` shareable blocks
+    from the arena (host-tier hits — never a silent recompute win);
+  * token streams byte-identical between the modes for every prompt (the
+    restored K/V is the computed K/V, bit-for-bit through greedy decode);
+  * the pool conservation invariant (free + live + parked == num_blocks-1)
+    holds after every run.
+
+Run:    python benchmarks/prefix_hierarchy_bench.py           # report only
+CI:     python benchmarks/prefix_hierarchy_bench.py --check   # enforce
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+import bench  # noqa: E402
+
+bench.force_cpu_if_dev()  # axon plugin overrides JAX_PLATFORMS; see helper
+
+import jax.numpy as jnp  # noqa: E402
+
+from lws_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from lws_tpu.serving.kv_host_arena import KVHostArena  # noqa: E402
+from lws_tpu.serving.paged_engine import PagedBatchEngine  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "prefix_hierarchy_budget.json")
+
+BLOCK = 64
+PREFIX_BLOCKS = 7
+PREFIX_LEN = PREFIX_BLOCKS * BLOCK   # 448 shared tokens
+SUFFIX_LEN = 17                      # per-request tail past the shared run
+MAX_LEN = 1024
+MAX_NEW = 4                          # greedy continuation, byte-compared
+NUM_BLOCKS = 24
+REPEATS = 3
+
+
+def build_model():
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=128, n_layers=4, n_heads=4, n_kv_heads=4,
+        d_ff=256, max_seq_len=MAX_LEN, dtype=jnp.float32,
+        param_dtype=jnp.float32, remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return cfg, params
+
+
+def make_prompts(n: int) -> list[np.ndarray]:
+    """Shared-prefix workload: one 448-token prefix, n distinct suffixes."""
+    rng = np.random.RandomState(7)
+    prefix = rng.randint(1, 255, size=PREFIX_LEN).astype(np.int32)
+    return [
+        np.concatenate([
+            prefix, rng.randint(1, 255, size=SUFFIX_LEN).astype(np.int32)
+        ])
+        for _ in range(n)
+    ]
+
+
+def assert_conserved(engine) -> None:
+    free = set(engine._free_blocks)
+    parked = set(engine._lru)
+    live = set()
+    for req in engine._active.values():
+        live |= set(req.blocks)
+    assert free | parked | live == set(range(1, engine.num_blocks)), \
+        "pool blocks leaked or double-counted"
+    assert not (free & parked) and not (free & live) and not (parked & live)
+
+
+def force_evict(engine) -> None:
+    """Empty the HBM prefix tier: one bulk allocation drains free + parked
+    (evicting — and, spill-on, spilling — every parked block), then hands
+    the blocks straight back. The big-dummy-alloc cold-cache lever."""
+    n = len(engine._free_blocks) + len(engine._lru)
+    blocks = engine._alloc_blocks(n)
+    assert blocks is not None
+    engine._free_blocks.extend(sorted(blocks))
+    assert not engine._prefix_map, "eviction left the HBM tier warm"
+
+
+def run_mode(cfg, params, prompts, spill: bool) -> dict:
+    arena = KVHostArena(64 << 20) if spill else None
+    engine = PagedBatchEngine(
+        cfg, params, slots=2, max_len=MAX_LEN, block_size=BLOCK,
+        num_blocks=NUM_BLOCKS, prefix_cache=True, host_arena=arena,
+    )
+    # Warm OUTSIDE the timed windows: the plain-prefill bucket (prompt 0
+    # cold), then one cold-HBM admission (prompt 1) to compile the restore
+    # upload + suffix-prefill executables (spill on) or re-warm the plain
+    # path (spill off).
+    r = engine.submit(prompts[0], MAX_NEW)
+    assert r is not None
+    engine.run_until_drained()
+    force_evict(engine)
+    r = engine.submit(prompts[1], MAX_NEW)
+    assert r is not None
+    engine.run_until_drained()
+
+    host_hits_before = engine.stats_prefix["host_hits"]
+    walls, tokens = [], []
+    for prompt in prompts[2:]:
+        force_evict(engine)  # cold HBM tier EVERY iteration
+        t0 = time.perf_counter()
+        rid = engine.submit(prompt, MAX_NEW)
+        jax.block_until_ready(engine.tokens)  # first token device-visible
+        walls.append(time.perf_counter() - t0)
+        assert rid is not None
+        engine.run_until_drained()
+        tokens.append(engine.result(rid))
+        assert_conserved(engine)
+    host_hits = engine.stats_prefix["host_hits"] - host_hits_before
+    return {
+        "ttft_s": sorted(walls)[len(walls) // 2],
+        "walls": walls,
+        "tokens": tokens,
+        "host_hits": host_hits,
+        "spills": engine.stats_prefix["spills"],
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true",
+                        help="enforce prefix_hierarchy_budget.json (CI mode)")
+    args = parser.parse_args()
+
+    cfg, params = build_model()
+    prompts = make_prompts(2 + REPEATS)  # 2 warm + REPEATS measured
+
+    off = run_mode(cfg, params, prompts, spill=False)
+    on = run_mode(cfg, params, prompts, spill=True)
+
+    reduction = 1.0 - on["ttft_s"] / off["ttft_s"]
+    identical = on["tokens"] == off["tokens"]
+    full_restores = on["host_hits"] == PREFIX_BLOCKS * REPEATS
+
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    ok = (
+        identical and full_restores
+        and reduction >= budget["min_ttft_reduction"]
+    )
+    record = {
+        "metric": "shared-prefix TTFT against a cold HBM cache, host-arena "
+                  f"restore vs full recompute ({jax.default_backend()})",
+        "prefix_tokens": PREFIX_LEN,
+        "suffix_tokens": SUFFIX_LEN,
+        "spill_off": {"ttft_s": round(off["ttft_s"], 4),
+                      "walls": [round(w, 4) for w in off["walls"]]},
+        "spill_on": {"ttft_s": round(on["ttft_s"], 4),
+                     "walls": [round(w, 4) for w in on["walls"]],
+                     "host_hits": on["host_hits"],
+                     "spills": on["spills"]},
+        "ttft_reduction": round(reduction, 4),
+        "tokens_identical": identical,
+        "full_restores": full_restores,
+        "budget": budget,
+        "ok": ok,
+    }
+    print(json.dumps(record), flush=True)
+    if args.check and not ok:
+        print(
+            f"[prefix-hierarchy] FAIL: reduction {reduction:.2%} < budget "
+            f"{budget['min_ttft_reduction']:.0%}, or streams diverged "
+            f"(identical={identical}), or restores were partial "
+            f"(host_hits={on['host_hits']}, "
+            f"want {PREFIX_BLOCKS * REPEATS})",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

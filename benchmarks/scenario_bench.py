@@ -85,9 +85,18 @@ def run_scenario(name: str, seed: int) -> dict:
     targets = loadgen.install_class_targets(spec)
     target = loadgen.build_local_target("paged", spec)
     warm_target(target, spec)
+    def all_tier_hits():
+        # Tier-labelled since the spill hierarchy landed; the bench runs
+        # with the arena off, but sum the tiers so it stays honest if a
+        # future scenario turns spill on.
+        return sum(
+            metrics.REGISTRY.counter_value(
+                "serving_prefix_cache_hits_total",
+                {"engine": "paged", "tier": t})
+            for t in ("hbm", "host", "remote"))
+
     pfx_before = (
-        metrics.REGISTRY.counter_value(
-            "serving_prefix_cache_hits_total", {"engine": "paged"}),
+        all_tier_hits(),
         metrics.REGISTRY.counter_value(
             "serving_prefix_cache_misses_total", {"engine": "paged"}),
     )
@@ -95,8 +104,7 @@ def run_scenario(name: str, seed: int) -> dict:
     report = loadgen.summarize(
         result, targets, float(spec["horizon_s"]), name, seed
     )
-    hits = metrics.REGISTRY.counter_value(
-        "serving_prefix_cache_hits_total", {"engine": "paged"}) - pfx_before[0]
+    hits = all_tier_hits() - pfx_before[0]
     misses = metrics.REGISTRY.counter_value(
         "serving_prefix_cache_misses_total", {"engine": "paged"}) - pfx_before[1]
     total = report["all"]

@@ -549,7 +549,19 @@ def _top_rows(fams: dict, by_class: bool = False) -> dict:
     fold("serving_inflight_dispatches", "inflight")
     fold("serving_slo_attainment", "slo", reducer=lambda old, v: v)
     fold("serving_decode_dispatch_duration_seconds", "dispatches")
-    fold("serving_prefix_cache_hits_total", "pfx_hits")
+    # Prefix hits carry a tier label (hbm/host/remote) since the spill tier
+    # landed; fold the aggregate AND per-tier fields so render_top can show
+    # either the single PFX% column or the --by-tier breakdown. Legacy
+    # tier-less series (older workers mid-rollout) count as hbm.
+    for name, labels, value, _ in fams.get(
+            "serving_prefix_cache_hits_total", {}).get("samples", []):
+        if name != "serving_prefix_cache_hits_total":
+            continue
+        r = row(labels)
+        r["pfx_hits"] = r.get("pfx_hits", 0.0) + value
+        tier = labels.get("tier", "hbm") or "hbm"
+        field = f"pfx_hits_{tier}"
+        r[field] = r.get(field, 0.0) + value
     fold("serving_prefix_cache_misses_total", "pfx_misses")
     # Goodput ledger (core/slo.py): delivered vs delivered-on-time tokens.
     # Without --by-class the per-class series of one engine sum into its
@@ -651,7 +663,8 @@ def history_rates(ring, now: float | None = None, window_s: float = 30.0,
 def render_top(fams: dict, alerts: dict | None = None,
                prev: dict | None = None, dt_s: float | None = None,
                rows: dict | None = None, by_class: bool = False,
-               rates: dict | None = None, top_k: int = 40) -> str:
+               rates: dict | None = None, top_k: int = 40,
+               by_tier: bool = False) -> str:
     """One frame of `lws-tpu top`. `rates` (a `history_rates` fold over the
     HistoryRing) supplies the DISP/S, KV_MB/S, and windowed GOOD% cells —
     present from the very first frame when the ring was seeded from
@@ -681,9 +694,13 @@ def render_top(fams: dict, alerts: dict | None = None,
         for d in details:
             lines.append(f"  ALERT {name}: {json.dumps(d)}")
     klass_col = f"{'CLASS':<9}" if by_class else ""
+    # --by-tier splits PFX% into the hierarchy's shares of all lookups
+    # (h=hbm resident, H=host arena restore, R=remote sibling fetch), so
+    # h+H+R = PFX% and the gap to 100% is the miss (recompute) share.
+    tier_cols = f"{'h%':>5}{'H%':>5}{'R%':>5}" if by_tier else ""
     lines.append(
         f"{'INSTANCE':<18}{'ENGINE':<9}{klass_col}{'SLO':>6}{'REQS':>7}{'ACTIVE':>7}"
-        f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{'SPEC%':>7}{'GOOD%':>7}{'TTFT_P95':>10}"
+        f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{tier_cols}{'SPEC%':>7}{'GOOD%':>7}{'TTFT_P95':>10}"
         f"{'ITL_P95':>10}{'DISP/S':>8}{'KV_MB/S':>9}"
     )
 
@@ -737,9 +754,12 @@ def render_top(fams: dict, alerts: dict | None = None,
         if pool > 0:
             kv = r.get("kv_live", 0.0) / pool
         pfx = None
+        tier_share = {"hbm": None, "host": None, "remote": None}
         lookups = r.get("pfx_hits", 0.0) + r.get("pfx_misses", 0.0)
         if lookups > 0:
             pfx = r.get("pfx_hits", 0.0) / lookups
+            for tier in tier_share:
+                tier_share[tier] = r.get(f"pfx_hits_{tier}", 0.0) / lookups
         # Speculation accept rate: accepted/drafted draft tokens. Low SPEC%
         # with speculation on means gamma is burning verify width for
         # nothing on this traffic (docs/tasks/speculative-decoding.md).
@@ -754,6 +774,11 @@ def render_top(fams: dict, alerts: dict | None = None,
         if good is None and r.get("tokens", 0.0) > 0:
             good = r.get("good_tokens", 0.0) / r["tokens"]
         klass_cell = f"{klass:<9}" if by_class else ""
+        tier_cells = "" if not by_tier else (
+            f"{fmt(tier_share['hbm'], '{:.0%}'):>5}"
+            f"{fmt(tier_share['host'], '{:.0%}'):>5}"
+            f"{fmt(tier_share['remote'], '{:.0%}'):>5}"
+        )
         lines.append(
             f"{instance:<18}{engine:<9}{klass_cell}"
             f"{fmt(r.get('slo'), '{:.2f}'):>6}"
@@ -761,7 +786,7 @@ def render_top(fams: dict, alerts: dict | None = None,
             f"{fmt(r.get('active'), '{:.0f}'):>7}"
             f"{fmt(r.get('inflight'), '{:.0f}'):>6}"
             f"{fmt(kv, '{:.0%}'):>6}"
-            f"{fmt(pfx, '{:.0%}'):>6}"
+            f"{fmt(pfx, '{:.0%}'):>6}{tier_cells}"
             f"{fmt(spec, '{:.0%}'):>7}"
             f"{fmt(good, '{:.0%}'):>7}"
             f"{fmt(r.get('ttft_p95'), '{:.3f}s'):>10}"
@@ -861,6 +886,7 @@ def cmd_top(args) -> int:
             dt_s=(now - prev_t) if prev_t is not None else None,
             rows=rows, by_class=by_class, rates=rates,
             top_k=getattr(args, "top_k", 40),
+            by_tier=getattr(args, "by_tier", False),
         )
         if not args.watch:
             print(frame)
@@ -1810,6 +1836,10 @@ def main(argv=None) -> int:
     tp.add_argument("--top-k", type=int, default=40, dest="top_k",
                     help="instance rows to render, worst SLO first "
                          "(0 = unbounded)")
+    tp.add_argument("--by-tier", action="store_true", dest="by_tier",
+                    help="split PFX% by cache tier: h%% (HBM resident), "
+                         "H%% (host arena restore), R%% (remote sibling "
+                         "fetch) — shares of all lookups, so h+H+R = PFX%%")
     tp.set_defaults(fn=cmd_top)
 
     mon = sub.add_parser("monitor", help="history-plane view: retained series "

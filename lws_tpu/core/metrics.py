@@ -781,9 +781,19 @@ describe("lws_profile_stacks_dropped_total", "Samples whose NOVEL stack was drop
 describe("serving_hbm_bytes_in_use", "Device memory in use per local device (jax allocator stats; absent on CPU)")
 describe("serving_hbm_bytes_limit", "Device memory capacity per local device (jax allocator stats; absent on CPU)")
 describe("serving_kv_pool_blocks", "Paged KV pool blocks by state (free / live / parked) — states sum to the pool size minus the null block")
-describe("serving_prefix_cache_hits_total", "Prefix-cache block lookups served from the pool (tokens skipped = hits x block_size)")
-describe("serving_prefix_cache_misses_total", "Shareable prompt blocks that had to be prefilled (no cached prefix)")
+describe("serving_prefix_cache_hits_total", "Prefix-cache block lookups served without recompute, per tier (hbm = resident pool block, host = restored from the spill arena, remote = fetched from a sibling over the KV wire); tokens skipped = hits x block_size")
+describe("serving_prefix_cache_misses_total", "Shareable prompt blocks that had to be prefilled (no cached prefix in any tier)")
 describe("serving_prefix_cache_evictions_total", "LRU-parked prefix blocks evicted to satisfy new allocations")
+# --- hierarchical prefix cache: host spill tier (serving/kv_host_arena.py) -
+describe("serving_kv_spill_bytes_total",
+         "Prefix-block bytes crossing the HBM/host boundary: direction=spill "
+         "(evicted block packed into the host arena) vs direction=restore "
+         "(arena or remote bytes uploaded back into a pool block)")
+describe("serving_kv_host_arena_bytes",
+         "Bytes resident in the host-RAM prefix spill arena (bounded by "
+         "LWS_TPU_KV_HOST_ARENA_MB)")
+describe("serving_kv_host_arena_entries",
+         "Spilled prefix blocks resident in the host arena")
 # --- resilience + fault injection (core/resilience.py, core/faults.py) -----
 describe("serving_retries_total", "Retry events per call site and outcome (retry / recovered / exhausted / budget_exhausted)")
 describe("serving_deadline_expirations_total", "Calls aborted (or work dropped) at a blocking point because the request deadline had expired, per site")

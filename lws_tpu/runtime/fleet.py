@@ -571,6 +571,69 @@ class FleetCollector:
             rows = rows[:limit] if limit else []
         return rows
 
+    def collect_prefix_index(self, limit: int = 512) -> dict:
+        """Fleet-merged prefix-cache digest index (ISSUE 18, the remote
+        tier's discovery half): every ready worker's `GET /debug/prefixes`
+        advertisement folded into digest-hex -> {instance, host, port,
+        tier}, where (host, port) is the sibling's KV wire endpoint a
+        `fetch_prefix` should dial. Arena-backed entries win over
+        HBM-resident ones for the same digest: the default fetch provider
+        serves the host arena, so those are the fetchable copies. Instances
+        that advertise no KV port contribute nothing fetchable and are
+        skipped."""
+        from lws_tpu.core import trace
+
+        index: dict[str, dict] = {}
+        targets = self.targets()
+        if not targets:
+            return {"digests": {}, "instances": 0}
+        from concurrent.futures import ThreadPoolExecutor
+
+        path = f"/debug/prefixes?limit={int(limit)}"
+        answered = 0
+        with trace.span("fleet.prefix_scrape", instances=len(targets)):
+            with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+                scraped = pool.map(
+                    lambda t: self._scrape_debug_json(
+                        t[0], *t[1], path, missing_ok=False
+                    ),
+                    targets,
+                )
+                for (labels, (host, _mport)), got in zip(targets, scraped):
+                    if not isinstance(got, dict):
+                        continue
+                    answered += 1
+                    kv_port = got.get("kv_port")
+                    if not kv_port:
+                        continue
+                    for tier, key in (("hbm", "digests"),
+                                      ("host", "arena_digests")):
+                        for hexd in got.get(key) or []:
+                            have = index.get(hexd)
+                            if have is None or (
+                                tier == "host" and have["tier"] == "hbm"
+                            ):
+                                index[hexd] = {
+                                    "instance": labels.get("instance", "-"),
+                                    "host": host,
+                                    "port": int(kv_port),
+                                    "tier": tier,
+                                }
+        return {"digests": index, "instances": answered}
+
+    def prefix_lookup(self, limit: int = 512):
+        """A `RemotePrefixSource`-shaped lookup closure over a fresh
+        digest index snapshot: digest_hex -> (host, kv_port) | None."""
+        snapshot = self.collect_prefix_index(limit)["digests"]
+
+        def lookup(digest_hex: str):
+            entry = snapshot.get(digest_hex)
+            if entry is None:
+                return None
+            return entry["host"], entry["port"]
+
+        return lookup
+
     def collect_shard_texts(self, force: bool = False,
                             now: Optional[float] = None) -> list[tuple[str, str]]:
         """[(shard_id, merged shard exposition)] over the ready fleet, the

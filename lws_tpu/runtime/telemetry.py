@@ -235,6 +235,24 @@ class TelemetryServer:
                         return
                     self._send(200, json.dumps(body, default=str),
                                "application/json")
+                elif path == "/debug/prefixes":
+                    # Prefix-cache digest advertisement (ISSUE 18): the
+                    # engine's resident digests + its host arena's spilled
+                    # digests, plus the KV port a sibling fetch_prefix
+                    # should dial. The FleetCollector merges these into the
+                    # digest -> instance index behind the remote tier.
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": f"bad limit: {e}"}),
+                                   "application/json")
+                        return
+                    # Lazy import: telemetry must stay importable (and
+                    # light) in processes that never load the serving stack.
+                    from lws_tpu.serving import kv_host_arena as _kha
+
+                    self._send(200, json.dumps(_kha.debug_prefixes(limit)),
+                               "application/json")
                 elif path == "/debug/faults":
                     self._send(200, json.dumps(faultsmod.INJECTOR.snapshot()),
                                "application/json")

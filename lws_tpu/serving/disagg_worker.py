@@ -198,6 +198,19 @@ def _start_telemetry():
     return server
 
 
+def _serve_prefix_tier(server) -> None:
+    """Join the cross-instance prefix tier: advertise this worker's KV wire
+    port for sibling `fetch_prefix` calls (the control plane folds it into
+    /debug/prefixes -> FleetCollector's digest index) and, when the host
+    arena is enabled (LWS_TPU_KV_HOST_ARENA_MB), serve arena-resident
+    spilled blocks over that wire. Serving costs no device traffic — the
+    arena holds wire-format host bytes already."""
+    from lws_tpu.serving import kv_host_arena
+
+    kv_host_arena.register_fetch_port(server.port)
+    server.serve_prefixes(kv_host_arena.get_spilled)
+
+
 def kv_chunk_tokens() -> int:
     """The streamed-handoff chunk size knob (`LWS_TPU_KV_CHUNK`, position
     rows per stream chunk; default 256). 0 selects the monolithic
@@ -339,6 +352,7 @@ def run_prefill_tcp(once: bool, max_len: int) -> int:
     _start_telemetry()
     engine = build_engine(batch=1, max_len=max_len)
     server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
+    _serve_prefix_tier(server)
     chunk_tokens = kv_chunk_tokens()
     print(f"[prefill {os.environ.get('POD_NAME', '?')}] serving KV on :{server.port}"
           f" (kv_chunk={chunk_tokens})", flush=True)
@@ -465,6 +479,7 @@ def run_decode_tcp(
     _start_telemetry()
     engine = build_engine(batch=1, max_len=max_len)
     server = kt.KVServer(port=int(os.environ.get("LWS_TPU_KV_PORT", "0")))
+    _serve_prefix_tier(server)
     # Replays HAPPEN on this path (ack loss, redelivery after a pull died
     # mid-processing): the bounded seen-id guard enforces the "decode is
     # idempotent per id" contract instead of documenting it.

@@ -1,0 +1,226 @@
+"""Host-RAM spill tier for the paged engine's prefix cache (ISSUE 18).
+
+HBM is the scarce resource the paged pool rations; host RAM is two orders
+of magnitude cheaper per byte. When `_alloc_blocks` evicts an LRU-parked
+prefix block its contents used to be simply lost — the next prompt sharing
+that prefix re-prefilled it from scratch. The arena keeps those bytes: the
+engine spills the evicted block's K/V (one `pack_payload`-format blob per
+content digest) into a bounded host arena, and a later prefix-map miss
+that hits the arena restores the block with a donated device upload
+instead of a recompute — a HOST-tier hit (`serving_prefix_cache_hits_total
+{tier="host"}`), TTFT-cheap next to the suffix prefill it replaces.
+
+Capacity is `LWS_TPU_KV_HOST_ARENA_MB` (0/unset disables the tier). The
+arena is LRU within itself: a `get` refreshes the entry, inserts evict
+from the cold end until the new entry fits, and an entry larger than the
+whole arena is dropped (counted — a silent drop would read as a cache that
+never hits). Entries are ONE contiguous bytes object in `pack_payload`'s
+wire format, so `get` returns zero-copy `np.frombuffer` views and a spill
+costs exactly one host join (counted in `serving_kv_spill_bytes_total
+{direction="spill"}`).
+
+This module also owns the process-level prefix REGISTRY the telemetry
+server's `GET /debug/prefixes` reads: engines register a snapshot provider
+(weakly — dead engines fall out), workers register their KV fetch port,
+and `debug_prefixes()` merges both into the digest advertisement the
+control plane's FleetCollector folds into its digest -> instance index
+(the remote tier's discovery half)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+from lws_tpu.core import metrics
+
+ARENA_MB_ENV = "LWS_TPU_KV_HOST_ARENA_MB"
+
+
+class KVHostArena:
+    """Bounded digest-addressed host store of spilled prefix blocks."""
+
+    def __init__(self, capacity_bytes: int) -> None:
+        if capacity_bytes <= 0:
+            raise ValueError("arena capacity must be > 0 bytes")
+        self.capacity = int(capacity_bytes)
+        self._lock = threading.Lock()
+        # digest -> packed payload bytes; dict order IS the LRU order
+        # (oldest first; get() re-inserts at the hot end).
+        self._entries: dict[bytes, bytes] = {}  # guarded-by: _lock
+        self._bytes = 0                         # guarded-by: _lock
+        self.drops = 0                          # guarded-by: _lock
+        self._publish_gauges(0, 0)
+        # Weak process registry: get_spilled() (the KVServer fetch_prefix
+        # provider) serves from whichever live arena holds the digest.
+        import weakref
+
+        with _REG_LOCK:
+            _ARENAS.append(weakref.ref(self))
+
+    @staticmethod
+    def _publish_gauges(nbytes: int, entries: int) -> None:
+        metrics.set("serving_kv_host_arena_bytes", float(nbytes))
+        metrics.set("serving_kv_host_arena_entries", float(entries))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def put(self, digest: bytes, arrays: dict) -> bool:
+        """Spill one block's arrays under `digest`. Returns False when the
+        entry alone exceeds the arena (dropped — the caller's eviction
+        proceeds as if the tier were off). The join here is the spill's one
+        host copy; the stored blob then serves every later restore
+        zero-copy."""
+        from lws_tpu.serving.kv_transport import pack_payload
+
+        bufs, _ = pack_payload(arrays)
+        payload = b"".join(
+            bytes(v) if isinstance(v, memoryview) else v for v in bufs
+        )
+        size = len(payload)
+        with self._lock:
+            if size > self.capacity:
+                self.drops += 1
+                return False
+            old = self._entries.pop(digest, None)
+            if old is not None:
+                self._bytes -= len(old)
+            while self._bytes + size > self.capacity and self._entries:
+                cold = next(iter(self._entries))
+                self._bytes -= len(self._entries.pop(cold))
+            self._entries[digest] = payload
+            self._bytes += size
+            nbytes, entries = self._bytes, len(self._entries)
+        metrics.inc("serving_kv_spill_bytes_total", {"direction": "spill"},
+                    value=float(size))
+        self._publish_gauges(nbytes, entries)
+        return True
+
+    def get(self, digest: bytes) -> Optional[dict]:
+        """Zero-copy array views of a spilled block (None on miss). The hit
+        refreshes the entry's LRU position; the restore-direction byte
+        accounting is the ENGINE's job (it knows whether the upload actually
+        landed)."""
+        from lws_tpu.serving.kv_transport import bytes_to_arrays
+
+        with self._lock:
+            payload = self._entries.pop(digest, None)
+            if payload is None:
+                return None
+            self._entries[digest] = payload  # re-insert at the hot end
+        return bytes_to_arrays(payload)
+
+    def __contains__(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest in self._entries
+
+    def digests(self) -> list[bytes]:
+        """Cold-to-hot digest list (a snapshot — advertisement, not truth)."""
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "capacity": self.capacity,
+                "drops": self.drops,
+            }
+
+
+def from_env() -> Optional[KVHostArena]:
+    """Arena sized by LWS_TPU_KV_HOST_ARENA_MB; None when unset/0 (the
+    spill tier is opt-in — host copies are not free on every deployment)."""
+    raw = os.environ.get(ARENA_MB_ENV, "").strip()
+    if not raw:
+        return None
+    mb = float(raw)
+    if mb <= 0:
+        return None
+    return KVHostArena(int(mb * 1e6))
+
+
+# ---------------------------------------------------------------------------
+# Process prefix registry: what GET /debug/prefixes advertises.
+
+_REG_LOCK = threading.Lock()
+# name -> snapshot provider; providers return {"block_size", "digests":
+# [bytes...], "arena_digests": [bytes...]} or None when their engine died
+# (weakref-backed providers prune themselves that way).
+_PREFIX_SOURCES: dict[str, Callable[[], Optional[dict]]] = {}  # guarded-by: _REG_LOCK
+_FETCH_PORT: Optional[int] = None  # guarded-by: _REG_LOCK
+_ARENAS: list = []  # weakrefs to every live KVHostArena; guarded-by: _REG_LOCK
+
+
+def get_spilled(digest: bytes) -> Optional[dict]:
+    """`fetch_prefix` provider: zero-copy views of the first live arena's
+    entry for `digest`, None when no arena holds it. Spilled blocks are
+    already host-resident wire-format bytes, so serving a sibling costs no
+    device traffic and no engine coordination — this is THE provider
+    workers wire into `KVServer.serve_prefixes`."""
+    with _REG_LOCK:
+        live = [r() for r in _ARENAS]
+        _ARENAS[:] = [r for r, a in zip(list(_ARENAS), live) if a is not None]
+    for arena in live:
+        if arena is None:
+            continue
+        got = arena.get(digest)
+        if got is not None:
+            return got
+    return None
+
+
+def register_prefix_source(name: str,
+                           provider: Callable[[], Optional[dict]]) -> None:
+    with _REG_LOCK:
+        _PREFIX_SOURCES[name] = provider
+
+
+def unregister_prefix_source(name: str) -> None:
+    with _REG_LOCK:
+        _PREFIX_SOURCES.pop(name, None)
+
+
+def register_fetch_port(port: Optional[int]) -> None:
+    """Advertise the KV wire port siblings should `fetch_prefix` against
+    (the worker's KVServer port). None clears it."""
+    global _FETCH_PORT
+    with _REG_LOCK:
+        _FETCH_PORT = int(port) if port is not None else None
+
+
+def debug_prefixes(limit: int = 256) -> dict:
+    """The /debug/prefixes body: every live source's resident (HBM) and
+    arena digests as hex, capped at `limit` each, plus the advertised KV
+    fetch port. Dead sources (provider returned None) are pruned."""
+    with _REG_LOCK:
+        sources = list(_PREFIX_SOURCES.items())
+        port = _FETCH_PORT
+    digests: list[str] = []
+    arena: list[str] = []
+    dead: list[str] = []
+    for name, provider in sources:
+        snap = provider()
+        if snap is None:
+            dead.append(name)
+            continue
+        digests.extend(d.hex() for d in snap.get("digests", []))
+        arena.extend(d.hex() for d in snap.get("arena_digests", []))
+    for name in dead:
+        unregister_prefix_source(name)
+    if limit:
+        digests, arena = digests[:limit], arena[:limit]
+    return {
+        "digests": digests,
+        "arena_digests": arena,
+        "count": len(digests) + len(arena),
+        "kv_port": port,
+    }

@@ -674,6 +674,9 @@ class KVServer:
         import os
 
         self._token = token if token is not None else os.environ.get("LWS_TPU_KV_TOKEN")
+        # Sibling prefix serving (ISSUE 18): provider(digest_bytes) ->
+        # arrays|None; set via serve_prefixes(). None = op answers {none}.
+        self._prefix_provider = None
         self._prompts: "queue.Queue[tuple[dict, bytes]]" = queue.Queue()
         self._bundles: "queue.Queue[tuple[dict, object]]" = queue.Queue()
         self._results: dict[str, tuple[dict, bytes]] = {}  # guarded-by: _results_lock
@@ -764,6 +767,16 @@ class KVServer:
     def post_result(self, req_id: str, meta: dict, payload: bytes) -> None:
         with self._results_lock:
             self._results[req_id] = (meta, payload)
+
+    def serve_prefixes(self, provider) -> None:
+        """Enable the `fetch_prefix` op: `provider(digest_bytes)` returns
+        one cached prefix block's array dict, or None when this instance no
+        longer holds that digest. Typical provider: the host arena's `get`
+        (spilled blocks are already host-resident wire-format bytes —
+        serving them costs no device traffic); serving HBM-resident blocks
+        requires a device gather against a possibly-busy engine, so wire it
+        only from the engine's own thread discipline."""
+        self._prefix_provider = provider
 
     def close(self) -> None:
         self._closed = True
@@ -905,15 +918,54 @@ class KVServer:
                 return
             with self._counts_lock:
                 self.results_served += 1
+        elif op == "fetch_prefix":
+            # Sibling warm-up leg (ISSUE 18): serve the CONTIGUOUS PREFIX of
+            # the requested digest chain this instance still holds, one
+            # block per chunk, over the standard kv_stream protocol
+            # (per-chunk acks, crc32 at END). The chain stops at the first
+            # digest the provider misses — a block whose predecessors are
+            # absent is useless to the requester (digests chain positions).
+            provider = self._prefix_provider
+            digests = [bytes.fromhex(h) for h in meta.get("digests", [])]
+            if provider is None or not digests:
+                send_msg(conn, {"none": True})
+                return
+            ack_timeout = float(meta.get("ack_timeout", 30.0))
+            stream = KVStream()
+            served: list[str] = []
+            try:
+                for d in digests:
+                    arrays = provider(d)
+                    if arrays is None:
+                        break
+                    stream.put_chunk(len(served), len(served) + 1, arrays)
+                    served.append(d.hex())
+                if not served:
+                    send_msg(conn, {"none": True})
+                    return
+                stream.finish({"digests": served})
+                # Torn legs raise OSError to _serve_one (connection-error
+                # counter); there is NO re-queue — the requester's retry
+                # re-serves from scratch, so a torn fetch can never leave a
+                # torn suffix on either side.
+                self._send_stream(conn, {"op": "fetch_prefix"}, stream,
+                                  ack_timeout, role="prefix")
+            finally:
+                # No-op after a fully-acked delivery; on a torn leg it
+                # releases the un-acked chunks' inflight-gauge contribution
+                # (this one-shot stream has no redelivery to hold them for).
+                stream.fail()
         else:
             send_msg(conn, {"error": f"unknown op {op!r}"})
 
     def _send_stream(self, conn: socket.socket, bmeta: dict,
-                     stream: KVStream, ack_timeout: float) -> None:
+                     stream: KVStream, ack_timeout: float,
+                     role: str = "prefill") -> None:
         """One streamed delivery attempt: BEGIN, then chunk/ack pairs as
         the producer lands them, then END. Raises OSError on any torn leg
         (caller re-queues the stream) or _StreamFailed when the producer
-        died (caller drops it)."""
+        died (caller drops it). `role` labels the transfer metrics:
+        "prefill" for bundle handoffs, "prefix" for sibling prefix legs."""
         import time as _time
 
         t0 = _time.perf_counter()
@@ -947,10 +999,10 @@ class KVServer:
             # END
             send_msg(conn, cmeta, bufs)
             metrics.inc("serving_kv_transfer_bytes_total",
-                        {"role": "prefill"},
+                        {"role": role},
                         value=float(stream.payload_bytes))
             metrics.observe("serving_kv_transfer_seconds",
-                            _time.perf_counter() - t0, {"role": "prefill"})
+                            _time.perf_counter() - t0, {"role": role})
             return
 
 
@@ -1223,6 +1275,147 @@ def pull_result(endpoint, req_id: str, timeout: float = 10.0):
     if meta.get("error"):
         raise RuntimeError(f"pull_result rejected: {meta}")
     return meta, payload
+
+
+# ---------------------------------------------------------------------------
+# Cross-instance prefix fetch (ISSUE 18): warm a replica's prefix cache from
+# a sibling over the same streamed KV wire as the disagg handoff.
+
+
+class _PrefixReceiver:
+    """fetch_prefix's stream receiver: chunk i is block i of the served
+    digest chain, kept as zero-copy array views; finish() returns the
+    ordered block list (the END meta's digest list zips against it)."""
+
+    def __init__(self) -> None:
+        self.blocks: list[dict] = []
+
+    def chunk(self, cmeta: dict, arrays: dict) -> None:
+        self.blocks.append(arrays)
+
+    def finish(self, end_meta: dict, end_arrays: dict):
+        return self.blocks
+
+
+def fetch_prefix(endpoint, digests: Sequence, timeout: float = 5.0,
+                 ack_timeout: float = 30.0) -> dict:
+    """Pull cached prefix blocks for `digests` (a hash-chain run, in order)
+    from a sibling's KVServer -> {digest_bytes: array dict}, covering the
+    contiguous chain prefix the peer still held; {} when it held nothing.
+    Rides the kv_stream protocol end to end: per-chunk acks, crc32/count
+    verification at END — any torn leg raises OSError WITHOUT a partial
+    result, so the caller falls back to recompute, never a torn cache. The
+    bound Deadline (if any) gates the dial and clamps every socket wait."""
+    resilience.check("kv.prefix.fetch")
+    faults.fire("kv.prefix.fetch")
+    import time as _time
+
+    with socket.create_connection(
+        endpoint, timeout=resilience.clamp_timeout(timeout)
+    ) as sock:
+        tune_socket(sock)
+        send_msg(sock, _auth({
+            "op": "fetch_prefix",
+            "digests": [d.hex() for d in digests],
+            "ack_timeout": ack_timeout,
+        }))
+        t0 = _time.perf_counter()
+        meta, _ = recv_msg(sock)
+        if meta is None:
+            raise OSError("truncated fetch_prefix reply")
+        if meta.get("error"):
+            raise RuntimeError(f"fetch_prefix rejected: {meta}")
+        if meta.get("none"):
+            return {}
+        if not meta.get("stream"):
+            raise OSError("fetch_prefix reply was not a stream")
+        merged, blocks, rx_bytes = _recv_stream(
+            sock, meta, _PrefixReceiver(), ack_timeout
+        )
+        if isinstance(blocks, PoisonPayload):
+            raise OSError(f"fetch_prefix receiver failed: {blocks.error!r}")
+        metrics.inc("serving_kv_transfer_bytes_total", {"role": "prefix"},
+                    value=float(rx_bytes))
+        metrics.observe("serving_kv_transfer_seconds",
+                        _time.perf_counter() - t0, {"role": "prefix"})
+        served = [bytes.fromhex(h) for h in merged.get("digests", [])]
+        return dict(zip(served, blocks))
+
+
+class RemotePrefixSource:
+    """The engine's remote tier: candidate sibling endpoints come from a
+    dynamic `lookup(digest_hex) -> (host, port)|None` (the FleetCollector's
+    digest index) and/or a static `endpoints` list, each behind its own
+    CircuitBreaker, each fetch retried once on transient OSError
+    (RetryPolicy — a retry re-serves the whole stream from chunk 0).
+
+    `fetch()` NEVER raises: every failure — open circuit, dead peer, torn
+    stream, expired deadline — degrades to {} and the engine prefills the
+    suffix itself. The remote tier is an optimization; it must not become
+    a new way for admission to crash or hang."""
+
+    def __init__(self, endpoints: Sequence = (), lookup=None,
+                 timeout: float = 5.0, ack_timeout: float = 30.0,
+                 failure_threshold: int = 3, reset_timeout_s: float = 10.0):
+        self.endpoints = [tuple(e) for e in endpoints]
+        self.lookup = lookup
+        self.timeout = timeout
+        self.ack_timeout = ack_timeout
+        self._failure_threshold = failure_threshold
+        self._reset_timeout_s = reset_timeout_s
+        self._breakers: dict[str, resilience.CircuitBreaker] = {}
+
+    def _breaker(self, endpoint) -> resilience.CircuitBreaker:
+        key = f"{endpoint[0]}:{endpoint[1]}"
+        br = self._breakers.get(key)
+        if br is None:
+            br = resilience.CircuitBreaker(
+                key, failure_threshold=self._failure_threshold,
+                reset_timeout_s=self._reset_timeout_s,
+            )
+            self._breakers[key] = br
+        return br
+
+    def _candidates(self, digests: list) -> list:
+        out: list = []
+        if self.lookup is not None:
+            try:
+                hit = self.lookup(digests[0].hex())
+            except Exception:  # noqa: BLE001 — index staleness is not fatal
+                hit = None
+            if hit:
+                out.append(tuple(hit))
+        for ep in self.endpoints:
+            if ep not in out:
+                out.append(ep)
+        return out
+
+    def fetch(self, digests: Sequence) -> dict:
+        digests = list(digests)
+        if not digests:
+            return {}
+        for endpoint in self._candidates(digests):
+            br = self._breaker(endpoint)
+            if not br.allow():
+                continue  # open circuit: fail fast to the next candidate
+            try:
+                found = resilience.call(
+                    lambda ep=endpoint: fetch_prefix(
+                        ep, digests, timeout=self.timeout,
+                        ack_timeout=self.ack_timeout,
+                    ),
+                    site="kv.prefix.fetch",
+                    policy=resilience.RetryPolicy(
+                        max_attempts=2, base_s=0.05, cap_s=0.25
+                    ),
+                )
+            except Exception:  # noqa: BLE001 — any failure = miss, next peer
+                br.record_failure()
+                continue
+            br.record_success()
+            if found:
+                return found
+        return {}
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,7 @@ benchmarks/spec_decode_bench.py.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import time
 from dataclasses import dataclass, field
@@ -122,6 +123,8 @@ class PagedBatchEngine:
         pipeline_depth: Optional[int] = None,
         donate_steps: Optional[bool] = None,
         spec_history: Optional[int] = None,
+        host_arena=None,
+        remote_prefix=None,
     ):
         """With `mesh` (axes incl. 'tp'), the engine serves TENSOR-PARALLEL
         paged continuous batching under GSPMD: params per param_shardings,
@@ -189,7 +192,10 @@ class PagedBatchEngine:
         self.num_blocks = num_blocks if num_blocks is not None else slots * self.max_blocks + 1
         self._ids = itertools.count()
         self._free_slots = list(range(slots))
-        self._free_blocks = list(range(1, self.num_blocks))  # 0 = null
+        # Deque, FIFO: submit/alloc pop from the LEFT, release appends on the
+        # right — the same recycling order the old list gave, without the
+        # O(pool) shift every pop(0) paid (pinned by the pool-order test).
+        self._free_blocks = collections.deque(range(1, self.num_blocks))  # 0 = null
         self._active: dict[int, PagedRequest] = {}
         self._completed: dict[int, PagedRequest] = {}
         # Automatic prefix caching (vLLM APC shape, opt-in): full prompt
@@ -203,7 +209,47 @@ class PagedBatchEngine:
         self._block_digest: dict[int, bytes] = {}    # reverse map
         self._block_refs: dict[int, int] = {}        # shareable-block refs
         self._lru: "dict[int, None]" = {}            # refcount-0, evictable
-        self.stats_prefix = {"hit_tokens": 0, "hit_blocks": 0, "evictions": 0}
+        self.stats_prefix = {
+            "hit_tokens": 0, "hit_blocks": 0, "evictions": 0,
+            "spills": 0, "host_hits": 0, "remote_hits": 0,
+        }
+        # Hierarchical prefix tiers (ISSUE 18): `host_arena` catches evicted
+        # parked blocks (device->host spill) so a later miss restores instead
+        # of recomputing; `remote_prefix` (a RemotePrefixSource) consults
+        # warm siblings over the KV wire when the arena misses too. Both are
+        # opt-in; the arena defaults from LWS_TPU_KV_HOST_ARENA_MB.
+        self._host_arena = host_arena
+        self._remote_prefix = remote_prefix
+        self._prefix_source_name: Optional[str] = None
+        if prefix_cache:
+            import weakref
+
+            from lws_tpu.serving import kv_host_arena as _kha
+
+            if host_arena is None:
+                self._host_arena = _kha.from_env()
+            # Advertise this engine's resident + spilled digests for
+            # GET /debug/prefixes (weakly: a dead engine's provider returns
+            # None and the registry prunes it).
+            _self = weakref.ref(self)
+
+            def _prefix_snapshot():
+                eng = _self()
+                if eng is None:
+                    return None
+                return {
+                    "block_size": eng.block_size,
+                    "digests": list(eng._prefix_map),
+                    "arena_digests": (
+                        eng._host_arena.digests()
+                        if eng._host_arena is not None else []
+                    ),
+                }
+
+            self._prefix_source_name = f"paged-engine-{id(self):x}"
+            _kha.register_prefix_source(
+                self._prefix_source_name, _prefix_snapshot
+            )
         # Request mid-chunked-admission: holds allocated blocks but is not
         # in _active yet — pool_accounting counts its blocks as live so the
         # interleaved decode steps' gauge updates stay conserved.
@@ -379,6 +425,19 @@ class PagedBatchEngine:
             return cache, pos_b.at[slot].set(plen), logits
 
         self._insert_with_prefix = _insert_with_prefix
+
+        # Spill-tier restore: scatter one host-resident block's K/V back
+        # into the pool (donated — the pool updates in place, same contract
+        # as _insert). paged_insert with a single block id IS the
+        # dynamic_update_slice upload the CacheAssembler path uses, shapes
+        # included: [L, bs, Hkv, hd] dense rows -> pool block `block_id`.
+        @partial(jax.jit, donate_argnums=(0,), **(
+            {"out_shardings": self._pool_shardings} if mesh is not None else {}
+        ))
+        def _restore_insert(cache, blk_k, blk_v, block_id, blk_ks=None, blk_vs=None):
+            return paged_insert(cache, blk_k, blk_v, block_id, blk_ks, blk_vs)
+
+        self._restore_insert = _restore_insert
 
         # ---- chunked-prefill admission helpers ---------------------------
         # One dense [1, width] cache is built per admission (width = bucket,
@@ -609,6 +668,12 @@ class PagedBatchEngine:
             )
 
     # ---- prefix caching ------------------------------------------------
+    def set_remote_prefix(self, source) -> None:
+        """Wire (or clear) the remote tier after construction — workers
+        learn the sibling digest index from the control plane long after
+        the engine exists."""
+        self._remote_prefix = source
+
     def _block_digests(self, prompt: np.ndarray, n: int) -> list[bytes]:
         """Position-binding hash chain over the first n full blocks: block
         i's digest commits to ALL tokens in [0, (i+1)*bs) — equal digests
@@ -642,7 +707,7 @@ class PagedBatchEngine:
         out: list[int] = []
         while len(out) < n:
             if self._free_blocks:
-                out.append(self._free_blocks.pop(0))
+                out.append(self._free_blocks.popleft())
                 continue
             if self._lru:
                 blk = next(iter(self._lru))
@@ -653,6 +718,13 @@ class PagedBatchEngine:
                 # remapped it to a newer block that must stay discoverable).
                 if digest is not None and self._prefix_map.get(digest) == blk:
                     self._prefix_map.pop(digest, None)
+                    # Spill instead of drop: the flush above guarantees no
+                    # in-flight chunk can still read this block, so the
+                    # device->host gather here sees its final contents. Only
+                    # mapped evictions spill — an unmapped block's bytes are
+                    # unreachable by digest anyway.
+                    if self._host_arena is not None:
+                        self._spill_block(blk, digest)
                 self._block_refs.pop(blk, None)
                 self.stats_prefix["evictions"] += 1
                 metrics.inc(
@@ -660,9 +732,58 @@ class PagedBatchEngine:
                 )
                 out.append(blk)
                 continue
-            self._free_blocks = out + self._free_blocks
+            self._free_blocks.extendleft(reversed(out))  # undo: restore order
             return None
         return out
+
+    def _spill_block(self, blk: int, digest: bytes) -> None:
+        """Evicted parked block -> host arena (tentpole (a) write side): one
+        device->host gather of the block's pool rows, packed by the arena
+        into pack_payload wire format. Eviction proceeds identically whether
+        the arena accepted the entry or dropped it as oversized."""
+        arrays = {
+            "k": np.asarray(self.cache.k[:, blk]),
+            "v": np.asarray(self.cache.v[:, blk]),
+        }
+        if self.cfg.kv_quant:
+            arrays["k_scale"] = np.asarray(self.cache.k_scale[:, blk])
+            arrays["v_scale"] = np.asarray(self.cache.v_scale[:, blk])
+        if self._host_arena.put(digest, arrays):
+            self.stats_prefix["spills"] += 1
+
+    def _restore_block(self, digest: bytes, arrays: dict) -> Optional[int]:
+        """Upload one spilled/fetched block into a freshly allocated pool
+        block, map its digest, and take this admission's ref on it (its
+        release path is the shared-block refcount, exactly like an HBM hit).
+        Returns None when the pool cannot supply a block — the caller stops
+        extending the hit chain and prefills the rest."""
+        got = self._alloc_blocks(1)
+        if got is None:
+            return None
+        blk = got[0]
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        with self._mesh_ctx():
+            scales = ()
+            if self.cfg.kv_quant:
+                scales = (
+                    self._put_rep(jnp.asarray(arrays["k_scale"])),
+                    self._put_rep(jnp.asarray(arrays["v_scale"])),
+                )
+            self.cache = self._restore_insert(
+                self.cache,
+                self._put_rep(jnp.asarray(arrays["k"])),
+                self._put_rep(jnp.asarray(arrays["v"])),
+                self._put_rep(jnp.asarray([blk], jnp.int32)),
+                *scales,
+            )
+        self._prefix_map[digest] = blk
+        self._block_digest[blk] = digest
+        self._block_refs[blk] = self._block_refs.get(blk, 0) + 1
+        metrics.inc(
+            "serving_kv_spill_bytes_total", {"direction": "restore"},
+            value=float(nbytes),
+        )
+        return blk
 
     def _assign_sampling(self, slot: int, temperature, top_k, top_p, seed):
         """Write the slot's sampling params and derive its request key.
@@ -899,7 +1020,7 @@ class PagedBatchEngine:
             return None
         slot = self._free_slots.pop(0)
         timeline.queue_wait()  # arrival -> slot (includes any ring flushes)
-        blocks = [self._free_blocks.pop(0) for _ in range(n_blocks)]
+        blocks = [self._free_blocks.popleft() for _ in range(n_blocks)]
         req = PagedRequest(
             next(self._ids), np.asarray(prompt), max_new_tokens, slot=slot,
             blocks=blocks, temperature=temperature, top_k=top_k, top_p=top_p,
@@ -947,21 +1068,49 @@ class PagedBatchEngine:
         # so the first-token logits exist (vLLM caps hits the same way).
         shareable_n = (plen - 1) // bs
         digests = self._block_digests(prompt, shareable_n)
+        # Tiered hit walk (ISSUE 18): hbm (resident in _prefix_map) -> host
+        # (arena restore) -> remote (sibling fetch over the KV wire), in
+        # digest-chain order; the first tier-exhausted digest ends the chain.
+        # Each hit is PINNED as the walk reaches it — a restore allocates a
+        # block, which can LRU-evict, and an unpinned earlier hit could be
+        # the victim (its id would alias the restored block: corruption).
+        # On a later allocation failure every pin rolls back.
         hits: list[int] = []
-        for d in digests:
+        tiers: list[str] = []
+        remote_found: Optional[dict] = None
+        for i, d in enumerate(digests):
             blk = self._prefix_map.get(d)
-            if blk is None:
-                break
-            hits.append(blk)
-        hit_len = len(hits) * bs
-        new_needed = n_blocks - len(hits)
-        # Pin the hit blocks BEFORE allocating (eviction must not take
-        # them); on allocation failure the pins roll back — a pre-check
-        # would double-count LRU-parked hit blocks as allocatable.
-        for blk in hits:
+            if blk is not None:
+                tiers.append("hbm")
+            else:
+                arrays = (
+                    self._host_arena.get(d)
+                    if self._host_arena is not None else None
+                )
+                if arrays is not None:
+                    tiers.append("host")
+                else:
+                    if self._remote_prefix is not None and remote_found is None:
+                        # One fetch per admission, for the whole remaining
+                        # chain — per-digest round trips would hand the TTFT
+                        # win back to wire latency.
+                        remote_found = self._remote_prefix.fetch(digests[i:]) or {}
+                    arrays = (remote_found or {}).get(d)
+                    if arrays is None:
+                        break
+                    tiers.append("remote")
+                blk = self._restore_block(d, arrays)
+                if blk is None:
+                    tiers.pop()  # pool exhausted: chain ends here
+                    break
+                hits.append(blk)
+                continue  # _restore_block already pinned
             if self._block_refs.get(blk, 0) == 0:
                 self._lru.pop(blk, None)
             self._block_refs[blk] = self._block_refs.get(blk, 0) + 1
+            hits.append(blk)
+        hit_len = len(hits) * bs
+        new_needed = n_blocks - len(hits)
         new_blocks = self._alloc_blocks(new_needed)
         if new_blocks is None:
             for blk in hits:  # backpressure: unpin and park again
@@ -1081,15 +1230,22 @@ class PagedBatchEngine:
             req.shared_blocks.append(blk)
         self.stats_prefix["hit_tokens"] += hit_len
         self.stats_prefix["hit_blocks"] += len(hits)
+        self.stats_prefix["host_hits"] += tiers.count("host")
+        self.stats_prefix["remote_hits"] += tiers.count("remote")
         # Hit-rate counters (capacity accounting): hits = shareable blocks
-        # served from the pool, misses = shareable blocks this admission had
-        # to prefill. hits/(hits+misses) is the cache hit rate `lws-tpu top`
-        # renders from the fleet scrape.
+        # served from SOME tier of the hierarchy (labelled hbm/host/remote),
+        # misses = shareable blocks this admission had to prefill.
+        # hits/(hits+misses) is the cache hit rate `lws-tpu top` renders
+        # from the fleet scrape; the tier label splits it (--by-tier).
         if hits:
-            metrics.inc(
-                "serving_prefix_cache_hits_total", {"engine": "paged"},
-                value=float(len(hits)),
-            )
+            for tier in ("hbm", "host", "remote"):
+                n_tier = tiers.count(tier)
+                if n_tier:
+                    metrics.inc(
+                        "serving_prefix_cache_hits_total",
+                        {"engine": "paged", "tier": tier},
+                        value=float(n_tier),
+                    )
         if shareable_n > len(hits):
             metrics.inc(
                 "serving_prefix_cache_misses_total", {"engine": "paged"},

@@ -1,0 +1,605 @@
+"""Hierarchical KV prefix cache (ISSUE 18): host-RAM spill tier +
+cross-instance prefix fetch over the streamed KV wire.
+
+Covers the tentpole's three tiers end to end — spill-on-evict into the
+host arena, restore-on-hit back into the pool, sibling fetch over the
+kv_stream protocol — plus the rider satellites: the O(1) FIFO free-block
+deque, the conservation invariant across every new block-lifecycle path,
+chaos on the fetch leg (a torn fetch falls back to recompute, never a
+torn cache), the /debug/prefixes advertisement + fleet digest index, and
+the `lws-tpu top --by-tier` breakdown."""
+
+import collections
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lws_tpu.core import faults, metrics
+from lws_tpu.serving import kv_host_arena
+from lws_tpu.serving import kv_transport as kt
+from lws_tpu.serving.kv_host_arena import KVHostArena
+
+
+def _small_engine(**kw):
+    from lws_tpu.models.llama import LlamaConfig, init_params
+    from lws_tpu.serving.paged_engine import PagedBatchEngine
+
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    return PagedBatchEngine(cfg, params, max_len=64, block_size=16, **kw)
+
+
+def _assert_conserved(engine):
+    """free, parked, and request-held block sets partition [1, num_blocks),
+    computed independently of the engine's own accounting."""
+    free = set(engine._free_blocks)
+    parked = set(engine._lru)
+    live = set()
+    for req in engine._active.values():
+        live |= set(req.blocks)
+    assert not free & parked, "block in free list AND parked LRU"
+    assert not free & live, "block in free list AND held by a request"
+    assert not parked & live, "block parked AND held by a request"
+    assert free | parked | live == set(range(1, engine.num_blocks)), \
+        "pool blocks leaked or double-counted"
+    acct = engine.pool_accounting()
+    assert acct["free"] + acct["live"] + acct["parked"] == engine.num_blocks - 1
+
+
+def _tier_hits(tier: str) -> float:
+    return metrics.REGISTRY.counter_value(
+        "serving_prefix_cache_hits_total", {"engine": "paged", "tier": tier})
+
+
+def _spill_bytes(direction: str) -> float:
+    return metrics.REGISTRY.counter_value(
+        "serving_kv_spill_bytes_total", {"direction": direction})
+
+
+PROMPT = np.arange(1, 25, dtype=np.int32)  # 24 tokens: 1 shareable block
+
+
+def _park_then_evict(engine):
+    """Drive the canonical spill sequence: park PROMPT's shareable block,
+    fill the pool with two active 4-block requests, then force a 1-block
+    admission to evict (and, with an arena, spill) the parked block.
+    Returns the fault-free oracle tokens for PROMPT."""
+    r = engine.submit(PROMPT, 8)
+    assert r is not None
+    engine.run_until_drained()
+    oracle = engine.result(r)
+    assert engine.pool_accounting()["parked"] == 1
+    f1 = engine.submit(np.full((24,), 9, np.int32), 40)   # 4 blocks
+    f2 = engine.submit(np.full((24,), 11, np.int32), 40)  # 4 blocks
+    g = engine.submit(np.arange(30, 38, dtype=np.int32), 8)  # 1 block: evicts
+    assert f1 is not None and f2 is not None and g is not None
+    _assert_conserved(engine)
+    engine.run_until_drained()
+    _assert_conserved(engine)
+    return oracle
+
+
+@pytest.fixture
+def armed():
+    def arm(point: str, spec: str) -> None:
+        faults.INJECTOR.arm(point, spec)
+
+    yield arm
+    faults.INJECTOR.disarm()
+
+
+@pytest.fixture
+def kv_server():
+    s = kt.KVServer(port=0, host="127.0.0.1")
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the free-block pool is an O(1) FIFO deque
+
+
+def test_free_block_pool_is_fifo_deque():
+    """The pool must allocate in FIFO order with O(1) ends (the old list's
+    pop(0) was O(n) per block): blocks leave from the head in id order, a
+    refused oversized allocation leaves the order untouched, and released
+    blocks recycle at the tail."""
+    engine = _small_engine(slots=2, num_blocks=10)
+    assert isinstance(engine._free_blocks, collections.deque)
+    assert list(engine._free_blocks) == list(range(1, 10))
+    assert engine._alloc_blocks(3) == [1, 2, 3]
+    assert list(engine._free_blocks) == [4, 5, 6, 7, 8, 9]
+    # Up-front refusal: no partial drain, order preserved.
+    assert engine._alloc_blocks(7) is None
+    assert list(engine._free_blocks) == [4, 5, 6, 7, 8, 9]
+    # A real request's blocks recycle at the TAIL on completion: the next
+    # admission still draws the untouched head first (FIFO, not LIFO).
+    rid = engine.submit(np.arange(1, 9, dtype=np.int32), 8)  # 1 block: 4
+    assert rid is not None
+    engine.run_until_drained()
+    assert list(engine._free_blocks) == [5, 6, 7, 8, 9, 4]
+
+
+# ---------------------------------------------------------------------------
+# The host arena itself
+
+
+def test_arena_lru_capacity_oversize_and_roundtrip():
+    a8 = {"k": np.arange(8, dtype=np.float32)}     # 32-byte payload
+    entry = KVHostArena(1 << 20)
+    entry.put(b"probe", a8)
+    unit = entry.nbytes  # one entry's packed size (header + payload)
+
+    arena = KVHostArena(2 * unit)  # room for exactly two entries
+    assert arena.put(b"a", a8) and arena.put(b"b", a8)
+    # get() refreshes LRU position: after touching "a", inserting "c"
+    # evicts "b" (the cold end), not "a".
+    got = arena.get(b"a")
+    np.testing.assert_array_equal(got["k"], a8["k"])
+    assert arena.put(b"c", a8)
+    assert b"a" in arena and b"c" in arena and b"b" not in arena
+    assert arena.digests() == [b"a", b"c"]  # cold -> hot
+    # Oversized entry: dropped and COUNTED, arena unchanged.
+    big = {"k": np.zeros(4 * unit, dtype=np.float32)}
+    assert not arena.put(b"huge", big)
+    assert arena.stats()["drops"] == 1 and len(arena) == 2
+    # Re-put of an existing digest replaces, never double-counts bytes.
+    assert arena.put(b"a", a8)
+    assert arena.nbytes == 2 * unit
+    assert arena.get(b"missing") is None
+    with pytest.raises(ValueError):
+        KVHostArena(0)
+
+
+def test_arena_from_env(monkeypatch):
+    monkeypatch.delenv(kv_host_arena.ARENA_MB_ENV, raising=False)
+    assert kv_host_arena.from_env() is None
+    monkeypatch.setenv(kv_host_arena.ARENA_MB_ENV, "0")
+    assert kv_host_arena.from_env() is None
+    monkeypatch.setenv(kv_host_arena.ARENA_MB_ENV, "2")
+    arena = kv_host_arena.from_env()
+    assert arena is not None and arena.capacity == 2_000_000
+
+
+# ---------------------------------------------------------------------------
+# Tentpole (a): spill on evict, restore on hit — conserved, byte-identical
+
+
+def test_spill_on_evict_then_host_restore_hits_and_conserves():
+    arena = KVHostArena(64 << 20)
+    engine = _small_engine(slots=4, num_blocks=10, prefix_cache=True,
+                           host_arena=arena)
+    host_before = _tier_hits("host")
+    spill_before = _spill_bytes("spill")
+    restore_before = _spill_bytes("restore")
+    oracle = _park_then_evict(engine)
+    assert engine.stats_prefix["evictions"] == 1
+    assert engine.stats_prefix["spills"] == 1
+    assert len(arena) == 1
+    assert _spill_bytes("spill") > spill_before
+
+    # Resubmit: the prefix map misses (evicted) but the arena restores —
+    # a HOST-tier hit, tokens byte-identical to the fault-free oracle.
+    r2 = engine.submit(PROMPT, 8)
+    assert r2 is not None
+    _assert_conserved(engine)
+    engine.run_until_drained()
+    _assert_conserved(engine)
+    assert engine.result(r2) == oracle
+    assert engine.stats_prefix["host_hits"] == 1
+    assert _tier_hits("host") == host_before + 1
+    assert _spill_bytes("restore") > restore_before
+    # The restored block is mapped again: a THIRD submit hits in HBM.
+    hbm_before = _tier_hits("hbm")
+    r3 = engine.submit(PROMPT, 8)
+    assert r3 is not None
+    engine.run_until_drained()
+    assert engine.result(r3) == oracle
+    assert _tier_hits("hbm") == hbm_before + 1
+    _assert_conserved(engine)
+
+
+def test_backpressure_rollback_parks_restored_block_and_conserves():
+    """The new hazard path: a host-tier restore mid-walk allocates a block,
+    then a LATER allocation fails — the rollback must unpin the restored
+    block into the LRU (not leak it, not free it while mapped)."""
+    arena = KVHostArena(64 << 20)
+    engine = _small_engine(slots=6, num_blocks=10, prefix_cache=True,
+                           host_arena=arena)
+    oracle = _park_then_evict(engine)
+    assert len(arena) == 1
+    # Occupy the pool: 4 + 2 + 1 live blocks on top of 2 parked -> free=0.
+    h1 = engine.submit(np.full((24,), 21, np.int32), 40)     # 4 blocks
+    h2 = engine.submit(np.arange(40, 48, dtype=np.int32), 24)  # 2 blocks
+    h3 = engine.submit(np.arange(50, 58, dtype=np.int32), 8)   # 1 block
+    assert h1 is not None and h2 is not None and h3 is not None
+    assert engine.pool_accounting()["free"] == 0
+    _assert_conserved(engine)
+
+    # PROMPT needs 4 blocks: the walk restores its block from the arena
+    # (evicting a parked block to make room), then the 3-block suffix
+    # allocation fails -> full rollback, admission refused.
+    refused = engine.submit(PROMPT, 40)
+    assert refused is None
+    _assert_conserved(engine)
+    # The restored block survived the rollback PARKED and still mapped —
+    # after the pool drains, the same prompt hits it in the HBM tier.
+    assert engine.pool_accounting()["parked"] >= 1
+    engine.run_until_drained()
+    _assert_conserved(engine)
+    hbm_before = engine.stats_prefix["hit_blocks"]
+    r = engine.submit(PROMPT, 8)
+    assert r is not None
+    engine.run_until_drained()
+    assert engine.result(r) == oracle
+    assert engine.stats_prefix["hit_blocks"] == hbm_before + 1
+    assert engine.stats_prefix["host_hits"] == 0  # restore preceded refusal
+    _assert_conserved(engine)
+
+
+def test_arena_full_drop_degrades_to_recompute_and_conserves():
+    """An arena too small for even one block: the spill is dropped (counted),
+    eviction proceeds, and the resubmitted prompt recomputes — a miss, with
+    tokens still byte-identical."""
+    arena = KVHostArena(64)  # smaller than any block payload
+    engine = _small_engine(slots=4, num_blocks=10, prefix_cache=True,
+                           host_arena=arena)
+    misses = lambda: metrics.REGISTRY.counter_value(  # noqa: E731
+        "serving_prefix_cache_misses_total", {"engine": "paged"})
+    oracle = _park_then_evict(engine)
+    assert engine.stats_prefix["evictions"] == 1
+    assert engine.stats_prefix["spills"] == 0
+    assert arena.stats()["drops"] == 1 and len(arena) == 0
+    m0 = misses()
+    r2 = engine.submit(PROMPT, 8)
+    assert r2 is not None
+    engine.run_until_drained()
+    assert engine.result(r2) == oracle
+    assert engine.stats_prefix["host_hits"] == 0
+    assert misses() == m0 + 1
+    _assert_conserved(engine)
+
+
+# ---------------------------------------------------------------------------
+# Tentpole (b): the fetch_prefix wire leg + the engine's remote tier
+
+
+def _synth_blocks(n: int):
+    """n digest->arrays entries of deterministic float32 payloads."""
+    out = {}
+    for i in range(n):
+        rng = np.random.default_rng(i)
+        out[bytes([i]) * 16] = {
+            "k": rng.standard_normal((1, 16, 2, 16)).astype(np.float32),
+            "v": rng.standard_normal((1, 16, 2, 16)).astype(np.float32),
+        }
+    return out
+
+
+def test_fetch_prefix_roundtrip_and_contiguity(kv_server):
+    entries = _synth_blocks(3)
+    arena = KVHostArena(64 << 20)
+    for d, arrays in entries.items():
+        assert arena.put(d, arrays)
+    kv_server.serve_prefixes(arena.get)
+    ep = ("127.0.0.1", kv_server.port)
+    d0, d1, d2 = entries
+    got = kt.fetch_prefix(ep, [d0, d1, d2])
+    assert set(got) == {d0, d1, d2}
+    for d in got:
+        np.testing.assert_array_equal(got[d]["k"], entries[d]["k"])
+        np.testing.assert_array_equal(got[d]["v"], entries[d]["v"])
+    # Digest-chain contiguity: the peer serves the contiguous prefix it
+    # holds and STOPS at the first miss — a gap never yields later blocks
+    # (they would be unusable: block i+1's digest commits to block i).
+    assert set(kt.fetch_prefix(ep, [d0, b"\x77" * 16, d2])) == {d0}
+    # Nothing held -> explicit empty, not an error.
+    assert kt.fetch_prefix(ep, [b"\x55" * 16]) == {}
+
+
+def test_fetch_prefix_without_provider_is_empty(kv_server):
+    assert kt.fetch_prefix(("127.0.0.1", kv_server.port), [b"\x01" * 16]) == {}
+
+
+def test_remote_source_skips_dead_peer_and_opens_breaker(kv_server):
+    entries = _synth_blocks(1)
+    (digest, arrays), = entries.items()
+    arena = KVHostArena(64 << 20)
+    arena.put(digest, arrays)
+    kv_server.serve_prefixes(arena.get)
+    # Dead candidate first: the source must fail over to the live sibling.
+    src = kt.RemotePrefixSource(
+        endpoints=[("127.0.0.1", 1), ("127.0.0.1", kv_server.port)],
+        timeout=0.2, failure_threshold=1,
+    )
+    got = src.fetch([digest])
+    assert set(got) == {digest}
+    # threshold=1: the dead peer's breaker opened on that first failure —
+    # the next fetch skips it without dialing (fetch still succeeds).
+    assert not src._breakers["127.0.0.1:1"].allow()
+    assert set(src.fetch([digest])) == {digest}
+    assert src.fetch([]) == {}
+
+
+def test_remote_fetch_tier_restores_and_matches_oracle(kv_server):
+    """Full cross-instance path: sibling A spills into its arena and serves
+    it over the KV wire; engine B (no arena) admits the same prompt via a
+    REMOTE-tier hit with byte-identical tokens."""
+    arena_a = KVHostArena(64 << 20)
+    a = _small_engine(slots=4, num_blocks=10, prefix_cache=True,
+                      host_arena=arena_a)
+    oracle = _park_then_evict(a)
+    assert len(arena_a) == 1
+    kv_server.serve_prefixes(arena_a.get)
+
+    remote_before = _tier_hits("remote")
+    src = kt.RemotePrefixSource(endpoints=[("127.0.0.1", kv_server.port)])
+    b = _small_engine(slots=4, num_blocks=10, prefix_cache=True,
+                      remote_prefix=src)
+    r = b.submit(PROMPT, 8)
+    assert r is not None
+    _assert_conserved(b)
+    b.run_until_drained()
+    assert b.result(r) == oracle
+    assert b.stats_prefix["remote_hits"] == 1
+    assert _tier_hits("remote") == remote_before + 1
+    _assert_conserved(b)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: chaos on the sibling-fetch leg — torn fetch NEVER tears the
+# cache; it degrades to recompute with byte-identical token streams.
+
+
+def _sibling_rig(kv_server):
+    arena_a = KVHostArena(64 << 20)
+    a = _small_engine(slots=4, num_blocks=10, prefix_cache=True,
+                      host_arena=arena_a)
+    oracle = _park_then_evict(a)
+    kv_server.serve_prefixes(arena_a.get)
+    src = kt.RemotePrefixSource(endpoints=[("127.0.0.1", kv_server.port)])
+    b = _small_engine(slots=4, num_blocks=10, prefix_cache=True,
+                      remote_prefix=src)
+    return b, oracle
+
+
+def test_chaos_torn_fetch_falls_back_to_recompute(armed, kv_server):
+    """drop:2 tears BOTH fetch attempts (the retry re-serves the whole
+    stream): the engine must recompute the prefix — a miss, byte-identical
+    tokens, the pool conserved, and no leaked inflight-chunk gauge."""
+    b, oracle = _sibling_rig(kv_server)
+    misses = lambda: metrics.REGISTRY.counter_value(  # noqa: E731
+        "serving_prefix_cache_misses_total", {"engine": "paged"})
+    m0 = misses()
+    armed("kv.stream.recv_chunk", "drop:2")
+    r = b.submit(PROMPT, 8)
+    assert r is not None
+    b.run_until_drained()
+    assert b.result(r) == oracle
+    assert b.stats_prefix["remote_hits"] == 0
+    assert misses() == m0 + 1
+    _assert_conserved(b)
+    # The server side released every unacked chunk of the torn streams.
+    assert metrics.REGISTRY.gauge_value(
+        "serving_kv_stream_inflight_chunks") in (None, 0.0)
+
+
+def test_chaos_single_drop_retries_whole_stream_then_hits(armed, kv_server):
+    """drop:1 tears only the first attempt: the retry replays the stream
+    from chunk 0 and the admission still lands a REMOTE-tier hit."""
+    b, oracle = _sibling_rig(kv_server)
+    armed("kv.stream.recv_chunk", "drop:1")
+    r = b.submit(PROMPT, 8)
+    assert r is not None
+    b.run_until_drained()
+    assert b.result(r) == oracle
+    assert b.stats_prefix["remote_hits"] == 1
+    _assert_conserved(b)
+
+
+def test_chaos_paced_fetch_leg_stays_byte_identical(armed, kv_server):
+    """pace: on the serving leg (a DCN-like slow link) delays but never
+    corrupts: the fetch completes as a remote hit with identical tokens."""
+    b, oracle = _sibling_rig(kv_server)
+    armed("kv.stream.send_chunk", "pace:50")
+    r = b.submit(PROMPT, 8)
+    assert r is not None
+    b.run_until_drained()
+    assert b.result(r) == oracle
+    assert b.stats_prefix["remote_hits"] == 1
+    _assert_conserved(b)
+
+
+def test_chaos_fetch_site_fault_degrades_to_recompute(armed, kv_server):
+    """The bare kv.prefix.fetch raising point: every fetch attempt dies
+    before dialing — fetch() absorbs it and the engine recomputes."""
+    b, oracle = _sibling_rig(kv_server)
+    armed("kv.prefix.fetch", "fail_n_times:4:OSError")
+    r = b.submit(PROMPT, 8)
+    assert r is not None
+    b.run_until_drained()
+    assert b.result(r) == oracle
+    assert b.stats_prefix["remote_hits"] == 0
+    _assert_conserved(b)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: /debug/prefixes advertisement + the fleet digest index
+
+
+def test_debug_prefixes_endpoint_auth_and_limit():
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    kv_host_arena.register_prefix_source(
+        "test-src",
+        lambda: {"block_size": 16,
+                 "digests": [b"\xaa" * 16, b"\xbb" * 16],
+                 "arena_digests": [b"\xcc" * 16]},
+    )
+    kv_host_arena.register_fetch_port(12345)
+    server = TelemetryServer(port=0, token="s3cret")
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/prefixes", timeout=10)
+        assert err.value.code == 401  # bearer-gated like every debug surface
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/debug/prefixes?limit=abc",
+                    headers={"Authorization": "Bearer s3cret"},
+                ), timeout=10)
+        assert err.value.code == 400  # parse_limit parity with /debug/*
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                f"{base}/debug/prefixes?limit=16",
+                headers={"Authorization": "Bearer s3cret"},
+            ), timeout=10,
+        ) as resp:
+            body = json.loads(resp.read().decode())
+        assert (b"\xaa" * 16).hex() in body["digests"]
+        assert (b"\xcc" * 16).hex() in body["arena_digests"]
+        assert body["kv_port"] == 12345
+    finally:
+        server.stop()
+        kv_host_arena.unregister_prefix_source("test-src")
+        kv_host_arena.register_fetch_port(None)
+
+
+def test_dead_prefix_source_is_pruned():
+    kv_host_arena.register_prefix_source("dead-src", lambda: None)
+    out = kv_host_arena.debug_prefixes()
+    assert "dead-src" not in kv_host_arena._PREFIX_SOURCES
+    assert isinstance(out["digests"], list)
+
+
+def test_fleet_prefix_index_merges_and_prefers_arena_tier():
+    """The FleetCollector folds /debug/prefixes advertisements into a
+    digest -> (instance, host, kv_port) index; for a digest present both
+    HBM-resident and arena-backed, the arena copy wins (it's the one the
+    default fetch provider actually serves)."""
+    from lws_tpu.api.pod import Container, EnvVar, Pod, PodPhase, PodSpec
+    from lws_tpu.core.store import new_meta
+    from lws_tpu.runtime import ControlPlane
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    both = b"\xd0" * 16  # advertised in BOTH tiers
+    kv_host_arena.register_prefix_source(
+        "fleet-src",
+        lambda: {"block_size": 16, "digests": [both, b"\xd1" * 16],
+                 "arena_digests": [both, b"\xd2" * 16]},
+    )
+    kv_host_arena.register_fetch_port(7070)
+    worker = TelemetryServer(port=0)
+    worker.start()
+    cp = ControlPlane()
+    try:
+        pod = cp.store.create(Pod(
+            meta=new_meta("pfx-w0"),
+            spec=PodSpec(containers=[Container(
+                name="w", command=["sleep", "1"],
+                env=[EnvVar("LWS_TPU_METRICS_PORT", str(worker.port))],
+            )]),
+        ))
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.ready = True
+        pod.status.address = "127.0.0.1"
+        cp.store.update_status(pod)
+        index = cp.fleet.collect_prefix_index()
+        assert index["instances"] == 1
+        digests = index["digests"]
+        assert digests[(b"\xd1" * 16).hex()]["tier"] == "hbm"
+        assert digests[(b"\xd2" * 16).hex()]["tier"] == "host"
+        assert digests[both.hex()]["tier"] == "host"  # arena copy wins
+        entry = digests[both.hex()]
+        assert entry["instance"] == "pfx-w0"
+        assert (entry["host"], entry["port"]) == ("127.0.0.1", 7070)
+        # The RemotePrefixSource-shaped closure resolves the same snapshot.
+        lookup = cp.fleet.prefix_lookup()
+        assert lookup(both.hex()) == ("127.0.0.1", 7070)
+        assert lookup("ff" * 16) is None
+    finally:
+        worker.stop()
+        kv_host_arena.unregister_prefix_source("fleet-src")
+        kv_host_arena.register_fetch_port(None)
+
+
+def test_engine_registers_prefix_snapshot_weakly():
+    """A prefix-cached engine self-registers its digest snapshot; once the
+    engine is collected the provider answers None and is pruned."""
+    arena = KVHostArena(64 << 20)
+    engine = _small_engine(slots=2, num_blocks=10, prefix_cache=True,
+                           host_arena=arena)
+    name = engine._prefix_source_name
+    assert name in kv_host_arena._PREFIX_SOURCES
+    engine.submit(PROMPT, 8)
+    engine.run_until_drained()
+    snap = kv_host_arena._PREFIX_SOURCES[name]()
+    assert snap is not None and len(snap["digests"]) == 1
+    del engine
+    import gc
+
+    gc.collect()
+    kv_host_arena.debug_prefixes()
+    assert name not in kv_host_arena._PREFIX_SOURCES
+
+
+# ---------------------------------------------------------------------------
+# Satellite: `lws-tpu top --by-tier` renders the hierarchy breakdown
+
+
+TIERED_EXPOSITION = """\
+# HELP serving_requests_total x
+# TYPE serving_requests_total counter
+serving_requests_total{engine="paged",instance="w0"} 20.0
+serving_requests_total{engine="paged",instance="w1"} 10.0
+# HELP serving_prefix_cache_hits_total x
+# TYPE serving_prefix_cache_hits_total counter
+serving_prefix_cache_hits_total{engine="paged",instance="w0",tier="hbm"} 6.0
+serving_prefix_cache_hits_total{engine="paged",instance="w0",tier="host"} 3.0
+serving_prefix_cache_hits_total{engine="paged",instance="w0",tier="remote"} 1.0
+serving_prefix_cache_hits_total{engine="paged",instance="w1"} 5.0
+# HELP serving_prefix_cache_misses_total x
+# TYPE serving_prefix_cache_misses_total counter
+serving_prefix_cache_misses_total{engine="paged",instance="w0"} 10.0
+serving_prefix_cache_misses_total{engine="paged",instance="w1"} 5.0
+"""
+
+
+def test_top_by_tier_splits_pfx_and_keeps_aggregate():
+    from lws_tpu.cli import _top_rows, render_top
+    from lws_tpu.core.metrics import parse_exposition
+
+    fams = parse_exposition(TIERED_EXPOSITION)
+    rows = _top_rows(fams)
+    w0 = rows[("w0", "paged")]
+    # Aggregate PFX survives the tier split; per-tier fields ride along.
+    assert w0["pfx_hits"] == 10.0
+    assert (w0["pfx_hits_hbm"], w0["pfx_hits_host"], w0["pfx_hits_remote"]) \
+        == (6.0, 3.0, 1.0)
+    # Legacy tier-less series (older worker mid-rollout) folds as hbm.
+    w1 = rows[("w1", "paged")]
+    assert w1["pfx_hits"] == 5.0 and w1["pfx_hits_hbm"] == 5.0
+
+    plain = render_top(fams)
+    assert "PFX%" in plain and "h%" not in plain
+    tiered = render_top(fams, by_tier=True)
+    header = tiered.splitlines()[1]
+    assert "h%" in header and "H%" in header and "R%" in header
+    w0_row = next(l for l in tiered.splitlines() if l.startswith("w0"))
+    # 20 lookups: 10 hits = 50% PFX, split 30% hbm / 15% host / 5% remote.
+    for cell in ("50%", "30%", "15%", "5%"):
+        assert cell in w0_row, (cell, w0_row)
+    w1_row = next(l for l in tiered.splitlines() if l.startswith("w1"))
+    assert "50%" in w1_row and "0%" in w1_row
