@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy bench-closed-loop clean
 
 test:
 	python -m pytest tests/ -q
@@ -48,7 +48,10 @@ bench-fleet-scale:  ## 1,000-instance sim fleet: tree scrape must beat flat, str
 bench-prefix-hierarchy:  ## host-arena prefix restore must cut cold-HBM shared-prefix TTFT >=30% vs recompute, byte-identical, pool conserved (budget json)
 	python benchmarks/prefix_hierarchy_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy  ## what CI would run (vet gates before tests)
+bench-closed-loop:  ## seeded flash-crowd sweep: scale-out within budget, one drained scale-in, zero flaps, full decision provenance (budget json)
+	python benchmarks/closed_loop_bench.py --check
+
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy bench-closed-loop  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

@@ -1,0 +1,123 @@
+"""Closed-loop actuation bench: the self-driving fleet must react, settle,
+and never oscillate.
+
+The decision plane (lws_tpu/obs/decisions.py) is only allowed to actuate
+by default because its behavior under the canonical incident — a flash
+crowd — is pinned here. The bench drives the seeded closed-loop sweep
+(lws_tpu/loadgen/closedloop.py: densified flash_crowd arrivals against a
+binary capacity plant, a REAL ScaleRecommender + ScaleActuator closing the
+loop through the AnnotationAdapter -> stock Autoscaler -> DS writeback
+chain on an in-process ControlPlane, injected clocks throughout) and
+asserts the control-theory contract:
+
+  * reaction   — scale-out lands within `max_reaction_evals` evaluations
+    of the crowd's first over-capacity tick;
+  * recovery   — exactly one DrainGate-mediated scale-in step after the
+    burn clears, and it converges;
+  * stability  — `serving_actuation_flaps_total` stays zero and the fleet
+    never exceeds `max_replicas` (the autoscaler clamp holds);
+  * provenance — every applied actuation resolves to a full decision
+    record (guards, generations, convergence timing).
+
+Run:    python benchmarks/closed_loop_bench.py           # report
+CI:     python benchmarks/closed_loop_bench.py --check   # enforce
+The budget lives in benchmarks/closed_loop_budget.json (wired into
+`make check`). Deterministic per (seed, density): no wall time anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from lws_tpu.loadgen import closedloop  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "closed_loop_budget.json")
+
+
+def measure(seed: int, density: float, max_replicas: int) -> dict:
+    res = closedloop.run_sweep(seed=seed, density=density,
+                               max_replicas=max_replicas)
+    first_bad = next((e["tick"] for e in res["evaluations"]
+                      if e["over_capacity"]), None)
+    reaction = (res["scale_out_tick"] - first_bad + 1
+                if first_bad is not None and res["scale_out_tick"] is not None
+                else None)
+    applied = [d for d in res["decisions"] if d["outcome"] == "applied"]
+    complete = sum(
+        1 for d in applied
+        if d["guards"] and all(g["passed"] for g in d["guards"])
+        and d["generation_before"] is not None
+        and d["converged_at"] is not None and d["converged_at"] >= 0
+        and d["convergence_s"] is not None
+    )
+    return {
+        "seed": seed,
+        "density": density,
+        "ticks": res["ticks"],
+        "first_over_capacity_tick": first_bad,
+        "scale_out_tick": res["scale_out_tick"],
+        "scale_in_tick": res["scale_in_tick"],
+        "reaction_evals": reaction,
+        "scale_in_steps": res["scale_in_steps"],
+        "scale_in_converged": res["converged"],
+        "drains": len(res["drains"]),
+        "max_replicas_seen": res["max_replicas_seen"],
+        "flaps": res["flaps"],
+        "applied": len(applied),
+        "provenance_complete": complete,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seed", type=int, default=7,
+                        help="schedule seed for the flash-crowd sweep")
+    parser.add_argument("--density", type=float, default=10.0,
+                        help="flash_crowd rate multiplier (see closedloop.py)")
+    parser.add_argument("--check", action="store_true",
+                        help="enforce closed_loop_budget.json (CI mode)")
+    args = parser.parse_args()
+
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+
+    m = measure(args.seed, args.density, budget["max_replicas"])
+    checks = {
+        "scaled_out": m["scale_out_tick"] is not None,
+        "reaction_within_budget": (
+            m["reaction_evals"] is not None
+            and m["reaction_evals"] <= budget["max_reaction_evals"]),
+        "one_scale_in_step": m["scale_in_steps"] == 1,
+        "scale_in_converged": m["scale_in_converged"],
+        "victim_drained": m["drains"] == 1,
+        "zero_flaps": m["flaps"] == 0,
+        "replicas_bounded": m["max_replicas_seen"] <= budget["max_replicas"],
+        "provenance_complete": (
+            m["applied"] > 0 and m["provenance_complete"] == m["applied"]),
+    }
+    verdict = dict(m)
+    verdict["metric"] = ("closed-loop flash crowd: reaction, one-step "
+                         "recovery, zero flaps, full provenance")
+    verdict["budget"] = {k: v for k, v in budget.items()
+                         if not k.startswith("_")}
+    verdict["checks"] = checks
+    verdict["within_budget"] = all(checks.values())
+    print(json.dumps(verdict), flush=True)
+    if args.check and not verdict["within_budget"]:
+        failed = [k for k, ok in checks.items() if not ok]
+        print(f"[closed-loop] FAIL: {', '.join(failed)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

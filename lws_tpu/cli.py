@@ -8,6 +8,7 @@
   python -m lws_tpu top    [--watch] [--server HOST:PORT]
   python -m lws_tpu monitor [FILTER] [--watch] [--server HOST:PORT]
   python -m lws_tpu rollout [--watch] [--timeline-only] [--server HOST:PORT]
+  python -m lws_tpu why DECISION_ID|last[:PLANE] [--server HOST:PORT]
   python -m lws_tpu faults [point=spec ...] [--clear] [--drain] [--server HOST:PORT]
   python -m lws_tpu plan-steps --initial 4,4 --target 4,4 [--surge 1,1] [--unavailable 0,0]
 """
@@ -899,7 +900,8 @@ def cmd_top(args) -> int:
 
 # ---------------------------------------------------------------------------
 # lws-tpu monitor: the history-plane view — per-series sparklines, burn
-# columns, firing alerts, and the current dry-run scale recommendation.
+# columns, firing alerts, the current scale recommendation, and the ACT
+# column (last actuation per plane).
 
 _SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 
@@ -937,10 +939,11 @@ def _series_cells(kind: str, points: list) -> tuple[list, str]:
 def render_monitor(snapshot: dict, fams: dict | None = None,
                    alerts: dict | None = None, now: float | None = None,
                    top_n: int = 24, name_filter: str = "",
-                   top_k: int = 40) -> str:
+                   top_k: int = 40, decisions: list | None = None) -> str:
     """One frame of `lws-tpu monitor`: the /debug/history snapshot's series
     as sparklines (counters as rates, gauges raw), the burn-rate and
-    scale-recommendation gauges folded from the metrics surface, and the
+    scale-recommendation gauges folded from the metrics surface, the ACT
+    column (last actuation per plane, from /debug/decisions), and the
     firing alerts. Pure function of its inputs so tests drive it from
     canned data. `top_k` bounds the burn table to the hottest rows
     (highest burn first, truncation footer; 0 unbounded) — the fleet
@@ -957,8 +960,9 @@ def render_monitor(snapshot: dict, fams: dict | None = None,
     for name, details in sorted((alerts or {}).items()):
         for d in details:
             lines.append(f"  ALERT {name}: {json.dumps(d)}")
-    # The dry-run recommendation + burn gauges ride the normal metrics
-    # surface (obs/recommend.py publishes them like any other sensor).
+    lines.extend(_act_lines(decisions, now=now))
+    # The recommendation + burn gauges ride the normal metrics surface
+    # (obs/recommend.py publishes them like any other sensor).
     if fams:
         rec = {
             labels.get("role", "-"): value
@@ -1069,9 +1073,10 @@ def _fetch_monitor_state(server: str) -> tuple[dict, dict]:
 
 def cmd_monitor(args) -> int:
     """History-plane view: the server's retained series (/debug/history) as
-    sparklines, the burn-rate columns and current dry-run scale
-    recommendation from its metrics surface, and firing watchdog alerts.
-    One-shot by default; --watch redraws every --interval seconds."""
+    sparklines, the burn-rate columns and current scale recommendation from
+    its metrics surface, the last actuation per decision plane (ACT lines,
+    from /debug/decisions), and firing watchdog alerts. One-shot by
+    default; --watch redraws every --interval seconds."""
     args.interval = max(args.interval, 1.0)
     while True:
         snap = _http(args.server, "GET", f"/debug/history?limit={args.limit}")
@@ -1081,9 +1086,11 @@ def cmd_monitor(args) -> int:
             raise SystemExit(
                 f"error: cannot reach server {args.server}: {e.reason}"
             ) from None
+        decisions = _fetch_decisions(args.server)
         frame = render_monitor(snap, fams, alerts, top_n=args.top,
                                name_filter=args.filter or "",
-                               top_k=getattr(args, "top_k", 40))
+                               top_k=getattr(args, "top_k", 40),
+                               decisions=decisions)
         if not args.watch:
             print(frame)
             return 0
@@ -1287,20 +1294,22 @@ def cmd_explain(args) -> int:
 # ---------------------------------------------------------------------------
 # lws-tpu rollout: the rollout intelligence plane — the control-plane
 # timeline ledger (/debug/rollout) plus the per-revision SLO comparison and
-# dry-run canary verdicts the analyzer publishes on the fleet surface
-# (lws_tpu/obs/rollout.py).
+# the canary verdicts the analyzer publishes on the fleet surface (and the
+# RolloutActuator acts on; lws_tpu/obs/rollout.py, obs/decisions.py).
 
 
 _VERDICT_NAMES = {1.0: "promote", 0.0: "hold", -1.0: "rollback"}
 
 
 def render_rollout(entries: list, fams: dict, alerts: dict,
-                   max_timeline: int = 32) -> str:
+                   max_timeline: int = 32, decisions: list | None = None,
+                   now: float | None = None) -> str:
     """One `lws-tpu rollout` frame: the per-revision comparison table
     (verdict gauge + revision-scoped burn twins + goodput folded from the
-    fleet exposition's revision labels), firing alerts, and the ledger
-    timeline newest-last. Pure function of the fetched state so tests drive
-    it from canned data."""
+    fleet exposition's revision labels), the ACT column (last actuation per
+    plane, from /debug/decisions), firing alerts, and the ledger timeline
+    newest-last. Pure function of the fetched state so tests drive it from
+    canned data."""
 
     def samples(family: str):
         return [
@@ -1350,6 +1359,10 @@ def render_rollout(entries: list, fams: dict, alerts: dict,
         )
     if len(revs) == 0:
         lines.append("(no revision-labelled serving series yet)")
+    act = _act_lines(decisions, now=now)
+    if act:
+        lines.append("")
+        lines.extend(act)
     if alerts:
         lines.append("")
         for name in sorted(alerts):
@@ -1374,10 +1387,11 @@ def render_rollout(entries: list, fams: dict, alerts: dict,
 
 def cmd_rollout(args) -> int:
     """Rollout intelligence: the control-plane transition timeline
-    (/debug/rollout), the per-revision SLO comparison table, and the
-    dry-run canary verdicts (`lws_rollout_canary_verdict`) the analyzer
-    refreshes on every fleet scrape. One-shot by default; --watch redraws
-    every --interval seconds; --timeline-only skips the metrics fetch."""
+    (/debug/rollout), the per-revision SLO comparison table, the canary
+    verdicts (`lws_rollout_canary_verdict`) the analyzer refreshes on every
+    fleet scrape, and the last actuation per decision plane (ACT lines).
+    One-shot by default; --watch redraws every --interval seconds;
+    --timeline-only skips the metrics fetch."""
     args.interval = max(args.interval, 1.0)
     while True:
         entries = _http(args.server, "GET",
@@ -1391,18 +1405,253 @@ def cmd_rollout(args) -> int:
                 raise SystemExit(
                     f"error: cannot reach server {args.server}: {e.reason}"
                 ) from None
+        decisions = _fetch_decisions(args.server)
         if args.json:
-            print(json.dumps({"timeline": entries,
-                              "alerts": alerts}, indent=1, default=str))
+            print(json.dumps({"timeline": entries, "alerts": alerts,
+                              "decisions": decisions},
+                             indent=1, default=str))
             return 0
         frame = render_rollout(entries, fams, alerts,
-                               max_timeline=args.limit)
+                               max_timeline=args.limit,
+                               decisions=decisions)
         if not args.watch:
             print(frame)
             return 0
         sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
         sys.stdout.flush()
         time.sleep(args.interval)
+
+
+# ---------------------------------------------------------------------------
+# lws-tpu why: decision forensics — one actuation decision's full evidence
+# chain (burn window → guards → verdict → actuation → convergence) from the
+# DecisionLedger served at /debug/decisions (lws_tpu/obs/decisions.py),
+# the way `explain` renders a request.
+
+
+def _fmt_age(seconds: float) -> str:
+    if seconds < 120:
+        return f"{seconds:.0f}s"
+    if seconds < 7200:
+        return f"{seconds / 60:.0f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def _fetch_decisions(server: str, limit: int = 64) -> list:
+    """Best-effort /debug/decisions window for the ACT column — a server
+    predating the decision plane (or a worker port behind auth) degrades
+    to no ACT lines, not a failed frame."""
+    try:
+        rows = _http(server, "GET", f"/debug/decisions?limit={limit}")
+        return rows if isinstance(rows, list) else []
+    except SystemExit:
+        return []
+
+
+def _last_actuations(decisions: list) -> dict:
+    """{plane: record} — the newest record carrying an actuation outcome
+    per plane, folded from a newest-last /debug/decisions window (the
+    client-side mirror of `DecisionLedger.last_actuation`)."""
+    out: dict = {}
+    for rec in decisions or []:
+        if rec.get("action"):
+            out[rec.get("plane", "-")] = rec
+    return out
+
+
+def _act_lines(decisions: list | None, now: float | None = None) -> list:
+    """The ACT column `lws-tpu monitor` and `lws-tpu rollout` share: one
+    line per decision plane with the last actuation's action, outcome,
+    subject, age, and decision id (the handle `lws-tpu why` takes)."""
+    if now is None:
+        now = time.time()
+    lines = []
+    last = _last_actuations(decisions or [])
+    for plane in sorted(last):
+        rec = last[plane]
+        acted = rec.get("acted_at")
+        age = _fmt_age(max(0.0, now - acted)) if acted is not None else "-"
+        detail = rec.get("detail") or {}
+        if detail.get("superseded_by"):
+            state = f"superseded by {detail['superseded_by']}"
+        elif rec.get("convergence_s") is not None:
+            state = f"converged {rec['convergence_s']:.1f}s"
+        elif rec.get("outcome") == "applied":
+            state = "converging"
+        else:
+            state = ""
+        if detail.get("flap"):
+            state = (state + "  FLAP").strip()
+        lines.append(
+            f"ACT {plane:<8} {str(rec.get('action', '-')):<10}"
+            f"{str(rec.get('outcome', '-')):<11}"
+            f"{str(rec.get('subject', '-'))[:20]:<21}"
+            f"{age:>5} ago  [{rec.get('id', '-')}]"
+            + (f"  {state}" if state else "")
+        )
+    return lines
+
+
+def render_why(record: dict, now: float | None = None) -> str:
+    """One `lws-tpu why <decision-id>` frame: the decision's evidence chain
+    end to end — the burn-window/ring inputs that drove the verdict, each
+    guard's pass/fail, the actuation outcome with the target's store
+    generations, and convergence. Pure function of the /debug/decisions
+    record so tests drive it from canned data."""
+    if now is None:
+        now = time.time()
+    detail = record.get("detail") or {}
+    head = (
+        f"DECISION {record.get('id', '-')}"
+        f"  plane={record.get('plane', '-')}"
+        f"  subject={record.get('subject', '-')}"
+        f"  verdict={record.get('verdict', '-')}"
+    )
+    if record.get("repeats"):
+        head += f"  repeats={record['repeats']}"
+    lines = [head]
+    at = record.get("at")
+    if at is not None:
+        lines.append(
+            f"at {time.strftime('%H:%M:%S', time.localtime(at))}"
+            f"  ({_fmt_age(max(0.0, now - at))} ago)"
+        )
+
+    inputs = record.get("inputs") or {}
+    lines.append("")
+    lines.append("EVIDENCE")
+    if inputs.get("reason"):
+        lines.append(f"  reason: {inputs['reason']}")
+    if inputs.get("current") is not None or inputs.get("desired") is not None:
+        lines.append(f"  replicas: current={inputs.get('current', '-')}"
+                     f" desired={inputs.get('desired', '-')}")
+    if inputs.get("firing"):
+        lines.append(f"  firing: {', '.join(inputs['firing'])}")
+    burns = inputs.get("burns") or []
+    if burns:
+        lines.append(f"  {'BURN SERIES':<30}{'WINDOW':<8}{'SHORT':>8}"
+                     f"{'LONG':>8}{'THRESH':>8}  FIRING")
+        for b in burns[:12]:
+            key = str(b.get("series", "-"))
+            if b.get("instance"):
+                key += "@" + str(b["instance"])
+            lines.append(
+                f"  {key[:29]:<30}{str(b.get('window', '-')):<8}"
+                f"{b.get('short_burn', 0.0):>7.1f}x"
+                f"{b.get('long_burn', 0.0):>7.1f}x"
+                f"{b.get('threshold', 0.0):>7.1f}x"
+                f"  {'yes' if b.get('firing') else 'no'}"
+            )
+        if len(burns) > 12:
+            lines.append(f"  ... {len(burns) - 12} more burn rows")
+    verdicts = inputs.get("verdicts") or {}
+    if verdicts:
+        if inputs.get("baseline"):
+            lines.append(f"  baseline: {inputs['baseline']}")
+
+        def x(v):
+            return f"{v:.1f}x" if isinstance(v, (int, float)) else "-"
+
+        lines.append(f"  {'REVISION':<16}{'VERDICT':>10}{'SHORT':>8}"
+                     f"{'LONG':>8}{'BASE':>8}  REASON")
+        for rev in sorted(verdicts):
+            v = verdicts[rev] or {}
+            lines.append(
+                f"  {rev[:15]:<16}{str(v.get('verdict', '-')):>10}"
+                f"{x(v.get('short_burn')):>8}{x(v.get('long_burn')):>8}"
+                f"{x(v.get('baseline_burn')):>8}  {v.get('reason', '-')}"
+            )
+    if not (inputs.get("reason") or burns or verdicts):
+        lines.append("  (no recorded inputs)")
+
+    lines.append("")
+    lines.append("GUARDS")
+    guards = record.get("guards") or []
+    for g in guards:
+        mark = "pass" if g.get("passed") else "FAIL"
+        lines.append(f"  [{mark}] {str(g.get('name', '-')):<18}"
+                     f"{g.get('detail', '')}")
+    if not guards:
+        lines.append("  (none recorded)")
+
+    lines.append("")
+    lines.append("ACTUATION")
+    if record.get("action"):
+        acted = record.get("acted_at")
+        line = f"  {record['action']} -> {record.get('outcome', '-')}"
+        if acted is not None:
+            line += f"  at {time.strftime('%H:%M:%S', time.localtime(acted))}"
+        lines.append(line)
+        gb = record.get("generation_before")
+        ga = record.get("generation_after")
+        if gb is not None or ga is not None:
+            lines.append(
+                f"  target generation: {gb if gb is not None else '?'}"
+                f" -> {ga if ga is not None else '?'}"
+            )
+        kv = " ".join(
+            f"{k}={json.dumps(detail[k]) if isinstance(detail[k], (dict, list)) else detail[k]}"
+            for k in sorted(detail) if k not in ("flap", "superseded_by")
+        )
+        if kv:
+            lines.append(f"  {kv}")
+        if detail.get("flap"):
+            lines.append("  FLAP: this actuation reversed direction inside"
+                         " the flap window")
+    else:
+        lines.append("  (not acted on — verdict recorded only)")
+
+    lines.append("")
+    if detail.get("superseded_by"):
+        lines.append(f"CONVERGENCE: superseded by {detail['superseded_by']}"
+                     " before the fleet settled")
+    elif record.get("convergence_s") is not None:
+        lines.append(f"CONVERGENCE: fleet settled "
+                     f"{record['convergence_s']:.2f}s after actuation")
+    elif record.get("outcome") == "applied":
+        lines.append("CONVERGENCE: pending — the fleet has not settled on"
+                     " the decided state yet")
+    else:
+        lines.append("CONVERGENCE: n/a (nothing was applied)")
+    return "\n".join(lines)
+
+
+def cmd_why(args) -> int:
+    """Decision forensics: fetch the /debug/decisions window, pick the
+    decision (by id, or `last` / `last:scale` / `last:rollout` for the
+    most recent actuation), and render its full evidence chain."""
+    decisions = _http(args.server, "GET",
+                      f"/debug/decisions?limit={max(args.limit, 1)}")
+    if not isinstance(decisions, list):
+        decisions = []
+    wanted = args.decision_id
+    record = None
+    if wanted == "last" or wanted.startswith("last:"):
+        _, _, plane = wanted.partition(":")
+        acted = _last_actuations(decisions)
+        if plane:
+            record = acted.get(plane)
+        elif acted:
+            record = max(acted.values(),
+                         key=lambda r: r.get("acted_at") or 0.0)
+        if record is None:
+            # Nothing acted yet: fall back to the newest verdict so `last`
+            # still explains a record-only fleet.
+            pool = [r for r in decisions
+                    if not plane or r.get("plane") == plane]
+            record = pool[-1] if pool else None
+    else:
+        record = next((r for r in decisions if r.get("id") == wanted), None)
+    if record is None:
+        print(f"error: decision '{wanted}' is not in the retained window "
+              f"({len(decisions)} records fetched; raise --limit, or pick "
+              "an id from `lws-tpu monitor`'s ACT lines)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(record, indent=1))
+        return 0
+    print(render_why(record))
+    return 0
 
 
 def render_profile(instances: list, top_n: int = 15) -> str:
@@ -1538,7 +1787,7 @@ def cmd_loadgen(args) -> int:
     # fleet surface for the run's duration (off the drive loop: a stalled
     # server must cost a sample gap, never delay an open-loop arrival),
     # and the final report appends the peak burn per class plus the
-    # dry-run recommendation trace.
+    # recommendation trace.
     ring = None
     if args.server:
         from lws_tpu.obs.history import HistoryRing
@@ -1632,10 +1881,15 @@ def cmd_loadgen(args) -> int:
         report["history"] = loadgen.fold_history(ring, targets)
         # With revision-labelled series in the ring (a rollout happened
         # during the run — bumped by the scenario or externally), the
-        # report appends the dry-run canary verdict trace.
+        # report appends the canary verdict trace.
         canary = loadgen.fold_canary(ring, lws=bump_lws or "-")
         if canary is not None:
             report["canary"] = canary
+        # Actuation counters in the ring mean the server closed the loop
+        # during the run: fold what it did into the report.
+        actuations = loadgen.fold_actuations(ring)
+        if actuations is not None:
+            report["actuations"] = actuations
     fleet = None
     if args.server:
         from lws_tpu.core.metrics import parse_exposition
@@ -1844,8 +2098,8 @@ def main(argv=None) -> int:
 
     mon = sub.add_parser("monitor", help="history-plane view: retained series "
                          "as sparklines, burn-rate columns, firing alerts, "
-                         "and the dry-run scale recommendation "
-                         "(from /debug/history)")
+                         "the scale recommendation, and the last actuation "
+                         "per plane (from /debug/history + /debug/decisions)")
     mon.add_argument("filter", nargs="?", default="",
                      help="only show series whose name{labels} contains this")
     mon.add_argument("--server", default="127.0.0.1:9443",
@@ -1891,8 +2145,8 @@ def main(argv=None) -> int:
 
     ro = sub.add_parser("rollout", help="rollout intelligence: the "
                         "control-plane transition timeline (/debug/rollout), "
-                        "per-revision SLO comparison, and dry-run canary "
-                        "verdicts")
+                        "per-revision SLO comparison, canary verdicts, and "
+                        "the last actuation per plane")
     ro.add_argument("--server", default="127.0.0.1:9443",
                     help="API server host:port")
     ro.add_argument("--watch", action="store_true",
@@ -1904,8 +2158,24 @@ def main(argv=None) -> int:
                     dest="timeline_only",
                     help="skip the metrics fetch; ledger timeline only")
     ro.add_argument("--json", action="store_true",
-                    help="emit the raw timeline/alerts JSON")
+                    help="emit the raw timeline/alerts/decisions JSON")
     ro.set_defaults(fn=cmd_rollout)
+
+    wy = sub.add_parser("why", help="decision forensics: one actuation "
+                        "decision's full evidence chain — burn window → "
+                        "guards → verdict → actuation → convergence "
+                        "(from /debug/decisions)")
+    wy.add_argument("decision_id",
+                    help="a decision id from the ACT lines / "
+                         "/debug/decisions, or `last`, `last:scale`, "
+                         "`last:rollout` for the most recent actuation")
+    wy.add_argument("--server", default="127.0.0.1:9443",
+                    help="API server or worker telemetry host:port")
+    wy.add_argument("--limit", type=int, default=256,
+                    help="decision records to fetch (the retained window)")
+    wy.add_argument("--json", action="store_true",
+                    help="emit the raw decision record JSON")
+    wy.set_defaults(fn=cmd_why)
 
     prf = sub.add_parser("profile", help="continuous-profiling view: per-span "
                          "and top-of-stack self-time (from /debug/profile)")

@@ -121,3 +121,14 @@ class AutoscalerReconciler:
         self.recorder.event(
             asc, "Normal", "Scaled", f"scaled {lws.meta.name} from {old} to {replicas} replicas"
         )
+        # Provenance feed: the move lands in the flight-recorder ring (and
+        # through it the rollout timeline), so a replica change is always
+        # attributable — `lws-tpu why` joins it to the decision that fed
+        # this autoscaler its annotations.
+        from lws_tpu.core import flightrecorder
+
+        flightrecorder.record(
+            "autoscaler_scaled", autoscaler=asc.meta.name,
+            lws=f"{lws.meta.namespace}/{lws.meta.name}",
+            from_replicas=old, to_replicas=replicas,
+        )

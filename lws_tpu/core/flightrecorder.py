@@ -167,6 +167,7 @@ class FlightRecorder:
         and the obs modules are consumers of this module's surfaces, not
         dependencies)."""
         from lws_tpu.core import profile as profmod
+        from lws_tpu.obs import decisions as decisionsmod
         from lws_tpu.obs import history as historymod
         from lws_tpu.obs import journey as journeymod
         from lws_tpu.obs import rollout as rolloutmod
@@ -186,6 +187,9 @@ class FlightRecorder:
             "history": historymod.HISTORY.snapshot(limit=64, max_points=256),
             "journeys": journeymod.VAULT.worst(limit=8),
             "rollout": rolloutmod.LEDGER.snapshot(limit=64),
+            # The recent decision window: an alert's dump carries the
+            # actuation provenance of the episode that fired it.
+            "decisions": decisionsmod.DECISIONS.snapshot(limit=32),
         }
 
 

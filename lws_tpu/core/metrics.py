@@ -805,7 +805,7 @@ describe("serving_kv_connection_errors_total", "KV handoff connections that died
 describe("lws_fault_trips_total", "Injected-fault firings per fault point and mode (chaos runs only; zero in production)")
 describe("lws_fault_points_armed", "Fault points currently armed in this process")
 describe("lws_fleet_scrape_skipped_total", "Fleet scrapes skipped because the instance is in failure backoff")
-# --- time-series history plane + dry-run recommender (lws_tpu/obs/) --------
+# --- time-series history plane + scale recommender (lws_tpu/obs/) ----------
 describe("lws_history_samples_total",
          "Exposition sampling passes folded into the process history ring")
 describe("lws_history_series_dropped_total",
@@ -813,7 +813,7 @@ describe("lws_history_series_dropped_total",
 describe("serving_slo_burn_rate",
          "Error-budget burn of the short window per tier (window=fast/slow), per engine and workload class — burn 1.0 exhausts the budget exactly at the SLO horizon; the fast tier pages at 14.4")
 describe("serving_scale_recommendation",
-         "Dry-run desired replica count per DS role from the burn/occupancy signals (lws_tpu/obs/recommend.py) — published as a decision, actuated only through the opt-in annotation adapter")
+         "Desired replica count per DS role from the burn/occupancy signals (lws_tpu/obs/recommend.py) — actuated by default through the stock annotation-adapter chain, recorded on the decision ledger; LWS_TPU_ACTUATION_DISABLE=scale makes it record-only")
 # --- request-journey forensics (lws_tpu/obs/journey.py) --------------------
 describe("serving_journeys_retained_total",
          "Request journeys kept by the tail-sampling vault, per retention outcome (breached/errored/deadline_expired/retried/fault kept 100%; slowest = the slow-K window; sampled = the healthy reservoir)")
@@ -827,6 +827,15 @@ describe("lws_rollout_ledger_dropped_total",
          "global capacity ring or the per-kind budget (a churn-noisy kind at "
          "fleet scale must not push revision flips off the timeline)")
 describe("lws_rollout_canary_verdict",
-         "Dry-run canary verdict per (lws, revision): +1 promote, 0 hold, -1 rollback — insufficient data holds, never promotes; actuation only through the opt-in RolloutActuationAdapter")
+         "Canary verdict per (lws, revision): +1 promote, 0 hold, -1 rollback — insufficient data holds, never promotes; a fresh rollback actuates by default (LWS_TPU_ACTUATION_DISABLE=rollout makes it record-only)")
 describe("serving_slo_burn_rate_by_revision",
          "Revision-scoped twin of serving_slo_burn_rate: the worst instance's short-window burn per (engine, revision, window) — the baseline-vs-canary divergence signal")
+# --- decision provenance + closed-loop actuation (lws_tpu/obs/decisions.py) -
+# Emitted through the DecisionLedger's registry handle; declared here so the
+# catalogue check anchors the names (same pattern as the ring's own drops).
+describe("serving_actuations_total",
+         "Decision-plane actuations per (plane, action, outcome): applied moved the fleet, suppressed = the kill switch, skipped = a failed guard, failed = an adapter error")
+describe("serving_actuation_flaps_total",
+         "Applied actuations that reversed the previous applied direction on the same plane within LWS_TPU_FLAP_WINDOW_S — the control-loop oscillation signal")
+describe("serving_convergence_seconds",
+         "Actuation-to-settled latency per plane: adapter call to the store reflecting the desired state (replicas ready / every pod on the restored revision)")

@@ -48,13 +48,20 @@ DISABLE_ENV = "LWS_TPU_RESILIENCE_DISABLE"
 MECHANISMS = ("deadline", "retry", "breaker", "drain", "dedup")
 
 
-def disabled(mechanism: str) -> bool:
-    """Read per call (not cached): the chaos suite flips the env var
-    between scenarios to prove each mechanism is load-bearing."""
-    raw = os.environ.get(DISABLE_ENV, "")
+def csv_disabled(env_var: str, name: str) -> bool:
+    """The shared kill-switch predicate: `name` appears in the comma list
+    held by `env_var`. Read per call (never cached) so the mutation-proof
+    suites can flip switches between scenarios to prove each mechanism is
+    load-bearing. The actuation planes (obs/decisions.py,
+    LWS_TPU_ACTUATION_DISABLE) share this exact contract."""
+    raw = os.environ.get(env_var, "")
     if not raw:
         return False
-    return mechanism in {part.strip() for part in raw.split(",")}
+    return name in {part.strip() for part in raw.split(",")}
+
+
+def disabled(mechanism: str) -> bool:
+    return csv_disabled(DISABLE_ENV, mechanism)
 
 
 # ---------------------------------------------------------------------------

@@ -27,7 +27,14 @@ from lws_tpu.loadgen.arrivals import (
     make_process,
     piecewise_poisson,
 )
+from lws_tpu.loadgen.closedloop import (
+    CapacityPlant,
+    crowd_arrivals,
+    densified_flash_crowd,
+    run_sweep,
+)
 from lws_tpu.loadgen.report import (
+    fold_actuations,
     fold_canary,
     fold_fleet,
     fold_history,
@@ -67,6 +74,7 @@ from lws_tpu.loadgen.workload import (
 __all__ = [
     "SCENARIOS",
     "BurstProcess",
+    "CapacityPlant",
     "DisaggTarget",
     "EngineTarget",
     "FlashCrowdProcess",
@@ -84,7 +92,10 @@ __all__ = [
     "build_prompt",
     "build_schedule",
     "class_targets",
+    "crowd_arrivals",
+    "densified_flash_crowd",
     "describe_scenario",
+    "fold_actuations",
     "fold_canary",
     "fold_fleet",
     "fold_history",
@@ -98,6 +109,7 @@ __all__ = [
     "render_report",
     "revision_bump",
     "run_schedule",
+    "run_sweep",
     "scenario_names",
     "schedule_digest",
     "summarize",

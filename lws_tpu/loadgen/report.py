@@ -54,7 +54,7 @@ def fold_history(ring, targets_by_class: Optional[dict] = None,
                  max_steps: int = 64) -> dict:
     """Fold a HistoryRing sampled DURING the run (`lws-tpu loadgen
     --server`) into the report's history block: per-class peak/final
-    fast-window burn over the run, plus the dry-run recommendation trace —
+    fast-window burn over the run, plus the recommendation trace —
     a throwaway ScaleRecommender replayed at each retained sample time,
     recording every point the desired-replica verdict changed. Pure
     function of the ring (private registry/recorder), so it never leaks
@@ -133,7 +133,7 @@ def fold_canary(ring, lws: str = "-",
                 delta: Optional[float] = None,
                 max_steps: int = 64) -> Optional[dict]:
     """Fold a run-sampled HistoryRing into the report's canary block: the
-    dry-run verdict trace a throwaway CanaryAnalyzer produces when replayed
+    verdict trace a throwaway CanaryAnalyzer produces when replayed
     at each retained sample time (every point any revision's verdict
     changed, run-relative), plus the final per-revision verdict table.
     Pure function of the ring — private registry/recorder, no ledger — so
@@ -174,6 +174,45 @@ def fold_canary(ring, lws: str = "-",
         "revisions": {r: v.to_dict() for r, v in report.verdicts.items()},
         "trace": trace,
     }
+
+
+def fold_actuations(ring) -> Optional[dict]:
+    """Fold a run-sampled HistoryRing's actuation counters into the
+    report's closed-loop block: per-(plane, action, outcome) totals from
+    `serving_actuations_total`, per-plane flap totals from
+    `serving_actuation_flaps_total`, and a run-relative trace of each
+    count step — the loadgen-side view of the decision plane
+    (obs/decisions.py), so a closed-loop sweep's report shows WHAT the
+    fleet did about the traffic it generated. Totals are the counters'
+    final sampled values. None when the ring never saw an actuation
+    series (open-loop run, or a server predating the decision plane)."""
+    rows = list(ring.series("serving_actuations_total"))
+    if not rows:
+        return None
+    t_all = [t for _, _, _, pts, _ in rows for t, _v in pts]
+    t0 = min(t_all) if t_all else 0.0  # trace times are RUN-relative
+    actuations: dict = {}
+    trace: list = []
+    for _, labels, _, pts, _ in rows:
+        if not pts:
+            continue
+        key = "{}/{}/{}".format(labels.get("plane", "-"),
+                                labels.get("action", "-"),
+                                labels.get("outcome", "-"))
+        actuations[key] = actuations.get(key, 0.0) + pts[-1][1]
+        prev = 0.0
+        for t, v in pts:
+            if v > prev:
+                trace.append({"t": round(t - t0, 3), "what": key,
+                              "count": v})
+            prev = v
+    trace.sort(key=lambda step: step["t"])
+    flaps: dict = {}
+    for _, labels, _, pts, _ in ring.series("serving_actuation_flaps_total"):
+        if pts:
+            plane = labels.get("plane", "-")
+            flaps[plane] = flaps.get(plane, 0.0) + pts[-1][1]
+    return {"actuations": actuations, "flaps": flaps, "trace": trace[-64:]}
 
 
 def _fmt(v, pattern: str = "{:.3f}", dash: str = "-") -> str:
@@ -282,4 +321,18 @@ def render_report(report: dict, fleet: Optional[dict] = None) -> str:
                 f"{r}={v}" for r, v in sorted(step["verdicts"].items())
             )
             lines.append(f"canary @{step['t']:.2f}s: {verdicts}")
+    act = report.get("actuations")
+    if act:
+        lines.append("")
+        totals = " ".join(f"{k}={v:.0f}"
+                          for k, v in sorted(act["actuations"].items()))
+        flaps = " ".join(f"{p}={v:.0f}"
+                         for p, v in sorted(act.get("flaps", {}).items()))
+        lines.append(f"closed loop: {totals}"
+                     + (f"  flaps: {flaps}" if flaps else "  flaps: none"))
+        for step in act.get("trace", []):
+            lines.append(
+                f"actuation @{step['t']:.2f}s: {step['what']}"
+                f" (count {step['count']:.0f})"
+            )
     return "\n".join(lines)

@@ -1,27 +1,43 @@
-"""The time-series decision plane (ROADMAP item 4's missing middle):
-retained scrape rings over the existing metrics surfaces, pure derived
-signals (rates, windowed quantiles, SRE-workbook multi-window burn rates),
-and a dry-run autoscaling recommender that publishes decisions as metrics
-and edge-triggered alerts — actuation stays opt-in via the
-`AnnotationAdapter` seam into the stock `AutoscalerReconciler`.
+"""The time-series decision plane (ROADMAP item 4): retained scrape rings
+over the existing metrics surfaces, pure derived signals (rates, windowed
+quantiles, SRE-workbook multi-window burn rates), an autoscaling
+recommender that publishes decisions as metrics and edge-triggered alerts,
+and — since the decision-provenance PR — closed-loop actuation: the
+recommendation feeds the `AnnotationAdapter` seam into the stock
+`AutoscalerReconciler` by default for DisaggregatedSet roles, audited by
+the bounded `DecisionLedger` (obs/decisions.py) and kill-switched via
+`LWS_TPU_ACTUATION_DISABLE=scale,rollout`.
 
     from lws_tpu import obs
     ring = obs.HistoryRing(interval_s=5.0, retention_s=900.0)
     ring.ingest(metrics.REGISTRY.render())          # or the fleet exposition
-    rec = obs.ScaleRecommender(ring).evaluate()     # dry-run decision
+    rec = obs.ScaleRecommender(ring).evaluate()     # the decision
+    obs.ScaleActuator(store).apply(rec)             # ...and the actuation
 
-Served at `GET /debug/history` on both the API server and the worker
-telemetry server; rendered by `lws-tpu monitor` and backing `lws-tpu top`'s
-rate columns. Docs: docs/observability.md ("History & burn-rate alerting"),
-docs/tasks/autoscaling.md (the recommender walkthrough).
+Served at `GET /debug/history` + `GET /debug/decisions` on both the API
+server and the worker telemetry server; rendered by `lws-tpu monitor` /
+`lws-tpu why` and backing `lws-tpu top`'s rate columns. Docs:
+docs/observability.md ("History & burn-rate alerting"),
+docs/tasks/autoscaling.md, docs/tasks/self-driving.md.
 
 The rollout plane (lws_tpu/obs/rollout.py) rides the same ring: a bounded
 ledger of control-plane state transitions (`GET /debug/rollout`,
-`lws-tpu rollout`), per-revision folds of every SLO signal, and a dry-run
-`CanaryAnalyzer` publishing `lws_rollout_canary_verdict` — actuation stays
-opt-in via `RolloutActuationAdapter`. Docs: docs/tasks/rollout-analysis.md.
+`lws-tpu rollout`), per-revision folds of every SLO signal, and a
+`CanaryAnalyzer` publishing `lws_rollout_canary_verdict` — acted on by the
+edge-triggered `RolloutActuator` through the stock
+`RolloutActuationAdapter`. Docs: docs/tasks/rollout-analysis.md.
 """
 
+from lws_tpu.obs.decisions import (
+    DECISIONS,
+    DecisionLedger,
+    DecisionRecord,
+    RolloutActuator,
+    ScaleActuator,
+    default_rollout_actuator,
+    default_scale_actuator,
+    evaluate_and_actuate,
+)
 from lws_tpu.obs.history import (
     DEFAULT_INTERVAL_S,
     DEFAULT_RETENTION_S,
@@ -72,6 +88,7 @@ from lws_tpu.obs.signals import (
 )
 
 __all__ = [
+    "DECISIONS",
     "DEFAULT_BURN_WINDOWS",
     "DEFAULT_INTERVAL_S",
     "DEFAULT_RETENTION_S",
@@ -82,18 +99,25 @@ __all__ = [
     "BurnWindow",
     "CanaryAnalyzer",
     "CanaryReport",
+    "DecisionLedger",
+    "DecisionRecord",
     "HistoryRing",
     "Recommendation",
     "RevisionVerdict",
     "RolloutActuationAdapter",
+    "RolloutActuator",
     "RolloutLedger",
+    "ScaleActuator",
     "ScaleRecommender",
     "breach_fraction",
     "burn_rate_from_counters",
     "burn_rate_from_gauge",
     "burn_windows",
     "default_canary_analyzer",
+    "default_rollout_actuator",
+    "default_scale_actuator",
     "error_series",
+    "evaluate_and_actuate",
     "ewma",
     "histogram_quantile",
     "increase",

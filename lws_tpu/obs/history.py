@@ -7,8 +7,8 @@ registry render, or the merged `/metrics/fleet` view — both through
 `core/metrics.parse_exposition`, the same production parser the fleet
 merger trusts) and appends one `(t, value)` point per series into a
 retention-bounded ring. Signals computed OVER these rings (`obs/signals.py`
-rates, burn rates, windowed quantiles) are what the dry-run scale
-recommender (`obs/recommend.py`) and `lws-tpu monitor`/`top` consume.
+rates, burn rates, windowed quantiles) are what the scale recommender
+(`obs/recommend.py`) and `lws-tpu monitor`/`top` consume.
 
 Semantics the ring guarantees:
 

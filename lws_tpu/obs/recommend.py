@@ -1,5 +1,4 @@
-"""Dry-run autoscaling recommender: ROADMAP item 4's decision plane,
-without the actuator.
+"""Autoscaling recommender: ROADMAP item 4's decision plane.
 
 Consumes the history plane exactly as the roadmap prescribes — prefill
 desired-replicas from TTFT/queue-wait burn, decode from ITL burn and
@@ -17,13 +16,16 @@ KV-occupancy trend — and publishes DECISIONS, not actions:
     event recorded on the firing edge embeds the offending error-series
     window, so the dump carries the evidence, not just the verdict.
 
-Actuation stays OFF by default. The `AnnotationAdapter` is the opt-in seam:
-it writes the recommendation into the existing `METRIC_ANNOTATION_PREFIX`
-pod-annotation contract (`metrics.lws.tpu/<metric>` on ready leader pods —
-normalized so the HPA math reproduces the recommendation exactly), which
-the stock `AutoscalerReconciler` already consumes. Wiring an `Autoscaler`
-whose `spec.metric` matches the adapter's closes the loop; not wiring one
-changes nothing — the same pattern as every other sensor in this repo.
+The `AnnotationAdapter` is the actuation seam: it writes the
+recommendation into the existing `METRIC_ANNOTATION_PREFIX` pod-annotation
+contract (`metrics.lws.tpu/<metric>` on ready leader pods — normalized so
+the HPA math reproduces the recommendation exactly), which the stock
+`AutoscalerReconciler` already consumes. Since the decision-provenance PR
+the loop is CLOSED by default for DisaggregatedSet roles: the
+`ScaleActuator` (obs/decisions.py) drives this adapter per evaluation,
+records the full provenance chain in the `DecisionLedger`, and honors the
+`LWS_TPU_ACTUATION_DISABLE=scale` kill switch — with the switch set, the
+evaluation below is once again a pure recommendation.
 """
 
 from __future__ import annotations
@@ -116,7 +118,7 @@ class ScaleRecommender:
         env, like core/slo.py). `attainment_target` sets the error budget
         (`LWS_TPU_SLO_BURN_TARGET`, default 0.99); `windows` the burn tiers
         (default `signals.burn_windows()`, env-scalable to the ring's
-        resolution). `current` maps role -> current replicas (the dry-run
+        resolution). `current` maps role -> current replicas (the
         baseline the recommendation scales from; default 1 each).
         `registry` receives the recommendation/burn gauges (default the
         process registry); `recorder` the flight recorder whose heartbeat
@@ -208,9 +210,9 @@ class ScaleRecommender:
 
     # ---- the evaluation --------------------------------------------------
     def evaluate(self, now: Optional[float] = None) -> Recommendation:
-        """One dry-run pass: burn every SLO series, derive per-role desired
-        replicas, publish the gauges, and drive the edge-triggered alert
-        feed. Deterministic under an injected `now`."""
+        """One evaluation pass (pure — the ScaleActuator acts on the result):
+        burn every SLO series, derive per-role desired replicas, publish
+        the gauges, and drive the edge-triggered alert feed. Deterministic under an injected `now`."""
         if now is None:
             now = time.monotonic()
         rec = Recommendation(at=now, current=dict(self.current))
@@ -303,7 +305,7 @@ class ScaleRecommender:
 
     def _desired(self, cur: int, burn_short, burn_firing: bool,
                  occ, occ_slope, fast) -> tuple:
-        """The dry-run policy, spelled out: scale up when the phase burn
+        """The policy, spelled out: scale up when the phase burn
         fires (severity-proportional, bounded), bump decode when the KV
         pool itself is the bottleneck, scale in one step only when every
         signal is both evaluable-or-absent and calm. No data ≠ calm."""
@@ -385,8 +387,10 @@ def role_replicas_from_store(store) -> dict:
 # plane evaluates it per fleet-history ingest (runtime/server.py), syncing
 # `current` from the store's DS roles first, so the recommendation/burn
 # gauges and the `burn_rate` alert feed exist on every live deployment
-# without any wiring — still strictly dry-run (only the AnnotationAdapter
-# below actuates, and only where a deployment opts in).
+# without any wiring. The same ingest step hands the verdict to the
+# default ScaleActuator (obs/decisions.py), which actuates DS roles
+# through the AnnotationAdapter below unless LWS_TPU_ACTUATION_DISABLE
+# says otherwise.
 RECOMMENDER: Optional[ScaleRecommender] = None
 _RECOMMENDER_LOCK = threading.Lock()
 
@@ -426,8 +430,9 @@ class AnnotationAdapter:
     (a bare `desired/n` share overshoots by one whenever the float
     round-trip lands epsilon above the integer, e.g. desired=25, n=11).
     The Autoscaler's own min/max clamps and scale-down stabilization stay
-    the operator's guardrails. Strictly opt-in: nothing constructs one by
-    default, so actuation stays off."""
+    the operator's guardrails. Driven per evaluation by the default
+    `ScaleActuator` (obs/decisions.py) for DS roles; still usable directly
+    for manual or out-of-tree wiring."""
 
     def __init__(self, store, namespace: str, target: str,
                  metric: str = "scale_recommendation") -> None:

@@ -16,7 +16,7 @@ observable, judgeable process:
     series via LWS_TPU_REVISION), so per-(engine, revision) burn,
     attainment, TTFT/ITL quantiles, and GOOD%/SPEC%/PFX% are one
     `ring.series(family, {"revision": r})` away.
-  * **CanaryAnalyzer** — dry-run promote/hold/rollback verdicts
+  * **CanaryAnalyzer** — promote/hold/rollback verdicts
     (`lws_rollout_canary_verdict{lws,revision}`: +1/0/-1) from
     baseline-vs-canary burn deltas, with minimum-sample and
     minimum-duration guards: NO DATA IS NOT PROMOTE — a revision that
@@ -28,11 +28,14 @@ observable, judgeable process:
     event embeds both the offending revision's error-series window and the
     ledger window, so the dump carries the evidence, not just the verdict.
 
-Actuation stays OFF by default, exactly like the scale recommender
-(obs/recommend.py): `RolloutActuationAdapter` is the opt-in seam that can
-pause the stock rollout controller (freeze the partition) or roll the
-template back to the baseline revision via the existing ControllerRevision
-machinery — nothing constructs one unless a deployment wires it.
+`RolloutActuationAdapter` is the actuation seam: it can pause the stock
+rollout controller (freeze the partition) or roll the template back to the
+baseline revision via the existing ControllerRevision machinery. Since the
+decision-provenance PR the edge-triggered `RolloutActuator`
+(obs/decisions.py) drives it by default when a canary regression fires,
+recording the full evidence chain in the decision ledger — behind the
+`LWS_TPU_ACTUATION_DISABLE=rollout` kill switch, which restores the old
+verdict-only behavior.
 """
 
 from __future__ import annotations
@@ -73,11 +76,12 @@ DEFAULT_LEDGER_CAPACITY = 512
 DEFAULT_LEDGER_RETENTION_S = 3600.0
 
 # Flight-recorder event kinds worth a rollout-timeline entry (drains,
-# restarts, alerts, chaos); everything else in the ring is request-scale
-# noise at rollout timescales.
+# restarts, alerts, chaos, actuations); everything else in the ring is
+# request-scale noise at rollout timescales.
 LEDGER_EVENT_KINDS = frozenset((
     "drain_requested", "drain_ignored", "watchdog_alert",
     "fault_injected", "burn_rate_fired", "canary_regression_fired",
+    "actuation", "actuation_flap", "autoscaler_scaled",
 ))
 
 
@@ -599,7 +603,7 @@ def revision_prefix_fraction(ring: HistoryRing, revision: str,
 
 @dataclass
 class RevisionVerdict:
-    """One revision's dry-run judgement — JSON-shaped for reports."""
+    """One revision's judgement — JSON-shaped for reports."""
 
     revision: str
     verdict: str                       # promote | hold | rollback
@@ -700,7 +704,8 @@ class CanaryAnalyzer:
 
     # ---- the evaluation --------------------------------------------------
     def evaluate(self, now: Optional[float] = None) -> CanaryReport:
-        """One dry-run pass: burn every revision, apply the guards, judge
+        """One evaluation pass (pure — the RolloutActuator acts on the
+        result): burn every revision, apply the guards, judge
         baseline-vs-canary deltas, publish the verdict + revision-burn
         gauges, and drive the edge-triggered `canary:*` alert feed.
         Deterministic under an injected `now`."""
@@ -875,9 +880,10 @@ class CanaryAnalyzer:
 # Process-default analyzer over the process history ring + ledger: the
 # control plane evaluates it per fleet-history ingest (runtime/server.py),
 # so the verdict/burn gauges and the `canary_regression` alert feed exist
-# on every live deployment without wiring — still strictly dry-run (only
-# the RolloutActuationAdapter below actuates, and only where a deployment
-# opts in).
+# on every live deployment without wiring. The analyzer itself never
+# mutates the store: acting on its reports is the RolloutActuator's job
+# (obs/decisions.py — on by default, LWS_TPU_ACTUATION_DISABLE=rollout to
+# record only).
 ANALYZER: Optional[CanaryAnalyzer] = None
 _ANALYZER_LOCK = threading.Lock()
 
@@ -913,8 +919,10 @@ class RolloutActuationAdapter:
     existing canary/xPyD semantics), and `rollback(revision_key)` restores
     the template from the named ControllerRevision via the same
     `utils/revision.py` path the controller uses, so the rollout controller
-    itself walks the fleet back. Strictly opt-in: nothing constructs one
-    by default, so actuation stays off — the PR-12 recommender contract."""
+    itself walks the fleet back. Driven by the edge-triggered
+    `RolloutActuator` (obs/decisions.py) when a canary regression fires —
+    behind the `LWS_TPU_ACTUATION_DISABLE=rollout` kill switch; still
+    usable directly for manual rollbacks."""
 
     def __init__(self, store, namespace: str, target: str) -> None:
         self.store = store

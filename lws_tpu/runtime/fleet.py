@@ -33,13 +33,15 @@ from lws_tpu.core import metrics, trace
 from lws_tpu.runtime.telemetry import METRICS_PORT_ENV, METRICS_TOKEN_ENV
 
 
-def _pod_metrics_endpoint(pod) -> Optional[tuple[str, int]]:
+def pod_metrics_endpoint(pod) -> Optional[tuple[str, int]]:
     """(host, port) when the pod declares a telemetry port, else None.
     Mirrors kv_transport.discover_role_endpoint: the published address is
     used VERBATIM (LocalBackend publishes 127.0.0.1; a rendezvous FQDN
     resolves through cluster DNS). An unresolvable address fails that one
     instance's scrape — never silently rewritten to loopback, which off
-    this host would scrape the wrong process under the pod's label."""
+    this host would scrape the wrong process under the pod's label.
+    Public: the scale actuator (obs/decisions.py) resolves the same
+    endpoint to drain a scale-in victim's worker before the pod goes."""
     for container in pod.spec.containers:
         for env in container.env:
             if env.name == METRICS_PORT_ENV and env.value:
@@ -124,7 +126,7 @@ class FleetCollector:
         for pod in self.store.list("Pod"):
             if not getattr(pod.status, "ready", False):
                 continue
-            endpoint = _pod_metrics_endpoint(pod)
+            endpoint = pod_metrics_endpoint(pod)
             if endpoint is None:
                 continue
             out.append((_pod_scrape_labels(pod), endpoint))

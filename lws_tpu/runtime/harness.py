@@ -303,6 +303,15 @@ class ControlPlane:
         self.rollout = rolloutmod.LEDGER
         rolloutmod.install(self.store)
 
+        # Decision plane: the provenance ledger (`GET /debug/decisions`,
+        # `lws-tpu why`) plus the synchronous DS replica writeback that
+        # lets the stock autoscaler move a DS child LWS without the DS
+        # reconciler fighting it (lws_tpu/obs/decisions.py).
+        from lws_tpu.obs import decisions as decisionsmod
+
+        self.decisions = decisionsmod.DECISIONS
+        decisionsmod.install(self.store)
+
     # ------------------------------------------------------------------
     def run_until_stable(self, max_iterations: int = 10000) -> int:
         if self.elector is not None:

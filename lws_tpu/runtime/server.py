@@ -27,6 +27,11 @@ Endpoints:
                                       per-series time series sampled from
                                       the /metrics and /metrics/fleet
                                       surfaces (lws_tpu/obs/history.py)
+  GET  /debug/decisions[?limit=N]     the decision ledger: provenance
+                                      records for every recommender/canary
+                                      evaluation with guards, actuation
+                                      outcome, and convergence timing
+                                      (lws_tpu/obs/decisions.py)
   GET  /debug/faults                  armed fault points + hit/trip counters
   POST /debug/faults                  arm/disarm deterministic fault
                                       schedules in this process
@@ -299,31 +304,25 @@ class ApiServer:
                     # materializes only when an ingest interval is actually
                     # due (at most once per interval), never per scrape.
                     # Each fresh ingest also evaluates the process-default
-                    # dry-run recommender, so
+                    # recommender, so
                     # `serving_scale_recommendation`/`serving_slo_burn_rate`
                     # and the `burn_rate` alert feed exist on every live
                     # deployment — published on the NEXT scrape, like every
                     # refresh-per-scrape gauge.
                     if historymod.HISTORY.ingest_if_due(
                             lambda: fleet.render_fleet()):
-                        from lws_tpu.obs import recommend as recmod
-                        from lws_tpu.obs import rollout as rolloutmod
+                        from lws_tpu.obs import decisions as decisionsmod
 
                         try:
-                            # `current` re-syncs from the store's DS roles
-                            # so desired counts scale from the fleet's REAL
-                            # width, not a hardcoded baseline of 1.
-                            recmod.default_recommender(cp.store).evaluate()
-                        except Exception:  # vet: ignore[hazard-exception-swallow]: a recommender hiccup must never 500 the fleet scrape (BLE001 intended)
-                            pass
-                        try:
-                            # Same cadence for the canary analyzer: the
-                            # dry-run verdict/revision-burn gauges and the
-                            # `canary_regression` alert feed ride every
-                            # live deployment's fleet scrape.
-                            rolloutmod.default_canary_analyzer(
-                                cp.store).evaluate()
-                        except Exception:  # vet: ignore[hazard-exception-swallow]: an analyzer hiccup must never 500 the fleet scrape (BLE001 intended)
+                            # The closed-loop decision step: evaluate the
+                            # recommender (`current` re-synced from the
+                            # store's DS roles) and the canary analyzer,
+                            # actuate both planes through the defaults
+                            # (kill-switched by LWS_TPU_ACTUATION_DISABLE),
+                            # and sweep convergence. Every verdict lands in
+                            # the decision ledger either way.
+                            decisionsmod.evaluate_and_actuate(cp.store)
+                        except Exception:  # vet: ignore[hazard-exception-swallow]: a decision-plane hiccup must never 500 the fleet scrape (BLE001 intended)
                             pass
                     self._stream_exposition(fleet.render_fleet_chunks())
                 elif path == "/debug/traces":
@@ -420,6 +419,19 @@ class ApiServer:
                         self._json(400, {"error": f"bad limit: {e}"})
                         return
                     self._json(200, rolloutmod.LEDGER.snapshot(limit))
+                elif path == "/debug/decisions":
+                    from urllib.parse import parse_qs, urlparse
+
+                    from lws_tpu.obs import decisions as decisionsmod
+                    from lws_tpu.runtime.telemetry import parse_limit
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad limit: {e}"})
+                        return
+                    self._json(200, decisionsmod.DECISIONS.snapshot(limit))
                 elif path == "/debug/requests":
                     from urllib.parse import parse_qs, urlparse
 

@@ -15,6 +15,10 @@ engine) exposes its process-default registries over one tiny HTTP server:
                              time series sampled from /metrics
                              (lws_tpu/obs/history.py; ?limit=N bounds the
                              series list, same 400 contract as the rest)
+  GET /debug/decisions       the decision ledger window: provenance records
+                             for the actuation planes with guards, outcome
+                             and convergence (lws_tpu/obs/decisions.py;
+                             ?limit=N, same 400 contract)
   GET  /debug/faults         armed fault points + hit/trip counters
   POST /debug/faults         arm/disarm fault schedules in this process
                              ({"arm": {point: spec}}, {"disarm": [...]},
@@ -200,6 +204,21 @@ class TelemetryServer:
                         return
                     self._send(200,
                                json.dumps(historymod.HISTORY.snapshot(limit)),
+                               "application/json")
+                elif path == "/debug/decisions":
+                    # The decision ledger window: provenance records for
+                    # the actuation planes (lws_tpu/obs/decisions.py) —
+                    # same parse_limit/bearer contract as the API server.
+                    from lws_tpu.obs import decisions as decisionsmod
+
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": f"bad limit: {e}"}),
+                                   "application/json")
+                        return
+                    self._send(200,
+                               json.dumps(decisionsmod.DECISIONS.snapshot(limit)),
                                "application/json")
                 elif path == "/debug/requests":
                     # The journey index: tail-retained requests by outcome

@@ -1,0 +1,786 @@
+"""Decision provenance + closed-loop actuation (ISSUE 19): the bounded
+DecisionLedger (collapse, flap detection, convergence timing, eviction),
+the scale/rollout actuators closing the loop through the STOCK machinery
+(AnnotationAdapter -> Autoscaler -> DS writeback; RolloutActuationAdapter),
+kill-switch mutation proofs per plane, DrainGate-mediated scale-in, the
+`/debug/decisions` surface on both servers, `lws-tpu why` + the ACT column,
+the loadgen closed-loop report fold, and the two deterministic end-to-end
+sweeps with chaos overlays (flash crowd -> scale-out -> one drained
+scale-in; degraded rollout -> automatic rollback).
+
+Everything is clock-injected and seeded — no wall-clock sleeps outside the
+socket-backed drain scenario (which reuses the chaos suite's bounded-wait
+idiom)."""
+
+import argparse
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lws_tpu import loadgen, obs
+from lws_tpu.api.pod import EnvVar
+from lws_tpu.core import resilience
+from lws_tpu.core.flightrecorder import FlightRecorder
+from lws_tpu.core.metrics import MetricsRegistry
+from lws_tpu.loadgen import closedloop
+from lws_tpu.obs import decisions, rollout
+from lws_tpu.obs.decisions import DecisionLedger, RolloutActuator, ScaleActuator
+from lws_tpu.obs.history import HistoryRing
+from lws_tpu.obs.recommend import Recommendation
+from lws_tpu.obs.rollout import CanaryAnalyzer, RolloutLedger
+from lws_tpu.runtime import ControlPlane
+from lws_tpu.testing import LWSBuilder, make_all_groups_ready
+from lws_tpu.utils import revision as revisionutils
+
+WINDOWS = tuple(w.scaled(0.05) for w in obs.DEFAULT_BURN_WINDOWS)
+
+
+def update_image(cp, name, image):
+    lws = cp.store.get("LeaderWorkerSet", "default", name)
+    for c in lws.spec.leader_worker_template.worker_template.spec.containers:
+        c.image = image
+    cp.store.update(lws)
+
+
+def _revision_ring(baseline: str, canary: str, now_span=195.0):
+    """Two-revision canary ring keyed on REAL revision hashes: the baseline
+    delivers every token on time, the canary mints tokens with zero
+    goodput (an all-late canary — absence of the goodput twin is a 100%
+    error series, not a missing signal)."""
+    ring = HistoryRing(interval_s=0.0, retention_s=3600.0)
+    acc = 0.0
+    for t in (0.0, 90.0, 180.0, now_span):
+        acc += 500.0
+        cum = MetricsRegistry()
+        cum.inc("serving_tokens_total",
+                {"engine": "paged", "revision": baseline}, acc * 2)
+        cum.inc("serving_goodput_tokens_total",
+                {"engine": "paged", "revision": baseline}, acc * 2)
+        cum.inc("serving_tokens_total",
+                {"engine": "paged", "revision": canary}, acc)
+        ring.ingest(cum.render(), now=t)
+    return ring
+
+
+def _mid_update_cp():
+    """A deployment caught mid-rolling-update: both revisions live, the
+    canary template is current — the state a rollback restores from."""
+    cp = ControlPlane()
+    cp.create(LWSBuilder().replicas(3).size(2).image("img:v1").build())
+    make_all_groups_ready(cp, "sample")
+    update_image(cp, "sample", "img:v2")
+    cp.run_until_stable()
+    lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+    revs = revisionutils.list_revisions(cp.store, lws)
+    assert len(revs) == 2
+    return (cp, revisionutils.get_revision_key(revs[0]),
+            revisionutils.get_revision_key(revs[-1]))
+
+
+# ---------------------------------------------------------------------------
+# DecisionLedger semantics
+
+
+def test_ledger_collapse_repeats_and_verdict_edges():
+    led = DecisionLedger(registry=MetricsRegistry(), recorder=FlightRecorder())
+    guards = [{"name": "evidence", "passed": True, "detail": "steady"}]
+    r1 = led.open("scale", "decode", "hold", guards=guards, now=1.0)
+    r2 = led.open("scale", "decode", "hold", guards=guards, now=2.0)
+    # Identical un-acted repeats fold onto one record: a steady "hold"
+    # stream must not flush the scale-out that mattered out of the window.
+    assert r2 is r1 and r1.repeats == 1 and r1.last_at == 2.0
+    # A verdict (or guard-outcome) change breaks the collapse.
+    r3 = led.open("scale", "decode", "scale_out", guards=guards, now=3.0)
+    assert r3.id != r1.id
+    # A different subject never collapses onto another's record.
+    r4 = led.open("scale", "prefill", "hold", guards=guards, now=4.0)
+    assert r4.id != r1.id
+    # Acted records never absorb repeats: provenance of an actuation is
+    # immutable history, not a counter.
+    led.actuate(r3.id, "scale_out", "applied", now=3.5)
+    r5 = led.open("scale", "decode", "scale_out", guards=guards, now=5.0)
+    assert r5.id != r3.id and r3.repeats == 0
+
+
+def test_ledger_capacity_evicts_oldest_and_snapshot_limits():
+    led = DecisionLedger(capacity=3, registry=MetricsRegistry(),
+                         recorder=FlightRecorder())
+    ids = [led.open("scale", f"r{i}", "hold", now=float(i)).id
+           for i in range(5)]
+    snap = led.snapshot(limit=256)
+    assert [d["id"] for d in snap] == ids[2:]  # newest-last, oldest evicted
+    assert led.get(ids[0]) is None
+    assert [d["id"] for d in led.snapshot(limit=1)] == [ids[-1]]
+
+
+def test_ledger_actuate_metrics_flap_detection_and_convergence(monkeypatch):
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    led = DecisionLedger(registry=reg, recorder=fr)
+    monkeypatch.setenv(decisions.FLAP_WINDOW_ENV, "100")
+
+    out = led.open("scale", "decode", "scale_out", now=10.0)
+    led.actuate(out.id, "scale_out", "applied", now=10.0,
+                generation_before=3, lws="child", namespace="default",
+                desired=4)
+    assert reg.counter_value(
+        "serving_actuations_total",
+        {"plane": "scale", "action": "scale_out", "outcome": "applied"}) == 1.0
+    # Applied-but-not-converged is what the convergence sweeps walk.
+    assert [r.id for r in led.pending("scale")] == [out.id]
+    led.converge(out.id, now=25.0, generation_after=7)
+    assert out.convergence_s == 15.0 and out.generation_after == 7
+    assert led.pending("scale") == []
+    assert "serving_convergence_seconds" in reg.render()
+
+    # Direction reversal INSIDE the window = a flap, counted and stamped.
+    back = led.open("scale", "decode", "scale_in", now=40.0)
+    led.actuate(back.id, "scale_in", "applied", now=40.0)
+    assert back.detail.get("flap") is True
+    assert reg.counter_value("serving_actuation_flaps_total",
+                             {"plane": "scale"}) == 1.0
+    # Reversal OUTSIDE the window is a normal correction.
+    monkeypatch.setenv(decisions.FLAP_WINDOW_ENV, "5")
+    fwd = led.open("scale", "decode", "scale_out", now=90.0)
+    led.actuate(fwd.id, "scale_out", "applied", now=90.0)
+    assert fwd.detail.get("flap") is None
+    assert reg.counter_value("serving_actuation_flaps_total",
+                             {"plane": "scale"}) == 1.0
+    # Suppressed actuations cannot oscillate: no direction memory burned.
+    sup = led.open("scale", "decode", "scale_in", now=91.0)
+    led.actuate(sup.id, "scale_in", "suppressed", now=91.0)
+    assert sup.detail.get("flap") is None
+
+    # Supersede closes a stale pending decision without "converging" it.
+    led.supersede(fwd.id, sup.id)
+    assert fwd.converged_at == -1.0
+    assert fwd.detail["superseded_by"] == sup.id
+    # last_actuation is the newest acted record — the ACT column's source.
+    assert led.last_actuation("scale").id == sup.id
+    assert led.last_actuation("rollout") is None
+
+
+# ---------------------------------------------------------------------------
+# Kill-switch mutation proofs: with LWS_TPU_ACTUATION_DISABLE set, verdicts
+# still publish but replicas/partitions provably never move — and flipping
+# the switch back is the ONLY thing needed for the same evidence to act.
+
+
+def test_scale_kill_switch_records_but_replicas_never_move():
+    res = closedloop.run_sweep(seed=7, disable="scale,rollout")
+    try:
+        # The recommender still saw the crowd and still recommended.
+        assert any(e["desired"] == 4 for e in res["evaluations"])
+        # But nothing moved, ever: no autoscale, no drain.
+        assert res["max_replicas_seen"] == 1
+        assert all(r == 1 for _, r in res["replicas"])
+        assert res["drains"] == []
+        suppressed = [d for d in res["decisions"]
+                      if d["outcome"] == "suppressed"]
+        assert suppressed, res["decisions"]
+        for d in suppressed:
+            assert d["action"] == "scale_out"
+            kill = next(g for g in d["guards"] if g["name"] == "kill_switch")
+            assert kill["passed"] is False
+            # The full burn evidence is still recorded — record-only mode
+            # is the same flight recorder, minus the control surface.
+            assert d["inputs"]["burns"]
+        assert set(res["actuations"]) == {"scale_out/suppressed"}
+        assert res["flaps"] == 0
+    finally:
+        rollout.LEDGER.clear()
+
+
+def test_rollout_kill_switch_records_but_partition_never_moves(monkeypatch):
+    cp, old_key, new_key = _mid_update_cp()
+    try:
+        reg = MetricsRegistry()
+        fr = FlightRecorder()
+        an = CanaryAnalyzer(_revision_ring(old_key, new_key),
+                            lws="default/sample", attainment_target=0.99,
+                            windows=WINDOWS, min_samples=100.0,
+                            min_duration_s=50.0, delta=2.0,
+                            ledger=RolloutLedger(registry=reg),
+                            registry=reg, recorder=fr)
+        led = DecisionLedger(registry=reg, recorder=fr)
+        act = RolloutActuator(cp.store, ledger=led)
+        monkeypatch.setenv(decisions.DISABLE_ENV, "scale,rollout")
+
+        report = an.evaluate(now=195.0)
+        assert report.baseline == old_key
+        assert report.verdicts[new_key].verdict == "rollback"
+        # The verdict gauge publishes regardless of the switch.
+        assert reg.gauge_value("lws_rollout_canary_verdict",
+                               {"lws": "default/sample",
+                                "revision": new_key}) == -1.0
+
+        before = cp.store.get("LeaderWorkerSet", "default", "sample")
+        image_before = (before.spec.leader_worker_template.worker_template
+                        .spec.containers[0].image)
+        record = act.apply(report, now=195.0)
+        assert record.action == "rollback" and record.outcome == "suppressed"
+        kill = next(g for g in record.guards if g["name"] == "kill_switch")
+        assert kill["passed"] is False
+        cp.run_until_stable()
+        after = cp.store.get("LeaderWorkerSet", "default", "sample")
+        assert (after.spec.leader_worker_template.worker_template
+                .spec.containers[0].image) == image_before == "img:v2"
+        assert reg.counter_value(
+            "serving_actuations_total",
+            {"plane": "rollout", "action": "rollback",
+             "outcome": "suppressed"}) == 1.0
+
+        # The switch is load-bearing: clearing it is the only change, and
+        # the SAME evidence now rolls the template back.
+        monkeypatch.delenv(decisions.DISABLE_ENV)
+        record2 = act.apply(report, now=196.0)
+        assert record2.outcome == "applied"
+        assert record2.detail["rolled_back_to"] == old_key
+        lws = cp.store.get("LeaderWorkerSet", "default", "sample")
+        assert (lws.spec.leader_worker_template.worker_template
+                .spec.containers[0].image) == "img:v1"
+    finally:
+        rollout.LEDGER.clear()
+
+
+# ---------------------------------------------------------------------------
+# DrainGate-mediated scale-in: the victim's worker finishes in-flight work
+# and parks the rest for a successor BEFORE the pod goes away.
+
+
+def _make_ds_with_telemetry(port: int, decode_replicas: int = 2):
+    from lws_tpu.api.disagg import (
+        DisaggregatedRoleSpec,
+        DisaggregatedSet,
+        DisaggregatedSetSpec,
+        LeaderWorkerSetTemplateSpec,
+    )
+    from lws_tpu.api.types import LeaderWorkerSetSpec, LeaderWorkerTemplate
+    from lws_tpu.core.store import new_meta
+    from lws_tpu.runtime.telemetry import METRICS_PORT_ENV
+    from lws_tpu.testing import make_worker_template
+
+    def role(name, replicas):
+        tpl = make_worker_template("img:v1")
+        tpl.spec.containers[0].env.append(
+            EnvVar(name=METRICS_PORT_ENV, value=str(port)))
+        return DisaggregatedRoleSpec(
+            name=name, replicas=replicas,
+            template=LeaderWorkerSetTemplateSpec(
+                spec=LeaderWorkerSetSpec(
+                    leader_worker_template=LeaderWorkerTemplate(
+                        worker_template=tpl, size=1))))
+
+    return DisaggregatedSet(
+        meta=new_meta("llmd"),
+        spec=DisaggregatedSetSpec(
+            roles=[role("prefill", 1), role("decode", decode_replicas)]),
+    )
+
+
+def test_scale_in_drains_the_victim_before_the_pod_goes():
+    """One-step scale-in through the REAL drain wire: the actuator POSTs
+    /debug/drain at the victim's published telemetry endpoint, the process
+    DrainGate latches, the worker loop finishes (and acks) its in-flight
+    bundle, parks the rest for a successor — and only then does the
+    autoscaler remove the replica. No token stream lost."""
+    from lws_tpu.runtime.telemetry import TelemetryServer
+    from lws_tpu.serving import kv_transport as kt
+
+    tele = TelemetryServer(port=0)
+    tele.start()
+    server = kt.KVServer(port=0, host="127.0.0.1")
+    try:
+        cp = ControlPlane(auto_ready=True)
+        cp.create(_make_ds_with_telemetry(tele.port))
+        cp.run_until_stable()
+        # The sim publishes headless-DNS pod addresses; point them at
+        # loopback so the actuator's drain POST reaches the test server.
+        for pod in cp.store.list("Pod", "default"):
+            pod.status.address = "127.0.0.1"
+            cp.store.update(pod)
+
+        for i in range(3):
+            server.offer_bundle({"id": f"d{i}"}, b"x")
+        hold, done = threading.Event(), threading.Event()
+        processed: list = []
+
+        def worker():
+            def process(meta, payload):
+                processed.append(meta["id"])
+                hold.wait(timeout=10)
+
+            while not resilience.DRAIN.draining:
+                try:
+                    if kt.pull_bundle(("127.0.0.1", server.port), timeout=0.2,
+                                      process=process) is None:
+                        continue
+                except OSError:
+                    break
+            done.set()
+
+        threading.Thread(target=worker, daemon=True).start()
+        deadline = time.time() + 5
+        while not processed and time.time() < deadline:
+            time.sleep(0.01)
+        assert processed == ["d0"]  # one bundle in flight
+
+        reg = MetricsRegistry()
+        led = DecisionLedger(registry=reg, recorder=FlightRecorder())
+        actuator = ScaleActuator(cp.store, ledger=led, min_replicas=1,
+                                 max_replicas=4, stabilization=2)
+        rec = Recommendation(
+            at=100.0,
+            desired={"prefill": 1, "decode": 1},
+            current={"prefill": 1, "decode": 2},
+            reasons={"prefill": "steady",
+                     "decode": "calm: burn 0.00x, budget intact"},
+        )
+        records = actuator.apply(rec, now=100.0)
+        scale_in = next(r for r in records if r.verdict == "scale_in")
+        assert scale_in.outcome == "applied"
+        # The drain hit the victim (highest group index) over HTTP and
+        # latched the process gate MID-processing.
+        assert resilience.DRAIN.draining
+        drained = scale_in.detail["drained"]
+        assert drained["ok"] is True and drained["pod"].endswith("-decode-1")
+        hold.set()                    # in-flight work completes...
+        assert done.wait(timeout=5)   # ...and the loop exits clean
+        deadline = time.time() + 5
+        while server.delivery_counts()[0] < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert server.delivery_counts()[0] == 1  # the in-flight item ACKED
+        assert processed == ["d0"]    # nothing new admitted past the drain
+        # Parked work survives for a successor: both remaining bundles pull.
+        survivors = {kt.pull_bundle(("127.0.0.1", server.port),
+                                    timeout=1.0)[0]["id"] for _ in range(2)}
+        assert survivors == {"d1", "d2"}
+
+        # The pod removal itself rides the stock autoscaler's scale-down
+        # stabilization: a second consecutive calm evaluation moves it.
+        cp.run_until_stable()
+        actuator.apply(rec, now=115.0)
+        cp.run_until_stable()
+        child = next(
+            lws for lws in cp.store.list("LeaderWorkerSet", "default")
+            if lws.meta.name.endswith("-decode"))
+        assert child.spec.replicas == 1
+        # ...and the DS writeback kept the role spec in lockstep.
+        ds = cp.store.get("DisaggregatedSet", "default", "llmd")
+        assert ds.role("decode").replicas == 1
+        settled = actuator.observe(now=120.0)
+        assert [r.id for r in settled] == [scale_in.id]
+        assert scale_in.convergence_s == 20.0
+        assert scale_in.repeats == 1  # the stabilization re-publish folded on
+    finally:
+        resilience.DRAIN.reset()
+        tele.stop()
+        server.close()
+        rollout.LEDGER.clear()
+
+
+# ---------------------------------------------------------------------------
+# The /debug/decisions surface + `lws-tpu why`
+
+
+def _seed_global_decision():
+    rec = decisions.DECISIONS.open(
+        "scale", "decode", "scale_out",
+        inputs={"reason": "burn 20.0x over threshold 14.4", "current": 1,
+                "desired": 4, "firing": ["paged/chat"],
+                "burns": [{"series": "paged/chat", "instance": "w0",
+                           "window": "fast", "short_burn": 20.0,
+                           "long_burn": 18.0, "threshold": 14.4,
+                           "firing": True}]},
+        guards=[{"name": "evidence", "passed": True, "detail": "burn"},
+                {"name": "kill_switch", "passed": True, "detail": "off"},
+                {"name": "target", "passed": True, "detail": "child"}],
+        now=100.0)
+    decisions.DECISIONS.actuate(
+        rec.id, "scale_out", "applied", now=100.0, generation_before=3,
+        namespace="default", ds="llmd", lws="child", desired=4)
+    decisions.DECISIONS.converge(rec.id, now=115.0, generation_after=5)
+    return rec
+
+
+def test_telemetry_server_decisions_endpoint_bearer_and_limit():
+    from lws_tpu.runtime.telemetry import TelemetryServer
+
+    decisions.DECISIONS.clear()
+    rec = _seed_global_decision()
+    server = TelemetryServer(port=0, token="s3cret")
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/decisions", timeout=10)
+        assert err.value.code == 401  # bearer parity with the other surfaces
+        req = urllib.request.Request(
+            f"{base}/debug/decisions",
+            headers={"Authorization": "Bearer s3cret"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read().decode())
+        assert [d["id"] for d in body] == [rec.id]
+        assert body[0]["convergence_s"] == 15.0
+        req = urllib.request.Request(
+            f"{base}/debug/decisions?limit=wat",
+            headers={"Authorization": "Bearer s3cret"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 400  # parse_limit contract: 400, never 500
+    finally:
+        server.stop()
+        decisions.DECISIONS.clear()
+
+
+def test_api_server_decisions_endpoint_and_why_cli(capsys):
+    from lws_tpu import cli
+    from lws_tpu.runtime.server import ApiServer
+
+    decisions.DECISIONS.clear()
+    cp = ControlPlane(auto_ready=True)
+    rec = _seed_global_decision()
+    api = ApiServer(cp, port=0)
+    api.start()
+    base = f"http://127.0.0.1:{api.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/debug/decisions", timeout=10) as r:
+            body = json.loads(r.read().decode())
+        assert [d["id"] for d in body] == [rec.id]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/debug/decisions?limit=-1",
+                                   timeout=10)
+        assert err.value.code == 400
+
+        # `lws-tpu why last` against the live server renders the chain.
+        ns = argparse.Namespace(server=f"127.0.0.1:{api.port}",
+                                decision_id="last", limit=64, json=False)
+        assert cli.cmd_why(ns) == 0
+        out = capsys.readouterr().out
+        assert f"DECISION {rec.id}" in out
+        assert "EVIDENCE" in out and "GUARDS" in out
+        assert "scale_out -> applied" in out
+        assert "CONVERGENCE: fleet settled 15.00s after actuation" in out
+        # --json round-trips the record; an unknown id is a 1, not a trace.
+        ns = argparse.Namespace(server=f"127.0.0.1:{api.port}",
+                                decision_id=rec.id, limit=64, json=True)
+        assert cli.cmd_why(ns) == 0
+        assert json.loads(capsys.readouterr().out)["id"] == rec.id
+        ns = argparse.Namespace(server=f"127.0.0.1:{api.port}",
+                                decision_id="scale-999999", limit=64,
+                                json=False)
+        assert cli.cmd_why(ns) == 1
+        assert "not in the retained window" in capsys.readouterr().err
+    finally:
+        api.stop()
+        decisions.DECISIONS.clear()
+        rollout.LEDGER.clear()
+
+
+def test_watchdog_dump_embeds_the_decision_window():
+    decisions.DECISIONS.clear()
+    try:
+        rec = _seed_global_decision()
+        dump = FlightRecorder().dump(reason="manual")
+        assert any(d["id"] == rec.id for d in dump["decisions"])
+    finally:
+        decisions.DECISIONS.clear()
+
+
+# ---------------------------------------------------------------------------
+# CLI renders: the ACT column + `why`, from canned records
+
+
+def _canned_records():
+    return [
+        {"id": "scale-000001", "plane": "scale", "subject": "decode",
+         "at": 10.0, "verdict": "hold", "inputs": {}, "guards": [],
+         "action": "", "outcome": "", "acted_at": None,
+         "generation_before": None, "generation_after": None, "detail": {},
+         "converged_at": None, "convergence_s": None, "repeats": 4,
+         "last_at": 50.0},
+        {"id": "scale-000002", "plane": "scale", "subject": "decode",
+         "at": 60.0, "verdict": "scale_out",
+         "inputs": {"reason": "burn 20.0x over threshold 14.4",
+                    "current": 1, "desired": 4, "firing": ["paged/chat"],
+                    "burns": [{"series": "paged/chat", "instance": "w0",
+                               "window": "fast", "short_burn": 20.0,
+                               "long_burn": 18.0, "threshold": 14.4,
+                               "firing": True}]},
+         "guards": [{"name": "evidence", "passed": True, "detail": "burn"},
+                    {"name": "kill_switch", "passed": True, "detail": "off"},
+                    {"name": "target", "passed": True, "detail": "child"}],
+         "action": "scale_out", "outcome": "applied", "acted_at": 60.0,
+         "generation_before": 3, "generation_after": 5,
+         "detail": {"lws": "crowd-0-x-decode", "desired": 4, "from": 1},
+         "converged_at": 75.0, "convergence_s": 15.0, "repeats": 2,
+         "last_at": 75.0},
+        {"id": "rollout-000001", "plane": "rollout",
+         "subject": "default/sample", "at": 80.0, "verdict": "rollback",
+         "inputs": {"baseline": "r1",
+                    "verdicts": {"r2": {"verdict": "rollback",
+                                        "reason": "fast burn 55.0x",
+                                        "short_burn": 55.0,
+                                        "long_burn": 40.0,
+                                        "baseline_burn": 0.0}}},
+         "guards": [{"name": "kill_switch", "passed": True, "detail": "off"}],
+         "action": "rollback", "outcome": "applied", "acted_at": 80.0,
+         "generation_before": 7, "generation_after": 8,
+         "detail": {"rolled_back_to": "r1", "flap": True},
+         "converged_at": None, "convergence_s": None, "repeats": 0,
+         "last_at": None},
+    ]
+
+
+def test_act_lines_fold_newest_actuation_per_plane():
+    from lws_tpu.cli import _act_lines
+
+    lines = _act_lines(_canned_records(), now=100.0)
+    assert len(lines) == 2  # one per plane; the un-acted hold never shows
+    scale = next(ln for ln in lines if ln.startswith("ACT scale"))
+    assert "scale_out" in scale and "applied" in scale
+    assert "[scale-000002]" in scale and "converged 15.0s" in scale
+    assert "40s ago" in scale
+    roll = next(ln for ln in lines if ln.startswith("ACT rollout"))
+    assert "[rollout-000001]" in roll
+    assert "converging" in roll and "FLAP" in roll
+    assert _act_lines([], now=100.0) == []
+
+
+def test_monitor_and_rollout_frames_carry_the_act_column():
+    from lws_tpu.cli import render_monitor, render_rollout
+
+    ring = HistoryRing(interval_s=0.0, retention_s=600.0)
+    for t, v in ((0.0, 1.0), (10.0, 100.0)):
+        cum = MetricsRegistry()
+        cum.inc("serving_tokens_total", {"engine": "paged"}, v)
+        ring.ingest(cum.render(), now=t)
+    frame = render_monitor(ring.snapshot(), {}, now=10.0,
+                           decisions=_canned_records())
+    assert "ACT scale" in frame and "[scale-000002]" in frame
+    out = render_rollout([], {}, {}, decisions=_canned_records(), now=100.0)
+    assert "ACT rollout" in out and "FLAP" in out
+
+
+def test_render_why_scale_and_rollout_chains():
+    from lws_tpu.cli import render_why
+
+    out = render_why(_canned_records()[1], now=100.0)
+    assert "DECISION scale-000002" in out and "repeats=2" in out
+    assert "reason: burn 20.0x over threshold 14.4" in out
+    assert "replicas: current=1 desired=4" in out
+    assert "paged/chat@w0" in out and "20.0x" in out and "yes" in out
+    assert "[pass] evidence" in out and "[pass] kill_switch" in out
+    assert "scale_out -> applied" in out
+    assert "target generation: 3 -> 5" in out
+    assert "CONVERGENCE: fleet settled 15.00s after actuation" in out
+
+    out = render_why(_canned_records()[2], now=100.0)
+    assert "baseline: r1" in out and "rollback" in out and "55.0x" in out
+    assert "rolled_back_to=r1" in out
+    assert "FLAP: this actuation reversed direction" in out
+    assert "CONVERGENCE: pending" in out
+
+    # A record-only verdict renders the negative lanes, not a stub.
+    out = render_why(_canned_records()[0], now=100.0)
+    assert "(no recorded inputs)" in out
+    assert "(not acted on — verdict recorded only)" in out
+    assert "CONVERGENCE: n/a" in out
+
+
+def test_fail_guard_renders_as_fail():
+    from lws_tpu.cli import render_why
+
+    rec = _canned_records()[1]
+    rec["guards"][1] = {"name": "kill_switch", "passed": False,
+                        "detail": "scale,rollout"}
+    out = render_why(rec, now=100.0)
+    assert "[FAIL] kill_switch" in out and "scale,rollout" in out
+
+
+# ---------------------------------------------------------------------------
+# Loadgen: the closed-loop report block
+
+
+def test_fold_actuations_totals_flaps_and_trace():
+    ring = HistoryRing(interval_s=0.0, retention_s=600.0)
+    steps = [
+        (0.0, {"scale/scale_out/applied": 1.0}, {}),
+        (30.0, {"scale/scale_out/applied": 1.0,
+                "scale/scale_in/applied": 1.0}, {}),
+        (60.0, {"scale/scale_out/applied": 2.0,
+                "scale/scale_in/applied": 1.0}, {"scale": 1.0}),
+    ]
+    for t, acts, flaps in steps:
+        cum = MetricsRegistry()
+        for key, v in acts.items():
+            plane, action, outcome = key.split("/")
+            cum.inc("serving_actuations_total",
+                    {"plane": plane, "action": action, "outcome": outcome}, v)
+        for plane, v in flaps.items():
+            cum.inc("serving_actuation_flaps_total", {"plane": plane}, v)
+        ring.ingest(cum.render(), now=t)
+    act = loadgen.fold_actuations(ring)
+    assert act["actuations"] == {"scale/scale_out/applied": 2.0,
+                                 "scale/scale_in/applied": 1.0}
+    assert act["flaps"] == {"scale": 1.0}
+    # Run-relative trace of every count STEP, in time order.
+    trace_keys = [(s["t"], s["what"]) for s in act["trace"]]
+    assert (0.0, "scale/scale_out/applied") in trace_keys
+    assert (30.0, "scale/scale_in/applied") in trace_keys
+    assert (60.0, "scale/scale_out/applied") in trace_keys
+    # No actuation series in the ring -> no block at all.
+    assert loadgen.fold_actuations(
+        HistoryRing(interval_s=0.0, retention_s=60.0)) is None
+
+
+def test_render_report_closed_loop_block():
+    report = {
+        "scenario": "flash_crowd", "seed": 7, "horizon_s": 1.5,
+        "wall_s": 1.6, "offered_rps": 30.0, "achieved_rps": 29.0,
+        "classes": {},
+        "all": {"count": 10, "completed": 10, "attainment": 0.9,
+                "goodput_fraction": 0.8, "tokens": 60, "good_tokens": 48,
+                "ttft_p50": 0.01, "ttft_p95": 0.05, "ttft_p99": 0.06,
+                "itl_p50": 0.001, "itl_p95": 0.002, "itl_p99": 0.003},
+        "actuations": {
+            "actuations": {"scale/scale_out/applied": 1.0,
+                           "scale/scale_in/applied": 1.0},
+            "flaps": {},
+            "trace": [{"t": 0.5, "what": "scale/scale_out/applied",
+                       "count": 1.0}],
+        },
+    }
+    out = loadgen.render_report(report)
+    assert "closed loop:" in out
+    assert "scale/scale_out/applied=1" in out
+    assert "flaps: none" in out
+    assert "actuation @0.50s: scale/scale_out/applied (count 1)" in out
+
+
+# ---------------------------------------------------------------------------
+# The two acceptance sweeps, chaos overlays included
+
+
+def test_closed_loop_flash_crowd_sweep_with_chaos():
+    """Acceptance sweep (a): seeded flash crowd -> decode scale-out within
+    two evaluations -> burn clears -> exactly ONE DrainGate-mediated
+    scale-in step -> converged, zero flaps, bounded replicas — while a
+    chaos overlay kills a decode pod mid-crowd. Every replica change
+    resolves to a full provenance record, rendered end-to-end by `why`."""
+    from lws_tpu.cli import render_why
+
+    deleted: list = []
+
+    def chaos(cp, now, tick):
+        if tick == 5:  # mid-crowd, post-scale-out
+            pod = sorted(
+                (p.meta.name for p in cp.store.list("Pod", "default")
+                 if "-decode" in p.meta.name))[0]
+            cp.store.delete("Pod", "default", pod)
+            deleted.append(pod)
+
+    res = closedloop.run_sweep(seed=7, chaos=chaos)
+    try:
+        assert deleted  # the overlay really fired
+        first_bad = next(e["tick"] for e in res["evaluations"]
+                         if e["over_capacity"])
+        assert res["scale_out_tick"] is not None
+        assert res["scale_out_tick"] - first_bad + 1 <= 2
+        assert res["max_replicas_seen"] == 4  # the autoscaler clamp held
+        assert res["scale_in_steps"] == 1 and res["converged"]
+        assert len(res["drains"]) == 1
+        assert res["drains"][0].endswith("-decode-3")  # highest group index
+        assert res["flaps"] == 0
+        assert set(res["actuations"]) == {"scale_out/applied",
+                                          "scale_in/applied"}
+
+        applied = [d for d in res["decisions"] if d["outcome"] == "applied"]
+        assert len(applied) == 2
+        for d in applied:  # full provenance on every replica change
+            assert all(g["passed"] for g in d["guards"])
+            assert d["inputs"]["burns"] and d["inputs"]["reason"]
+            assert d["generation_before"] is not None
+            assert d["converged_at"] is not None and d["converged_at"] >= 0
+            assert d["convergence_s"] is not None
+        scale_in = next(d for d in applied if d["verdict"] == "scale_in")
+        assert scale_in["detail"]["drained"]["ok"] is True
+        out = render_why(scale_in, now=300.0)
+        assert "EVIDENCE" in out and "GUARDS" in out
+        assert "calm" in out and "drained=" in out
+        assert "CONVERGENCE: fleet settled" in out
+    finally:
+        rollout.LEDGER.clear()
+
+
+def test_closed_loop_rollback_sweep_with_chaos():
+    """Acceptance sweep (b): a rolling update to a degraded revision ->
+    the canary analyzer's rollback verdict actuates through the STOCK
+    rollout machinery -> the fleet walks back to the baseline and the
+    decision converges — while a chaos overlay kills a pod mid-walk-back.
+    The episode is edge-triggered: re-judging the same regression never
+    actuates twice, and the flap counter stays zero."""
+    from lws_tpu.cli import render_why
+
+    cp, old_key, new_key = _mid_update_cp()
+    try:
+        reg = MetricsRegistry()
+        fr = FlightRecorder()
+        an = CanaryAnalyzer(_revision_ring(old_key, new_key),
+                            lws="default/sample", attainment_target=0.99,
+                            windows=WINDOWS, min_samples=100.0,
+                            min_duration_s=50.0, delta=2.0,
+                            ledger=RolloutLedger(registry=reg),
+                            registry=reg, recorder=fr)
+        led = DecisionLedger(registry=reg, recorder=fr)
+        act = RolloutActuator(cp.store, ledger=led)
+
+        report = an.evaluate(now=195.0)
+        record = act.apply(report, now=195.0)
+        assert record.outcome == "applied" and record.action == "rollback"
+        assert record.detail["paused"] is True
+        assert record.detail["rolled_back_to"] == old_key
+        assert record.detail["offenders"] == [new_key]
+        assert record.generation_before is not None
+        assert record.generation_after is not None
+
+        # Chaos overlay: a pod dies mid-walk-back; the stock controller
+        # replaces it and the rollback still converges.
+        victim = cp.store.list("Pod", "default")[0]
+        cp.store.delete("Pod", "default", victim.meta.name)
+
+        settled: list = []
+        for _ in range(12):
+            cp.run_until_stable()
+            make_all_groups_ready(cp, "sample")
+            settled = act.observe(now=210.0)
+            if settled:
+                break
+        assert [r.id for r in settled] == [record.id]
+        assert record.convergence_s == 15.0
+        for pod in cp.store.list("Pod", "default"):
+            assert pod.spec.containers[0].image == "img:v1", pod.meta.name
+
+        # Edge-triggered: the same regression re-judged does NOT actuate
+        # again — the repeat records as guard-skipped, counters stay put.
+        record2 = act.apply(report, now=220.0)
+        assert record2.id != record.id and record2.outcome == "skipped"
+        edge = next(g for g in record2.guards
+                    if g["name"] == "regression_edge")
+        assert edge["passed"] is False
+        assert reg.counter_value(
+            "serving_actuations_total",
+            {"plane": "rollout", "action": "rollback",
+             "outcome": "applied"}) == 1.0
+        assert reg.counter_value("serving_actuation_flaps_total",
+                                 {"plane": "rollout"}) == 0.0
+
+        out = render_why(record.to_dict(), now=300.0)
+        assert "baseline:" in out and "rollback" in out
+        assert "CONVERGENCE: fleet settled 15.00s after actuation" in out
+    finally:
+        rollout.LEDGER.clear()
