@@ -1,4 +1,4 @@
-.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy bench-closed-loop clean
+.PHONY: test lint vet metrics-catalogue chaos check native bench bench-trace-overhead bench-decode-overlap bench-profile-overhead bench-device-obs-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy bench-closed-loop clean
 
 test:
 	python -m pytest tests/ -q
@@ -20,6 +20,9 @@ bench-decode-overlap:  ## pipelined decode must beat the sync loop's host-blocke
 
 bench-profile-overhead:  ## the stack sampler at default hz must cost <2% decode throughput (budget json)
 	python benchmarks/profile_overhead_bench.py --check
+
+bench-device-obs-overhead:  ## the armed compile ledger + transfer meters must cost <2% decode dispatch time (budget json)
+	python benchmarks/device_obs_overhead_bench.py --check
 
 bench-spec-decode:  ## device-resident speculative loop must beat the host-loop oracle's host-blocked fraction (budget json)
 	python benchmarks/spec_decode_bench.py --check
@@ -51,7 +54,7 @@ bench-prefix-hierarchy:  ## host-arena prefix restore must cut cold-HBM shared-p
 bench-closed-loop:  ## seeded flash-crowd sweep: scale-out within budget, one drained scale-in, zero flaps, full decision provenance (budget json)
 	python benchmarks/closed_loop_bench.py --check
 
-check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy bench-closed-loop  ## what CI would run (vet gates before tests)
+check: vet metrics-catalogue test chaos bench-decode-overlap bench-profile-overhead bench-device-obs-overhead bench-spec-decode bench-kv-handoff bench-scenarios bench-history-overhead bench-journey-overhead bench-rollout-overhead bench-vet-wallclock bench-fleet-scale bench-prefix-hierarchy bench-closed-loop  ## what CI would run (vet gates before tests)
 
 native:  ## build the C runtime extensions into lws_tpu/core/
 	python native/build.py

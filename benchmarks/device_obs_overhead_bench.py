@@ -1,0 +1,165 @@
+"""Device-observability overhead microbench: the always-on guarantee for
+the compile ledger + transfer accounting.
+
+The device-runtime plane (lws_tpu/obs/device.py) is only allowed on the
+serving hot path if it is nearly free — the acceptance line is <2% decode
+throughput cost with everything armed. Its steady-state per-dispatch cost
+is exactly the instrumentation the engines execute every step:
+
+  * one `compile_site()` enter/exit (thread-local provenance push/pop —
+    the jax.monitoring listener itself fires only on compiles, which a
+    warm engine never pays);
+  * the `record_transfer()` calls metering dispatch-input uploads
+    (bounded-label counter incs on the process registry).
+
+An end-to-end armed/disarmed A/B cannot gate this: arming only registers
+the compile listener — the per-dispatch instrumentation runs either way,
+and dispatch-block A/Bs flap +-3% on a loaded box (see
+profile_overhead_bench.py), an order of magnitude above the effect. So,
+like the profile and trace benches, this one enforces the deterministic
+decomposition: the median cost of one dispatch's instrumentation set,
+measured with the ledger ARMED, as a percentage of the median real
+`step_n(1)` dispatch — both factors printed so a regression in either
+moves the gated number.
+
+Run:    python benchmarks/device_obs_overhead_bench.py            # report only
+CI:     python benchmarks/device_obs_overhead_bench.py --check    # enforce
+The budget lives in benchmarks/device_obs_overhead_budget.json (same
+contract shape as profile_overhead_budget.json; wired into `make check`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from lws_tpu.obs import device as devicemod  # noqa: E402
+from lws_tpu.models.llama import LlamaConfig, init_params  # noqa: E402
+from lws_tpu.serving.paged_engine import PagedBatchEngine  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "device_obs_overhead_budget.json")
+
+
+def build_engine():
+    cfg = LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=2048, dtype=jnp.float32, param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = jax.jit(lambda: init_params(cfg, jax.random.key(0)))()
+    # pipeline_depth=0: each step_n(1) contains its own chunk's device
+    # compute, so the dispatch median is a whole decode chunk (same
+    # reasoning as profile_overhead_bench.py).
+    return PagedBatchEngine(cfg, params, slots=8, max_len=2048, block_size=16,
+                            pipeline_depth=0)
+
+
+def median(xs: list) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def instrumentation_once() -> None:
+    """One dispatch's worth of device-obs instrumentation, armed: the
+    provenance site around the step plus the dispatch-input transfer
+    meters (paged_engine.step_n's per-dispatch set)."""
+    with devicemod.compile_site("paged.dispatch", engine="paged",
+                                shape="b8", request_id="bench"):
+        devicemod.record_transfer("paged.dispatch_inputs", 4096.0)
+        devicemod.record_transfer("paged.dispatch_inputs", 512.0)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--iters", type=int, default=2000,
+                        help="instrumentation sets to time")
+    parser.add_argument("--dispatches", type=int, default=200,
+                        help="step_n(1) calls to time for the scale row")
+    parser.add_argument("--check", action="store_true",
+                        help="enforce device_obs_overhead_budget.json "
+                             "(CI mode)")
+    args = parser.parse_args()
+
+    armed = devicemod.LEDGER.arm()
+
+    engine = build_engine()
+    r = np.random.RandomState(0)
+    for _ in range(engine.slots):
+        assert engine.submit(
+            r.randint(1, 255, size=24).astype(np.int32), 2000
+        ) is not None
+    engine.step_n(1)  # compile outside every timed window
+
+    # Decode dispatch cost, for scale.
+    dispatch_times = []
+    for _ in range(args.dispatches):
+        t0 = time.perf_counter()
+        executed = engine.step_n(1)
+        dispatch_times.append(time.perf_counter() - t0)
+        assert executed == 1, "engine drained mid-run; shrink --dispatches"
+    dispatch_s = median(dispatch_times)
+
+    # The per-dispatch instrumentation tax, armed. Timed in blocks of 8 so
+    # one perf_counter pair amortizes over several sub-microsecond calls.
+    block = 8
+    tax_times = []
+    for _ in range(args.iters // block):
+        t0 = time.perf_counter()
+        for _ in range(block):
+            instrumentation_once()
+        tax_times.append((time.perf_counter() - t0) / block)
+    tax_s = median(tax_times)
+
+    overhead_pct = tax_s / dispatch_s * 100.0
+    print(json.dumps({
+        "metric": "paged decode dispatch (scale reference)",
+        "dispatches": len(dispatch_times),
+        "value": round(engine.slots / dispatch_s, 1),
+        "unit": "tok/s (median dispatch)",
+    }))
+    print(json.dumps({
+        "metric": "device-obs instrumentation set (site + transfer meters)",
+        "iters": args.iters,
+        "armed": armed,
+        "value": round(tax_s * 1e6, 3),
+        "unit": "us (median, per dispatch)",
+    }))
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    verdict = {
+        "metric": "device-obs overhead on paged decode loop "
+                  "(per-dispatch instrumentation / dispatch cost)",
+        "value": round(overhead_pct, 3),
+        "unit": "% of dispatch time",
+        "tax_us": round(tax_s * 1e6, 3),
+        "dispatch_us": round(dispatch_s * 1e6, 1),
+        "budget_pct": budget["max_overhead_pct"],
+        "within_budget": overhead_pct < budget["max_overhead_pct"],
+    }
+    print(json.dumps(verdict), flush=True)
+    if args.check and not verdict["within_budget"]:
+        print(
+            f"[device-obs-overhead] FAIL: {overhead_pct:.2f}% >= budget "
+            f"{budget['max_overhead_pct']}%",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

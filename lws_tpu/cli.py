@@ -589,6 +589,22 @@ def _top_rows(fams: dict, by_class: bool = False) -> dict:
         field = f"spec_{labels.get('kind', '?')}"
         r[field] = r.get(field, 0.0) + value
 
+    # HBM occupancy: the device-labelled gauges are engine-less, so they
+    # fold (summed across an instance's devices) into the instance's `-`
+    # row; render_top derives in_use/limit as the HBM% column.
+    fold("serving_hbm_bytes_in_use", "hbm_in_use")
+    fold("serving_hbm_bytes_limit", "hbm_limit")
+    # Compile ledger: the kind-labelled counter folds into per-row
+    # cmp_first/cmp_recompile. render_top's CMP cell prefers the WINDOWED
+    # recompile count from history_rates (steady nonzero = storm in
+    # progress) and falls back to the lifetime recompile total.
+    for name, labels, value, _ in fams.get("serving_compiles_total", {}).get("samples", []):
+        if name != "serving_compiles_total":
+            continue
+        r = row(labels)
+        field = f"cmp_{labels.get('kind', '?')}"
+        r[field] = r.get(field, 0.0) + value
+
     for family, field in (("serving_ttft_seconds", "ttft"),
                           ("serving_itl_seconds", "itl")):
         per_key: dict = {}
@@ -646,6 +662,17 @@ def history_rates(ring, now: float | None = None, window_s: float = 30.0,
                 key += ("-",)
             s = slot(key)
             s["kv_mbps"] = s.get("kv_mbps", 0.0) + r / 1e6
+    # Recompiles in the window (the CMP column): increase() over the
+    # kind=recompile compile counter — one steady-state recompile per
+    # window per executable is exactly the bucket-miss signature
+    # docs/tasks/device-observability.md walks through.
+    for _, labels, _, pts, _ in ring.series("serving_compiles_total"):
+        if (labels.get("kind") or "") != "recompile":
+            continue
+        inc = signals.increase(pts, window_s, now)
+        if inc is not None:
+            s = slot(key_of(labels))
+            s["cmp"] = s.get("cmp", 0.0) + inc
     inc_good: dict = {}
     inc_tok: dict = {}
     for family, acc in (("serving_goodput_tokens_total", inc_good),
@@ -701,8 +728,8 @@ def render_top(fams: dict, alerts: dict | None = None,
     tier_cols = f"{'h%':>5}{'H%':>5}{'R%':>5}" if by_tier else ""
     lines.append(
         f"{'INSTANCE':<18}{'ENGINE':<9}{klass_col}{'SLO':>6}{'REQS':>7}{'ACTIVE':>7}"
-        f"{'INFL':>6}{'KV%':>6}{'PFX%':>6}{tier_cols}{'SPEC%':>7}{'GOOD%':>7}{'TTFT_P95':>10}"
-        f"{'ITL_P95':>10}{'DISP/S':>8}{'KV_MB/S':>9}"
+        f"{'INFL':>6}{'KV%':>6}{'HBM%':>6}{'PFX%':>6}{tier_cols}{'SPEC%':>7}{'GOOD%':>7}{'TTFT_P95':>10}"
+        f"{'ITL_P95':>10}{'DISP/S':>8}{'KV_MB/S':>9}{'CMP':>5}"
     )
 
     def fmt(v, pattern="{:.3f}", dash="-"):
@@ -754,6 +781,18 @@ def render_top(fams: dict, alerts: dict | None = None,
         pool = r.get("kv_free", 0.0) + r.get("kv_live", 0.0) + r.get("kv_parked", 0.0)
         if pool > 0:
             kv = r.get("kv_live", 0.0) / pool
+        # HBM occupancy: the device gauges are engine-less, so they ride
+        # the instance's `-` row (same routing as KV_MB/S).
+        hbm = None
+        hbm_row = r if r.get("hbm_limit") else rows.get(blank_key(instance), {})
+        if hbm_row.get("hbm_limit", 0.0) > 0:
+            hbm = hbm_row.get("hbm_in_use", 0.0) / hbm_row["hbm_limit"]
+        # CMP: recompiles in the rate window (ring-fed) — lifetime total
+        # as the one-shot fallback. A row that keeps a nonzero CMP is
+        # paying XLA compile time on steady-state traffic.
+        cmp_n = rr.get("cmp")
+        if cmp_n is None and ("cmp_recompile" in r or "cmp_first" in r):
+            cmp_n = r.get("cmp_recompile", 0.0)
         pfx = None
         tier_share = {"hbm": None, "host": None, "remote": None}
         lookups = r.get("pfx_hits", 0.0) + r.get("pfx_misses", 0.0)
@@ -787,6 +826,7 @@ def render_top(fams: dict, alerts: dict | None = None,
             f"{fmt(r.get('active'), '{:.0f}'):>7}"
             f"{fmt(r.get('inflight'), '{:.0f}'):>6}"
             f"{fmt(kv, '{:.0%}'):>6}"
+            f"{fmt(hbm, '{:.0%}'):>6}"
             f"{fmt(pfx, '{:.0%}'):>6}{tier_cells}"
             f"{fmt(spec, '{:.0%}'):>7}"
             f"{fmt(good, '{:.0%}'):>7}"
@@ -794,6 +834,7 @@ def render_top(fams: dict, alerts: dict | None = None,
             f"{fmt(r.get('itl_p95'), '{:.4f}s'):>10}"
             f"{fmt(rate, '{:.1f}'):>8}"
             f"{fmt(kv_rate, '{:.1f}'):>9}"
+            f"{fmt(cmp_n, '{:.0f}'):>5}"
         )
     if hidden_rows:
         what = (f"{len(hidden_instances)} more instances"
@@ -1234,6 +1275,20 @@ def render_explain(journey: dict, bar_width: int = 28) -> str:
         lines.append(
             f"wire chunks: {len(chunks)} ({nbytes} B) arrivals {arrivals}"
         )
+    compiles = (journey.get("annotations") or {}).get("compiles") or []
+    if compiles:
+        # The compile ledger annotated this request: XLA paid compile time
+        # on its critical path (lws_tpu/obs/device.py) — the forensic
+        # detail behind a "phase: compile" verdict.
+        lines.append("")
+        for c in compiles[:8]:
+            lines.append(
+                f"compile {c.get('kind', '?')}: {c.get('executable', '?')}"
+                f" shape={c.get('shape') or '-'}"
+                f" {float(c.get('seconds') or 0.0):.4f}s"
+            )
+        if len(compiles) > 8:
+            lines.append(f"... {len(compiles) - 8} more compiles")
     events = journey.get("events") or []
     if events:
         lines.append("")
@@ -1733,6 +1788,126 @@ def cmd_profile(args) -> int:
         else:
             instances = [("-", body)]
         frame = render_profile(instances, top_n=args.top)
+        if not args.watch:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+def _pool_rows(fams: dict) -> dict:
+    """{instance: {pool: bytes}} folded from `serving_hbm_pool_bytes` on a
+    fleet exposition — pure function so tests drive it from canned text."""
+    out: dict = {}
+    for name, labels, value, _ in fams.get(
+            "serving_hbm_pool_bytes", {}).get("samples", []):
+        if name != "serving_hbm_pool_bytes":
+            continue
+        row = out.setdefault(labels.get("instance", "-"), {})
+        pool = labels.get("pool", "?")
+        row[pool] = row.get(pool, 0.0) + value
+    return out
+
+
+def render_devices(compile_body: dict, pools: dict | None = None,
+                   top_n: int = 10) -> str:
+    """One frame of `lws-tpu devices`: per-instance HBM pool attribution,
+    the fleet per-executable compile fold (recompile-heavy first — those
+    are the rows costing steady-state wall-clock), and the recent ledger
+    tail (newest last). Pure function of a /debug/compile[/fleet] body and
+    a `_pool_rows` fold so tests drive it from canned dicts."""
+    instances = compile_body.get("instances", [])
+    execs = compile_body.get("executables", {})
+    storming = sorted({
+        name for e in instances
+        for name in ((e.get("compile") or {}).get("storms") or {})
+    })
+    lines = [
+        f"DEVICES  instances={len(instances)}  executables={len(execs)}"
+        f"  storms={','.join(storming) if storming else 'none'}"
+    ]
+    if pools:
+        lines.append("")
+        lines.append(f"{'INSTANCE':<18}{'WEIGHTS_MB':>11}{'KV_MB':>8}"
+                     f"{'ARENA_MB':>10}{'WORK_MB':>9}")
+        for inst in sorted(pools):
+            p = pools[inst]
+
+            def mb(pool):
+                v = p.get(pool)
+                return f"{v / 1e6:.0f}" if v is not None else "-"
+
+            lines.append(f"{inst:<18}{mb('weights'):>11}{mb('kv'):>8}"
+                         f"{mb('arena_restore'):>10}{mb('workspace'):>9}")
+    lines.append("")
+    lines.append(f"{'EXECUTABLE':<34}{'FIRST':>6}{'RECOMP':>7}"
+                 f"{'SECONDS':>9}{'INSTANCES':>10}")
+    table = sorted(execs.items(),
+                   key=lambda kv: (-int(kv[1].get("recompiles") or 0),
+                                   -float(kv[1].get("seconds") or 0.0)))
+    for name, agg in (table[:top_n] if top_n else table):
+        lines.append(f"{name[-34:]:<34}{int(agg.get('first') or 0):>6}"
+                     f"{int(agg.get('recompiles') or 0):>7}"
+                     f"{float(agg.get('seconds') or 0.0):>9.2f}"
+                     f"{int(agg.get('instances') or 1):>10}")
+    recent = []
+    for entry in instances:
+        inst = (entry.get("labels") or {}).get("instance", "-")
+        for rec in (entry.get("compile") or {}).get("records", []):
+            recent.append((float(rec.get("unix") or 0.0), inst, rec))
+    recent.sort(key=lambda t: t[0])
+    if recent:
+        lines.append("")
+        lines.append(f"{'INSTANCE':<18}{'KIND':<10}{'EXECUTABLE':<26}"
+                     f"{'SHAPE':<14}{'SECONDS':>9}")
+        for _, inst, rec in (recent[-top_n:] if top_n else recent):
+            lines.append(f"{inst:<18}{rec.get('kind', '?'):<10}"
+                         f"{(rec.get('executable') or '?')[-26:]:<26}"
+                         f"{(rec.get('shape') or '-')[:14]:<14}"
+                         f"{float(rec.get('seconds') or 0.0):>9.3f}")
+    return "\n".join(lines)
+
+
+def cmd_devices(args) -> int:
+    """Device-runtime view: which executables keep recompiling (and where),
+    how much wall-clock they cost, and how each instance's HBM splits
+    across the weights/kv/arena_restore/workspace pools. Prefers the
+    control plane's fleet fold (`/debug/compile/fleet` + the fleet
+    exposition's pool gauges); a bare worker telemetry server degrades to
+    its single-instance ledger. One-shot by default; --watch redraws;
+    --json dumps the raw fold for scripting."""
+    from lws_tpu.core.metrics import parse_exposition
+
+    args.interval = max(args.interval, 1.0)
+    while True:
+        try:
+            body = _http(args.server, "GET",
+                         f"/debug/compile/fleet?limit={args.limit}")
+        except SystemExit:
+            local = _http(args.server, "GET",
+                          f"/debug/compile?limit={args.limit}")
+            body = {
+                "instances": [{"labels": {"instance": "-"},
+                               "compile": local}],
+                "executables": {
+                    name: {**agg, "instances": 1}
+                    for name, agg in (local.get("executables") or {}).items()
+                },
+            }
+        if args.json:
+            print(json.dumps(body, indent=2, default=str))
+            return 0
+        pools = None
+        try:
+            url = f"{_server_base(args.server)}/metrics/fleet"
+            req = urllib.request.Request(url, headers=_auth_headers())
+            with urllib.request.urlopen(
+                    req, timeout=30, context=_url_context(url)) as resp:
+                pools = _pool_rows(parse_exposition(resp.read().decode()))
+        except (urllib.error.URLError, OSError):
+            pools = None  # bare telemetry server: compile tables only
+        frame = render_devices(body, pools=pools, top_n=args.top)
         if not args.watch:
             print(frame)
             return 0
@@ -2245,6 +2420,24 @@ def main(argv=None) -> int:
     ep.add_argument("--namespace", "-n", default=None)
     ep.add_argument("--server", default="127.0.0.1:9443")
     ep.set_defaults(fn=cmd_events)
+
+    dv = sub.add_parser("devices", help="device-runtime view: fleet compile "
+                        "ledger (which executables keep recompiling, and "
+                        "their wall-clock cost) + per-pool HBM attribution "
+                        "(from /debug/compile/fleet + the fleet exposition)")
+    dv.add_argument("--server", default="127.0.0.1:9443",
+                    help="API server (fleet fold) or a worker telemetry "
+                         "host:port (single-instance ledger)")
+    dv.add_argument("--watch", action="store_true",
+                    help="redraw every --interval seconds")
+    dv.add_argument("--interval", type=float, default=2.0)
+    dv.add_argument("--limit", type=int, default=256,
+                    help="ledger records to fetch per instance")
+    dv.add_argument("--top", type=int, default=10,
+                    help="rows per table to render (0 = unbounded)")
+    dv.add_argument("--json", action="store_true",
+                    help="dump the raw fleet fold instead of tables")
+    dv.set_defaults(fn=cmd_devices)
 
     args = p.parse_args(argv)
     global _TOKEN

@@ -168,6 +168,7 @@ class FlightRecorder:
         dependencies)."""
         from lws_tpu.core import profile as profmod
         from lws_tpu.obs import decisions as decisionsmod
+        from lws_tpu.obs import device as devicemod
         from lws_tpu.obs import history as historymod
         from lws_tpu.obs import journey as journeymod
         from lws_tpu.obs import rollout as rolloutmod
@@ -190,6 +191,9 @@ class FlightRecorder:
             # The recent decision window: an alert's dump carries the
             # actuation provenance of the episode that fired it.
             "decisions": decisionsmod.DECISIONS.snapshot(limit=32),
+            # The compile-ledger window: a compile_storm (or any) alert
+            # ships the offending executable's recompile provenance.
+            "compiles": devicemod.LEDGER.snapshot(limit=64),
         }
 
 
@@ -326,6 +330,18 @@ def default_rules() -> list:
         # edge's ring event embedding the offending revision's error
         # series and the rollout-ledger window.
         BacklogRule("canary_regression", "canary:*",
+                    depth_threshold=1.0, sustain_s=0.0),
+        # Device-runtime rules (lws_tpu/obs/device.py feeds): the compile
+        # ledger holds `compile_storm:{executable}` at depth >= storm_n
+        # while one executable has recompiled N times inside the window,
+        # and the shared device-memory refresh holds
+        # `hbm_pressure:{device}` at its occupancy while past the
+        # LWS_TPU_HBM_PRESSURE threshold — both pinned-progress, so each
+        # episode fires exactly once and the dump embeds the compile
+        # ledger window that explains it.
+        BacklogRule("compile_storm", "compile_storm:*",
+                    depth_threshold=1.0, sustain_s=0.0),
+        BacklogRule("hbm_pressure", "hbm_pressure:*",
                     depth_threshold=1.0, sustain_s=0.0),
     ]
 

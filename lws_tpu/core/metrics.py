@@ -839,3 +839,20 @@ describe("serving_actuation_flaps_total",
          "Applied actuations that reversed the previous applied direction on the same plane within LWS_TPU_FLAP_WINDOW_S — the control-loop oscillation signal")
 describe("serving_convergence_seconds",
          "Actuation-to-settled latency per plane: adapter call to the store reflecting the desired state (replicas ready / every pod on the restored revision)")
+# --- device-runtime observability (lws_tpu/obs/device.py) -------------------
+describe("serving_compiles_total",
+         "Backend (XLA) compiles recorded by the compile ledger, per engine and kind — kind=first is the expected warm-up compile per executable, kind=recompile is a shape/bucket miss paying compile time on the serving path")
+describe("serving_compile_seconds",
+         "Wall seconds one backend compile took (jax.monitoring backend_compile_duration), per engine — the tail IS the TTFT cliff a recompiling request sees",
+         buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+describe("serving_hbm_pool_bytes",
+         "Device memory attributed per pool (weights / kv / arena_restore / workspace) — workspace is the allocator residual nothing else claims; pools vs serving_hbm_bytes_limit is the admission headroom answer")
+describe("serving_hbm_peak_bytes",
+         "Allocator high-water mark per device (peak_bytes_in_use) — the burst footprint capacity planning must fit, not the steady state")
+describe("serving_hbm_fragmentation",
+         "Allocator-held headroom fraction per device: (peak - live)/peak — memory the allocator touched but nothing lives in; high after a burst means the next admission may not get it back contiguously")
+describe("serving_transfer_bytes_total",
+         "Host<->device bytes crossing the PCIe/ICI boundary per call site and direction (h2d/d2h) — the serial fraction that caps pod-scale throughput")
+describe("serving_transfer_seconds",
+         "Wall seconds of one synchronous host<->device transfer per site and direction",
+         buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0))

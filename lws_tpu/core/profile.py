@@ -290,25 +290,28 @@ def merge_collapsed(sources: list[tuple[dict, dict]]) -> str:
 # render (both servers call this before rendering — state, not a feed).
 
 
-def record_device_memory() -> int:
+def record_device_memory() -> list:
     """Refresh `serving_hbm_bytes_in_use` / `serving_hbm_bytes_limit` from
-    jax's per-device allocator stats; returns the device count recorded.
-    Guarded and CPU-safe: backends without memory_stats (CPU, some
-    plugins) record nothing rather than raising into a scrape handler."""
+    jax's per-device allocator stats; returns the per-device stat dicts
+    ({device, in_use, limit, peak}) so `obs.device.refresh_device_memory`
+    can derive the peak watermark, fragmentation, and pressure heartbeat
+    from one allocator read. Guarded and CPU-safe: backends without
+    memory_stats (CPU, some plugins) record nothing rather than raising
+    into a scrape handler."""
     if "jax" not in sys.modules:
         # Only processes that already initialized jax have device memory to
         # report. A cold import here would drag multi-second PJRT backend
         # init into a /metrics scrape — and on a TPU host the control
         # plane's scrape handler would EXCLUSIVELY acquire the chips the
         # colocated worker processes need.
-        return 0
+        return []
     try:
         import jax
 
         devices = jax.local_devices()
     except Exception:  # noqa: BLE001 — backend init failure: a scrape must still answer
-        return 0
-    n = 0
+        return []
+    out = []
     for d in devices:
         try:
             stats = d.memory_stats()
@@ -323,8 +326,13 @@ def record_device_memory() -> int:
             metrics.set("serving_hbm_bytes_in_use", float(in_use), labels)
         if limit is not None:
             metrics.set("serving_hbm_bytes_limit", float(limit), labels)
-        n += 1
-    return n
+        out.append({
+            "device": labels["device"],
+            "in_use": in_use,
+            "limit": limit,
+            "peak": stats.get("peak_bytes_in_use"),
+        })
+    return out
 
 
 # Process-default sampler + env wiring (one profile surface per process,

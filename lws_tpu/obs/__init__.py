@@ -38,6 +38,17 @@ from lws_tpu.obs.decisions import (
     default_scale_actuator,
     evaluate_and_actuate,
 )
+from lws_tpu.obs.device import (
+    CompileLedger,
+    arm_from_env,
+    compile_site,
+    debug_compile,
+    record_transfer,
+    refresh_device_memory,
+    register_pool_provider,
+    set_pool_bytes,
+)
+from lws_tpu.obs.device import LEDGER as COMPILE_LEDGER
 from lws_tpu.obs.history import (
     DEFAULT_INTERVAL_S,
     DEFAULT_RETENTION_S,
@@ -88,6 +99,7 @@ from lws_tpu.obs.signals import (
 )
 
 __all__ = [
+    "COMPILE_LEDGER",
     "DECISIONS",
     "DEFAULT_BURN_WINDOWS",
     "DEFAULT_INTERVAL_S",
@@ -99,6 +111,7 @@ __all__ = [
     "BurnWindow",
     "CanaryAnalyzer",
     "CanaryReport",
+    "CompileLedger",
     "DecisionLedger",
     "DecisionRecord",
     "HistoryRing",
@@ -109,10 +122,13 @@ __all__ = [
     "RolloutLedger",
     "ScaleActuator",
     "ScaleRecommender",
+    "arm_from_env",
     "breach_fraction",
     "burn_rate_from_counters",
     "burn_rate_from_gauge",
     "burn_windows",
+    "compile_site",
+    "debug_compile",
     "default_canary_analyzer",
     "default_rollout_actuator",
     "default_scale_actuator",
@@ -126,6 +142,9 @@ __all__ = [
     "multiwindow_burn",
     "quantile_over_window",
     "rate",
+    "record_transfer",
+    "refresh_device_memory",
+    "register_pool_provider",
     "revision_attainment",
     "revision_burn",
     "revision_good_fraction",
@@ -134,6 +153,7 @@ __all__ = [
     "revision_samples",
     "revision_spec_fraction",
     "revision_values",
+    "set_pool_bytes",
     "slope",
     "start_from_env",
 ]

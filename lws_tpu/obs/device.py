@@ -1,0 +1,482 @@
+"""Device-runtime observability: recompilation forensics, HBM attribution,
+and host<->device transfer accounting.
+
+Every plane before this one observes the request/fleet layer; this module
+observes the JAX/XLA layer the engines actually live on. The serving code
+is saturated with compile discipline — power-of-two bucketing "so XLA
+compiles one executable" (paged_engine/batch_engine), the pallas
+compile-probe fallback, first-call compiles silently eaten inside
+KV-stream ack windows — yet until now a recompile storm, an HBM
+high-water crossing, or a donation fallback was invisible. Three feeds,
+one bounded ledger:
+
+  * **Compile ledger** — `jax.monitoring` duration listeners (the CPU
+    backend emits the same `/jax/core/compile/backend_compile_duration`
+    events as TPU, so everything here is CPU-testable) record every
+    backend compile as a bounded provenance record {executable, compile
+    seconds, triggering shape/bucket, engine + trace ctx at trigger
+    time}. The JAX event carries no executable name, so engines declare
+    an ambient `compile_site(...)` around the dispatch seams where a
+    first-call (or shape-miss) compile can fire — the listener runs
+    synchronously on the compiling thread, so a thread-local stack
+    attributes it. `observe()` is the deterministic injectable feed for
+    tests (the `StackSampler.sample_once(frames=...)` pattern).
+    Published as `serving_compiles_total{engine,kind}` +
+    `serving_compile_seconds`, served at `GET /debug/compile` on both
+    servers, folded fleet-wide by `FleetCollector.collect_compiles`.
+  * **HBM attribution** — `refresh_device_memory()` is the single shared
+    helper both scrape seams call: per-device in-use/limit gauges
+    (core/profile.py), the allocator peak watermark + fragmentation
+    fraction, and per-pool accounting (`serving_hbm_pool_bytes{pool}`,
+    pools = weights | kv | arena_restore | workspace) from bytes the
+    engines register at allocation time.
+  * **Transfer accounting** — `record_transfer(site, nbytes)` /
+    `transfer(site, nbytes)` count host<->device bytes and seconds at
+    the engines' device_put / host-consume seams, labelled by site.
+
+Closing the loop: the ledger holds a `compile_storm:{executable}`
+heartbeat at depth>=1 with pinned progress while one executable has
+recompiled >= N times inside the window (the `circuit_open` convention —
+one edge-triggered Watchdog alert + diagnostics dump per episode, the
+dump embedding the ledger window), and `refresh_device_memory` holds
+`hbm_pressure:{device}` the same way past the occupancy threshold.
+Compile records that fire under a request-carrying site annotate the
+owning journey, so `lws-tpu explain` renders a compile row and the
+verdict can name recompilation as the phase that blew TTFT.
+
+The module-level LEDGER is the process default (one ledger per process,
+like metrics.REGISTRY / trace.TRACER / flightrecorder.RECORDER). Docs:
+docs/tasks/device-observability.md; budget:
+benchmarks/device_obs_overhead_bench.py (<2% decode throughput with
+listeners armed, enforced in `make check`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+from lws_tpu.core import metrics, trace
+from lws_tpu.utils.common import env_float as _env_float
+
+# The jax.monitoring event one backend compile emits (same key on the CPU
+# backend as on TPU — what makes the whole plane CPU-testable).
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+COMPILE_LEDGER_ENV = "LWS_TPU_COMPILE_LEDGER"      # 0 disables arming
+STORM_N_ENV = "LWS_TPU_COMPILE_STORM_N"            # recompiles per window
+STORM_WINDOW_ENV = "LWS_TPU_COMPILE_STORM_WINDOW_S"
+HBM_PRESSURE_ENV = "LWS_TPU_HBM_PRESSURE"          # occupancy threshold
+
+POOLS = ("weights", "kv", "arena_restore", "workspace")
+# Pools that occupy HBM (subtracted from device in-use to derive the
+# workspace residual). arena_restore is HOST-resident by construction — it
+# rides the same gauge family for one capacity view but never subtracts
+# from device memory.
+DEVICE_RESIDENT_POOLS = ("weights", "kv")
+
+# ---------------------------------------------------------------------------
+# Ambient compile-site context: the engines declare WHERE a compile could
+# fire (executable name, engine label, triggering shape/bucket, request id)
+# around their dispatch seams; the monitoring listener fires synchronously
+# on the compiling thread, so a thread-local stack attributes the event.
+
+_SITE = threading.local()
+
+
+def _site_stack() -> list:
+    stack = getattr(_SITE, "stack", None)
+    if stack is None:
+        stack = _SITE.stack = []
+    return stack
+
+
+@contextmanager
+def compile_site(executable: str, engine: str = "", shape: str = "",
+                 request_id: str = ""):
+    """Declare the ambient compile provenance for the enclosed dispatch:
+    any backend compile that fires inside the block is recorded against
+    `executable` with this engine/shape/request attribution. Nesting wins
+    innermost (a prefill site inside a request site names the prefill)."""
+    stack = _site_stack()
+    stack.append({"executable": executable, "engine": engine,
+                  "shape": shape, "request_id": request_id})
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def current_site() -> Optional[dict]:
+    stack = _site_stack()
+    return stack[-1] if stack else None
+
+
+# ---------------------------------------------------------------------------
+# The compile ledger.
+
+
+class CompileLedger:
+    """Bounded provenance ring of backend compiles + per-executable
+    counters and storm windows. `observe()` is BOTH the monitoring
+    listener's body and the deterministic injectable feed tests drive
+    (same pattern as `StackSampler.sample_once(frames=...)`)."""
+
+    def __init__(self, ring: int = 256, recorder=None,
+                 storm_n: Optional[int] = None,
+                 storm_window_s: Optional[float] = None,
+                 max_request_annotations: int = 64) -> None:
+        self._ring: "deque[dict]" = deque(maxlen=ring)  # guarded-by: _lock
+        self._counts: dict[str, dict] = {}              # guarded-by: _lock
+        self._recent: dict[str, deque] = {}             # guarded-by: _lock
+        self._per_request: "dict[str, list]" = {}       # guarded-by: _lock
+        self._request_order: "deque[str]" = deque()     # guarded-by: _lock
+        self._max_request_annotations = max_request_annotations
+        self._lock = threading.Lock()
+        self._recorder = recorder
+        self._armed = False
+        self._enabled = True
+        self._seq = 0
+        self.storm_n = int(storm_n if storm_n is not None
+                           else _env_float(STORM_N_ENV, 3.0))
+        self.storm_window_s = (storm_window_s if storm_window_s is not None
+                               else _env_float(STORM_WINDOW_ENV, 60.0))
+
+    def _beat(self, name: str, depth: float, now: Optional[float]) -> None:
+        # Pinned progress (always 0.0): the BacklogRule convention for
+        # externally-evaluated conditions — depth>=1 with a non-advancing
+        # progress counter fires once per episode; depth 0 clears it.
+        recorder = self._recorder
+        if recorder is None:
+            from lws_tpu.core import flightrecorder as frmod
+
+            recorder = frmod.RECORDER
+        recorder.beat(name, progress=0.0, depth=depth, now=now)
+
+    # ---- the feed --------------------------------------------------------
+    def observe(self, seconds: float, executable: Optional[str] = None,
+                engine: Optional[str] = None, shape: Optional[str] = None,
+                request_id: Optional[str] = None,
+                now: Optional[float] = None,
+                unix: Optional[float] = None) -> Optional[dict]:
+        """Record one backend compile. Explicit kwargs override the ambient
+        `compile_site` (the injectable test feed passes everything; the
+        jax.monitoring listener passes only `seconds`). `now` (monotonic)
+        drives the storm window deterministically in tests; `unix` stamps
+        the record. Returns the appended record (None while disabled)."""
+        if not self._enabled:
+            return None
+        site = current_site() or {}
+        name = executable if executable is not None else (
+            site.get("executable") or "unattributed")
+        eng = engine if engine is not None else (site.get("engine") or "-")
+        shp = shape if shape is not None else (site.get("shape") or "")
+        rid = request_id if request_id is not None else (
+            site.get("request_id") or "")
+        if now is None:
+            now = time.monotonic()
+        if unix is None:
+            unix = time.time()
+        ctx = trace.current_context()
+        with self._lock:
+            self._seq += 1
+            counts = self._counts.setdefault(
+                name, {"first": 0, "recompiles": 0, "seconds": 0.0,
+                       "last_unix": 0.0})
+            kind = "first" if counts["first"] == 0 else "recompile"
+            counts[{"first": "first", "recompile": "recompiles"}[kind]] += 1
+            counts["seconds"] += float(seconds)
+            counts["last_unix"] = unix
+            record = {
+                "seq": self._seq,
+                "unix": round(unix, 6),
+                "executable": name,
+                "kind": kind,
+                "seconds": round(float(seconds), 6),
+                "engine": eng,
+                "shape": shp,
+                "request_id": rid,
+                "trace": ctx,
+            }
+            self._ring.append(record)
+            # Storm window: in-window RECOMPILES of this executable. A
+            # first compile never storms (every executable compiles once).
+            recent = self._recent.setdefault(
+                name, deque(maxlen=max(self.storm_n * 4, 16)))
+            if kind == "recompile":
+                recent.append(now)
+            while recent and now - recent[0] > self.storm_window_s:
+                recent.popleft()
+            in_window = len(recent)
+            if rid:
+                entries = self._per_request.get(rid)
+                if entries is None:
+                    entries = self._per_request[rid] = []
+                    self._request_order.append(rid)
+                    while len(self._request_order) > self._max_request_annotations:
+                        self._per_request.pop(self._request_order.popleft(),
+                                              None)
+                if len(entries) < 32:
+                    entries.append({
+                        "executable": name, "kind": kind,
+                        "seconds": record["seconds"], "unix": record["unix"],
+                        "shape": shp,
+                    })
+                annotation = list(entries)
+            else:
+                annotation = None
+        metrics.inc("serving_compiles_total", {"engine": eng, "kind": kind})
+        metrics.observe("serving_compile_seconds", float(seconds),
+                        {"engine": eng})
+        self._beat(f"compile_storm:{name}",
+                   float(in_window) if in_window >= self.storm_n else 0.0,
+                   now)
+        if annotation is not None:
+            # The compile rode a request-carrying site: annotate the owning
+            # journey so `lws-tpu explain` renders the compile row and the
+            # verdict can blame recompilation for a blown TTFT.
+            from lws_tpu.obs import journey as journeymod
+
+            journeymod.VAULT.annotate(rid, compiles=annotation)
+        return record
+
+    # ---- jax.monitoring wiring -------------------------------------------
+    def arm(self) -> bool:
+        """Register the backend-compile duration listener (idempotent).
+        False when jax is unavailable — arming never imports a backend
+        into a process that didn't already pay for one."""
+        if self._armed:
+            return True
+        try:
+            import jax.monitoring as monitoring
+        except Exception:  # vet: ignore[hazard-exception-swallow]: a process without jax simply has no compiles to ledger (BLE001 intended)
+            return False
+
+        def _listener(event: str, duration_secs: float, **_kw) -> None:
+            if event == COMPILE_EVENT:
+                self.observe(duration_secs)
+
+        monitoring.register_event_duration_secs_listener(_listener)
+        self._armed = True
+        self._enabled = True
+        return True
+
+    def disarm(self) -> None:
+        """Stop recording (jax.monitoring has no selective unregister; the
+        registered listener stays but observes nothing)."""
+        self._enabled = False
+
+    @property
+    def armed(self) -> bool:
+        return self._armed and self._enabled
+
+    # ---- views -----------------------------------------------------------
+    def records(self, limit: Optional[int] = None,
+                executable: Optional[str] = None) -> list[dict]:
+        """Ledger records oldest-first; `limit` keeps the newest N,
+        `executable` narrows to one executable's window (what a
+        compile_storm dump embeds)."""
+        with self._lock:
+            out = list(self._ring)
+        if executable is not None:
+            out = [r for r in out if r["executable"] == executable]
+        if limit is not None and limit >= 0:
+            out = out[-limit:] if limit else []
+        return out
+
+    def snapshot(self, limit: int = 256) -> dict:
+        """The `GET /debug/compile` body — one shape for every surface
+        that serves it (worker telemetry server, API server, fleet fold)."""
+        with self._lock:
+            records = list(self._ring)
+            executables = {
+                name: {"first": c["first"], "recompiles": c["recompiles"],
+                       "seconds": round(c["seconds"], 6),
+                       "last_unix": round(c["last_unix"], 6)}
+                for name, c in self._counts.items()
+            }
+            storms = {
+                name: len(recent)
+                for name, recent in self._recent.items()
+                if len(recent) >= self.storm_n
+            }
+        if limit >= 0:
+            records = records[-limit:] if limit else []
+        return {
+            "armed": self.armed,
+            "storm_n": self.storm_n,
+            "storm_window_s": self.storm_window_s,
+            "records": records,
+            "executables": executables,
+            "storms": storms,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._counts.clear()
+            self._recent.clear()
+            self._per_request.clear()
+            self._request_order.clear()
+            self._seq = 0
+
+
+# Process-default ledger + conveniences (one ledger per process).
+LEDGER = CompileLedger()
+
+
+def arm_from_env() -> bool:
+    """Arm the process-default ledger unless LWS_TPU_COMPILE_LEDGER=0 —
+    called from the telemetry/server start paths, so every process that
+    serves /debug/compile also records into it."""
+    if os.environ.get(COMPILE_LEDGER_ENV, "1").lower() in ("0", "false",
+                                                           "off"):
+        return False
+    return LEDGER.arm()
+
+
+def debug_compile(limit: int = 256) -> dict:
+    return LEDGER.snapshot(limit)
+
+
+# ---------------------------------------------------------------------------
+# HBM attribution: per-pool accounting + fragmentation watermark, refreshed
+# on the scrape seams through ONE shared helper both servers call.
+
+_POOL_LOCK = threading.Lock()
+_POOL_BYTES: dict[str, float] = {}                    # guarded-by: _POOL_LOCK
+_POOL_PROVIDERS: dict[str, Callable[[], float]] = {}  # guarded-by: _POOL_LOCK
+
+
+def set_pool_bytes(pool: str, nbytes: float) -> None:
+    """Push-style pool accounting: an engine reports the bytes a pool
+    holds at (re)allocation time (weights at init, the paged KV pool at
+    build, the host arena on spill/evict)."""
+    with _POOL_LOCK:
+        _POOL_BYTES[pool] = float(nbytes)
+
+
+def register_pool_provider(pool: str, provider: Callable[[], float]) -> None:
+    """Pull-style pool accounting: `provider()` is called per refresh (for
+    pools whose size moves between scrapes, e.g. the restore arena)."""
+    with _POOL_LOCK:
+        _POOL_PROVIDERS[pool] = provider
+
+
+def clear_pools() -> None:
+    with _POOL_LOCK:
+        _POOL_BYTES.clear()
+        _POOL_PROVIDERS.clear()
+
+
+def refresh_device_memory(stats: Optional[list] = None,
+                          recorder=None, now: Optional[float] = None) -> int:
+    """The single shared device-memory refresh both scrape seams call
+    (runtime/telemetry.py and runtime/server.py /metrics handlers):
+
+      * per-device in-use/limit gauges (core/profile.py, unchanged);
+      * `serving_hbm_peak_bytes{device}` — the allocator high-water mark —
+        and `serving_hbm_fragmentation{device}` = (peak - live)/peak, the
+        fraction of the watermark the allocator holds but nothing lives
+        in (allocator-held headroom: a high value after a burst is memory
+        the next admission can't necessarily get back contiguously);
+      * `serving_hbm_pool_bytes{pool}` from the registered pools, with
+        `workspace` computed as the residual (device in-use minus the
+        attributed pools) when allocator stats exist;
+      * the `hbm_pressure:{device}` heartbeat, held at depth>=1 with
+        pinned progress while occupancy >= LWS_TPU_HBM_PRESSURE (0.92).
+
+    `stats` injects deterministic per-device dicts ({device, in_use,
+    limit, peak}) for tests — the production seams pass nothing and read
+    the live allocator. Returns the device count seen."""
+    from lws_tpu.core import profile as profmod
+
+    if stats is None:
+        stats = profmod.record_device_memory()
+    else:
+        for d in stats:
+            labels = {"device": d["device"]}
+            if d.get("in_use") is not None:
+                metrics.set("serving_hbm_bytes_in_use", float(d["in_use"]),
+                            labels)
+            if d.get("limit") is not None:
+                metrics.set("serving_hbm_bytes_limit", float(d["limit"]),
+                            labels)
+    threshold = _env_float(HBM_PRESSURE_ENV, 0.92)
+    if recorder is None:
+        from lws_tpu.core import flightrecorder as frmod
+
+        recorder = frmod.RECORDER
+    total_in_use = 0.0
+    have_in_use = False
+    for d in stats:
+        labels = {"device": d["device"]}
+        in_use = d.get("in_use")
+        limit = d.get("limit")
+        peak = d.get("peak")
+        if in_use is not None:
+            total_in_use += float(in_use)
+            have_in_use = True
+        if peak is not None:
+            metrics.set("serving_hbm_peak_bytes", float(peak), labels)
+            if in_use is not None and peak > 0:
+                metrics.set("serving_hbm_fragmentation",
+                            max(0.0, (float(peak) - float(in_use))
+                                / float(peak)),
+                            labels)
+        if in_use is not None and limit:
+            occupancy = float(in_use) / float(limit)
+            # Depth is occupancy over the threshold (>= 1.0 exactly when
+            # the device is past LWS_TPU_HBM_PRESSURE), so the BacklogRule
+            # depth_threshold=1.0 convention reads it directly. Pinned
+            # progress: one edge-triggered alert + dump per episode.
+            depth = occupancy / threshold if occupancy >= threshold else 0.0
+            recorder.beat(f"hbm_pressure:{d['device']}", progress=0.0,
+                          depth=depth, now=now)
+    with _POOL_LOCK:
+        pools = dict(_POOL_BYTES)
+        for pool, provider in _POOL_PROVIDERS.items():
+            try:
+                pools[pool] = float(provider())
+            except Exception:  # vet: ignore[hazard-exception-swallow]: a broken pool provider must never 500 a scrape (BLE001 intended)
+                continue
+    attributed = 0.0
+    for pool, nbytes in pools.items():
+        metrics.set("serving_hbm_pool_bytes", float(nbytes), {"pool": pool})
+        if pool in DEVICE_RESIDENT_POOLS:
+            attributed += float(nbytes)
+    if have_in_use:
+        metrics.set("serving_hbm_pool_bytes",
+                    max(0.0, total_in_use - attributed),
+                    {"pool": "workspace"})
+    return len(stats)
+
+
+# ---------------------------------------------------------------------------
+# Transfer accounting: host<->device bytes/seconds at the engines'
+# device_put / host-consume seams, labelled by site.
+
+
+def record_transfer(site: str, nbytes: float, direction: str = "h2d",
+                    seconds: Optional[float] = None) -> None:
+    labels = {"site": site, "direction": direction}
+    metrics.inc("serving_transfer_bytes_total", labels, float(nbytes))
+    if seconds is not None:
+        metrics.observe("serving_transfer_seconds", float(seconds), labels)
+
+
+@contextmanager
+def transfer(site: str, nbytes: float, direction: str = "h2d"):
+    """Time a transfer block: counts bytes AND wall seconds (use at seams
+    where the upload is synchronous enough for the wall time to mean
+    something; fire-and-forget dispatch inputs use record_transfer)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_transfer(site, nbytes, direction,
+                        seconds=time.perf_counter() - t0)

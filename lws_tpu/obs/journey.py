@@ -209,6 +209,24 @@ def verdict(journey: dict) -> dict:
             worst = (phase, value, target, overrun)
     if worst is not None:
         phase, value, target, _ = worst
+        if phase == "ttft":
+            # Compile blame: when the compile ledger annotated this journey
+            # (lws_tpu/obs/device.py) and the compile seconds cover at
+            # least half the TTFT overrun, recompilation IS the phase —
+            # name it, so the fix is bucket tuning, not prefill capacity.
+            compiles = (journey.get("annotations") or {}).get("compiles") or []
+            compile_s = sum(c.get("seconds") or 0.0 for c in compiles)
+            if compiles and compile_s >= 0.5 * (value - target):
+                kinds = sorted({c.get("kind") or "?" for c in compiles})
+                return {
+                    "ok": False, "phase": "compile", "value": value,
+                    "target": target,
+                    "text": f"BREACHED — ttft {value:.4f}s blew the "
+                            f"{target:.4f}s budget; {compile_s:.4f}s of it "
+                            f"was XLA compilation ({len(compiles)} "
+                            f"{'/'.join(kinds)} compile(s) — tune shape "
+                            "buckets, don't add prefill capacity)",
+                }
         return {
             "ok": False, "phase": phase, "value": value, "target": target,
             "text": f"BREACHED — {phase} {value:.4f}s blew the "

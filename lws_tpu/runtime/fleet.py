@@ -636,6 +636,54 @@ class FleetCollector:
 
         return lookup
 
+    # ---- compile-ledger fan-in (GET /debug/compile/fleet) ----------------
+    def collect_compiles(self, limit: int = 256) -> dict:
+        """Fleet-merged compile-ledger view: every ready worker's
+        `GET /debug/compile` plus this process's own ledger as instance
+        "control-plane", each under its instance labels, with a cross-fleet
+        `executables` fold (per-executable first/recompile/seconds summed
+        over instances — the "which executable storms fleet-wide" answer).
+        Operator-driven like collect_profiles (no cache: `lws-tpu devices`
+        polls at human rates, and ledger counters are cumulative anyway)."""
+        from lws_tpu.core import trace
+        from lws_tpu.obs import device as devicemod
+
+        instances: list[dict] = [{
+            "labels": {"instance": "control-plane"},
+            "compile": devicemod.debug_compile(limit),
+        }]
+        targets = self.targets()
+        if targets:
+            from concurrent.futures import ThreadPoolExecutor
+
+            path = f"/debug/compile?limit={int(limit)}"
+            with trace.span("fleet.compile_scrape", instances=len(targets)):
+                with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+                    scraped = pool.map(
+                        lambda t: self._scrape_debug_json(
+                            t[0], *t[1], path, missing_ok=False
+                        ),
+                        targets,
+                    )
+                    instances.extend(
+                        {"labels": labels, "compile": got}
+                        for (labels, _), got in zip(targets, scraped)
+                        if isinstance(got, dict)
+                    )
+        executables: dict[str, dict] = {}
+        for entry in instances:
+            for name, counts in (entry["compile"].get("executables")
+                                 or {}).items():
+                agg = executables.setdefault(
+                    name, {"first": 0, "recompiles": 0, "seconds": 0.0,
+                           "instances": 0})
+                agg["first"] += int(counts.get("first") or 0)
+                agg["recompiles"] += int(counts.get("recompiles") or 0)
+                agg["seconds"] = round(
+                    agg["seconds"] + float(counts.get("seconds") or 0.0), 6)
+                agg["instances"] += 1
+        return {"instances": instances, "executables": executables}
+
     def collect_shard_texts(self, force: bool = False,
                             now: Optional[float] = None) -> list[tuple[str, str]]:
         """[(shard_id, merged shard exposition)] over the ready fleet, the

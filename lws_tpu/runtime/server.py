@@ -32,6 +32,14 @@ Endpoints:
                                       evaluation with guards, actuation
                                       outcome, and convergence timing
                                       (lws_tpu/obs/decisions.py)
+  GET  /debug/compile[?limit=N]       this process's compile ledger:
+                                      backend-compile provenance records,
+                                      per-executable counters, active storm
+                                      windows (lws_tpu/obs/device.py)
+  GET  /debug/compile/fleet           every ready worker's /debug/compile
+                                      plus the control-plane leg, instance-
+                                      labelled, with a cross-fleet
+                                      executables fold (runtime/fleet.py)
   GET  /debug/faults                  armed fault points + hit/trip counters
   POST /debug/faults                  arm/disarm deterministic fault
                                       schedules in this process
@@ -269,10 +277,15 @@ class ApiServer:
                     from lws_tpu.core import slo as slomod
 
                     # Device-memory gauges refresh per scrape (CPU-safe
-                    # no-op without allocator stats); SLO attainment
-                    # windows age-evict the same way (stale-attainment
-                    # guard, core/slo.py).
-                    profmod.record_device_memory()
+                    # no-op without allocator stats) via the shared helper
+                    # — per-device + per-pool + peak/fragmentation + the
+                    # hbm_pressure heartbeat, same call the worker
+                    # telemetry server makes; SLO attainment windows
+                    # age-evict the same way (stale-attainment guard,
+                    # core/slo.py).
+                    from lws_tpu.obs import device as devicemod
+
+                    devicemod.refresh_device_memory()
                     slomod.RECORDER.refresh()
                     regs = (cp.metrics,) if cp.metrics is metricsmod.REGISTRY \
                         else (cp.metrics, metricsmod.REGISTRY)
@@ -393,6 +406,30 @@ class ApiServer:
                             {"labels": labels, "profile": snap}
                             for labels, snap in sources
                         ]})
+                elif path in ("/debug/compile", "/debug/compile/fleet"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    from lws_tpu.obs import device as devicemod
+                    from lws_tpu.runtime.telemetry import parse_limit
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._json(400, {"error": f"bad limit: {e}"})
+                        return
+                    if path == "/debug/compile":
+                        self._json(200, devicemod.debug_compile(limit))
+                        return
+                    # Fleet-merged: every ready worker's /debug/compile
+                    # plus the control plane's own leg, instance-labelled
+                    # like /metrics/fleet, with a cross-fleet executables
+                    # fold (runtime/fleet.py).
+                    fleet = getattr(cp, "fleet", None)
+                    if fleet is None:
+                        self._json(404, {"error": "fleet collector not wired"})
+                        return
+                    self._json(200, fleet.collect_compiles(limit))
                 elif path == "/debug/history":
                     from urllib.parse import parse_qs, urlparse
 

@@ -66,6 +66,7 @@ class SimInstance:
         )
         self.port = self.server.port
         self.requests = 0
+        self._compiles_first = 0
         self._labels = {"engine": self.role, "klass": self.klass,
                         "revision": self.revision}
 
@@ -101,6 +102,31 @@ class SimInstance:
         reg.set("serving_slo_attainment",
                 round(0.9 + 0.1 * rng.random(), 4), self._labels)
         reg.set("serving_active_slots", float(rng.randrange(8)), eng)
+        # Device-runtime series (lws_tpu/obs/device.py twins): every
+        # instance paid one first compile per executable at warm-up; a
+        # small minority of ticks recompile (bucket misses) — exercises
+        # the CMP column, the fleet compile folds, and top-k bounding.
+        if self.requests and self._compiles_first == 0:
+            self._compiles_first = 1
+            reg.inc("serving_compiles_total", {**eng, "kind": "first"})
+            reg.observe("serving_compile_seconds",
+                        0.2 + rng.random() * 0.8, eng)
+        if rng.random() < 0.05:
+            reg.inc("serving_compiles_total", {**eng, "kind": "recompile"})
+            reg.observe("serving_compile_seconds",
+                        0.2 + rng.random() * 0.8, eng)
+        limit = 16 * (1 << 30)
+        weights = 4.2 * (1 << 30)
+        kv = (2.0 + 1.5 * rng.random()) * (1 << 30)
+        reg.set("serving_hbm_pool_bytes", weights, {"pool": "weights"})
+        reg.set("serving_hbm_pool_bytes", kv, {"pool": "kv"})
+        reg.set("serving_hbm_pool_bytes", 0.2 * (1 << 30),
+                {"pool": "arena_restore"})
+        reg.set("serving_hbm_pool_bytes", 0.3 * (1 << 30),
+                {"pool": "workspace"})
+        dev = {"device": "tpu:0"}
+        reg.set("serving_hbm_bytes_in_use", weights + kv, dev)
+        reg.set("serving_hbm_bytes_limit", float(limit), dev)
 
 
 class SimFleet:

@@ -19,6 +19,10 @@ engine) exposes its process-default registries over one tiny HTTP server:
                              for the actuation planes with guards, outcome
                              and convergence (lws_tpu/obs/decisions.py;
                              ?limit=N, same 400 contract)
+  GET /debug/compile         the compile ledger: backend-compile provenance
+                             records, per-executable counters, active storm
+                             windows (lws_tpu/obs/device.py; ?limit=N, same
+                             400 contract)
   GET  /debug/faults         armed fault points + hit/trip counters
   POST /debug/faults         arm/disarm fault schedules in this process
                              ({"arm": {point: spec}}, {"disarm": [...]},
@@ -146,10 +150,15 @@ class TelemetryServer:
                         text = registry.render()
                     else:
                         # Device-memory gauges are state, not a feed: refresh
-                        # them per scrape (guarded no-op on CPU backends). The
-                        # SLO attainment windows age-evict the same way — a
-                        # quiet engine must not advertise stale attainment.
-                        profmod.record_device_memory()
+                        # them per scrape (guarded no-op on CPU backends) via
+                        # the shared helper (per-device + per-pool + peak/
+                        # fragmentation + pressure heartbeat — the API server
+                        # calls the same one). The SLO attainment windows
+                        # age-evict the same way — a quiet engine must not
+                        # advertise stale attainment.
+                        from lws_tpu.obs import device as devicemod
+
+                        devicemod.refresh_device_memory()
                         slomod.RECORDER.refresh()
                         text = metricsmod.REGISTRY.render()
                         # The scrape opportunistically feeds the history ring
@@ -272,6 +281,22 @@ class TelemetryServer:
 
                     self._send(200, json.dumps(_kha.debug_prefixes(limit)),
                                "application/json")
+                elif path == "/debug/compile":
+                    # The compile ledger: backend-compile provenance records
+                    # + per-executable counters + active storm windows
+                    # (lws_tpu/obs/device.py) — same parse_limit/bearer
+                    # contract as the API server's twin.
+                    try:
+                        limit = parse_limit(q)
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": f"bad limit: {e}"}),
+                                   "application/json")
+                        return
+                    from lws_tpu.obs import device as devicemod
+
+                    self._send(200, json.dumps(devicemod.debug_compile(limit),
+                                               default=str),
+                               "application/json")
                 elif path == "/debug/faults":
                     self._send(200, json.dumps(faultsmod.INJECTOR.snapshot()),
                                "application/json")
@@ -375,6 +400,12 @@ def start_from_env() -> Optional[TelemetryServer]:
     from lws_tpu.obs import history as history_env
 
     history_env.start_from_env()
+    # Compile ledger: arm the jax.monitoring backend-compile listener so
+    # every compile this worker pays lands on /debug/compile with engine/
+    # shape/request provenance (LWS_TPU_COMPILE_LEDGER=0 disables).
+    from lws_tpu.obs import device as device_env
+
+    device_env.arm_from_env()
     server = TelemetryServer(
         port=int(raw),
         watchdog=Watchdog(),

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lws_tpu.core import metrics, slo, trace
+from lws_tpu.obs import device as devicemod
 from lws_tpu.serving.pipeline import DecodePipeline, remaining_steps
 
 from lws_tpu.models.llama import (
@@ -150,7 +151,10 @@ class BatchEngine:
             bucket = min(bucket, self.max_len)
             padded = np.zeros((bucket,), np.int32)
             padded[:plen] = prompt
-            with trace.span("serve.prefill", chunked=False, prompt_len=plen):
+            with trace.span("serve.prefill", chunked=False, prompt_len=plen), \
+                    devicemod.compile_site(
+                        "batch.prefill", engine="batch", shape=f"b{bucket}",
+                        request_id=req.slo.request_id if req.slo else ""):
                 first, slot_cache = self._prefill_one(
                     self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
                 )
@@ -205,9 +209,11 @@ class BatchEngine:
                 active = jnp.asarray(
                     [s in self._active for s in range(self.slots)]
                 )
-                self.cache, self.tokens, self.pos_b = self._step_fn(
-                    self.params, self.cache, self.tokens, self.pos_b, active
-                )
+                with devicemod.compile_site("batch.step", engine="batch"):
+                    self.cache, self.tokens, self.pos_b = self._step_fn(
+                        self.params, self.cache, self.tokens, self.pos_b,
+                        active,
+                    )
             # Only requests active AT DISPATCH got a real token this step.
             snapshot = dict(self._active)
 

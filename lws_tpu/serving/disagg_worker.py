@@ -84,6 +84,25 @@ def _decode_bundle(
     fewer dispatches on repetitive content — a streamed handoff ships the
     prompt token ids alongside the KV rows, so drafting seeds from the
     REAL prompt instead of warming up from generated tokens only."""
+    from lws_tpu.obs import device as devicemod
+
+    # Ambient compile provenance for the whole leg: the decode engine's
+    # first-call jit (the compile the KV ack window silently eats —
+    # kv_transport.pull_bundle) lands on the ledger attributed to THIS
+    # request, so the fleet-joined journey can blame it for TTFT.
+    with devicemod.compile_site(
+        "disagg.decode", engine="disagg", shape=f"steps{steps}/g{gamma}",
+        request_id=request_id,
+    ):
+        return _decode_bundle_inner(
+            engine, payload, steps, gamma, ngram, klass, request_id,
+        )
+
+
+def _decode_bundle_inner(
+    engine, payload, steps: int, gamma: int = 0, ngram: int = 3,
+    klass: str = "", request_id: str = "",
+) -> tuple[np.ndarray, dict, list]:  # hot-path
     import jax
 
     from lws_tpu.core import slo, trace

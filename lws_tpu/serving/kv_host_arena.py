@@ -33,6 +33,7 @@ import threading
 from typing import Callable, Optional
 
 from lws_tpu.core import metrics
+from lws_tpu.obs import device
 
 ARENA_MB_ENV = "LWS_TPU_KV_HOST_ARENA_MB"
 
@@ -176,6 +177,21 @@ def get_spilled(digest: bytes) -> Optional[dict]:
         if got is not None:
             return got
     return None
+
+
+def arena_pool_bytes() -> float:
+    """Total bytes across every live arena — the `arena_restore` pool feed
+    for serving_hbm_pool_bytes (host-resident, so the device-memory refresh
+    reports it without subtracting it from HBM in-use)."""
+    with _REG_LOCK:
+        live = [r() for r in _ARENAS]
+        _ARENAS[:] = [r for r, a in zip(list(_ARENAS), live) if a is not None]
+    return float(sum(a.nbytes for a in live if a is not None))
+
+
+# Registered once at import: the pool reads 0 until an arena exists, which
+# is itself the honest answer.
+device.register_pool_provider("arena_restore", arena_pool_bytes)
 
 
 def register_prefix_source(name: str,

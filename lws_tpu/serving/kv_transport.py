@@ -306,12 +306,18 @@ def bundle_to_cache(data, max_len: Optional[int] = None):
         pad[2] = (0, max_len - a.shape[2])
         return np.pad(a, pad)
 
+    from lws_tpu.obs import device as devicemod
+
     cache = KVCache(
         k=jnp.asarray(fit(bundle["k"])), v=jnp.asarray(fit(bundle["v"])),
         pos=jnp.asarray(bundle["pos"]),
         k_scale=jnp.asarray(fit(bundle["k_scale"])) if "k_scale" in bundle else None,
         v_scale=jnp.asarray(fit(bundle["v_scale"])) if "v_scale" in bundle else None,
     )
+    devicemod.record_transfer(
+        "kv.bundle_to_cache",
+        sum(int(a.nbytes) for a in bundle.values()
+            if hasattr(a, "nbytes")))
     return cache, jnp.asarray(bundle["token"])
 
 
@@ -537,6 +543,8 @@ def _device_insert(buf, chunk, lo: int):
     import jax
     import jax.numpy as jnp
 
+    from lws_tpu.obs import device as devicemod
+
     with _DEVICE_INSERT_LOCK:
         if _DEVICE_INSERT is None:
             _DEVICE_INSERT = jax.jit(
@@ -546,7 +554,11 @@ def _device_insert(buf, chunk, lo: int):
                 donate_argnums=(0,),
             )
         fn = _DEVICE_INSERT
-    return fn(buf, jnp.asarray(chunk), jnp.asarray(lo, jnp.int32))
+    devicemod.record_transfer("kv.assembler_insert",
+                              int(getattr(chunk, "nbytes", 0) or 0))
+    with devicemod.compile_site("kv.assembler_insert", engine="disagg",
+                                shape=f"c{chunk.shape[2]}"):
+        return fn(buf, jnp.asarray(chunk), jnp.asarray(lo, jnp.int32))
 
 
 class CacheAssembler:

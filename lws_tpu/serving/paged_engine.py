@@ -64,6 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lws_tpu.core import flightrecorder, metrics, slo, trace
+from lws_tpu.obs import device as devicemod
 from lws_tpu.serving.pipeline import DecodePipeline, remaining_steps
 
 from lws_tpu.models.llama import (
@@ -76,6 +77,13 @@ from lws_tpu.models.llama import (
     paged_cache_shardings,
     paged_insert,
 )
+
+
+def _tree_nbytes(tree) -> int:
+    """Total buffer bytes across a pytree's array leaves (HBM pool
+    attribution feed — leaves without nbytes contribute nothing)."""
+    return sum(int(getattr(leaf, "nbytes", 0) or 0)
+               for leaf in jax.tree.leaves(tree))
 
 
 @dataclass
@@ -535,6 +543,11 @@ class PagedBatchEngine:
         # pipeline (two [slots, V] sorts + softmax + cumsum + categorical)
         # would tax every decode step of the benchmarked path for nothing.
         self._step_cache: dict = {}
+        # HBM attribution (lws_tpu/obs/device.py): the two big pools this
+        # engine owns, published as serving_hbm_pool_bytes{pool} on the
+        # scrape-time refresh (workspace is the allocator residual).
+        devicemod.set_pool_bytes("weights", _tree_nbytes(self.params))
+        devicemod.set_pool_bytes("kv", _tree_nbytes(self.cache))
         self._update_pool_gauges()  # capacity gauges valid from first scrape
 
     def _get_step_fn(self, sample: bool):
@@ -559,15 +572,22 @@ class PagedBatchEngine:
         if self._dirty_active:
             self._active_dev = self._put_rep(jnp.asarray(np.array(self._active_mask)))
             self._dirty_active = False
+            devicemod.record_transfer("paged.dispatch_inputs",
+                                      self._active_mask.nbytes)
         if self._dirty_table:
             self._table_dev = self._put_rep(jnp.asarray(np.array(self.table)))
             self._dirty_table = False
+            devicemod.record_transfer("paged.dispatch_inputs",
+                                      self.table.nbytes)
         if self._dirty_sampling:
             self._sampling_dev = tuple(
                 self._put_rep(jnp.asarray(np.array(a)))
                 for a in (self.temp, self.top_k, self.top_p)
             )
             self._dirty_sampling = False
+            devicemod.record_transfer(
+                "paged.dispatch_inputs",
+                self.temp.nbytes + self.top_k.nbytes + self.top_p.nbytes)
         return self._active_dev, self._table_dev, (self._keys, *self._sampling_dev)
 
     def _make_step_n(self, use_kernel: bool, donate: bool = True, sample: bool = False):
@@ -783,6 +803,7 @@ class PagedBatchEngine:
             "serving_kv_spill_bytes_total", {"direction": "restore"},
             value=float(nbytes),
         )
+        devicemod.record_transfer("paged.kv_restore", nbytes)
         return blk
 
     def _assign_sampling(self, slot: int, temperature, top_k, top_p, seed):
@@ -1038,7 +1059,10 @@ class PagedBatchEngine:
 
         padded = np.zeros((bucket,), np.int32)
         padded[:plen] = prompt
-        with trace.span("serve.prefill", chunked=False, prompt_len=plen):
+        with trace.span("serve.prefill", chunked=False, prompt_len=plen), \
+                devicemod.compile_site(
+                    "paged.prefill", engine="paged", shape=f"b{bucket}",
+                    request_id=timeline.request_id):
             with self._mesh_ctx():
                 logits, slot_cache = self._prefill_one(
                     self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
@@ -1168,7 +1192,10 @@ class PagedBatchEngine:
             # computed blocks for future prompts.
             padded = np.zeros((bucket,), np.int32)
             padded[:plen] = prompt
-            with trace.span("serve.prefill", chunked=False, prompt_len=plen):
+            with trace.span("serve.prefill", chunked=False, prompt_len=plen), \
+                    devicemod.compile_site(
+                        "paged.prefill", engine="paged", shape=f"b{bucket}",
+                        request_id=timeline.request_id if timeline else ""):
                 with self._mesh_ctx():
                     logits, slot_cache = self._prefill_one(
                         self.params, jnp.asarray(padded)[None, :], jnp.asarray(plen - 1)
@@ -1203,6 +1230,10 @@ class PagedBatchEngine:
             with trace.span(
                 "serve.prefill", chunked=False, prompt_len=plen,
                 prefix_hit_tokens=hit_len,
+            ), devicemod.compile_site(
+                "paged.prefill_suffix", engine="paged",
+                shape=f"b{bucket}/s{s_suf}",
+                request_id=timeline.request_id if timeline else "",
             ):
                 with self._mesh_ctx():
                     args = tuple(self._put_rep(a) for a in args)
@@ -1310,6 +1341,10 @@ class PagedBatchEngine:
             with trace.span(
                 "serve.prefill", chunked=True, chunks=n_chunks,
                 prompt_len=plen, prefix_hit_tokens=hit_len,
+            ), devicemod.compile_site(
+                "paged.chunk_prefill", engine="paged",
+                shape=f"b{bucket}/c{C}",
+                request_id=req.slo.request_id if req.slo else "",
             ):
                 for i in range(n_chunks):
                     chunk = jnp.asarray(padded[i * C:(i + 1) * C])[None, :]
@@ -1430,7 +1465,10 @@ class PagedBatchEngine:
                 # All-greedy batches (the default and the benchmarked
                 # configuration) take the argmax-only executable.
                 any_sampled = self._sampled_active > 0
-                with self._mesh_ctx():
+                with devicemod.compile_site(
+                    "paged.step_n", engine="paged",
+                    shape=f"n{n}/sample={any_sampled}",
+                ), self._mesh_ctx():
                     try:
                         step_fn = self._get_step_fn(any_sampled)
                         out = step_fn(
@@ -1690,7 +1728,10 @@ class PagedBatchEngine:
             with self._pipeline.host_section():
                 active, table, sampling = self._dispatch_inputs()
                 any_sampled = self._sampled_active > 0
-                with self._mesh_ctx():
+                with devicemod.compile_site(
+                    "paged.spec_step", engine="paged",
+                    shape=f"g{gamma}/n{ngram}/sample={any_sampled}",
+                ), self._mesh_ctx():
                     fn = self._get_spec_step(any_sampled, gamma, ngram)
                     (self.cache, self.tokens, self.pos_b, self._keys,
                      self._hist, self._hist_len, self._rem, packed) = fn(

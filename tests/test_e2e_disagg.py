@@ -613,6 +613,83 @@ def _run_disagg_e2e(tmp_path, extra_env: list | None = None,
             assert rc == 0
             assert "req-tail" in buf.getvalue(), buf.getvalue()
 
+            # ISSUE 20: device-runtime forensics across the live pair.
+            # The decode worker paid its first-call compile for the
+            # bundle-decode dispatch under the `disagg.decode` compile
+            # site while serving req1 (the process's first bundle), so
+            # the record carries that request id. Three surfaces must
+            # agree: the worker's own /debug/compile ledger, the fleet
+            # compile fold on the control plane, and req1's fleet-joined
+            # journey (slowest-K retention keeps the healthy first
+            # request; the compile annotation rode VAULT.annotate from
+            # the site teardown).
+            dev_deadline = time.time() + 60
+            worker_compile = None
+            while time.time() < dev_deadline:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{decode_metrics}/debug/compile",
+                    timeout=10,
+                ) as resp:
+                    worker_compile = _json.loads(resp.read().decode())
+                if any(
+                    r.get("executable") == "disagg.decode"
+                    for r in worker_compile.get("records", [])
+                ):
+                    break
+                time.sleep(0.5)
+            assert worker_compile is not None
+            assert worker_compile.get("armed") is True, worker_compile
+            dec_records = [
+                r for r in worker_compile.get("records", [])
+                if r.get("executable") == "disagg.decode"
+            ]
+            assert dec_records, worker_compile
+            assert dec_records[0]["kind"] == "first", dec_records
+            assert dec_records[0]["request_id"] == "req1", dec_records
+            assert dec_records[0]["seconds"] > 0, dec_records
+
+            # The same record rides the fleet fold: per-executable sums
+            # plus how many instances compiled it, with the decode
+            # worker present among the scraped instances.
+            with urllib.request.urlopen(
+                f"{api_url}/debug/compile/fleet?limit=64", timeout=10
+            ) as resp:
+                fleet_compile = _json.loads(resp.read().decode())
+            folded = fleet_compile["executables"].get("disagg.decode")
+            assert folded is not None, fleet_compile["executables"]
+            assert folded["first"] >= 1, folded
+            assert folded["instances"] >= 1, folded
+            fold_instances = {
+                i["labels"].get("instance")
+                for i in fleet_compile.get("instances", [])
+            }
+            assert by_role["decode"] & fold_instances, fold_instances
+
+            # Compile-blame journey: the fleet join merges the decode
+            # leg's `compiles` annotation to the top level, naming the
+            # executable and the seconds the request spent compiling.
+            dev_joined = None
+            while time.time() < dev_deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"{api_url}/debug/request/req1", timeout=10
+                    ) as resp:
+                        dev_joined = _json.loads(resp.read().decode())
+                except urllib.error.HTTPError:
+                    dev_joined = None
+                if dev_joined is not None and \
+                        (dev_joined.get("annotations") or {}).get("compiles"):
+                    break
+                time.sleep(0.5)
+            assert dev_joined is not None, "fleet join never found req1"
+            blamed = (dev_joined.get("annotations") or {}).get("compiles")
+            assert blamed, dev_joined.get("annotations")
+            assert any(
+                c.get("executable") == "disagg.decode"
+                and c.get("seconds", 0) > 0
+                for c in blamed
+            ), blamed
+
         # ISSUE 12 satellite: counter resets + series retirement across a
         # REAL worker restart, as seen by the history plane. Sample the
         # merged fleet exposition into a HistoryRing, kill the prefill

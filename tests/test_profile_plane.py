@@ -182,8 +182,10 @@ def test_fold_helpers_and_merge_collapsed():
 def test_record_device_memory_is_cpu_safe():
     # On the CPU test backend allocator stats are absent: the refresh must
     # be a quiet no-op, never a scrape-handler exception.
-    n = profile.record_device_memory()
-    assert n >= 0
+    stats = profile.record_device_memory()
+    assert isinstance(stats, list)
+    for d in stats:
+        assert set(d) == {"device", "in_use", "limit", "peak"}
 
 
 # ---------------------------------------------------------------------------

@@ -347,6 +347,31 @@ def test_spans_rules_cover_rollout_plane():
         assert not f.detail.startswith("ok_"), f
 
 
+def test_spans_rules_cover_device_plane():
+    """The device-runtime plane (lws_tpu/obs/device.py) is INSIDE the
+    catalogue scope: its forensics surface (`serving_compiles_total{kind}`,
+    `serving_hbm_pool_bytes{pool}`, the `fleet.compile_scrape` span) is
+    what recompile-storm and HBM-pressure runbooks key on — a ledger
+    minting per-kind/per-pool names dynamically would make the one surface
+    that explains compile stalls itself uncatalogueable."""
+    found = run_pass(
+        "spans",
+        [FIXTURES / "lws_tpu" / "obs" / "device_cases.py"],
+        root=FIXTURES,
+    )
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert any("bad_kind_metric" in f.detail
+               for f in by_rule.get("metric-name-literal", [])), found
+    assert any("bad_pool_span" in f.detail
+               for f in by_rule.get("span-name-literal", [])), found
+    assert any("bad_unentered_span" in f.detail
+               for f in by_rule.get("span-context-manager", [])), found
+    for f in found:
+        assert not f.detail.startswith("ok_"), f
+
+
 def test_spans_name_rules_scoped_to_catalogue_source():
     """The same file OUTSIDE an lws_tpu/ root only keeps the context-
     manager rule — test code can't pollute the metrics catalogue."""

@@ -1,0 +1,43 @@
+"""Fixture: span/metric hygiene inside the device-runtime plane. Lives
+under a fake lws_tpu/obs/ root (the self-tests pass
+root=tests/vet_fixtures) because the compile ledger and HBM attribution
+emit the forensics surface (`serving_compiles_total{kind}`,
+`serving_hbm_pool_bytes{pool}`) that recompile-storm runbooks key on — a
+ledger minting per-kind or per-pool metric names dynamically would make
+the one surface that explains compile stalls itself unauditable by the
+catalogue checker."""
+
+from lws_tpu.core import metrics, trace
+
+KIND = "recompile"
+POOL = "kv"
+
+
+def bad_kind_metric():
+    # Building the counter name from the compile kind would fragment the
+    # catalogue: first/recompile would mint separate ungreppable families
+    # instead of riding the `kind` label.
+    metrics.inc("serving_compiles_" + KIND)
+
+
+def bad_pool_span(name):
+    with trace.span(name):
+        return None
+
+
+def bad_unentered_span():
+    leak = trace.span("fleet.compile_scrape")
+    return leak is not None
+
+
+def ok_kind_metric():
+    metrics.inc("serving_compiles_total", {"engine": "batch", "kind": KIND})
+
+
+def ok_pool_metric():
+    metrics.set("serving_hbm_pool_bytes", 4.2e9, {"pool": POOL})
+
+
+def ok_entered_span():
+    with trace.span("fleet.compile_scrape", instances=2):
+        return None
